@@ -1,0 +1,29 @@
+"""bigdl_tpu — a TPU-native deep learning framework.
+
+A ground-up reimplementation of the capabilities of Intel BigDL v0.1.0
+(reference: MikeTam1021/BigDL) designed for TPU hardware:
+
+- Compute path: JAX/XLA (jnp ops compile onto the MXU; Pallas for custom
+  kernels).  The reference's native MKL/JNI layer (native/mkl/src/main/c/jni/
+  mkl.c) dissolves into XLA-compiled kernels.
+- Module system: Torch-style ergonomics (`forward`/`backward`/`parameters`)
+  over a pure functional core (`apply(params, input, state, ctx)`) so the
+  same model object works eagerly AND under `jax.jit`/`pjit`.
+- Distributed: `jax.sharding.Mesh` + collectives over ICI replace the
+  reference's Spark BlockManager parameter all-reduce
+  (parameters/AllReduceParameter.scala).
+
+Package layout (mirrors the reference's package inventory, SURVEY.md §2):
+  nn/        layer + criterion inventory  (ref: dl/.../bigdl/nn)
+  tensor/    dtype policy + tensor helpers (ref: dl/.../bigdl/tensor)
+  dataset/   DataSet/Transformer/Sample    (ref: dl/.../bigdl/dataset)
+  optim/     Optimizer/OptimMethod/Trigger (ref: dl/.../bigdl/optim)
+  parallel/  mesh, collectives, sharded training (ref: dl/.../bigdl/parameters)
+  models/    LeNet/VGG/Inception/ResNet/... (ref: dl/.../bigdl/models)
+  utils/     Engine, Table, File, RandomGenerator (ref: dl/.../bigdl/utils)
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.utils.table import Table, T  # noqa: F401
+from bigdl_tpu.utils.engine import Engine  # noqa: F401

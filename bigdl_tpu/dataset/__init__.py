@@ -1,0 +1,15 @@
+from bigdl_tpu.dataset.sample import Sample, MiniBatch, ByteRecord, LabeledSentence
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, Identity, SampleToBatch,
+)
+from bigdl_tpu.dataset.dataset import (
+    DataSet, LocalDataSet, LocalArrayDataSet, DistributedDataSet,
+    ShardedDataSet,
+)
+
+__all__ = [
+    "Sample", "MiniBatch", "ByteRecord", "LabeledSentence",
+    "Transformer", "ChainedTransformer", "Identity", "SampleToBatch",
+    "DataSet", "LocalDataSet", "LocalArrayDataSet", "DistributedDataSet",
+    "ShardedDataSet",
+]

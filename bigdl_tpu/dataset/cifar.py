@@ -1,0 +1,43 @@
+"""CIFAR-10 binary reader (ref models/vgg/Utils.scala CIFAR loader).
+
+Binary format: per record 1 label byte + 3072 pixel bytes (RGB planes).
+``synthetic()`` provides shape-identical stand-in data.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from bigdl_tpu.dataset.image import LabeledImage
+
+# per-channel BGR means/stds used by the reference's vgg pipeline
+TRAIN_MEAN = (0.4913996898739353 * 255, 0.4821584196221302 * 255, 0.44653092422369434 * 255)
+TRAIN_STD = (0.24703223517429462 * 255, 0.2434851308749409 * 255, 0.26158784442034005 * 255)
+
+
+def load_bin(path):
+    raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
+    labels = raw[:, 0].astype(np.float32)
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32)
+    return imgs, labels
+
+
+def load(folder, training: bool = True):
+    files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if training
+             else ["test_batch.bin"])
+    records = []
+    for fn in files:
+        p = os.path.join(folder, fn)
+        if not os.path.exists(p):
+            raise FileNotFoundError(p)
+        imgs, labels = load_bin(p)
+        records += [LabeledImage(i, l + 1) for i, l in zip(imgs, labels)]
+    return records
+
+
+def synthetic(n: int = 1024, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    imgs = rng.uniform(0, 255, (n, 32, 32, 3)).astype(np.float32)
+    labels = rng.randint(0, 10, n).astype(np.float32)
+    return [LabeledImage(i, l + 1) for i, l in zip(imgs, labels)]

@@ -1,0 +1,165 @@
+"""DataSet abstractions (ref dataset/DataSet.scala).
+
+Two worlds, as in the reference (DataSet.scala:111/164):
+
+- ``LocalDataSet``: host-local iterator source.
+- ``ShardedDataSet`` (the ``DistributedDataSet`` role): each JAX process
+  holds its shard of the data; ``Utils.getBatchSize`` semantics
+  (global batch ÷ node count, must divide evenly — ref Utils.scala:26-48)
+  decide the per-host slice, and the distributed optimizer forms global
+  device arrays from per-host batches.
+
+``transform``/``>>`` composition matches DataSet.scala:74-88.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.random import RNG
+
+
+def get_batch_size(total_batch: int, node_number: int) -> int:
+    """Global batch ÷ nodes with divisibility check (ref Utils.scala:26-48)."""
+    if total_batch % node_number != 0:
+        raise ValueError(
+            f"total batch size {total_batch} cannot be divided by node number "
+            f"{node_number}; adjust the batch size (ref dataset/Utils.scala:26)")
+    return total_batch // node_number
+
+
+class AbstractDataSet:
+    """(ref DataSet.scala:47)"""
+
+    def data(self, train: bool):
+        """An iterator over records. ``train=True`` loops forever (shuffled);
+        ``train=False`` makes one pass."""
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "AbstractDataSet":
+        return TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer):
+        """``ds >> transformer`` == reference's ``ds -> transformer``."""
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """Iterator-based local dataset (ref DataSet.scala:111)."""
+
+
+class LocalArrayDataSet(LocalDataSet):
+    """In-memory array dataset with looped shuffled iteration
+    (ref DataSet.scala:128)."""
+
+    def __init__(self, data):
+        self._data = list(data)
+
+    def size(self):
+        return len(self._data)
+
+    def shuffle(self):
+        RNG.shuffle(self._data)
+        return self
+
+    def data(self, train: bool):
+        if train:
+            def looped():
+                while True:
+                    idx = RNG.np_rng().permutation(len(self._data))
+                    for i in idx:
+                        yield self._data[i]
+            return looped()
+        return iter(list(self._data))
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+        return self
+
+    def data(self, train: bool):
+        return self.transformer(self.base.data(train))
+
+
+class ShardedDataSet(AbstractDataSet):
+    """Per-process shard of a global dataset (the DistributedDataSet role,
+    ref DataSet.scala:164 + CachedDistriDataSet:203).
+
+    The reference coalesces the RDD to one partition per node and iterates
+    with a random offset per partition; here each JAX process takes the
+    ``process_index``-th strided shard and iterates it shuffled.
+    """
+
+    def __init__(self, data, n_shards: int = None, shard_index: int = None):
+        import jax
+        self.n_shards = n_shards if n_shards is not None else jax.process_count()
+        self.shard_index = shard_index if shard_index is not None else jax.process_index()
+        data = list(data)
+        self._global_size = len(data)
+        self._shard = data[self.shard_index::self.n_shards]
+
+    def size(self):
+        return self._global_size
+
+    def shard_size(self):
+        return len(self._shard)
+
+    def shuffle(self):
+        RNG.shuffle(self._shard)
+        return self
+
+    def data(self, train: bool):
+        if train:
+            def looped():
+                while True:
+                    idx = RNG.np_rng().permutation(len(self._shard))
+                    for i in idx:
+                        yield self._shard[i]
+            return looped()
+        return iter(list(self._shard))
+
+
+# DistributedDataSet is the reference's name for the concept; ShardedDataSet
+# is the implementation.  Alias for API parity.
+DistributedDataSet = ShardedDataSet
+
+
+class DataSet:
+    """Factory namespace (ref object DataSet, DataSet.scala:271-455)."""
+
+    @staticmethod
+    def array(data, distributed: bool = False):
+        """(ref DataSet.array :271-294)"""
+        if distributed:
+            return ShardedDataSet(data)
+        return LocalArrayDataSet(data)
+
+    @staticmethod
+    def image_folder(path, distributed: bool = False):
+        """Class-per-subfolder image dataset (ref DataSet.ImageFolder
+        :322-379).  Returns paths + 1-based float labels as Samples of
+        (path, label); decode happens in the transformer pipeline."""
+        import os
+        classes = sorted(d for d in os.listdir(path)
+                         if os.path.isdir(os.path.join(path, d)))
+        records = []
+        for li, cls in enumerate(classes):
+            d = os.path.join(path, cls)
+            for f in sorted(os.listdir(d)):
+                records.append((os.path.join(d, f), float(li + 1)))
+        return DataSet.array(records, distributed)

@@ -1,0 +1,264 @@
+"""Image pipeline (ref dataset/image/, 22 files — SURVEY.md §2.4).
+
+Records are HWC float32 numpy arrays ("BGRImage"/"GreyImage" roles, ref
+image/Types.scala:127/246/278) paired with a 1-based float label:
+``LabeledImage``.  All augmentation runs on host numpy (the reference runs
+it on executor JVM threads); batches cross to the device once assembled.
+
+Decode uses Pillow when available (the javax.imageio role), else raw
+numpy codecs for the formats the bundled readers produce.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer, FuncTransformer
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.utils.random import RNG
+
+
+class LabeledImage:
+    """HWC float image + label (ref LabeledBGRImage image/Types.scala:246)."""
+
+    __slots__ = ("data", "label")
+
+    def __init__(self, data, label):
+        self.data = np.asarray(data, np.float32)
+        self.label = float(label)
+
+    @property
+    def height(self):
+        return self.data.shape[0]
+
+    @property
+    def width(self):
+        return self.data.shape[1]
+
+
+def _decode_bytes(raw: bytes):
+    try:
+        import io
+        from PIL import Image as PILImage
+        img = PILImage.open(io.BytesIO(raw)).convert("RGB")
+        return np.asarray(img, np.float32)
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("Pillow unavailable for image decode") from e
+
+
+class BytesToImg(Transformer):
+    """Decode ByteRecord bytes to LabeledImage, optional resize to
+    (scale_to, scale_to) (ref BytesToBGRImg; BGRImage.resize
+    image/Types.scala:278)."""
+
+    def __init__(self, scale_to: int = None):
+        self.scale_to = scale_to
+
+    def __call__(self, iterator):
+        for rec in iterator:
+            arr = _decode_bytes(rec.data)
+            if self.scale_to is not None:
+                arr = _resize(arr, self.scale_to, self.scale_to)
+            yield LabeledImage(arr, rec.label)
+
+
+def _resize(arr, h, w):
+    """Bilinear resize via PIL if present, else nearest with numpy."""
+    try:
+        from PIL import Image as PILImage
+        img = PILImage.fromarray(arr.astype(np.uint8))
+        return np.asarray(img.resize((w, h), PILImage.BILINEAR), np.float32)
+    except ImportError:  # pragma: no cover
+        ys = (np.arange(h) * arr.shape[0] / h).astype(int)
+        xs = (np.arange(w) * arr.shape[1] / w).astype(int)
+        return arr[ys][:, xs]
+
+
+class ImgNormalizer(Transformer):
+    """Subtract mean, divide std, per channel (ref BGRImgNormalizer /
+    GreyImgNormalizer).  Means/stds are scalars or per-channel tuples."""
+
+    def __init__(self, mean, std):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+
+    def __call__(self, iterator):
+        for img in iterator:
+            img.data = (img.data - self.mean) / self.std
+            yield img
+
+    @staticmethod
+    def from_dataset(dataset, max_samples: int = 10000):
+        """Estimate mean/std from data (ref GreyImgNormalizer dataset ctor)."""
+        n, s, s2 = 0, 0.0, 0.0
+        it = dataset.data(train=False)
+        for i, img in enumerate(it):
+            if i >= max_samples:
+                break
+            d = img.data if isinstance(img, LabeledImage) else img
+            s += d.mean()
+            s2 += (d ** 2).mean()
+            n += 1
+        mean = s / n
+        std = float(np.sqrt(max(s2 / n - mean ** 2, 1e-12)))
+        return ImgNormalizer(mean, std)
+
+
+class ImgPixelNormalizer(Transformer):
+    """Subtract a full per-pixel mean image (ref BGRImgPixelNormalizer)."""
+
+    def __init__(self, mean_image):
+        self.mean_image = np.asarray(mean_image, np.float32)
+
+    def __call__(self, iterator):
+        for img in iterator:
+            img.data = img.data - self.mean_image
+            yield img
+
+
+class ImgCropper(Transformer):
+    """Center crop (ref BGRImgCropper with CropCenter)."""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        self.cw, self.ch = crop_width, crop_height
+
+    def __call__(self, iterator):
+        for img in iterator:
+            h, w = img.data.shape[:2]
+            y0 = (h - self.ch) // 2
+            x0 = (w - self.cw) // 2
+            img.data = img.data[y0:y0 + self.ch, x0:x0 + self.cw]
+            yield img
+
+
+class ImgRdmCropper(Transformer):
+    """Random-position crop with optional zero padding
+    (ref BGRImgRdmCropper / GreyImgCropper)."""
+
+    def __init__(self, crop_width: int, crop_height: int, padding: int = 0):
+        self.cw, self.ch = crop_width, crop_height
+        self.padding = padding
+
+    def __call__(self, iterator):
+        for img in iterator:
+            d = img.data
+            if self.padding > 0:
+                p = self.padding
+                pads = ((p, p), (p, p)) + ((0, 0),) * (d.ndim - 2)
+                d = np.pad(d, pads)
+            h, w = d.shape[:2]
+            y0 = RNG.np_rng().randint(0, h - self.ch + 1)
+            x0 = RNG.np_rng().randint(0, w - self.cw + 1)
+            img.data = d[y0:y0 + self.ch, x0:x0 + self.cw]
+            yield img
+
+
+class HFlip(Transformer):
+    """Random horizontal flip (ref HFlip.scala)."""
+
+    def __init__(self, threshold: float = 0.5):
+        self.threshold = threshold
+
+    def __call__(self, iterator):
+        for img in iterator:
+            if RNG.np_rng().uniform() < self.threshold:
+                img.data = img.data[:, ::-1].copy()
+            yield img
+
+
+class ColorJitter(Transformer):
+    """Random brightness/contrast/saturation in random order
+    (ref ColoJitter.scala)."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    def _grayscale(self, d):
+        # BGR weights as in the reference
+        g = 0.114 * d[..., 0] + 0.587 * d[..., 1] + 0.299 * d[..., 2]
+        return g[..., None]
+
+    def __call__(self, iterator):
+        rng = RNG.np_rng()
+        for img in iterator:
+            ops = [self._do_brightness, self._do_contrast, self._do_saturation]
+            rng.shuffle(ops)
+            for op in ops:
+                img.data = op(img.data, rng)
+            yield img
+
+    def _do_brightness(self, d, rng):
+        alpha = 1.0 + rng.uniform(-self.brightness, self.brightness)
+        return d * alpha
+
+    def _do_contrast(self, d, rng):
+        alpha = 1.0 + rng.uniform(-self.contrast, self.contrast)
+        mean = self._grayscale(d).mean()
+        return d * alpha + mean * (1 - alpha)
+
+    def _do_saturation(self, d, rng):
+        alpha = 1.0 + rng.uniform(-self.saturation, self.saturation)
+        return d * alpha + self._grayscale(d) * (1 - alpha)
+
+
+class Lighting(Transformer):
+    """PCA lighting noise with ImageNet eigen-decomposition
+    (ref Lighting.scala)."""
+
+    alphastd = 0.1
+    eig_val = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
+    eig_vec = np.asarray([
+        [-0.5675, 0.7192, 0.4009],
+        [-0.5808, -0.0045, -0.8140],
+        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __call__(self, iterator):
+        rng = RNG.np_rng()
+        for img in iterator:
+            alpha = rng.normal(0, self.alphastd, 3).astype(np.float32)
+            shift = (self.eig_vec * alpha * self.eig_val).sum(axis=1)
+            img.data = img.data + shift
+            yield img
+
+
+class ImgToBatch(Transformer):
+    """LabeledImage -> MiniBatch in NCHW (ref BGRImgToBatch/GreyImgToBatch)."""
+
+    def __init__(self, batch_size: int, to_chw: bool = True):
+        self.batch_size = batch_size
+        self.to_chw = to_chw
+
+    def __call__(self, iterator):
+        buf_x, buf_y = [], []
+        for img in iterator:
+            d = img.data
+            if d.ndim == 2:
+                d = d[None]  # grey -> (1, H, W)
+            elif self.to_chw:
+                d = np.transpose(d, (2, 0, 1))
+            buf_x.append(d)
+            buf_y.append(img.label)
+            if len(buf_x) == self.batch_size:
+                yield MiniBatch(np.stack(buf_x), np.asarray(buf_y, np.float32))
+                buf_x, buf_y = [], []
+        if buf_x:
+            yield MiniBatch(np.stack(buf_x), np.asarray(buf_y, np.float32))
+
+
+class ImgToSample(Transformer):
+    """LabeledImage -> Sample (for RDD-of-Sample style ingestion)."""
+
+    def __init__(self, to_chw: bool = True):
+        self.to_chw = to_chw
+
+    def __call__(self, iterator):
+        for img in iterator:
+            d = img.data
+            if d.ndim == 2:
+                d = d[None]
+            elif self.to_chw:
+                d = np.transpose(d, (2, 0, 1))
+            yield Sample(d, np.asarray([img.label], np.float32))

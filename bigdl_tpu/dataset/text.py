@@ -1,0 +1,96 @@
+"""Text pipeline (ref dataset/text/: LabeledSentence types,
+LabeledSentenceToSample.scala:43; models/rnn/Utils.scala Dictionary :144,
+WordTokenizer :207, readSentence :132).
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample, LabeledSentence
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class Dictionary:
+    """Vocabulary built from tokenized sentences (ref rnn/Utils.Dictionary
+    :144): most-frequent ``vocab_size`` words, the rest map to an
+    out-of-vocabulary bucket."""
+
+    def __init__(self, sentences=None, vocab_size: int = None):
+        self.word2index = {}
+        self.index2word = []
+        if sentences is not None:
+            from collections import Counter
+            counts = Counter(w for s in sentences for w in s)
+            words = [w for w, _ in counts.most_common(vocab_size)]
+            for w in words:
+                self.add_word(w)
+
+    def add_word(self, word):
+        if word not in self.word2index:
+            self.word2index[word] = len(self.index2word)
+            self.index2word.append(word)
+        return self.word2index[word]
+
+    def vocab_size(self):
+        return len(self.index2word)
+
+    def index(self, word):
+        """0-based index; unknown words map to vocab_size (OOV bucket)."""
+        return self.word2index.get(word, len(self.index2word))
+
+
+class WordTokenizer(Transformer):
+    """Lower-case word tokenizer (ref rnn/Utils.WordTokenizer :207)."""
+
+    def __call__(self, iterator):
+        for line in iterator:
+            tokens = re.findall(r"[\w']+", line.lower())
+            if tokens:
+                yield tokens
+
+
+class SentenceToLabeledSentence(Transformer):
+    """Language-model pairs: data = w_0..w_{n-2}, label = w_1..w_{n-1}
+    (the reference rnn Train pipeline's shift-by-one)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def __call__(self, iterator):
+        for tokens in iterator:
+            ids = np.asarray([self.dictionary.index(w) for w in tokens], np.int64)
+            if len(ids) < 2:
+                continue
+            yield LabeledSentence(ids[:-1], ids[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence -> Sample with one-hot or index encoding and padding
+    (ref text/LabeledSentenceToSample.scala:43).
+
+    one-hot when ``n_input_dims`` (vocab size) is given (reference's
+    SimpleRNN input format); labels are 1-based class indices.
+    """
+
+    def __init__(self, n_input_dims: int = None, fixed_length: int = None,
+                 pad_value: int = 0):
+        self.n_input_dims = n_input_dims
+        self.fixed_length = fixed_length
+        self.pad_value = pad_value
+
+    def __call__(self, iterator):
+        for s in iterator:
+            length = self.fixed_length if self.fixed_length is not None else s.data_length()
+            data_ids = s.data[:length]
+            label_ids = s.label[:length]
+            if self.n_input_dims is not None:
+                feat = np.zeros((length, self.n_input_dims), np.float32)
+                feat[np.arange(len(data_ids)), data_ids] = 1.0
+            else:
+                feat = np.full((length,), self.pad_value, np.float32)
+                feat[:len(data_ids)] = data_ids
+            label = np.full((length,), self.pad_value, np.float32)
+            label[:len(label_ids)] = label_ids + 1  # 1-based class targets
+            yield Sample(feat, label)

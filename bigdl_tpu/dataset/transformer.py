@@ -1,0 +1,139 @@
+"""Transformer pipeline (ref dataset/Transformer.scala:40-55).
+
+A ``Transformer[A, B]`` is ``Iterator[A] -> Iterator[B]``, composed with
+``->`` — here the ``>>`` operator (and ``.chain()``).  SampleToBatch
+(Transformer.scala:99-241) assembles fixed-shape padded MiniBatches, the
+contact point with jit's static-shape requirement (SURVEY.md §7 hard parts:
+variable-length batching must pad to fixed shapes).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample, MiniBatch
+
+
+class Transformer:
+    """Iterator-to-iterator stage. Subclasses override __call__."""
+
+    def __call__(self, iterator):
+        raise NotImplementedError
+
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        """``a >> b`` == reference's ``a -> b``."""
+        return ChainedTransformer(self, other)
+
+    def chain(self, other):
+        return self.__rshift__(other)
+
+    def clone_transformer(self):
+        import copy
+        return copy.deepcopy(self)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, first, last):
+        self.first = first
+        self.last = last
+
+    def __call__(self, iterator):
+        return self.last(self.first(iterator))
+
+
+class Identity(Transformer):
+    def __call__(self, iterator):
+        return iterator
+
+
+class FuncTransformer(Transformer):
+    """Wrap a per-record function into a Transformer."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, iterator):
+        return (self.fn(x) for x in iterator)
+
+
+class SampleToBatch(Transformer):
+    """Sample -> MiniBatch with optional fixed-length padding
+    (ref Transformer.scala:99-241).
+
+    ``feature_padding``/``label_padding``: pad value for variable-length
+    features/labels.  ``fixed_length``: pad every batch to this length
+    (keeps one static shape for jit instead of per-batch max).
+    ``partition_num``: drop the tail so every partition yields whole batches.
+    """
+
+    def __init__(self, batch_size: int, feature_padding=None, label_padding=None,
+                 fixed_length: int = None, drop_last: bool = False):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.fixed_length = fixed_length
+        self.drop_last = drop_last
+
+    def _assemble(self, samples):
+        feats = [s.feature for s in samples]
+        labels = [s.label for s in samples]
+        if self.feature_padding is not None:
+            feats = _pad_stack(feats, self.feature_padding, self.fixed_length)
+        else:
+            feats = np.stack(feats)
+        if self.label_padding is not None:
+            labels = _pad_stack(labels, self.label_padding, self.fixed_length)
+        else:
+            labels = np.stack(labels)
+        return MiniBatch(feats, labels)
+
+    def __call__(self, iterator):
+        buf = []
+        for s in iterator:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield self._assemble(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self._assemble(buf)
+
+
+def _pad_stack(arrays, pad_value, fixed_length=None):
+    """Stack 1..nD arrays, padding dim 0 to max (or fixed) length."""
+    max_len = fixed_length if fixed_length is not None else max(a.shape[0] for a in arrays)
+    out_shape = (len(arrays), max_len) + arrays[0].shape[1:]
+    out = np.full(out_shape, pad_value, dtype=arrays[0].dtype)
+    for i, a in enumerate(arrays):
+        n = min(a.shape[0], max_len)
+        out[i, :n] = a[:n]
+    return out
+
+
+class PreFetch(Transformer):
+    """Background-thread prefetch (the capability of the reference's
+    MTLabeledBGRImgToBatch + PreFetch, MTLabeledBGRImgToBatch.scala:47,106:
+    overlap host-side decode/augment with device compute)."""
+
+    def __init__(self, depth: int = 2):
+        self.depth = depth
+
+    def __call__(self, iterator):
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=self.depth)
+        _END = object()
+
+        def worker():
+            try:
+                for item in iterator:
+                    q.put(item)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            yield item

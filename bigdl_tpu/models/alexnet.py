@@ -1,0 +1,64 @@
+"""AlexNet (ref example/loadmodel/AlexNet.scala — AlexNet + AlexNet_OWT)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def AlexNet(class_num: int = 1000):
+    """Caffe-style AlexNet with grouped convs (ref AlexNet.scala)."""
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 96, 11, 11, 4, 4).set_name("conv1"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, n_group=2).set_name("conv2"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1).set_name("conv3"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, n_group=2).set_name("conv4"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, n_group=2).set_name("conv5"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.View(256 * 6 * 6))
+    m.add(nn.Linear(256 * 6 * 6, 4096).set_name("fc6"))
+    m.add(nn.ReLU(True))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096).set_name("fc7"))
+    m.add(nn.ReLU(True))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num).set_name("fc8"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def AlexNet_OWT(class_num: int = 1000, has_dropout: bool = True):
+    """One-weird-trick variant without groups/LRN (ref AlexNet.scala)."""
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 64, 11, 11, 4, 4, 2, 2))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.SpatialConvolution(64, 192, 5, 5, 1, 1, 2, 2))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.SpatialConvolution(192, 384, 3, 3, 1, 1, 1, 1))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(256, 256, 3, 3, 1, 1, 1, 1))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2))
+    m.add(nn.View(256 * 6 * 6))
+    m.add(nn.Linear(256 * 6 * 6, 4096))
+    m.add(nn.ReLU(True))
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096))
+    m.add(nn.ReLU(True))
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num))
+    m.add(nn.LogSoftMax())
+    return m

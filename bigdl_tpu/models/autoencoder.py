@@ -1,0 +1,20 @@
+"""Autoencoder on MNIST (ref models/autoencoder/Autoencoder.scala:28)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+ROW_N = 28
+COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+def Autoencoder(class_num: int = 32):
+    """(ref Autoencoder.scala:28-36): 784 -> classNum -> 784 with sigmoid
+    reconstruction; trained with MSE against the input."""
+    return nn.Sequential(
+        nn.Reshape([FEATURE_SIZE]),
+        nn.Linear(FEATURE_SIZE, class_num),
+        nn.ReLU(True),
+        nn.Linear(class_num, FEATURE_SIZE),
+        nn.Sigmoid(),
+    )

@@ -1,0 +1,125 @@
+"""Inception/GoogLeNet models (ref models/inception/Inception_v1.scala:96,
+Inception_v2.scala) — the distributed-training flagship (BASELINE config 3:
+Inception-v1 ImageNet sync-SGD).
+"""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn import Xavier
+
+
+def _conv(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0):
+    return nn.SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                                 init_method=Xavier)
+
+
+def inception_module(input_size, c1, c3r, c3, c5r, c5, pool_proj):
+    """4-branch inception block (ref Inception_v1.scala inception():
+    Concat over channel dim of 1x1 / 1x1-3x3 / 1x1-5x5 / pool-1x1)."""
+    return nn.Concat(
+        2,
+        nn.Sequential(_conv(input_size, c1, 1, 1), nn.ReLU(True)),
+        nn.Sequential(_conv(input_size, c3r, 1, 1), nn.ReLU(True),
+                      _conv(c3r, c3, 3, 3, 1, 1, 1, 1), nn.ReLU(True)),
+        nn.Sequential(_conv(input_size, c5r, 1, 1), nn.ReLU(True),
+                      _conv(c5r, c5, 5, 5, 1, 1, 2, 2), nn.ReLU(True)),
+        nn.Sequential(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil(),
+                      _conv(input_size, pool_proj, 1, 1), nn.ReLU(True)),
+    )
+
+
+def Inception_v1_NoAuxClassifier(class_num: int = 1000):
+    """GoogLeNet without aux heads (ref Inception_v1.scala:96 main path)."""
+    m = nn.Sequential()
+    m.add(_conv(3, 64, 7, 7, 2, 2, 3, 3).set_name("conv1/7x7_s2"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(_conv(64, 64, 1, 1).set_name("conv2/3x3_reduce"))
+    m.add(nn.ReLU(True))
+    m.add(_conv(64, 192, 3, 3, 1, 1, 1, 1).set_name("conv2/3x3"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(inception_module(192, 64, 96, 128, 16, 32, 32))    # 3a -> 256
+    m.add(inception_module(256, 128, 128, 192, 32, 96, 64))  # 3b -> 480
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(inception_module(480, 192, 96, 208, 16, 48, 64))   # 4a -> 512
+    m.add(inception_module(512, 160, 112, 224, 24, 64, 64))  # 4b -> 512
+    m.add(inception_module(512, 128, 128, 256, 24, 64, 64))  # 4c -> 512
+    m.add(inception_module(512, 112, 144, 288, 32, 64, 64))  # 4d -> 528
+    m.add(inception_module(528, 256, 160, 320, 32, 128, 128))  # 4e -> 832
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(inception_module(832, 256, 160, 320, 32, 128, 128))  # 5a -> 832
+    m.add(inception_module(832, 384, 192, 384, 48, 128, 128))  # 5b -> 1024
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.Dropout(0.4))
+    m.add(nn.View(1024))
+    m.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+# main entry matching the reference's default training graph
+def Inception_v1(class_num: int = 1000):
+    return Inception_v1_NoAuxClassifier(class_num)
+
+
+def _conv_bn(n_in, n_out, kw, kh, sw=1, sh=1, pw=0, ph=0):
+    return nn.Sequential(
+        nn.SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                              init_method=Xavier),
+        nn.SpatialBatchNormalization(n_out, 1e-3),
+        nn.ReLU(True))
+
+
+def inception_v2_module(input_size, c1, c3r, c3, d3r, d3, pool_proj,
+                        pool_type="avg", stride=1):
+    """BN-Inception block (ref Inception_v2.scala): 5x5 branch factorized
+    into double-3x3; stride-2 variants drop the 1x1 branch and pass the
+    pool through (c1 == 0)."""
+    branches = []
+    if c1 > 0:
+        branches.append(_conv_bn(input_size, c1, 1, 1))
+    branches.append(nn.Sequential(
+        _conv_bn(input_size, c3r, 1, 1),
+        _conv_bn(c3r, c3, 3, 3, stride, stride, 1, 1)))
+    branches.append(nn.Sequential(
+        _conv_bn(input_size, d3r, 1, 1),
+        _conv_bn(d3r, d3, 3, 3, 1, 1, 1, 1),
+        _conv_bn(d3, d3, 3, 3, stride, stride, 1, 1)))
+    pool = (nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1, ceil_mode=True)
+            if pool_type == "avg"
+            else nn.SpatialMaxPooling(3, 3, stride, stride,
+                                      1 if stride == 1 else 0,
+                                      1 if stride == 1 else 0).ceil())
+    if pool_proj > 0:
+        branches.append(nn.Sequential(pool, _conv_bn(input_size, pool_proj, 1, 1)))
+    else:
+        branches.append(nn.Sequential(pool))
+    return nn.Concat(2, *branches)
+
+
+def Inception_v2(class_num: int = 1000):
+    """BN-Inception (ref Inception_v2.scala)."""
+    m = nn.Sequential()
+    m.add(_conv_bn(3, 64, 7, 7, 2, 2, 3, 3))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(_conv_bn(64, 64, 1, 1))
+    m.add(_conv_bn(64, 192, 3, 3, 1, 1, 1, 1))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(inception_v2_module(192, 64, 64, 64, 64, 96, 32, "avg"))     # 3a -> 256
+    m.add(inception_v2_module(256, 64, 64, 96, 64, 96, 64, "avg"))     # 3b -> 320
+    m.add(inception_v2_module(320, 0, 128, 160, 64, 96, 0, "max", 2))  # 3c -> 576
+    m.add(inception_v2_module(576, 224, 64, 96, 96, 128, 128, "avg"))  # 4a -> 576
+    m.add(inception_v2_module(576, 192, 96, 128, 96, 128, 128, "avg")) # 4b -> 576
+    m.add(inception_v2_module(576, 160, 128, 160, 128, 160, 96, "avg"))  # 4c -> 576
+    m.add(inception_v2_module(576, 96, 128, 192, 160, 192, 96, "avg"))   # 4d -> 576
+    m.add(inception_v2_module(576, 0, 128, 192, 192, 256, 0, "max", 2))  # 4e -> 1024
+    m.add(inception_v2_module(1024, 352, 192, 320, 160, 224, 128, "avg"))  # 5a -> 1024
+    m.add(inception_v2_module(1024, 352, 192, 320, 192, 224, 128, "max"))  # 5b -> 1024
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.View(1024))
+    m.add(nn.Linear(1024, class_num))
+    m.add(nn.LogSoftMax())
+    return m

@@ -1,0 +1,25 @@
+"""LeNet-5 (ref models/lenet/LeNet5.scala:24) — the canonical end-to-end
+slice (SURVEY.md §7, BASELINE config 1).
+"""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def LeNet5(class_num: int = 10):
+    """Layer-for-layer the reference graph (LeNet5.scala:24-41):
+    reshape -> conv(1,6,5x5) -> tanh -> maxpool -> tanh? ... -> log_softmax."""
+    return nn.Sequential(
+        nn.Reshape([1, 28, 28]),
+        nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5"),
+        nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Tanh(),
+        nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5"),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape([12 * 4 * 4]),
+        nn.Linear(12 * 4 * 4, 100).set_name("fc_1"),
+        nn.Tanh(),
+        nn.Linear(100, class_num).set_name("fc_2"),
+        nn.LogSoftMax(),
+    )

@@ -1,0 +1,140 @@
+"""ResNet (ref models/resnet/ResNet.scala:59).
+
+The reference's ``shareGradInput`` memory trick (ResNet.scala:62-100) is
+obsolete under XLA buffer assignment; the MSRA init (``modelInit``
+:102-132) is preserved via init_method=MSRA on convs + BN gamma init.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn import MSRA
+
+
+def _shortcut(n_in, n_out, stride, shortcut_type="B"):
+    """(ref ResNet.scala shortcut) A: identity/pad, B: 1x1 conv when shape
+    changes."""
+    use_conv = shortcut_type == "C" or (shortcut_type == "B" and n_in != n_out)
+    if use_conv:
+        return nn.Sequential(
+            nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride,
+                                  init_method=MSRA, with_bias=False),
+            nn.SpatialBatchNormalization(n_out))
+    if n_in != n_out:
+        # type A: stride then zero-pad channels
+        return nn.Sequential(
+            nn.SpatialAveragePooling(1, 1, stride, stride),
+            nn.Concat(2, nn.Identity(), nn.MulConstant(0.0)))
+    return nn.Identity()
+
+
+def basic_block(n_in, n_out, stride=1, shortcut_type="B"):
+    """(ref ResNet.scala basicBlock :162)"""
+    s = nn.Sequential(
+        nn.SpatialConvolution(n_in, n_out, 3, 3, stride, stride, 1, 1,
+                              init_method=MSRA, with_bias=False),
+        nn.SpatialBatchNormalization(n_out),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n_out, n_out, 3, 3, 1, 1, 1, 1,
+                              init_method=MSRA, with_bias=False),
+        nn.SpatialBatchNormalization(n_out),
+    )
+    return nn.Sequential(
+        nn.ConcatTable(s, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True))
+
+
+def bottleneck(n_in, n_mid, stride=1, shortcut_type="B"):
+    """(ref ResNet.scala bottleneck :182) — expansion 4."""
+    n_out = n_mid * 4
+    s = nn.Sequential(
+        nn.SpatialConvolution(n_in, n_mid, 1, 1, init_method=MSRA, with_bias=False),
+        nn.SpatialBatchNormalization(n_mid), nn.ReLU(True),
+        nn.SpatialConvolution(n_mid, n_mid, 3, 3, stride, stride, 1, 1,
+                              init_method=MSRA, with_bias=False),
+        nn.SpatialBatchNormalization(n_mid), nn.ReLU(True),
+        nn.SpatialConvolution(n_mid, n_out, 1, 1, init_method=MSRA, with_bias=False),
+        nn.SpatialBatchNormalization(n_out),
+    )
+    return nn.Sequential(
+        nn.ConcatTable(s, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True))
+
+
+def _layer(block, n_in, n_mid, count, stride, shortcut_type="B", expansion=1):
+    m = nn.Sequential()
+    for i in range(count):
+        m.add(block(n_in if i == 0 else n_mid * expansion, n_mid,
+                    stride if i == 0 else 1, shortcut_type))
+    return m
+
+
+def ResNetCifar(depth: int = 20, class_num: int = 10, shortcut_type: str = "A"):
+    """CIFAR-10 ResNet, depth = 6n+2 (ref ResNet.scala cifar path)."""
+    assert (depth - 2) % 6 == 0, "depth must be 6n+2"
+    n = (depth - 2) // 6
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1,
+                                init_method=MSRA, with_bias=False))
+    m.add(nn.SpatialBatchNormalization(16))
+    m.add(nn.ReLU(True))
+    m.add(_layer(basic_block, 16, 16, n, 1, shortcut_type))
+    m.add(_layer(basic_block, 16, 32, n, 2, shortcut_type))
+    m.add(_layer(basic_block, 32, 64, n, 2, shortcut_type))
+    m.add(nn.SpatialAveragePooling(8, 8, 1, 1))
+    m.add(nn.View(64))
+    m.add(nn.Linear(64, class_num))
+    m.add(nn.LogSoftMax())
+    _zero_init_final_bn(m)
+    return m
+
+
+def ResNet(depth: int = 50, class_num: int = 1000, shortcut_type: str = "B"):
+    """ImageNet ResNet (ref ResNet.scala imagenet path)."""
+    cfgs = {18: (basic_block, [2, 2, 2, 2], 1, 512),
+            34: (basic_block, [3, 4, 6, 3], 1, 512),
+            50: (bottleneck, [3, 4, 6, 3], 4, 2048),
+            101: (bottleneck, [3, 4, 23, 3], 4, 2048),
+            152: (bottleneck, [3, 8, 36, 3], 4, 2048)}
+    block, counts, expansion, n_features = cfgs[depth]
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                                init_method=MSRA, with_bias=False))
+    m.add(nn.SpatialBatchNormalization(64))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+    m.add(_layer(block, 64, 64, counts[0], 1, shortcut_type, expansion))
+    m.add(_layer(block, 64 * expansion, 128, counts[1], 2, shortcut_type, expansion))
+    m.add(_layer(block, 128 * expansion, 256, counts[2], 2, shortcut_type, expansion))
+    m.add(_layer(block, 256 * expansion, 512, counts[3], 2, shortcut_type, expansion))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    m.add(nn.View(n_features))
+    m.add(nn.Linear(n_features, class_num))
+    m.add(nn.LogSoftMax())
+    _zero_init_final_bn(m)
+    return m
+
+
+def _zero_init_final_bn(model):
+    """MSRA-style: zero the last BN gamma of each residual branch
+    (ref ResNet.modelInit ResNet.scala:102-132)."""
+    def visit(mod):
+        if isinstance(mod, nn.Sequential):
+            mods = mod.modules
+            for i, child in enumerate(mods):
+                if (isinstance(child, nn.SpatialBatchNormalization)
+                        and i == len(mods) - 1
+                        and "weight" in child._params):
+                    child._params["weight"] = jnp.zeros_like(child._params["weight"])
+            for child in mods:
+                visit(child)
+        elif isinstance(mod, nn.Container):
+            for child in mod.modules:
+                visit(child)
+
+    visit(model)
+    return model

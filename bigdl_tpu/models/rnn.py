@@ -1,0 +1,31 @@
+"""SimpleRNN language model (ref models/rnn/SimpleRNN.scala:23-38) plus a
+Bi-LSTM classifier head (BASELINE config 4).
+"""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def SimpleRNN(input_size: int = 4000, hidden_size: int = 40,
+              output_size: int = 4000, bptt_truncate: int = 4):
+    """(ref SimpleRNN.scala:23-38) Recurrent(RnnCell+Tanh) -> per-timestep
+    Linear -> LogSoftMax over (N, T, vocab) one-hot input."""
+    return nn.Sequential(
+        nn.Recurrent(bptt_truncate).add(
+            nn.RnnCell(input_size, hidden_size, nn.Tanh())),
+        nn.TimeDistributed(nn.Sequential(
+            nn.Linear(hidden_size, output_size),
+            nn.LogSoftMax())),
+    )
+
+
+def BiLSTMClassifier(input_size: int, hidden_size: int, class_num: int):
+    """Bi-LSTM text classifier (BASELINE config 4): BiRecurrent(LSTM) over
+    (N, T, D), mean-pool time, linear head."""
+    return nn.Sequential(
+        nn.BiRecurrent(nn.LSTMCell(input_size, hidden_size),
+                       nn.LSTMCell(input_size, hidden_size)),
+        nn.Mean(2, n_input_dims=2),  # mean over time: (N, T, 2H) -> (N, 2H)
+        nn.Linear(2 * hidden_size, class_num),
+        nn.LogSoftMax(),
+    )

@@ -1,0 +1,88 @@
+"""VGG models (ref models/vgg/VggForCifar10.scala:25, Vgg_16/Vgg_19 :74+)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def _conv_bn_relu(model, n_in, n_out):
+    """convBNReLU helper (ref VggForCifar10.scala convBNReLU)."""
+    model.add(nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+    model.add(nn.SpatialBatchNormalization(n_out, 1e-3))
+    model.add(nn.ReLU(True))
+    return model
+
+
+def VggForCifar10(class_num: int = 10):
+    """(ref VggForCifar10.scala:25-72)"""
+    m = nn.Sequential()
+    _conv_bn_relu(m, 3, 64).add(nn.Dropout(0.3))
+    _conv_bn_relu(m, 64, 64)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+    _conv_bn_relu(m, 64, 128).add(nn.Dropout(0.4))
+    _conv_bn_relu(m, 128, 128)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+    _conv_bn_relu(m, 128, 256).add(nn.Dropout(0.4))
+    _conv_bn_relu(m, 256, 256).add(nn.Dropout(0.4))
+    _conv_bn_relu(m, 256, 256)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+    _conv_bn_relu(m, 256, 512).add(nn.Dropout(0.4))
+    _conv_bn_relu(m, 512, 512).add(nn.Dropout(0.4))
+    _conv_bn_relu(m, 512, 512)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+    _conv_bn_relu(m, 512, 512).add(nn.Dropout(0.4))
+    _conv_bn_relu(m, 512, 512).add(nn.Dropout(0.4))
+    _conv_bn_relu(m, 512, 512)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+    m.add(nn.View(512))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(512, 512))
+    m.add(nn.BatchNormalization(512))
+    m.add(nn.ReLU(True))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(512, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def _vgg_block(model, n_in, n_out, n_convs):
+    for i in range(n_convs):
+        model.add(nn.SpatialConvolution(n_in if i == 0 else n_out, n_out,
+                                        3, 3, 1, 1, 1, 1))
+        model.add(nn.ReLU(True))
+    model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+    return model
+
+
+def _vgg_head(model, class_num):
+    model.add(nn.View(512 * 7 * 7))
+    model.add(nn.Linear(512 * 7 * 7, 4096))
+    model.add(nn.Threshold(0, 1e-6))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096))
+    model.add(nn.Threshold(0, 1e-6))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def Vgg_16(class_num: int = 1000):
+    """(ref VggForCifar10.scala Vgg_16 :74+) — 224x224 ImageNet VGG-16."""
+    m = nn.Sequential()
+    _vgg_block(m, 3, 64, 2)
+    _vgg_block(m, 64, 128, 2)
+    _vgg_block(m, 128, 256, 3)
+    _vgg_block(m, 256, 512, 3)
+    _vgg_block(m, 512, 512, 3)
+    return _vgg_head(m, class_num)
+
+
+def Vgg_19(class_num: int = 1000):
+    """(ref Vgg_19)"""
+    m = nn.Sequential()
+    _vgg_block(m, 3, 64, 2)
+    _vgg_block(m, 64, 128, 2)
+    _vgg_block(m, 128, 256, 4)
+    _vgg_block(m, 256, 512, 4)
+    _vgg_block(m, 512, 512, 4)
+    return _vgg_head(m, class_num)

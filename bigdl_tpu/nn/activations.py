@@ -1,0 +1,280 @@
+"""Activation layers — full inventory of the reference (SURVEY.md §2.3,
+"Activations (24)"): ReLU, ReLU6, PReLU, RReLU, LeakyReLU, ELU, Tanh,
+TanhShrink, Sigmoid, LogSigmoid, LogSoftMax, SoftMax, SoftMin, SoftPlus,
+SoftShrink, SoftSign, HardTanh, HardShrink, Threshold, Clamp, Abs, Sqrt,
+Square, Power, Exp, Log, GradientReversal.
+
+All are stateless jnp expressions that XLA fuses into neighbouring matmuls —
+the reference's hand-threaded versions (e.g. Threshold.scala's Engine.model
+pool) are unnecessary on TPU.
+
+Note on in-place (``ip``) flags: the reference offers in-place variants to
+save JVM allocations; under XLA, buffer reuse is the compiler's job, so the
+flag is accepted for API parity and ignored.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+from bigdl_tpu.nn import init as init_
+
+
+class _Elementwise(TensorModule):
+    """Base for parameter-free elementwise layers."""
+
+    def _fn(self, x, ctx):
+        raise NotImplementedError
+
+    def _forward(self, P, x, S, ctx):
+        return self._fn(x, ctx), None
+
+
+class ReLU(_Elementwise):
+    def __init__(self, ip: bool = False):
+        super().__init__()
+        self.inplace = ip
+
+    def _fn(self, x, ctx):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+        self.inplace = inplace
+
+    def _fn(self, x, ctx):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class Tanh(_Elementwise):
+    def _fn(self, x, ctx):
+        return jnp.tanh(x)
+
+
+class TanhShrink(_Elementwise):
+    def _fn(self, x, ctx):
+        return x - jnp.tanh(x)
+
+
+class Sigmoid(_Elementwise):
+    def _fn(self, x, ctx):
+        return jax.nn.sigmoid(x)
+
+
+class LogSigmoid(_Elementwise):
+    def _fn(self, x, ctx):
+        return jax.nn.log_sigmoid(x)
+
+
+class LogSoftMax(_Elementwise):
+    """Over the last dim for 1D/2D input, matching Torch LogSoftMax."""
+
+    def _fn(self, x, ctx):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class SoftMax(_Elementwise):
+    def _fn(self, x, ctx):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class SoftMin(_Elementwise):
+    def _fn(self, x, ctx):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class SoftPlus(_Elementwise):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def _fn(self, x, ctx):
+        # Torch: 1/beta * log(1 + exp(beta * x)), with linear tail for stability
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def _fn(self, x, ctx):
+        return x / (1.0 + jnp.abs(x))
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, lam: float = 0.5):
+        super().__init__()
+        self.lam = lam
+
+    def _fn(self, x, ctx):
+        return jnp.where(x > self.lam, x - self.lam,
+                         jnp.where(x < -self.lam, x + self.lam, 0.0))
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, lam: float = 0.5):
+        super().__init__()
+        self.lam = lam
+
+    def _fn(self, x, ctx):
+        return jnp.where(jnp.abs(x) > self.lam, x, 0.0)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 inplace: bool = False):
+        super().__init__()
+        assert max_value > min_value
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def _fn(self, x, ctx):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    """(ref Clamp.scala — HardTanh with int bounds)"""
+
+    def __init__(self, min_value: int, max_value: int):
+        super().__init__(float(min_value), float(max_value))
+
+
+class Threshold(_Elementwise):
+    """x if x > th else value (ref Threshold.scala:403)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.threshold = th
+        self.value = v
+
+    def _fn(self, x, ctx):
+        return jnp.where(x > self.threshold, x, self.value)
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, inplace: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def _fn(self, x, ctx):
+        return jnp.where(x >= 0, x, x * self.negval)
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, inplace: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def _fn(self, x, ctx):
+        return jnp.where(x > 0, x, self.alpha * (jnp.exp(x) - 1.0))
+
+
+class Abs(_Elementwise):
+    def _fn(self, x, ctx):
+        return jnp.abs(x)
+
+
+class Sqrt(_Elementwise):
+    def _fn(self, x, ctx):
+        return jnp.sqrt(x)
+
+
+class Square(_Elementwise):
+    def _fn(self, x, ctx):
+        return x * x
+
+
+class Power(_Elementwise):
+    """(shift + scale * x) ** power (ref Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power = power
+        self.scale = scale
+        self.shift = shift
+
+    def _fn(self, x, ctx):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Exp(_Elementwise):
+    def _fn(self, x, ctx):
+        return jnp.exp(x)
+
+
+class Log(_Elementwise):
+    def _fn(self, x, ctx):
+        return jnp.log(x)
+
+
+class PReLU(TensorModule):
+    """Learnable leaky slope; nOutputPlane=0 means one shared slope
+    (ref PReLU.scala:318)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+        self.reset()
+
+    def reset(self):
+        n = max(1, self.n_output_plane)
+        self._add_param("weight", jnp.full((n,), 0.25))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        w = P["weight"]
+        if self.n_output_plane > 0:
+            # per-channel slope; channel dim is 1 for 4D (N,C,H,W), 0 for 3D
+            shape = [1] * x.ndim
+            ch_dim = 1 if x.ndim >= 2 else 0
+            shape[ch_dim] = self.n_output_plane
+            w = w.reshape(shape)
+        return jnp.where(x >= 0, x, x * w), None
+
+
+class RReLU(TensorModule):
+    """Randomized leaky ReLU (ref RReLU.scala): slope ~ U(lower, upper) in
+    training, fixed mean slope in eval."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 inplace: bool = False):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def _forward(self, P, x, S, ctx):
+        if ctx.training:
+            a = jax.random.uniform(ctx.next_key(), x.shape,
+                                   minval=self.lower, maxval=self.upper,
+                                   dtype=x.dtype)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, x * a), None
+
+
+class GradientReversal(TensorModule):
+    """Identity forward, -lambda * grad backward (ref GradientReversal.scala)."""
+
+    def __init__(self, lam: float = 1.0):
+        super().__init__()
+        self.lam = lam
+
+    def _forward(self, P, x, S, ctx):
+        lam = self.lam
+
+        @jax.custom_vjp
+        def rev(v):
+            return v
+
+        def fwd(v):
+            return v, None
+
+        def bwd(_, g):
+            return (-lam * g,)
+
+        rev.defvjp(fwd, bwd)
+        return rev(x), None
+
+    def set_lambda(self, lam):
+        self.lam = lam
+        return self

@@ -1,0 +1,123 @@
+"""Containers (ref SURVEY.md §2.3: 8 containers).
+
+Sequential (Sequential.scala:26), Concat (Concat.scala — the reference runs
+branches on a thread pool, Concat.scala:73; under XLA the branches fuse into
+one program and the compiler schedules them), ConcatTable, ParallelTable,
+MapTable, Bottle (Bottle.scala).  Recurrent/TimeDistributed live in
+``recurrent.py``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Container, Module
+from bigdl_tpu.utils.table import Table
+
+
+def _child_apply(container, i, params, x, state, ctx):
+    name = str(i)
+    m = container.modules[i]
+    y, ns = m.apply(params[name], x, state[name], ctx)
+    return y, ns
+
+
+class Sequential(Container):
+    """Chain modules serially (ref Sequential.scala:26)."""
+
+    def apply(self, params, x, state, ctx):
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            x, ns = _child_apply(self, i, params, x, state, ctx)
+            new_state[str(i)] = ns
+        return x, new_state
+
+
+class Concat(Container):
+    """Apply every branch to the same input, concatenate outputs along
+    ``dimension`` (1-based, ref Concat.scala)."""
+
+    def __init__(self, dimension: int, *modules):
+        super().__init__(*modules)
+        self.dimension = dimension
+
+    def apply(self, params, x, state, ctx):
+        outs = []
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            y, ns = _child_apply(self, i, params, x, state, ctx)
+            outs.append(y)
+            new_state[str(i)] = ns
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+
+class ConcatTable(Container):
+    """Apply every branch to the same input; output is a Table of results
+    (ref ConcatTable.scala)."""
+
+    def apply(self, params, x, state, ctx):
+        out = Table()
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            y, ns = _child_apply(self, i, params, x, state, ctx)
+            out[i + 1] = y
+            new_state[str(i)] = ns
+        return out, new_state
+
+
+class ParallelTable(Container):
+    """i-th module consumes i-th element of the input Table
+    (ref ParallelTable.scala)."""
+
+    def apply(self, params, x, state, ctx):
+        out = Table()
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            y, ns = _child_apply(self, i, params, x[i + 1], state, ctx)
+            out[i + 1] = y
+            new_state[str(i)] = ns
+        return out, new_state
+
+
+class MapTable(Container):
+    """Apply the same module to every element of the input Table
+    (ref MapTable.scala).  The single child's parameters are shared across
+    all elements — exactly the reference's clone-with-shared-storage."""
+
+    def __init__(self, module: Module = None):
+        super().__init__()
+        if module is not None:
+            self.add(module)
+
+    def apply(self, params, x, state, ctx):
+        out = Table()
+        new_state = dict(state)
+        n = x.length()
+        ns = state["0"]
+        for i in range(n):
+            y, ns = self.modules[0].apply(params["0"], x[i + 1], ns, ctx)
+            out[i + 1] = y
+        new_state["0"] = ns
+        return out, new_state
+
+
+class Bottle(Container):
+    """Flatten leading dims to apply an n-D module to higher-D input
+    (ref Bottle.scala): input (d1..dk, rest) -> view (prod(d1..dk), rest)
+    -> module -> restore leading dims."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2, n_output_dim: int = None):
+        super().__init__(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim if n_output_dim is not None else n_input_dim
+
+    def apply(self, params, x, state, ctx):
+        in_shape = x.shape
+        lead = in_shape[: x.ndim - self.n_input_dim + 1]
+        rest = in_shape[x.ndim - self.n_input_dim + 1:]
+        squashed = x.reshape((-1,) + rest)
+        y, ns = _child_apply(self, 0, params, squashed, state, ctx)
+        out_rest = y.shape[1:]
+        y = y.reshape(lead + out_rest)
+        new_state = dict(state)
+        new_state["0"] = ns
+        return y, new_state

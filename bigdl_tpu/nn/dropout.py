@@ -1,0 +1,70 @@
+"""Regularization layers (ref Dropout.scala:31, L1Penalty.scala).
+
+Dropout's Bernoulli mask comes from the ctx PRNG key stream — the pure-
+functional equivalent of the reference's thread-local Mersenne draws
+(Dropout.scala threads over Engine.model; XLA fuses the masked multiply).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class Dropout(TensorModule):
+    """Zero with prob ``init_p``; scale kept units by 1/(1-p) in training
+    (inverted dropout, matching the reference's scale-at-train default)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p):
+        self.p = p
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        if not ctx.training or self.p <= 0.0:
+            return x, None
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(ctx.next_key(), keep, x.shape)
+        y = jnp.where(mask, x, 0.0)
+        if self.scale:
+            y = y / keep
+        return y, None
+
+    def __repr__(self):
+        return f"Dropout({self.p})"
+
+
+class L1Penalty(TensorModule):
+    """Identity forward; adds l1 subgradient in backward
+    (ref L1Penalty.scala).  Implemented with a custom VJP so trainers using
+    ``jax.grad`` see the same effect."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+    def _forward(self, P, x, S, ctx):
+        w = self.l1weight
+        avg = self.size_average
+
+        @jax.custom_vjp
+        def pen(v):
+            return v
+
+        def fwd(v):
+            return v, v
+
+        def bwd(v, g):
+            m = w / v.size if avg else w
+            return (g + m * jnp.sign(v),)
+
+        pen.defvjp(fwd, bwd)
+        return pen(x), None

@@ -1,0 +1,283 @@
+"""Linear-algebra layers (SURVEY.md §2.3 "Linear-algebra layers"):
+Linear, Bilinear, CMul, CAdd, Mul, Add, MulConstant, AddConstant, MM, MV,
+Cosine, Euclidean, LookupTable.
+
+Matmuls go through one dot chokepoint (``_dot``) with the bf16 compute
+policy — the TPU-native equivalent of the reference's single-gemm design
+(DenseTensorBLAS.gemm, DenseTensorBLAS.scala:70 → MKL vsgemm mkl.c:408),
+where every layer funnels into one tuned kernel.  Here the kernel is the
+MXU via XLA dot_general.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule, Module
+from bigdl_tpu.nn import init as init_
+from bigdl_tpu.tensor import policy
+from bigdl_tpu.utils.table import Table
+
+
+def _dot(a, b):
+    """Single matmul chokepoint: cast per dtype policy, accumulate in f32."""
+    p = policy()
+    return jnp.matmul(p.cast_compute(a), p.cast_compute(b),
+                      preferred_element_type=jnp.float32).astype(p.output_dtype)
+
+
+class Linear(TensorModule):
+    """y = x W^T + b (ref Linear.scala:~40, gemm path :103-136)."""
+
+    def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
+                 init_method: str = init_.Default):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.init_method = init_method
+        self.reset()
+
+    def reset(self):
+        if self.init_method == init_.Xavier:
+            w = init_.xavier((self.output_size, self.input_size),
+                             self.input_size, self.output_size)
+            b = np.zeros((self.output_size,), np.float32)
+        else:
+            w = init_.default_linear((self.output_size, self.input_size),
+                                     self.input_size)
+            b = init_.default_linear((self.output_size,), self.input_size)
+        self._add_param("weight", w)
+        if self.with_bias:
+            self._add_param("bias", b)
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        y = _dot(x, P["weight"].T)
+        if self.with_bias:
+            y = y + P["bias"]
+        return y, None
+
+    def __repr__(self):
+        return f"Linear({self.input_size} -> {self.output_size})"
+
+
+class Bilinear(TensorModule):
+    """y_k = x1^T W_k x2 + b_k over a Table(x1, x2) (ref Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True):
+        super().__init__()
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / np.sqrt(self.input_size1)
+        self._add_param("weight", init_.uniform(
+            (self.output_size, self.input_size1, self.input_size2), -stdv, stdv))
+        if self.bias_res:
+            self._add_param("bias", init_.uniform((self.output_size,), -stdv, stdv))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        x1, x2 = x[1], x[2]
+        # (n,i1) x (o,i1,i2) x (n,i2) -> (n,o)
+        y = jnp.einsum("ni,oij,nj->no", x1, P["weight"], x2)
+        if self.bias_res:
+            y = y + P["bias"]
+        return y, None
+
+
+class CMul(TensorModule):
+    """Learnable per-element scale, broadcast over batch (ref CMul.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.reset()
+
+    def reset(self):
+        n = int(np.prod(self.size))
+        stdv = 1.0 / np.sqrt(n)
+        self._add_param("weight", init_.uniform(self.size, -stdv, stdv))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        return x * P["weight"], None
+
+
+class CAdd(TensorModule):
+    """Learnable per-element bias (ref CAdd.scala)."""
+
+    def __init__(self, size):
+        super().__init__()
+        self.size = tuple(size)
+        self.reset()
+
+    def reset(self):
+        n = int(np.prod(self.size))
+        stdv = 1.0 / np.sqrt(n)
+        self._add_param("bias", init_.uniform(self.size, -stdv, stdv))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        return x + P["bias"], None
+
+
+class Mul(TensorModule):
+    """Single learnable scalar gain (ref Mul.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.reset()
+
+    def reset(self):
+        self._add_param("weight", init_.uniform((1,), -1.0, 1.0))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        return x * P["weight"][0], None
+
+
+class Add(TensorModule):
+    """Learnable bias vector of ``input_size`` (ref Add.scala)."""
+
+    def __init__(self, input_size: int, scalar: bool = False):
+        super().__init__()
+        self.input_size = 1 if scalar else input_size
+        self.scalar = scalar
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        self._add_param("bias", init_.uniform((self.input_size,), -stdv, stdv))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        b = P["bias"]
+        return (x + b[0], None) if self.scalar else (x + b, None)
+
+
+class MulConstant(TensorModule):
+    def __init__(self, scalar: float, inplace: bool = False):
+        super().__init__()
+        self.scalar = scalar
+
+    def _forward(self, P, x, S, ctx):
+        return x * self.scalar, None
+
+
+class AddConstant(TensorModule):
+    def __init__(self, constant_scalar: float, inplace: bool = False):
+        super().__init__()
+        self.constant_scalar = constant_scalar
+
+    def _forward(self, P, x, S, ctx):
+        return x + self.constant_scalar, None
+
+
+class MM(Module):
+    """Batch/plain matmul of Table(a, b) (ref MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def _forward(self, P, x, S, ctx):
+        a, b = x[1], x[2]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return _dot(a, b), None
+
+
+class MV(Module):
+    """Matrix-vector product of Table(mat, vec), batched (ref MV.scala)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def _forward(self, P, x, S, ctx):
+        m, v = x[1], x[2]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v), None
+
+
+class Cosine(TensorModule):
+    """Cosine similarity to each of ``output_size`` learned prototypes
+    (ref Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        self._add_param("weight", init_.uniform(
+            (self.output_size, self.input_size), -stdv, stdv))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        w = P["weight"]
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        wn = w / (jnp.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+        return _dot(xn, wn.T), None
+
+
+class Euclidean(TensorModule):
+    """Euclidean distance to each learned prototype (ref Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int, fast_backward: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / np.sqrt(self.input_size)
+        self._add_param("weight", init_.uniform(
+            (self.input_size, self.output_size), -stdv, stdv))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        w = P["weight"]  # (in, out)
+        diff = x[..., :, None] - w[None, :, :]
+        return jnp.linalg.norm(diff, axis=-2), None
+
+
+class LookupTable(TensorModule):
+    """Embedding lookup with optional max-norm renorm
+    (ref LookupTable.scala:273).  Indices are 1-based, like Torch."""
+
+    def __init__(self, n_index: int, n_output: int, padding_value: float = 0,
+                 max_norm: float = None, norm_type: float = 2.0,
+                 should_scale_grad_by_freq: bool = False):
+        super().__init__()
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.reset()
+
+    def reset(self):
+        self._add_param("weight", init_.normal((self.n_index, self.n_output), 0, 1))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        w = P["weight"]
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1, keepdims=True)
+            scale = jnp.minimum(1.0, self.max_norm / (norms + 1e-7))
+            w = w * scale
+        idx = jnp.asarray(x, jnp.int32) - 1  # 1-based -> 0-based
+        return jnp.take(w, idx, axis=0), None

@@ -1,0 +1,198 @@
+"""Normalization layers (ref BatchNormalization.scala:31 [673 LoC],
+SpatialBatchNormalization, SpatialCrossMapLRN.scala [221 LoC],
+SpatialSubtractiveNormalization / SpatialDivisiveNormalization /
+SpatialContrastiveNormalization).
+
+BatchNorm running stats are the one true *state* in the module system: the
+pure ``_forward`` returns updated buffers, which the eager path writes back
+and the jitted trainer threads through the step function — the reference's
+in-place ``runningMean/runningVar`` mutation made functional.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import TensorModule
+from bigdl_tpu.nn import init as init_
+
+
+class BatchNormalization(TensorModule):
+    """Batch norm over (N, D) input (ref BatchNormalization.scala:31).
+
+    Constructor mirrors the reference: (nOutput, eps, momentum, affine).
+    Training: batch stats + EMA update of running stats; eval: running stats.
+    """
+
+    n_dim = 2
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.reset()
+
+    def reset(self):
+        if self.affine:
+            self._add_param("weight", init_.uniform((self.n_output,), 0.0, 1.0))
+            self._add_param("bias", np.zeros((self.n_output,), np.float32))
+        self._add_buffer("running_mean", np.zeros((self.n_output,), np.float32))
+        self._add_buffer("running_var", np.ones((self.n_output,), np.float32))
+        return self
+
+    def _stat_axes(self, x):
+        return tuple(i for i in range(x.ndim) if i != 1) if x.ndim > 2 else (0,)
+
+    def _forward(self, P, x, S, ctx):
+        was_unbatched = x.ndim == self.n_dim - 1
+        if was_unbatched:
+            x = x[None]
+        axes = self._stat_axes(x)
+        bshape = [1] * x.ndim
+        bshape[1 if x.ndim > 2 else -1] = self.n_output
+        new_S = None
+        if ctx.training:
+            mean = x.mean(axis=axes)
+            var = x.var(axis=axes)
+            n = x.size / self.n_output
+            unbiased = var * (n / max(n - 1, 1.0))
+            new_S = {
+                "running_mean": (1 - self.momentum) * S["running_mean"] + self.momentum * mean,
+                "running_var": (1 - self.momentum) * S["running_var"] + self.momentum * unbiased,
+            }
+        else:
+            mean, var = S["running_mean"], S["running_var"]
+        inv = lax.rsqrt(var + self.eps)
+        scale, shift = inv, -mean * inv
+        if self.affine:
+            scale = scale * P["weight"]
+            shift = shift * P["weight"] + P["bias"]
+        y = x * scale.reshape(bshape) + shift.reshape(bshape)
+        return (y[0] if was_unbatched else y), new_S
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.n_output})"
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """Batch norm over (N, C, H, W) (ref SpatialBatchNormalization.scala)."""
+
+    n_dim = 4
+
+
+class SpatialCrossMapLRN(TensorModule):
+    """Local response normalization across channels
+    (ref SpatialCrossMapLRN.scala:221):
+    y = x / (k + alpha/size * sum_{window} x^2) ** beta.
+
+    Implemented as a window reduction over the channel dim — a single fused
+    XLA op instead of the reference's per-thread sliding accumulation.
+    """
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def _forward(self, P, x, S, ctx):
+        lo = (self.size - 1) // 2
+        hi = self.size - 1 - lo
+        sq_sum = lax.reduce_window(
+            x * x, 0.0, lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (lo, hi), (0, 0), (0, 0)))
+        denom = (self.k + (self.alpha / self.size) * sq_sum) ** self.beta
+        return x / denom, None
+
+
+def _gaussian_kernel(kernel_size: int) -> np.ndarray:
+    """Normalized 2D gaussian, like image.gaussian in Torch."""
+    sigma = 0.25 * kernel_size  # torch default sigma=0.25 relative to size
+    xs = np.arange(kernel_size, dtype=np.float64)
+    c = (kernel_size - 1) / 2.0
+    g = np.exp(-((xs - c) ** 2) / (2 * sigma ** 2))
+    k2 = np.outer(g, g)
+    return (k2 / k2.sum()).astype(np.float32)
+
+
+class SpatialSubtractiveNormalization(TensorModule):
+    """Subtract a kernel-weighted local mean
+    (ref SpatialSubtractiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        if kernel is None:
+            kernel = _gaussian_kernel(9)
+        kernel = np.asarray(kernel, np.float32)
+        if kernel.ndim == 1:
+            kernel = np.outer(kernel, kernel)
+        self.kernel = kernel / (kernel.sum() * n_input_plane)
+        self.kh, self.kw = self.kernel.shape
+
+    def _local_mean(self, x):
+        n, c, h, w = x.shape
+        k = jnp.asarray(self.kernel)[None, None].repeat(c, axis=1)  # (1,C,kh,kw)
+        ph, pw = (self.kh - 1) // 2, (self.kw - 1) // 2
+        pad = [(ph, self.kh - 1 - ph), (pw, self.kw - 1 - pw)]
+        mean = lax.conv_general_dilated(
+            x, k, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # normalize by the actually-covered kernel mass near borders (coef map)
+        ones = jnp.ones((1, c, h, w), x.dtype)
+        coef = lax.conv_general_dilated(
+            ones, k, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return mean / coef
+
+    def _forward(self, P, x, S, ctx):
+        was3d = x.ndim == 3
+        if was3d:
+            x = x[None]
+        y = x - self._local_mean(x)
+        return (y[0] if was3d else y), None
+
+
+class SpatialDivisiveNormalization(TensorModule):
+    """Divide by local std-dev estimate (ref SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def _forward(self, P, x, S, ctx):
+        was3d = x.ndim == 3
+        if was3d:
+            x = x[None]
+        local_var = self.sub._local_mean(x * x)
+        local_std = jnp.sqrt(jnp.maximum(local_var, 0.0))
+        mean_std = local_std.mean(axis=(1, 2, 3), keepdims=True)
+        denom = jnp.maximum(local_std, mean_std)
+        denom = jnp.where(denom < self.threshold, self.thresval, denom)
+        y = x / denom
+        return (y[0] if was3d else y), None
+
+
+class SpatialContrastiveNormalization(TensorModule):
+    """Subtractive then divisive normalization
+    (ref SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def _forward(self, P, x, S, ctx):
+        y, _ = self.sub._forward(P, x, S, ctx)
+        return self.div._forward(P, y, S, ctx)

@@ -1,0 +1,226 @@
+"""Recurrence (ref Recurrent.scala:27, RNN.scala:28, TimeDistributed.scala).
+
+The reference's ``Recurrent`` container runs a serial Scala time loop with
+truncated BPTT (bpttTruncate, Recurrent.scala:66-110).  TPU-native design:
+the time loop is ``lax.scan`` — one compiled region, weights resident in
+HBM, per-step matmuls batched onto the MXU.  Truncated BPTT maps to chunked
+scans with ``stop_gradient`` on the carry at chunk boundaries.
+
+The reference ships only the vanilla ``RnnCell``; BASELINE.json config 4
+("Bi-LSTM text classifier ... recurrence via scan") additionally requires
+LSTM and bidirectional wrappers, provided here as ``LSTMCell``, ``GRUCell``
+and ``BiRecurrent``.
+
+Layout: batch-first (N, T, D) input; hidden state (N, H).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module, TensorModule, Container, Context
+from bigdl_tpu.nn import init as init_
+from bigdl_tpu.nn.activations import Tanh
+from bigdl_tpu.tensor import policy
+from bigdl_tpu.utils.table import Table
+
+
+class Cell(Module):
+    """Recurrent cell protocol: ``_step(P, x_t, h, ctx) -> (out_t, h_new)``
+    where ``h`` is an array or a tuple of arrays (LSTM)."""
+
+    hidden_size: int
+
+    def init_hidden(self, batch):
+        return jnp.zeros((batch, self.hidden_size))
+
+    def _step(self, P, x, h, ctx):
+        raise NotImplementedError
+
+    def _forward(self, P, x, S, ctx):
+        # standalone use: input Table(x, h) -> h' (ref RnnCell contract)
+        out, h = self._step(P, x[1], x[2], ctx)
+        return out, None
+
+
+class RnnCell(Cell):
+    """Vanilla RNN: h' = act(W_i x + b_i + W_h h + b_h) (ref RNN.scala:28)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation if activation is not None else Tanh()
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / np.sqrt(self.hidden_size)
+        self._add_param("i2h", init_.uniform((self.hidden_size, self.input_size), -stdv, stdv))
+        self._add_param("h2h", init_.uniform((self.hidden_size, self.hidden_size), -stdv, stdv))
+        self._add_param("bias_i", init_.uniform((self.hidden_size,), -stdv, stdv))
+        self._add_param("bias_h", init_.uniform((self.hidden_size,), -stdv, stdv))
+        return self
+
+    def _step(self, P, x, h, ctx):
+        p = policy()
+        pre = (jnp.matmul(p.cast_compute(x), p.cast_compute(P["i2h"].T),
+                          preferred_element_type=jnp.float32) + P["bias_i"] +
+               jnp.matmul(p.cast_compute(h), p.cast_compute(P["h2h"].T),
+                          preferred_element_type=jnp.float32) + P["bias_h"])
+        h_new = self.activation._fn(pre.astype(p.output_dtype), ctx)
+        return h_new, h_new
+
+
+class LSTMCell(Cell):
+    """Standard LSTM cell; hidden is (h, c).  One fused (4H, D+H) gemm per
+    step keeps the MXU busy."""
+
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / np.sqrt(self.hidden_size)
+        h, d = self.hidden_size, self.input_size
+        self._add_param("w", init_.uniform((4 * h, d + h), -stdv, stdv))
+        self._add_param("bias", init_.uniform((4 * h,), -stdv, stdv))
+        return self
+
+    def init_hidden(self, batch):
+        z = jnp.zeros((batch, self.hidden_size))
+        return (z, z)
+
+    def _step(self, P, x, hc, ctx):
+        h, c = hc
+        p = policy()
+        z = jnp.matmul(p.cast_compute(jnp.concatenate([x, h], axis=-1)),
+                       p.cast_compute(P["w"].T),
+                       preferred_element_type=jnp.float32) + P["bias"]
+        z = z.astype(p.output_dtype)
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Cell):
+    def __init__(self, input_size: int, hidden_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.reset()
+
+    def reset(self):
+        stdv = 1.0 / np.sqrt(self.hidden_size)
+        h, d = self.hidden_size, self.input_size
+        self._add_param("w_rz", init_.uniform((2 * h, d + h), -stdv, stdv))
+        self._add_param("b_rz", init_.uniform((2 * h,), -stdv, stdv))
+        self._add_param("w_h", init_.uniform((h, d + h), -stdv, stdv))
+        self._add_param("b_h", init_.uniform((h,), -stdv, stdv))
+        return self
+
+    def _step(self, P, x, h, ctx):
+        xh = jnp.concatenate([x, h], axis=-1)
+        rz = jax.nn.sigmoid(jnp.matmul(xh, P["w_rz"].T) + P["b_rz"])
+        r, z = jnp.split(rz, 2, axis=-1)
+        xrh = jnp.concatenate([x, r * h], axis=-1)
+        n = jnp.tanh(jnp.matmul(xrh, P["w_h"].T) + P["b_h"])
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+
+class Recurrent(Container):
+    """Time-loop container (ref Recurrent.scala:27).
+
+    ``Recurrent().add(cell)``; forward over (N, T, D) returns (N, T, H).
+    ``bptt_truncate > 0`` stops gradients at chunk boundaries (the scan
+    equivalent of the reference's truncated backward loop).
+    ``reverse=True`` scans right-to-left (for BiRecurrent).
+    """
+
+    def __init__(self, bptt_truncate: int = 0, reverse: bool = False):
+        super().__init__()
+        self.bptt_truncate = int(bptt_truncate)
+        self.reverse = reverse
+
+    @property
+    def cell(self) -> Cell:
+        return self.modules[0]
+
+    def apply(self, params, x, state, ctx):
+        cell = self.cell
+        cp = params["0"]
+        cs = state["0"]
+        n, t = x.shape[0], x.shape[1]
+        h0 = cell.init_hidden(n)
+        xs = jnp.swapaxes(x, 0, 1)  # (T, N, D) scan-major
+        if self.reverse:
+            xs = jnp.flip(xs, axis=0)
+        key = ctx.next_key() if ctx.training else jax.random.PRNGKey(0)
+
+        def step(carry, x_t):
+            h, k = carry
+            k, sub = jax.random.split(k)
+            sctx = Context(training=ctx.training, key=sub)
+            out, h_new = cell._step(cp, x_t, h, sctx)
+            return (h_new, k), out
+
+        k = self.bptt_truncate
+        if k <= 0 or k >= t:
+            (_, _), outs = lax.scan(step, (h0, key), xs)
+        else:
+            # chunked scan; stop_gradient on the carry between chunks
+            outs_list = []
+            carry = (h0, key)
+            for start in range(0, t, k):
+                chunk = xs[start:start + k]
+                carry, o = lax.scan(step, carry, chunk)
+                h_c, k_c = carry
+                carry = (jax.tree_util.tree_map(lax.stop_gradient, h_c), k_c)
+                outs_list.append(o)
+            outs = jnp.concatenate(outs_list, axis=0)
+        if self.reverse:
+            outs = jnp.flip(outs, axis=0)
+        return jnp.swapaxes(outs, 0, 1), state
+
+
+class BiRecurrent(Container):
+    """Bidirectional wrapper: runs a forward and a backward Recurrent over
+    the same input and merges (concat on feature dim, or add).  Not in the
+    reference (capability extension for BASELINE config 4 Bi-LSTM)."""
+
+    def __init__(self, cell_fwd: Cell, cell_bwd: Cell, merge: str = "concat",
+                 bptt_truncate: int = 0):
+        super().__init__()
+        self.merge = merge
+        self.add(Recurrent(bptt_truncate).add(cell_fwd))
+        self.add(Recurrent(bptt_truncate, reverse=True).add(cell_bwd))
+
+    def apply(self, params, x, state, ctx):
+        yf, sf = self.modules[0].apply(params["0"], x, state["0"], ctx)
+        yb, sb = self.modules[1].apply(params["1"], x, state["1"], ctx)
+        y = jnp.concatenate([yf, yb], axis=-1) if self.merge == "concat" else yf + yb
+        return y, {"~": state.get("~", {}), "0": sf, "1": sb}
+
+
+class TimeDistributed(Container):
+    """Apply a module independently at every timestep of (N, T, ...)
+    (ref TimeDistributed.scala): fold T into the batch so the inner module
+    sees one big (N*T, ...) batch — a single large MXU-friendly call instead
+    of T small ones."""
+
+    def __init__(self, module: Module):
+        super().__init__(module)
+
+    def apply(self, params, x, state, ctx):
+        n, t = x.shape[0], x.shape[1]
+        flat = x.reshape((n * t,) + x.shape[2:])
+        y, ns = self.modules[0].apply(params["0"], flat, state["0"], ctx)
+        y = y.reshape((n, t) + y.shape[1:])
+        new_state = dict(state)
+        new_state["0"] = ns
+        return y, new_state

@@ -1,0 +1,132 @@
+"""Reduction / indexing layers (SURVEY.md §2.3): Mean, Sum, Max, Min, Index,
+Select, Narrow, MaskedSelect.  All dims 1-based per the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule, Module
+from bigdl_tpu.tensor import narrow as _narrow, select as _select
+
+
+class Mean(TensorModule):
+    """(ref Mean.scala) mean over 1-based ``dimension``; ``n_input_dims``
+    shifts for batched input; ``squeeze`` drops the reduced dim."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def _axis(self, x):
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += 1
+        return d
+
+    def _forward(self, P, x, S, ctx):
+        return x.mean(axis=self._axis(x), keepdims=not self.squeeze), None
+
+
+class Sum(TensorModule):
+    """(ref Sum.scala) with optional ``size_average`` divide by dim size."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def _forward(self, P, x, S, ctx):
+        d = self.dimension - 1
+        if self.n_input_dims > 0 and x.ndim > self.n_input_dims:
+            d += 1
+        y = x.sum(axis=d, keepdims=not self.squeeze)
+        if self.size_average:
+            y = y / x.shape[d]
+        return y, None
+
+
+class Max(TensorModule):
+    """Max over ``dim``, returning values (ref Max.scala returns max;
+    indices available via ``Index``)."""
+
+    def __init__(self, dim: int = 1, num_input_dims: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _forward(self, P, x, S, ctx):
+        return x.max(axis=self.dim - 1), None
+
+
+class Min(TensorModule):
+    def __init__(self, dim: int = 1, num_input_dims: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _forward(self, P, x, S, ctx):
+        return x.min(axis=self.dim - 1), None
+
+
+class Index(Module):
+    """Gather rows: Table(src, indices 1-based) -> src indexed along ``dim``
+    (ref Index.scala)."""
+
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def _forward(self, P, x, S, ctx):
+        src, idx = x[1], x[2]
+        idx = jnp.asarray(idx, jnp.int32) - 1
+        return jnp.take(src, idx, axis=self.dimension - 1), None
+
+
+class Select(TensorModule):
+    """Select 1-based ``index`` along 1-based ``dim`` (ref Select.scala);
+    negative index counts from the end."""
+
+    def __init__(self, dimension: int, index: int):
+        super().__init__()
+        self.dimension = dimension
+        self.index = index
+
+    def _forward(self, P, x, S, ctx):
+        idx = self.index if self.index > 0 else x.shape[self.dimension - 1] + self.index + 1
+        return _select(x, self.dimension, idx), None
+
+
+class Narrow(TensorModule):
+    """Slice ``length`` entries from 1-based ``offset`` along ``dimension``
+    (ref Narrow.scala); negative length counts from the end."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dimension = dimension
+        self.offset = offset
+        self.length = length
+
+    def _forward(self, P, x, S, ctx):
+        n = self.length
+        if n < 0:
+            n = x.shape[self.dimension - 1] - self.offset + 2 + n
+        return _narrow(x, self.dimension, self.offset, n), None
+
+
+class MaskedSelect(Module):
+    """Table(src, byte mask) -> 1D tensor of selected elements
+    (ref MaskedSelect.scala).
+
+    XLA constraint: the output size is data-dependent, which cannot live
+    under jit with static shapes.  Eager use returns the compact vector;
+    under jit, wrap with a fixed-size pad or avoid (documented divergence).
+    """
+
+    def _forward(self, P, x, S, ctx):
+        src, mask = x[1], x[2]
+        import numpy as np
+        return jnp.asarray(np.asarray(src)[np.asarray(mask) != 0]), None

@@ -1,0 +1,218 @@
+"""Shape manipulation layers (SURVEY.md §2.3 "Shape ops"): Reshape,
+InferReshape, View, Transpose, Replicate, Squeeze, Unsqueeze, Padding,
+SpatialZeroPadding, Contiguous, Copy, Identity, Echo.
+
+All 1-based dims, matching the reference. ``Contiguous``/``Copy`` are
+identities under XLA (arrays are immutable and layout is the compiler's),
+kept for API parity.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule, Module
+
+
+class Reshape(TensorModule):
+    """(ref Reshape.scala) — reshapes non-batch dims; ``batch_mode`` forces
+    treating dim 0 as batch (None = auto-detect like the reference)."""
+
+    def __init__(self, size, batch_mode: bool = None):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def _forward(self, P, x, S, ctx):
+        n_el = int(np.prod(self.size))
+        if self.batch_mode is True or (
+                self.batch_mode is None and x.size != n_el and
+                x.shape[0] != 1 and x.size == x.shape[0] * n_el):
+            return x.reshape((x.shape[0],) + self.size), None
+        if self.batch_mode is None and x.size == x.shape[0] * n_el and x.shape[0] == 1:
+            # ambiguous singleton batch: reference treats it as non-batch
+            pass
+        return x.reshape(self.size) if x.size == n_el \
+            else x.reshape((x.shape[0],) + self.size), None
+
+    def __repr__(self):
+        return f"Reshape({'x'.join(map(str, self.size))})"
+
+
+class InferReshape(TensorModule):
+    """Reshape with -1 (inferred) and 0 (copy input dim) entries
+    (ref InferReshape.scala)."""
+
+    def __init__(self, size, batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(int(s) for s in size)
+        self.batch_mode = batch_mode
+
+    def _forward(self, P, x, S, ctx):
+        base = 1 if self.batch_mode else 0
+        out = []
+        if self.batch_mode:
+            out.append(x.shape[0])
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(x.shape[base + i])
+            else:
+                out.append(s)  # -1 handled by jnp.reshape
+        return x.reshape(tuple(out)), None
+
+
+class View(TensorModule):
+    """(ref View.scala) — reshape keeping total elements; supports
+    ``num_input_dims`` for batch disambiguation."""
+
+    def __init__(self, *sizes):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(int(s) for s in sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n):
+        self.num_input_dims = n
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        n_el = int(np.prod(self.sizes))
+        if x.size == n_el:
+            return x.reshape(self.sizes), None
+        return x.reshape((x.shape[0],) + self.sizes), None
+
+
+class Transpose(TensorModule):
+    """Swap listed (1-based) dim pairs in order (ref Transpose.scala)."""
+
+    def __init__(self, permutations):
+        super().__init__()
+        self.permutations = [(int(a), int(b)) for a, b in permutations]
+
+    def _forward(self, P, x, S, ctx):
+        for a, b in self.permutations:
+            x = jnp.swapaxes(x, a - 1, b - 1)
+        return x, None
+
+
+class Replicate(TensorModule):
+    """Insert a new dim of size nFeatures at 1-based ``dim``
+    (ref Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = np.inf):
+        super().__init__()
+        self.n_features = n_features
+        self.dim = dim
+
+    def _forward(self, P, x, S, ctx):
+        y = jnp.expand_dims(x, self.dim - 1)
+        reps = [1] * y.ndim
+        reps[self.dim - 1] = self.n_features
+        return jnp.tile(y, reps), None
+
+
+class Squeeze(TensorModule):
+    def __init__(self, dim: int = None, num_input_dims: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _forward(self, P, x, S, ctx):
+        if self.dim is None:
+            return jnp.squeeze(x), None
+        return (jnp.squeeze(x, axis=self.dim - 1) if x.shape[self.dim - 1] == 1
+                else x), None
+
+
+class Unsqueeze(TensorModule):
+    def __init__(self, pos: int, num_input_dims: int = None):
+        super().__init__()
+        self.pos = pos
+
+    def _forward(self, P, x, S, ctx):
+        return jnp.expand_dims(x, self.pos - 1), None
+
+
+class Padding(TensorModule):
+    """Pad ``pad`` entries (negative = front) along 1-based ``dim`` with
+    ``value``; ``n_index`` offsets the insert position (ref Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim = dim
+        self.pad = pad
+        self.n_input_dim = n_input_dim
+        self.value = value
+        self.n_index = n_index
+
+    def _forward(self, P, x, S, ctx):
+        dim = self.dim - 1
+        if x.ndim > self.n_input_dim:
+            dim += 1  # batched input
+        widths = [(0, 0)] * x.ndim
+        if self.pad < 0:
+            widths[dim] = (-self.pad, 0)
+        else:
+            widths[dim] = (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value), None
+
+
+class SpatialZeroPadding(TensorModule):
+    """(ref SpatialZeroPadding.scala) pad H/W dims of (N,C,H,W) or (C,H,W);
+    negative pads crop."""
+
+    def __init__(self, pad_left: int, pad_right: int = None,
+                 pad_top: int = None, pad_bottom: int = None):
+        super().__init__()
+        self.pl = pad_left
+        self.pr = pad_left if pad_right is None else pad_right
+        self.pt = pad_left if pad_top is None else pad_top
+        self.pb = pad_left if pad_bottom is None else pad_bottom
+
+    def _forward(self, P, x, S, ctx):
+        was3d = x.ndim == 3
+        if was3d:
+            x = x[None]
+
+        def do(v, lo, hi, axis):
+            if lo > 0 or hi > 0:
+                widths = [(0, 0)] * v.ndim
+                widths[axis] = (max(lo, 0), max(hi, 0))
+                v = jnp.pad(v, widths)
+            sl = [slice(None)] * v.ndim
+            sl[axis] = slice(-min(lo, 0), v.shape[axis] + min(hi, 0))
+            return v[tuple(sl)]
+
+        x = do(x, self.pt, self.pb, 2)
+        x = do(x, self.pl, self.pr, 3)
+        return (x[0] if was3d else x), None
+
+
+class Contiguous(TensorModule):
+    """No-op under XLA (ref Contiguous.scala forces a compact copy on JVM)."""
+
+    def _forward(self, P, x, S, ctx):
+        return x, None
+
+
+class Copy(TensorModule):
+    """(ref Copy.scala)"""
+
+    def _forward(self, P, x, S, ctx):
+        return jnp.asarray(x), None
+
+
+class Identity(Module):
+    """(ref Identity.scala) — passes through any Activity."""
+
+    def _forward(self, P, x, S, ctx):
+        return x, None
+
+
+class Echo(TensorModule):
+    """Debug layer: print shape during eager forward (ref Echo.scala)."""
+
+    def _forward(self, P, x, S, ctx):
+        print(f"{self.get_name()}: shape {tuple(x.shape)}")
+        return x, None

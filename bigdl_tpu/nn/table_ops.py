@@ -1,0 +1,178 @@
+"""Table/structure ops (SURVEY.md §2.3 "Table/structure ops (14)"):
+CAddTable, CSubTable, CMulTable, CDivTable, CMaxTable, CMinTable, JoinTable,
+SelectTable, NarrowTable, FlattenTable, MixtureTable, CriterionTable,
+DotProduct, PairwiseDistance, CosineDistance.
+"""
+from __future__ import annotations
+
+from functools import reduce
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+class CAddTable(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def _forward(self, P, x, S, ctx):
+        return reduce(jnp.add, list(x)), None
+
+
+class CSubTable(Module):
+    def _forward(self, P, x, S, ctx):
+        return x[1] - x[2], None
+
+
+class CMulTable(Module):
+    def _forward(self, P, x, S, ctx):
+        return reduce(jnp.multiply, list(x)), None
+
+
+class CDivTable(Module):
+    def _forward(self, P, x, S, ctx):
+        return x[1] / x[2], None
+
+
+class CMaxTable(Module):
+    def _forward(self, P, x, S, ctx):
+        return reduce(jnp.maximum, list(x)), None
+
+
+class CMinTable(Module):
+    def _forward(self, P, x, S, ctx):
+        return reduce(jnp.minimum, list(x)), None
+
+
+class JoinTable(Module):
+    """Concatenate table elements along 1-based ``dimension``; ``n_input_dims``
+    disambiguates batched input (ref JoinTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def _forward(self, P, x, S, ctx):
+        elems = list(x)
+        dim = self.dimension - 1
+        if self.n_input_dims > 0 and elems[0].ndim > self.n_input_dims:
+            dim += 1
+        return jnp.concatenate(elems, axis=dim), None
+
+
+class SelectTable(Module):
+    """Select i-th element of the input Table; negative indexes from the end
+    (ref SelectTable.scala)."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def _forward(self, P, x, S, ctx):
+        idx = self.index if self.index > 0 else x.length() + self.index + 1
+        return x[idx], None
+
+
+class NarrowTable(Module):
+    """Slice ``length`` elements of the table starting at ``offset``
+    (ref NarrowTable.scala)."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset = offset
+        self.length = length
+
+    def _forward(self, P, x, S, ctx):
+        n = self.length
+        if n < 0:
+            n = x.length() - self.offset + 2 + n
+        out = Table()
+        for i in range(n):
+            out[i + 1] = x[self.offset + i]
+        return out, None
+
+
+class FlattenTable(Module):
+    """Flatten nested Tables into a flat Table (ref FlattenTable.scala)."""
+
+    def _forward(self, P, x, S, ctx):
+        out = Table()
+
+        def rec(t):
+            for v in t:
+                if isinstance(v, Table):
+                    rec(v)
+                else:
+                    out.insert(v)
+
+        rec(x)
+        return out, None
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend: input Table(gates (N,E), experts)
+    where experts is a Table of E tensors (N, ...) or a tensor (N, E, ...)
+    (ref MixtureTable.scala:221 — single-device gating, not distributed EP)."""
+
+    def __init__(self, dim: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def _forward(self, P, x, S, ctx):
+        gates, experts = x[1], x[2]
+        if isinstance(experts, Table):
+            stacked = jnp.stack(list(experts), axis=1)  # (N, E, ...)
+        else:
+            stacked = experts
+        g = gates.reshape(gates.shape + (1,) * (stacked.ndim - gates.ndim))
+        return (stacked * g).sum(axis=1), None
+
+
+class DotProduct(Module):
+    """Row-wise dot product of Table(a, b) (ref DotProduct.scala)."""
+
+    def _forward(self, P, x, S, ctx):
+        a, b = x[1], x[2]
+        if a.ndim == 1:
+            return jnp.dot(a, b), None
+        return (a * b).sum(axis=-1), None
+
+
+class PairwiseDistance(Module):
+    """Row-wise Lp distance of Table(a, b) (ref PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def _forward(self, P, x, S, ctx):
+        a, b = x[1], x[2]
+        d = jnp.abs(a - b)
+        axis = -1 if a.ndim > 1 else 0
+        return (d ** self.norm).sum(axis=axis) ** (1.0 / self.norm), None
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity of Table(a, b) (ref CosineDistance.scala)."""
+
+    def _forward(self, P, x, S, ctx):
+        a, b = x[1], x[2]
+        axis = -1 if a.ndim > 1 else 0
+        num = (a * b).sum(axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, 1e-12), None
+
+
+class CriterionTable(Module):
+    """Wrap a criterion as a module over Table(input, target)
+    (ref CriterionTable.scala)."""
+
+    def __init__(self, criterion):
+        super().__init__()
+        self.criterion = criterion
+
+    def _forward(self, P, x, S, ctx):
+        return self.criterion.apply_loss(x[1], x[2]), None

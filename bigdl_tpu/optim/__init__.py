@@ -1,0 +1,29 @@
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod, SGD, Adagrad,
+    LearningRateSchedule, Default, Step, Poly, EpochDecay, EpochStep,
+    EpochSchedule,
+)
+from bigdl_tpu.optim.lbfgs import LBFGS
+from bigdl_tpu.optim import trigger as Trigger
+from bigdl_tpu.optim.trigger import (
+    every_epoch, several_iteration, max_epoch, max_iteration, min_loss,
+)
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, AccuracyResult, LossResult,
+    Top1Accuracy, Top5Accuracy, Loss,
+)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer, validate
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.optim.optimizer import Optimizer
+
+__all__ = [
+    "OptimMethod", "SGD", "Adagrad", "LBFGS",
+    "LearningRateSchedule", "Default", "Step", "Poly", "EpochDecay",
+    "EpochStep", "EpochSchedule",
+    "Trigger", "every_epoch", "several_iteration", "max_epoch",
+    "max_iteration", "min_loss",
+    "ValidationMethod", "ValidationResult", "AccuracyResult", "LossResult",
+    "Top1Accuracy", "Top5Accuracy", "Loss", "Metrics",
+    "LocalOptimizer", "DistriOptimizer", "Optimizer", "validate",
+]

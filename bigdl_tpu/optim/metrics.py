@@ -1,0 +1,51 @@
+"""Phase metrics (ref optim/Metrics.scala:25).
+
+Named counters for per-iteration phase breakdown ("computing time for each
+node", "aggregate gradient time", "get weights average" —
+DistriOptimizer.scala:114-118).  The reference aggregates via Spark
+accumulators; here values are host-side floats (per-process), merged across
+hosts by the distributed optimizer when needed.
+"""
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self):
+        self._sums = defaultdict(float)
+        self._counts = defaultdict(int)
+
+    def set(self, name: str, value: float):
+        self._sums[name] = value
+        self._counts[name] = 1
+
+    def add(self, name: str, value: float):
+        self._sums[name] += value
+        self._counts[name] += 1
+
+    def get(self, name: str):
+        return self._sums[name], self._counts[name]
+
+    def mean(self, name: str) -> float:
+        return self._sums[name] / max(self._counts[name], 1)
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        yield
+        self.add(name, time.perf_counter() - t0)
+
+    def summary(self, unit_scale: float = 1.0) -> str:
+        """(ref Metrics.summary) one line per metric, averaged."""
+        lines = ["========== Metrics Summary =========="]
+        for name in sorted(self._sums):
+            lines.append(f"{name} : {self.mean(name) * unit_scale}")
+        lines.append("=====================================")
+        return "\n".join(lines)
+
+    def reset(self):
+        self._sums.clear()
+        self._counts.clear()

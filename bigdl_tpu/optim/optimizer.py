@@ -1,0 +1,25 @@
+"""Optimizer factory (ref optim/Optimizer.scala:30,151-186): picks Local vs
+Distri from the dataset type, exactly as the reference dispatches on
+LocalDataSet vs DistributedDataSet.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, LocalDataSet, ShardedDataSet, TransformedDataSet,
+)
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+
+def _root_dataset(ds):
+    while isinstance(ds, TransformedDataSet):
+        ds = ds.base
+    return ds
+
+
+def Optimizer(model, dataset, criterion, **kwargs):
+    """(ref Optimizer.apply :151-186)"""
+    root = _root_dataset(dataset)
+    if isinstance(root, ShardedDataSet):
+        return DistriOptimizer(model, dataset, criterion, **kwargs)
+    return LocalOptimizer(model, dataset, criterion)

@@ -1,0 +1,10 @@
+from bigdl_tpu.parallel.mesh import make_mesh, data_parallel_mesh, hybrid_mesh
+from bigdl_tpu.parallel import collectives
+from bigdl_tpu.parallel.sharding import (
+    replicated, batch_sharded, shard_params_rule, constrain,
+)
+
+__all__ = [
+    "make_mesh", "data_parallel_mesh", "hybrid_mesh", "collectives",
+    "replicated", "batch_sharded", "shard_params_rule", "constrain",
+]

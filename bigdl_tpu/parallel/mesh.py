@@ -1,0 +1,45 @@
+"""Device-mesh construction.
+
+Replaces the reference's cluster-topology bookkeeping (Engine.nodeNumber /
+partition-per-node, Engine.scala:254) with ``jax.sharding.Mesh`` axes:
+
+- ``data``  — data parallelism (the reference's only inter-node axis),
+- ``model`` — tensor parallelism (absent in the reference, SURVEY.md §2.9),
+- ``seq``   — sequence/context parallelism for ring attention.
+
+Collectives over ``data``/``model`` within a slice ride ICI; multi-slice
+spans DCN.  Axis sizes multiply to the device count.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_sizes: dict, devices=None) -> Mesh:
+    """Build a mesh from ``{axis_name: size}``; a single ``-1`` size is
+    inferred from the device count."""
+    if devices is None:
+        devices = jax.devices()
+    devices = np.asarray(devices)
+    names = tuple(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+                         f"have {len(devices)}")
+    return Mesh(devices.reshape(sizes), names)
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """Pure-DP mesh — the reference's DistriOptimizer topology."""
+    return make_mesh({"data": -1}, devices)
+
+
+def hybrid_mesh(dp: int = -1, mp: int = 1, devices=None) -> Mesh:
+    """(data, model) mesh for DP x TP hybrid sharding."""
+    return make_mesh({"data": dp, "model": mp}, devices)

@@ -1,0 +1,61 @@
+"""Sharding rules for parameters and batches.
+
+The reference shards the flat parameter vector across Spark partitions
+(AllReduceParameter.init :100-117); here sharding is per-tensor
+``NamedSharding`` over the mesh, chosen by rule:
+
+- default: replicate params, shard batch dim over ``data``;
+- ``shard_params_rule``: tensor-parallel layout for Linear/Conv weights over
+  the ``model`` axis (row/col split by tensor rank), the hybrid layout the
+  dryrun exercises;
+- optimizer-state sharding (the ZeRO-1 analogue of the reference's
+  owner-partition update, DistriOptimizer.scala:232 "update on MY slice
+  only") via ``zero1_rule``.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data", ndim: int = None):
+    """Shard dim 0 (batch) over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_params_rule(mesh: Mesh, model_axis: str = "model"):
+    """Pytree-mapped rule: 2D weights (out, in) split ``out`` over the model
+    axis; 4D conv kernels (O, I, H, W) split ``O``; 1D (bias) replicated.
+    Returns a fn param_array -> NamedSharding."""
+    if model_axis not in mesh.axis_names or mesh.shape[model_axis] == 1:
+        return lambda x: NamedSharding(mesh, P())
+    size = mesh.shape[model_axis]
+
+    def rule(x):
+        if x.ndim >= 2 and x.shape[0] % size == 0:
+            return NamedSharding(mesh, P(model_axis))
+        return NamedSharding(mesh, P())
+
+    return rule
+
+
+def zero1_rule(mesh: Mesh, data_axis: str = "data"):
+    """Shard optimizer-state leaves (velocity/variance mirrors of params)
+    over the data axis where divisible — ZeRO-1: each data-parallel rank
+    owns the update state for its parameter slice."""
+    size = mesh.shape[data_axis]
+
+    def rule(x):
+        if x.ndim >= 1 and x.shape[0] % size == 0:
+            return NamedSharding(mesh, P(data_axis))
+        return NamedSharding(mesh, P())
+
+    return rule

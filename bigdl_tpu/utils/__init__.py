@@ -1,0 +1,6 @@
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.random import RandomGenerator
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils import file as File
+
+__all__ = ["Table", "T", "RandomGenerator", "Engine", "File"]

@@ -1,0 +1,100 @@
+"""Engine — cluster topology + device runtime singleton.
+
+Reference: ``utils/Engine.scala:208``.  There the Engine holds node/core
+counts, two JVM thread pools (``Engine.default`` for replica parallelism,
+``Engine.model`` for intra-op parallelism) and builds a pinned SparkConf.
+
+On TPU the thread pools dissolve into XLA (intra-op parallelism is the
+compiler's job) and Spark's executor topology becomes the JAX process/device
+topology.  What remains is the topology bookkeeping that the data and
+optimizer layers query: node_number (hosts), core_number (local devices),
+plus mesh construction for the distributed optimizer.
+"""
+from __future__ import annotations
+
+import os
+import numpy as np
+import jax
+
+
+class _Engine:
+    def __init__(self):
+        self._initialized = False
+        self._node_number = 1
+        self._core_number = 1
+        self._mesh = None
+
+    # -- lifecycle (ref Engine.init Engine.scala:339) ---------------------
+    def init(self, node_number: int | None = None, core_number: int | None = None,
+             distributed: bool = False):
+        """Initialize topology.  Defaults to the live JAX topology.
+
+        ``distributed=True`` with multiple hosts expects
+        ``jax.distributed.initialize`` to have been called by the launcher
+        (one process per TPU VM host — the Spark-executor role in the
+        reference, DistriOptimizer.scala).
+        """
+        if node_number is None:
+            node_number = jax.process_count()
+        if core_number is None:
+            core_number = jax.local_device_count()
+        self._node_number = int(node_number)
+        self._core_number = int(core_number)
+        self._initialized = True
+        return self
+
+    def _ensure_init(self):
+        if not self._initialized:
+            self.init()
+
+    # -- topology queries (ref Engine.scala:234-264) ----------------------
+    def node_number(self) -> int:
+        self._ensure_init()
+        return self._node_number
+
+    def core_number(self) -> int:
+        self._ensure_init()
+        return self._core_number
+
+    def device_count(self) -> int:
+        return jax.device_count()
+
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    # -- mesh construction -------------------------------------------------
+    def mesh(self, axis_names=("data",), shape=None, devices=None):
+        """Build a ``jax.sharding.Mesh`` over the visible devices.
+
+        With the default single "data" axis this is the topology the
+        reference's DistriOptimizer assumes (pure data parallelism, one
+        replica per node — DistriOptimizer.scala:361-404).  Pass
+        ``axis_names=("data","model")`` + ``shape`` for hybrid shardings.
+        """
+        if devices is None:
+            devices = np.array(jax.devices())
+        else:
+            devices = np.array(devices)
+        if shape is None:
+            shape = (len(devices),) if len(axis_names) == 1 else None
+        if shape is None:
+            raise ValueError("shape required for multi-axis mesh")
+        devices = devices.reshape(shape)
+        return jax.sharding.Mesh(devices, axis_names)
+
+    def set_mesh(self, mesh):
+        self._mesh = mesh
+
+    def get_mesh(self):
+        if self._mesh is None:
+            self._mesh = self.mesh()
+        return self._mesh
+
+    def reset(self):
+        self.__init__()
+
+
+Engine = _Engine()

@@ -1,0 +1,61 @@
+"""Model/state persistence (ref utils/File.scala:27).
+
+The reference uses JVM serialization (local + HDFS).  Here checkpoints are a
+portable pickle of numpy-converted pytrees: (params, state, metadata) for
+modules; plain pytrees for optimizer state Tables.  Orbax-compatible layouts
+can be added on top; this format is dependency-free and survives process
+restarts, which is the capability being ported (checkpoint/resume,
+SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+def _to_numpy(tree):
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree)
+
+
+def _to_jax(tree):
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, tree)
+
+
+def save(obj, path, overwrite: bool = True):
+    """Save an arbitrary pytree (ref File.save File.scala:63)."""
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(path)
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(tmp, "wb") as f:
+        pickle.dump(_to_numpy(obj), f)
+    os.replace(tmp, path)
+
+
+def load(path):
+    with open(path, "rb") as f:
+        return _to_jax(pickle.load(f))
+
+
+def save_module(module, path, overwrite: bool = True):
+    """Persist a module's (params, state) + class info."""
+    save({
+        "format": "bigdl_tpu.module.v1",
+        "cls": type(module).__name__,
+        "params": module.params(),
+        "state": module.state(),
+    }, path, overwrite=overwrite)
+
+
+def load_module_into(module, path):
+    """Load a checkpoint produced by ``save_module`` into ``module``."""
+    blob = load(path)
+    module.load_params(blob["params"])
+    module.load_state(blob["state"])
+    return module

@@ -1,0 +1,64 @@
+"""Seeded RNG for the framework.
+
+The reference carries a hand-written Torch-compatible Mersenne-Twister
+(utils/RandomGenerator.scala:50) because bit-exact Torch streams mattered for
+its golden tests.  On TPU we port the *reproducibility guarantee* (seeded
+determinism), not the generator (SURVEY.md §7 "hard parts"): host-side
+initialization uses a numpy MT19937 stream, device-side randomness (dropout)
+uses JAX's counter-based PRNG keyed off the same seed.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+
+class RandomGenerator:
+    """Global, seedable RNG. ``RNG`` below is the process-wide instance."""
+
+    def __init__(self, seed: int = 1):
+        self.set_seed(seed)
+
+    def set_seed(self, seed: int):
+        self._seed = int(seed)
+        self._np = np.random.RandomState(self._seed)
+        self._key_counter = 0
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    # -- host-side (parameter init, shuffles) -----------------------------
+    def uniform(self, a=0.0, b=1.0, size=None):
+        return self._np.uniform(a, b, size)
+
+    def normal(self, mean=0.0, stdv=1.0, size=None):
+        return self._np.normal(mean, stdv, size)
+
+    def bernoulli(self, p=0.5, size=None):
+        return (self._np.uniform(0.0, 1.0, size) < p).astype(np.float32)
+
+    def randperm(self, n):
+        """1-based random permutation, like Torch randperm."""
+        return self._np.permutation(n) + 1
+
+    def shuffle(self, array):
+        self._np.shuffle(array)
+        return array
+
+    def np_rng(self) -> np.random.RandomState:
+        return self._np
+
+    # -- device-side key stream (dropout etc.) ----------------------------
+    def next_key(self):
+        """A fresh JAX PRNG key; successive calls give independent keys."""
+        self._key_counter += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._key_counter)
+
+
+RNG = RandomGenerator(seed=1)
+
+
+def set_seed(seed: int):
+    RNG.set_seed(seed)
+    return RNG

@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's pattern of testing multi-node without a cluster
+(DistriOptimizerSpec runs Engine.init(nodeNumber=4,...) against a local
+SparkContext, SURVEY.md §4): here the "cluster" is 8 virtual XLA CPU
+devices, so every sharding/collective path compiles and runs in CI with no
+TPU attached.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# XLA CPU may route f32 matmuls through AMX/bf16; pin full precision so
+# value tests compare against numpy exactly.  (On TPU the default bf16-pass
+# MXU precision is the intended fast path — production code does not set this.)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from bigdl_tpu.utils.random import set_seed
+    set_seed(1)
+    np.random.seed(1)
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

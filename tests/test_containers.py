@@ -1,0 +1,177 @@
+"""Container + table/shape-op tests (mirrors reference container specs)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.table import Table, T
+
+
+def randn(*shape, seed=11):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape), jnp.float32)
+
+
+def test_sequential_chains():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    y = m.forward(randn(3, 4))
+    assert y.shape == (3, 2)
+    assert len(m.parameters()[0]) == 4
+
+
+def test_sequential_get_1based():
+    l1, l2 = nn.Linear(2, 2), nn.ReLU()
+    m = nn.Sequential(l1, l2)
+    assert m.get(1) is l1 and m.get(2) is l2
+
+
+def test_concat():
+    m = nn.Concat(2, nn.Linear(4, 3), nn.Linear(4, 5))
+    assert m.forward(randn(2, 4)).shape == (2, 8)
+
+
+def test_concat_table():
+    m = nn.ConcatTable(nn.Linear(4, 3), nn.Identity())
+    out = m.forward(randn(2, 4))
+    assert isinstance(out, Table)
+    assert out[1].shape == (2, 3) and out[2].shape == (2, 4)
+
+
+def test_parallel_table():
+    m = nn.ParallelTable(nn.Linear(4, 2), nn.Linear(3, 5))
+    out = m.forward(T(randn(2, 4), randn(2, 3)))
+    assert out[1].shape == (2, 2) and out[2].shape == (2, 5)
+
+
+def test_map_table_shares_params():
+    m = nn.MapTable(nn.Linear(4, 2))
+    out = m.forward(T(randn(2, 4), randn(2, 4, seed=5)))
+    assert out[1].shape == (2, 2) and out[2].shape == (2, 2)
+    assert len(m.parameters()[0]) == 2  # one Linear only
+
+
+def test_bottle():
+    m = nn.Bottle(nn.Linear(4, 2), 2, 2)
+    y = m.forward(randn(3, 5, 4))
+    assert y.shape == (3, 5, 2)
+
+
+def test_table_arith_ops():
+    a, b = jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 5.0])
+    assert np.allclose(nn.CAddTable().forward(T(a, b)), [4, 7])
+    assert np.allclose(nn.CSubTable().forward(T(a, b)), [-2, -3])
+    assert np.allclose(nn.CMulTable().forward(T(a, b)), [3, 10])
+    assert np.allclose(nn.CDivTable().forward(T(a, b)), [1 / 3, 2 / 5])
+    assert np.allclose(nn.CMaxTable().forward(T(a, b)), [3, 5])
+    assert np.allclose(nn.CMinTable().forward(T(a, b)), [1, 2])
+
+
+def test_join_select_narrow_flatten():
+    a, b = randn(2, 3), randn(2, 4, seed=2)
+    joined = nn.JoinTable(2).forward(T(a, b))
+    assert joined.shape == (2, 7)
+    assert nn.SelectTable(2).forward(T(a, b)).shape == (2, 4)
+    assert nn.SelectTable(-1).forward(T(a, b)).shape == (2, 4)
+    nt = nn.NarrowTable(2, 1).forward(T(a, b, a))
+    assert nt.length() == 1 and nt[1].shape == (2, 4)
+    flat = nn.FlattenTable().forward(T(a, T(b, a)))
+    assert flat.length() == 3
+
+
+def test_mixture_table():
+    gates = jnp.asarray([[0.3, 0.7]])
+    e1, e2 = jnp.ones((1, 4)), 2 * jnp.ones((1, 4))
+    y = nn.MixtureTable().forward(T(gates, T(e1, e2)))
+    np.testing.assert_allclose(y, 1.7 * np.ones((1, 4)), rtol=1e-5)
+
+
+def test_dot_pairwise_cosine():
+    a = jnp.asarray([[1.0, 0.0], [0.0, 2.0]])
+    b = jnp.asarray([[1.0, 0.0], [0.0, 1.0]])
+    np.testing.assert_allclose(nn.DotProduct().forward(T(a, b)), [1.0, 2.0])
+    np.testing.assert_allclose(nn.PairwiseDistance().forward(T(a, b)), [0.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(nn.CosineDistance().forward(T(a, b)), [1.0, 1.0], rtol=1e-5)
+
+
+def test_shape_ops():
+    x = randn(2, 12)
+    assert nn.Reshape([3, 4]).forward(x).shape == (2, 3, 4)
+    assert nn.View(3, 4).forward(x).shape == (2, 3, 4)
+    assert nn.InferReshape([-1, 4], batch_mode=True).forward(x).shape == (2, 3, 4)
+    assert nn.Transpose([(1, 2)]).forward(randn(2, 3)).shape == (3, 2)
+    assert nn.Replicate(5, 2).forward(randn(2, 3)).shape == (2, 5, 3)
+    assert nn.Squeeze(2).forward(randn(2, 1, 3)).shape == (2, 3)
+    assert nn.Unsqueeze(2).forward(randn(2, 3)).shape == (2, 1, 3)
+    assert nn.Contiguous().forward(x).shape == x.shape
+    assert nn.Identity().forward(x).shape == x.shape
+
+
+def test_padding():
+    x = randn(2, 3)
+    y = nn.Padding(2, 2, 2, value=9.0).forward(x)
+    assert y.shape == (2, 5)
+    np.testing.assert_allclose(np.asarray(y)[:, 3:], 9.0)
+    y2 = nn.Padding(2, -2, 2).forward(x)
+    assert y2.shape == (2, 5)
+    np.testing.assert_allclose(np.asarray(y2)[:, :2], 0.0)
+
+
+def test_spatial_zero_padding():
+    x = randn(1, 1, 4, 4)
+    y = nn.SpatialZeroPadding(1, 2, 3, 0).forward(x)
+    assert y.shape == (1, 1, 7, 7)
+    y2 = nn.SpatialZeroPadding(-1, -1, 0, 0).forward(x)
+    assert y2.shape == (1, 1, 4, 2)
+
+
+def test_reductions():
+    x = randn(4, 6)
+    assert nn.Mean(1).forward(x).shape == (6,)
+    assert nn.Sum(2).forward(x).shape == (4,)
+    assert nn.Max(2).forward(x).shape == (4,)
+    assert nn.Min(1).forward(x).shape == (6,)
+    assert nn.Select(1, 2).forward(x).shape == (6,)
+    assert nn.Select(1, -1).forward(x).shape == (6,)
+    np.testing.assert_allclose(nn.Select(1, -1).forward(x), x[3])
+    assert nn.Narrow(2, 2, 3).forward(x).shape == (4, 3)
+    assert nn.Narrow(2, 2, -2).forward(x).shape == (4, 4)
+
+
+def test_index():
+    src = randn(5, 3)
+    idx = jnp.asarray([2, 2, 5])
+    y = nn.Index(1).forward(T(src, idx))
+    np.testing.assert_allclose(y[0], src[1])
+    np.testing.assert_allclose(y[2], src[4])
+
+
+def test_nested_model_grad_flow():
+    """End-to-end: grads flow through containers + table ops under jit."""
+    model = nn.Sequential(
+        nn.ConcatTable(nn.Linear(4, 4), nn.Linear(4, 4)),
+        nn.CAddTable(),
+        nn.ReLU(),
+        nn.Linear(4, 2),
+        nn.LogSoftMax(),
+    )
+    crit = nn.ClassNLLCriterion()
+    x = randn(6, 4)
+    tgt = jnp.asarray([1, 2, 1, 2, 1, 2])
+    params, state = model.params(), model.state()
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x, state, nn.Context(training=True, key=jax.random.PRNGKey(0)))
+        return crit.apply_loss(out, tgt)
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert len(leaves) == 6  # 3 Linears x (w, b)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert float(loss) > 0
+
+
+def test_echo_passthrough(capsys):
+    x = randn(2, 3)
+    y = nn.Echo().forward(x)
+    assert "shape (2, 3)" in capsys.readouterr().out
+    np.testing.assert_allclose(y, x)

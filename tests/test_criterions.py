@@ -1,0 +1,179 @@
+"""Criterion tests (mirrors reference nn/ criterion specs + GradientChecker)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.table import T
+from tests.gradient_checker import GradientChecker
+
+
+def randn(*shape, seed=7):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape), jnp.float32)
+
+
+def test_class_nll():
+    logp = jnp.log(jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    tgt = jnp.asarray([1, 2])
+    c = nn.ClassNLLCriterion()
+    expected = -(np.log(0.7) + np.log(0.8)) / 2
+    assert float(c.forward(logp, tgt)) == pytest.approx(expected, rel=1e-4)
+    c2 = nn.ClassNLLCriterion(size_average=False)
+    assert float(c2.forward(logp, tgt)) == pytest.approx(expected * 2, rel=1e-4)
+
+
+def test_class_nll_weights():
+    logp = jnp.log(jnp.asarray([[0.5, 0.5], [0.5, 0.5]]))
+    c = nn.ClassNLLCriterion(weights=[1.0, 3.0])
+    tgt = jnp.asarray([1, 2])
+    # weighted mean: (1*l + 3*l)/(1+3) = l
+    assert float(c.forward(logp, tgt)) == pytest.approx(-np.log(0.5), rel=1e-5)
+
+
+def test_cross_entropy_equals_logsoftmax_nll():
+    x = randn(4, 5)
+    tgt = jnp.asarray([1, 3, 5, 2])
+    ce = nn.CrossEntropyCriterion().forward(x, tgt)
+    nll = nn.ClassNLLCriterion().forward(nn.LogSoftMax().forward(x), tgt)
+    assert float(ce) == pytest.approx(float(nll), rel=1e-5)
+
+
+def test_mse():
+    a, b = jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 2.0])
+    assert float(nn.MSECriterion().forward(a, b)) == pytest.approx(2.0)
+    assert float(nn.MSECriterion(size_average=False).forward(a, b)) == pytest.approx(4.0)
+
+
+def test_abs():
+    a, b = jnp.asarray([1.0, -2.0]), jnp.asarray([3.0, 2.0])
+    assert float(nn.AbsCriterion().forward(a, b)) == pytest.approx(3.0)
+
+
+def test_bce():
+    p = jnp.asarray([0.8, 0.3])
+    t = jnp.asarray([1.0, 0.0])
+    expected = -(np.log(0.8) + np.log(0.7)) / 2
+    assert float(nn.BCECriterion().forward(p, t)) == pytest.approx(expected, rel=1e-4)
+
+
+def test_kl_div():
+    logq = jnp.log(jnp.asarray([[0.5, 0.5]]))
+    p = jnp.asarray([[0.75, 0.25]])
+    expected = 0.75 * np.log(0.75 / 0.5) + 0.25 * np.log(0.25 / 0.5)
+    assert float(nn.DistKLDivCriterion().forward(logq, p)) == pytest.approx(expected, rel=1e-4)
+
+
+def test_margin():
+    x = jnp.asarray([0.5, -0.5])
+    y = jnp.asarray([1.0, -1.0])
+    # both margins: 1-0.5 = 0.5 each -> mean 0.5
+    assert float(nn.MarginCriterion().forward(x, y)) == pytest.approx(0.5)
+
+
+def test_soft_margin():
+    x, y = jnp.asarray([2.0]), jnp.asarray([1.0])
+    assert float(nn.SoftMarginCriterion().forward(x, y)) == pytest.approx(
+        np.log(1 + np.exp(-2.0)), rel=1e-5)
+
+
+def test_smooth_l1():
+    a = jnp.asarray([0.5, 3.0])
+    b = jnp.zeros(2)
+    expected = (0.5 * 0.25 + 2.5) / 2
+    assert float(nn.SmoothL1Criterion().forward(a, b)) == pytest.approx(expected)
+
+
+def test_hinge_embedding():
+    x = jnp.asarray([0.3, 0.4])
+    y = jnp.asarray([1.0, -1.0])
+    expected = (0.3 + max(0, 1 - 0.4)) / 2
+    assert float(nn.HingeEmbeddingCriterion().forward(x, y)) == pytest.approx(expected, rel=1e-5)
+
+
+def test_cosine_embedding():
+    x1 = jnp.asarray([[1.0, 0.0]])
+    x2 = jnp.asarray([[1.0, 0.0]])
+    y = jnp.asarray([1.0])
+    assert float(nn.CosineEmbeddingCriterion().forward(T(x1, x2), y)) == pytest.approx(0.0, abs=1e-6)
+    y2 = jnp.asarray([-1.0])
+    assert float(nn.CosineEmbeddingCriterion().forward(T(x1, x2), y2)) == pytest.approx(1.0, rel=1e-5)
+
+
+def test_margin_ranking():
+    x1, x2 = jnp.asarray([1.0]), jnp.asarray([0.5])
+    y = jnp.asarray([1.0])
+    assert float(nn.MarginRankingCriterion().forward(T(x1, x2), y)) == pytest.approx(0.5)
+
+
+def test_multi_criterion():
+    mc = nn.MultiCriterion().add(nn.MSECriterion()).add(nn.AbsCriterion(), 2.0)
+    a, b = jnp.asarray([1.0]), jnp.asarray([0.0])
+    assert float(mc.forward(a, b)) == pytest.approx(1.0 + 2.0)
+
+
+def test_parallel_criterion():
+    pc = nn.ParallelCriterion().add(nn.MSECriterion()).add(nn.AbsCriterion())
+    inp = T(jnp.asarray([2.0]), jnp.asarray([1.0]))
+    tgt = T(jnp.asarray([0.0]), jnp.asarray([0.0]))
+    assert float(pc.forward(inp, tgt)) == pytest.approx(4.0 + 1.0)
+
+
+def test_multi_margin():
+    x = jnp.asarray([[0.1, 0.2, 0.7]])
+    t = jnp.asarray([3])
+    # margins vs classes 1,2: max(0,1-0.7+0.1)+max(0,1-0.7+0.2) = 0.4+0.5 -> /3
+    assert float(nn.MultiMarginCriterion().forward(x, t)) == pytest.approx(0.9 / 3, rel=1e-5)
+
+
+def test_multilabel_soft_margin():
+    x = jnp.asarray([[0.0, 0.0]])
+    t = jnp.asarray([[1.0, 0.0]])
+    assert float(nn.MultiLabelSoftMarginCriterion().forward(x, t)) == pytest.approx(
+        np.log(2.0), rel=1e-4)
+
+
+def test_multilabel_margin():
+    x = jnp.asarray([[0.1, 0.2, 0.4, 0.8]])
+    t = jnp.asarray([[3, 0, 0, 0]])  # only label 3
+    got = float(nn.MultiLabelMarginCriterion().forward(x, t))
+    expected = (max(0, 1 - (0.4 - 0.1)) + max(0, 1 - (0.4 - 0.2)) + max(0, 1 - (0.4 - 0.8))) / 4
+    assert got == pytest.approx(expected, rel=1e-5)
+
+
+def test_l1_cost():
+    x = jnp.asarray([1.0, -2.0])
+    assert float(nn.L1Cost().forward(x, None)) == pytest.approx(3.0)
+
+
+def test_softmax_with_criterion():
+    x = randn(2, 5)
+    t = jnp.asarray([1, 4])
+    got = nn.SoftmaxWithCriterion().forward(x, t)
+    want = nn.CrossEntropyCriterion().forward(x, t)
+    assert float(got) == pytest.approx(float(want), rel=1e-5)
+
+
+def test_class_simplex():
+    c = nn.ClassSimplexCriterion(3)
+    s = np.asarray(c.simplex)
+    # vertices are unit-norm and pairwise equidistant
+    np.testing.assert_allclose(np.linalg.norm(s, axis=1), 1.0, atol=1e-5)
+
+
+def test_time_distributed_criterion():
+    base = nn.MSECriterion()
+    c = nn.TimeDistributedCriterion(base, size_average=True)
+    x = jnp.ones((2, 3, 4))
+    t = jnp.zeros((2, 3, 4))
+    assert float(c.forward(x, t)) == pytest.approx(1.0)
+
+
+def test_criterion_gradients():
+    gc = GradientChecker()
+    x = randn(3, 5)
+    tgt = jnp.asarray([1, 3, 5])
+    assert gc.check_criterion(nn.CrossEntropyCriterion(), x, tgt) < 1e-2
+    assert gc.check_criterion(nn.MSECriterion(), x, randn(3, 5, seed=9)) < 1e-2
+    probs = jnp.asarray(np.random.RandomState(0).uniform(0.2, 0.8, (3, 5)), jnp.float32)
+    bins = jnp.asarray((np.random.RandomState(1).uniform(size=(3, 5)) > 0.5).astype(np.float32))
+    assert gc.check_criterion(nn.BCECriterion(), probs, bins) < 1e-2

@@ -1,0 +1,304 @@
+"""Per-layer unit tests: shapes, values, gradients.
+
+Mirrors the reference's nn/ spec suite (SURVEY.md §4: 50 files of per-layer
+shape/value assertions + GradientChecker).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from tests.gradient_checker import GradientChecker
+
+
+def randn(*shape):
+    return jnp.asarray(np.random.RandomState(3).randn(*shape), jnp.float32)
+
+
+class TestLinear:
+    def test_shape_and_value(self):
+        m = nn.Linear(4, 3)
+        x = randn(2, 4)
+        y = m.forward(x)
+        assert y.shape == (2, 3)
+        w, b = m._params["weight"], m._params["bias"]
+        expected = x @ w.T + b
+        np.testing.assert_allclose(y, expected, rtol=1e-5)
+
+    def test_no_bias(self):
+        m = nn.Linear(4, 3, with_bias=False)
+        assert "bias" not in m._params
+        assert m.forward(randn(2, 4)).shape == (2, 3)
+
+    def test_grad(self):
+        err = GradientChecker().check_layer(nn.Linear(6, 4), randn(3, 6))
+        assert err < 1e-2
+
+
+class TestConv:
+    def test_shape(self):
+        m = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+        assert m.forward(randn(2, 3, 8, 8)).shape == (2, 8, 8, 8)
+
+    def test_stride_pad(self):
+        m = nn.SpatialConvolution(1, 4, 5, 5, 2, 2, 2, 2)
+        assert m.forward(randn(2, 1, 28, 28)).shape == (2, 4, 14, 14)
+
+    def test_3d_input(self):
+        m = nn.SpatialConvolution(3, 8, 3, 3)
+        assert m.forward(randn(3, 8, 8)).shape == (8, 6, 6)
+
+    def test_groups(self):
+        m = nn.SpatialConvolution(4, 8, 3, 3, n_group=2)
+        assert m.forward(randn(2, 4, 8, 8)).shape == (2, 8, 6, 6)
+
+    def test_value_identity_kernel(self):
+        m = nn.SpatialConvolution(1, 1, 1, 1, with_bias=False)
+        m.load_params({"~": {"weight": jnp.ones((1, 1, 1, 1))}})
+        x = randn(1, 1, 4, 4)
+        np.testing.assert_allclose(m.forward(x), x, rtol=1e-6)
+
+    def test_grad(self):
+        err = GradientChecker().check_layer(
+            nn.SpatialConvolution(2, 3, 3, 3), randn(2, 2, 6, 6))
+        assert err < 1e-2
+
+    def test_dilated(self):
+        m = nn.SpatialDilatedConvolution(2, 4, 3, 3, dilation_w=2, dilation_h=2)
+        # effective kernel 5 -> out 8-5+1=4
+        assert m.forward(randn(1, 2, 8, 8)).shape == (1, 4, 4, 4)
+
+    def test_full_conv_shape(self):
+        m = nn.SpatialFullConvolution(4, 2, 3, 3, 2, 2, 1, 1, 1, 1)
+        # out = (in-1)*2 - 2 + 3 + 1 = (5-1)*2 - 2 + 4 = 10
+        assert m.forward(randn(1, 4, 5, 5)).shape == (1, 2, 10, 10)
+
+    def test_full_conv_grad(self):
+        err = GradientChecker().check_layer(
+            nn.SpatialFullConvolution(2, 3, 3, 3, 2, 2), randn(1, 2, 4, 4))
+        assert err < 1e-2
+
+    def test_conv_map(self):
+        table = nn.SpatialConvolutionMap.one_to_one(3)
+        m = nn.SpatialConvolutionMap(table, 3, 3)
+        y = m.forward(randn(2, 3, 6, 6))
+        assert y.shape == (2, 3, 4, 4)
+        # masked weights: off-diagonal connections are zero
+        w = np.asarray(m._params["weight"])
+        assert np.all(w[0, 1] == 0) and np.all(w[1, 2] == 0)
+
+
+class TestPooling:
+    def test_max_pool(self):
+        m = nn.SpatialMaxPooling(2, 2, 2, 2)
+        x = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        y = m.forward(x)
+        np.testing.assert_allclose(y[0, 0], [[5, 7], [13, 15]])
+
+    def test_ceil_mode(self):
+        m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        assert m.forward(randn(1, 1, 7, 7)).shape == (1, 1, 4, 4)
+        m2 = nn.SpatialMaxPooling(3, 3, 2, 2)
+        assert m2.forward(randn(1, 1, 7, 7)).shape == (1, 1, 3, 3)
+
+    def test_avg_pool_value(self):
+        m = nn.SpatialAveragePooling(2, 2, 2, 2)
+        x = jnp.ones((1, 1, 4, 4))
+        np.testing.assert_allclose(m.forward(x), jnp.ones((1, 1, 2, 2)))
+
+    def test_avg_pool_pad_counts(self):
+        x = jnp.ones((1, 1, 2, 2))
+        inc = nn.SpatialAveragePooling(2, 2, 2, 2, 1, 1, ceil_mode=False,
+                                       count_include_pad=True)
+        exc = nn.SpatialAveragePooling(2, 2, 2, 2, 1, 1, ceil_mode=False,
+                                       count_include_pad=False)
+        assert float(inc.forward(x)[0, 0, 0, 0]) == pytest.approx(0.25)
+        assert float(exc.forward(x)[0, 0, 0, 0]) == pytest.approx(1.0)
+
+
+class TestBatchNorm:
+    def test_train_normalizes(self):
+        m = nn.BatchNormalization(4, affine=False)
+        x = randn(32, 4) * 5 + 2
+        y = m.forward(x)
+        np.testing.assert_allclose(np.asarray(y).mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(y).std(0), 1, atol=2e-2)
+
+    def test_running_stats_update(self):
+        m = nn.BatchNormalization(4, momentum=0.5)
+        x = randn(64, 4) + 3.0
+        m.forward(x)
+        rm = np.asarray(m._buffers["running_mean"])
+        assert np.all(rm > 1.0)  # moved toward batch mean of ~3
+
+    def test_eval_uses_running(self):
+        m = nn.BatchNormalization(2, affine=False)
+        m.forward(randn(16, 2))
+        m.evaluate()
+        rm = m._buffers["running_mean"].copy()
+        m.forward(randn(16, 2) + 100.0)
+        np.testing.assert_allclose(m._buffers["running_mean"], rm)
+
+    def test_spatial(self):
+        m = nn.SpatialBatchNormalization(3)
+        y = m.forward(randn(4, 3, 5, 5))
+        assert y.shape == (4, 3, 5, 5)
+        np.testing.assert_allclose(np.asarray(y).mean((0, 2, 3)), 0, atol=1e-4)
+
+
+class TestLRN:
+    def test_shape_and_positive_denominator(self):
+        m = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)
+        x = randn(2, 8, 4, 4)
+        y = m.forward(x)
+        assert y.shape == x.shape
+        assert np.all(np.abs(np.asarray(y)) <= np.abs(np.asarray(x)) + 1e-6)
+
+    def test_grad(self):
+        err = GradientChecker().check_layer(
+            nn.SpatialCrossMapLRN(3), randn(1, 4, 3, 3))
+        assert err < 1e-2
+
+
+class TestActivations:
+    @pytest.mark.parametrize("layer,fn", [
+        (nn.ReLU(), lambda x: np.maximum(x, 0)),
+        (nn.ReLU6(), lambda x: np.clip(x, 0, 6)),
+        (nn.Tanh(), np.tanh),
+        (nn.Sigmoid(), lambda x: 1 / (1 + np.exp(-x))),
+        (nn.Abs(), np.abs),
+        (nn.Square(), lambda x: x * x),
+        (nn.Exp(), np.exp),
+        (nn.SoftSign(), lambda x: x / (1 + np.abs(x))),
+        (nn.TanhShrink(), lambda x: x - np.tanh(x)),
+        (nn.HardTanh(), lambda x: np.clip(x, -1, 1)),
+        (nn.LeakyReLU(0.1), lambda x: np.where(x >= 0, x, 0.1 * x)),
+        (nn.ELU(), lambda x: np.where(x > 0, x, np.exp(x) - 1)),
+    ])
+    def test_values(self, layer, fn):
+        # atol 1e-5: XLA CPU uses polynomial approximations for tanh/exp
+        x = randn(3, 5)
+        np.testing.assert_allclose(layer.forward(x), fn(np.asarray(x)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_log_softmax_rows_sum_to_one(self):
+        y = nn.LogSoftMax().forward(randn(4, 7))
+        np.testing.assert_allclose(np.exp(np.asarray(y)).sum(1), 1.0, rtol=1e-5)
+
+    def test_softmin_reverses_order(self):
+        x = jnp.asarray([[1.0, 2.0, 3.0]])
+        y = np.asarray(nn.SoftMin().forward(x))
+        assert y[0, 0] > y[0, 1] > y[0, 2]
+
+    def test_prelu_per_channel(self):
+        m = nn.PReLU(3)
+        x = -jnp.ones((2, 3, 4, 4))
+        y = m.forward(x)
+        np.testing.assert_allclose(y, -0.25 * np.ones((2, 3, 4, 4)))
+
+    def test_rrelu_train_vs_eval(self):
+        m = nn.RReLU(0.1, 0.3)
+        x = -jnp.ones((100,))
+        m.evaluate()
+        np.testing.assert_allclose(m.forward(x), -0.2 * np.ones(100), rtol=1e-5)
+
+    def test_threshold(self):
+        m = nn.Threshold(0.5, -7.0)
+        x = jnp.asarray([0.0, 0.4, 0.6, 2.0])
+        np.testing.assert_allclose(m.forward(x), [-7.0, -7.0, 0.6, 2.0])
+
+    def test_power(self):
+        m = nn.Power(2.0, 2.0, 1.0)
+        x = jnp.asarray([1.0, 2.0])
+        np.testing.assert_allclose(m.forward(x), [9.0, 25.0], rtol=1e-5)
+
+    def test_gradient_reversal(self):
+        m = nn.GradientReversal(2.0)
+        x = randn(3)
+        y = m.forward(x)
+        np.testing.assert_allclose(y, x)
+        gi = m.backward(x, jnp.ones(3))
+        np.testing.assert_allclose(gi, -2.0 * np.ones(3))
+
+
+class TestDropout:
+    def test_eval_identity(self):
+        m = nn.Dropout(0.5).evaluate()
+        x = randn(10, 10)
+        np.testing.assert_allclose(m.forward(x), x)
+
+    def test_train_zeros_and_scales(self):
+        m = nn.Dropout(0.5)
+        x = jnp.ones((100, 100))
+        y = np.asarray(m.forward(x))
+        frac_zero = (y == 0).mean()
+        assert 0.4 < frac_zero < 0.6
+        kept = y[y != 0]
+        np.testing.assert_allclose(kept, 2.0, rtol=1e-5)
+
+    def test_l1_penalty_backward(self):
+        m = nn.L1Penalty(0.1)
+        x = jnp.asarray([1.0, -2.0, 3.0])
+        m.forward(x)
+        gi = m.backward(x, jnp.zeros(3))
+        np.testing.assert_allclose(gi, [0.1, -0.1, 0.1], rtol=1e-5)
+
+
+class TestEmbedding:
+    def test_lookup(self):
+        m = nn.LookupTable(10, 4)
+        idx = jnp.asarray([[1, 2], [3, 10]])
+        y = m.forward(idx)
+        assert y.shape == (2, 2, 4)
+        np.testing.assert_allclose(y[0, 0], m._params["weight"][0])
+        np.testing.assert_allclose(y[1, 1], m._params["weight"][9])
+
+    def test_max_norm(self):
+        m = nn.LookupTable(5, 8, max_norm=1.0)
+        y = np.asarray(m.forward(jnp.arange(1, 6)))
+        norms = np.linalg.norm(y, axis=1)
+        assert np.all(norms <= 1.0 + 1e-4)
+
+
+class TestLinAlgLayers:
+    def test_cmul_cadd(self):
+        m = nn.CMul([4]); a = nn.CAdd([4])
+        x = randn(2, 4)
+        np.testing.assert_allclose(m.forward(x), x * m._params["weight"], rtol=1e-6)
+        np.testing.assert_allclose(a.forward(x), x + a._params["bias"], rtol=1e-6)
+
+    def test_mm(self):
+        from bigdl_tpu.utils.table import T
+        m = nn.MM()
+        a, b = randn(2, 3, 4), randn(2, 4, 5)
+        np.testing.assert_allclose(m.forward(T(a, b)), np.matmul(a, b), rtol=1e-4)
+
+    def test_mv(self):
+        from bigdl_tpu.utils.table import T
+        m = nn.MV()
+        a, b = randn(2, 3, 4), randn(2, 4)
+        np.testing.assert_allclose(m.forward(T(a, b)),
+                                   np.einsum("nij,nj->ni", a, b), rtol=1e-4)
+
+    def test_bilinear(self):
+        from bigdl_tpu.utils.table import T
+        m = nn.Bilinear(3, 4, 2)
+        x1, x2 = randn(5, 3), randn(5, 4)
+        y = m.forward(T(x1, x2))
+        assert y.shape == (5, 2)
+        expected = np.einsum("ni,oij,nj->no", x1, m._params["weight"], x2) + m._params["bias"]
+        np.testing.assert_allclose(y, expected, rtol=1e-4)
+
+    def test_cosine(self):
+        m = nn.Cosine(4, 3)
+        y = np.asarray(m.forward(randn(2, 4)))
+        assert y.shape == (2, 3)
+        assert np.all(np.abs(y) <= 1.0 + 1e-5)
+
+    def test_euclidean(self):
+        m = nn.Euclidean(4, 3)
+        x = randn(2, 4)
+        y = np.asarray(m.forward(x))
+        w = np.asarray(m._params["weight"])
+        expected = np.linalg.norm(np.asarray(x)[:, :, None] - w[None], axis=1)
+        np.testing.assert_allclose(y, expected, rtol=1e-4)
