@@ -1,0 +1,118 @@
+"""Benchmark: Inception-v1 synchronous-SGD training throughput.
+
+The TPU-native counterpart of the reference's DistriOptimizerPerf CLI
+(models/utils/DistriOptimizerPerf.scala:41-138: synthetic data, inception_v1,
+default batch 128).  Prints ONE JSON line:
+  {"metric": ..., "value": images/sec, "unit": ..., "vs_baseline": ...}
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+reported against the BASELINE.json north-star bar of 0.4 MFU:
+vs_baseline = achieved_MFU / 0.4 (>1.0 beats the target).  MFU uses XLA's
+own per-step FLOP count from compiled cost analysis and the chip's peak
+for the dtype in use.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+PEAK_FLOPS = {
+    # bf16 dense peak per chip
+    "TPU v2": 45e12, "TPU v3": 123e12, "TPU v4": 275e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 459e12,
+    "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
+
+
+def guess_peak(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 197e12  # default to v5e
+
+
+def main(batch_size: int = 128, iterations: int = 10, warmup: int = 3):
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import tensor as bt
+    from bigdl_tpu.models.inception import Inception_v1
+    from bigdl_tpu.nn.module import Context
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.utils.random import set_seed
+
+    set_seed(1)
+    bt.set_policy(bt.BF16_COMPUTE)  # matmuls/convs in bf16 on the MXU
+
+    model = Inception_v1(class_num=1000)
+    criterion = nn.ClassNLLCriterion()
+    method = SGD()
+    params, net_state = model.params(), model.state()
+    opt_state = method.init_state(params)
+    hyper = {"lr": 0.01, "momentum": 0.9, "dampening": 0.0,
+             "weight_decay": 0.0001, "nesterov": False}
+
+    def train_step(params, net_state, opt_state, x, y, key):
+        def loss_fn(p):
+            out, ns = model.apply(p, x, net_state, Context(training=True, key=key))
+            return criterion.apply_loss(out, y), ns
+
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = method.update(grads, opt_state, params, hyper)
+        return new_params, ns, new_opt, loss
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(batch_size, 3, 224, 224), jnp.float32)
+    y = jnp.asarray(rs.randint(1, 1001, (batch_size,)))
+    key = jax.random.PRNGKey(0)
+
+    step = jax.jit(train_step)
+    try:
+        flops_per_step = float(
+            step.lower(params, net_state, opt_state, x, y, key)
+            .compile().cost_analysis()["flops"])
+    except Exception:
+        flops_per_step = float("nan")
+
+    for _ in range(warmup):
+        params, net_state, opt_state, loss = step(
+            params, net_state, opt_state, x, y, key)
+    float(loss)  # device->host copy = hard sync (block_until_ready may be a
+    # no-op under remote-relay PJRT backends; a transfer cannot lie)
+
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        params, net_state, opt_state, loss = step(
+            params, net_state, opt_state, x, y, key)
+    last_loss = float(loss)  # syncs the whole sequential step chain
+    dt = (time.perf_counter() - t0) / iterations
+
+    images_per_sec = batch_size / dt
+    peak = guess_peak(jax.devices()[0])
+    mfu = (flops_per_step / dt) / peak if np.isfinite(flops_per_step) else float("nan")
+    vs_baseline = mfu / 0.4 if np.isfinite(mfu) else 1.0
+
+    print(json.dumps({
+        "metric": "images/sec/chip (Inception-v1 bs%d sync-SGD train)" % batch_size,
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(vs_baseline, 4),
+        "detail": {
+            "step_time_ms": round(dt * 1e3, 3),
+            "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
+            "flops_per_step": flops_per_step,
+            "device": jax.devices()[0].device_kind,
+            "loss": last_loss,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    main(batch_size=bs)

@@ -75,10 +75,13 @@ class LabeledSentenceToSample(Transformer):
     """
 
     def __init__(self, n_input_dims: int = None, fixed_length: int = None,
-                 pad_value: int = 0):
+                 pad_value: int = 0, label_pad_class: int = 1):
         self.n_input_dims = n_input_dims
         self.fixed_length = fixed_length
         self.pad_value = pad_value
+        # labels are 1-based class targets: pad positions must still carry a
+        # valid class id (ref LabeledSentenceToSample padding semantics)
+        self.label_pad_class = label_pad_class
 
     def __call__(self, iterator):
         for s in iterator:
@@ -91,6 +94,6 @@ class LabeledSentenceToSample(Transformer):
             else:
                 feat = np.full((length,), self.pad_value, np.float32)
                 feat[:len(data_ids)] = data_ids
-            label = np.full((length,), self.pad_value, np.float32)
+            label = np.full((length,), self.label_pad_class, np.float32)
             label[:len(label_ids)] = label_ids + 1  # 1-based class targets
             yield Sample(feat, label)

@@ -25,7 +25,7 @@ def BiLSTMClassifier(input_size: int, hidden_size: int, class_num: int):
     return nn.Sequential(
         nn.BiRecurrent(nn.LSTMCell(input_size, hidden_size),
                        nn.LSTMCell(input_size, hidden_size)),
-        nn.Mean(2, n_input_dims=2),  # mean over time: (N, T, 2H) -> (N, 2H)
+        nn.Mean(1, n_input_dims=2),  # mean over time: (N, T, 2H) -> (N, 2H)
         nn.Linear(2 * hidden_size, class_num),
         nn.LogSoftMax(),
     )

@@ -26,13 +26,16 @@ _DN = ("NCHW", "OIHW", "NCHW")
 
 
 def _conv(x, w, stride, padding, *, lhs_dilation=None, rhs_dilation=None, groups=1):
+    # Both operands cast to the compute dtype (bf16 feeds the MXU at full
+    # rate; accumulation is f32 inside the MXU regardless), output cast back.
+    # No preferred_element_type: its VJP would pair an f32 cotangent with
+    # bf16 operands, which conv_general_dilated rejects.
     p = policy()
     y = lax.conv_general_dilated(
         p.cast_compute(x), p.cast_compute(w),
         window_strides=stride, padding=padding,
         lhs_dilation=lhs_dilation, rhs_dilation=rhs_dilation,
-        dimension_numbers=_DN, feature_group_count=groups,
-        preferred_element_type=jnp.float32)
+        dimension_numbers=_DN, feature_group_count=groups)
     return y.astype(p.output_dtype)
 
 

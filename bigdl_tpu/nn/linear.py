@@ -20,10 +20,10 @@ from bigdl_tpu.utils.table import Table
 
 
 def _dot(a, b):
-    """Single matmul chokepoint: cast per dtype policy, accumulate in f32."""
+    """Single matmul chokepoint: cast per dtype policy (bf16 feeds the MXU;
+    accumulation is f32 inside the MXU), output cast back."""
     p = policy()
-    return jnp.matmul(p.cast_compute(a), p.cast_compute(b),
-                      preferred_element_type=jnp.float32).astype(p.output_dtype)
+    return jnp.matmul(p.cast_compute(a), p.cast_compute(b)).astype(p.output_dtype)
 
 
 class Linear(TensorModule):

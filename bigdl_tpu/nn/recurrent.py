@@ -153,7 +153,7 @@ class Recurrent(Container):
 
     def apply(self, params, x, state, ctx):
         cell = self.cell
-        cp = params["0"]
+        cp = params["0"]["~"]  # cells keep all params in their own dict
         cs = state["0"]
         n, t = x.shape[0], x.shape[1]
         h0 = cell.init_hidden(n)

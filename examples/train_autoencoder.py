@@ -1,0 +1,53 @@
+"""Autoencoder on MNIST (ref models/autoencoder/Train.scala).
+
+  python examples/train_autoencoder.py -f ./mnist -b 150
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--folder", default="./mnist")
+    p.add_argument("-b", "--batchSize", type=int, default=150)
+    p.add_argument("--learningRate", type=float, default=0.01)
+    p.add_argument("--maxEpoch", type=int, default=10)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import mnist, DataSet, Sample
+    from bigdl_tpu.dataset.transformer import FuncTransformer, SampleToBatch
+    from bigdl_tpu.optim import LocalOptimizer, max_epoch
+    from bigdl_tpu.utils.table import T
+    from bigdl_tpu.models.autoencoder import Autoencoder
+
+    try:
+        data = mnist.load(args.folder, training=True)
+    except FileNotFoundError:
+        logging.warning("no MNIST in %s — synthetic", args.folder)
+        data = mnist.synthetic(2048)
+
+    # target = the (normalized) input itself (ref autoencoder Train:
+    # GreyImgToSample with feature as label)
+    def to_sample(img):
+        flat = (img.data / 255.0).astype(np.float32).reshape(-1)
+        return Sample(flat, flat)
+
+    ds = (DataSet.array(data) >> FuncTransformer(to_sample)
+          >> SampleToBatch(args.batchSize))
+
+    model = Autoencoder(class_num=32)
+    opt = LocalOptimizer(model, ds, nn.MSECriterion())
+    opt.set_state(T(learningRate=args.learningRate, momentum=0.9))
+    opt.set_end_when(max_epoch(args.maxEpoch))
+    opt.optimize()
+
+
+if __name__ == "__main__":
+    main()

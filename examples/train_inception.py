@@ -1,0 +1,74 @@
+"""Inception-v1 on ImageNet — the distributed flagship
+(ref models/inception/Train.scala: SGD + Poly(0.5, maxIter) schedule,
+Train.scala:39-51), BASELINE config 3.
+
+  python examples/train_inception.py -f ./imagenet -b 256 --maxIteration 62000
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--folder", default="./imagenet",
+                   help="ImageFolder layout (class subdirs) or shard files")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--learningRate", type=float, default=0.0898)
+    p.add_argument("--weightDecay", type=float, default=0.0001)
+    p.add_argument("--maxIteration", type=int, default=62000)
+    p.add_argument("--classNumber", type=int, default=1000)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--synthetic", action="store_true",
+                   help="synthetic 224x224 data (DistriOptimizerPerf mode)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.image import (
+        LabeledImage, ImgNormalizer, ImgToBatch, ImgRdmCropper, HFlip,
+        BytesToImg)
+    from bigdl_tpu.models.inception import Inception_v1
+    from bigdl_tpu.optim import (
+        Optimizer, max_iteration, several_iteration, Top1Accuracy,
+        Top5Accuracy)
+    from bigdl_tpu.optim.optim_method import Poly
+    from bigdl_tpu.utils.table import T
+
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        data = [LabeledImage(rng.uniform(0, 255, (256, 256, 3)),
+                             rng.randint(1, args.classNumber + 1))
+                for _ in range(args.batchSize * 4)]
+        train_ds = (DataSet.array(data, distributed=True)
+                    >> ImgRdmCropper(224, 224) >> HFlip()
+                    >> ImgNormalizer((123.0, 117.0, 104.0), (1.0, 1.0, 1.0))
+                    >> ImgToBatch(args.batchSize))
+    else:
+        train_ds = (DataSet.image_folder(args.folder, distributed=True)
+                    >> BytesToImg(256)
+                    >> ImgRdmCropper(224, 224) >> HFlip()
+                    >> ImgNormalizer((123.0, 117.0, 104.0), (1.0, 1.0, 1.0))
+                    >> ImgToBatch(args.batchSize))
+
+    model = Inception_v1(class_num=args.classNumber)
+    optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
+    optimizer.set_state(T(
+        learningRate=args.learningRate,
+        weightDecay=args.weightDecay,
+        momentum=0.9,
+        dampening=0.0,
+        learningRateSchedule=Poly(0.5, args.maxIteration)))
+    optimizer.set_end_when(max_iteration(args.maxIteration))
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, several_iteration(620))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""LeNet-5 on MNIST — the reference's canonical Train main
+(ref models/lenet/Train.scala:41-104), flag-for-flag:
+
+  python examples/train_lenet.py -f /path/to/mnist -b 128 \
+      --learningRate 0.05 --maxEpoch 15 [--model snap.model --state snap.state]
+
+Falls back to synthetic data when no MNIST idx files are found (so the
+example always runs; the reference instead exits).
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--folder", default="./mnist",
+                   help="folder with train/t10k idx files")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--learningRate", type=float, default=0.05)
+    p.add_argument("--momentum", type=float, default=0.0)
+    p.add_argument("--weightDecay", type=float, default=0.0)
+    p.add_argument("--maxEpoch", type=int, default=15)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--model", default=None, help="model snapshot to resume")
+    p.add_argument("--state", default=None, help="state snapshot to resume")
+    p.add_argument("--distributed", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import mnist, DataSet
+    from bigdl_tpu.dataset.image import ImgNormalizer, ImgToBatch
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import (
+        Optimizer, max_epoch, every_epoch, Top1Accuracy)
+    from bigdl_tpu.utils.table import T
+    from bigdl_tpu.utils import file as File
+
+    try:
+        train_data = mnist.load(args.folder, training=True)
+        test_data = mnist.load(args.folder, training=False)
+        norm_train = ImgNormalizer(mnist.TRAIN_MEAN, mnist.TRAIN_STD)
+        norm_test = ImgNormalizer(mnist.TEST_MEAN, mnist.TEST_STD)
+    except FileNotFoundError:
+        logging.warning("no MNIST idx files in %s — using synthetic data", args.folder)
+        train_data, test_data = mnist.synthetic(2048), mnist.synthetic(512, seed=1)
+        norm_train = norm_test = ImgNormalizer(mnist.TRAIN_MEAN, mnist.TRAIN_STD)
+
+    train_ds = (DataSet.array(train_data, distributed=args.distributed)
+                >> norm_train >> ImgToBatch(args.batchSize))
+    test_ds = DataSet.array(test_data) >> norm_test >> ImgToBatch(args.batchSize)
+
+    model = LeNet5(class_num=10)
+    if args.model:
+        File.load_module_into(model, args.model)
+
+    optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
+    state = T(learningRate=args.learningRate, momentum=args.momentum,
+              weightDecay=args.weightDecay)
+    if args.state:
+        state.update(File.load(args.state)["state"])
+    optimizer.set_state(state)
+    optimizer.set_end_when(max_epoch(args.maxEpoch))
+    optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

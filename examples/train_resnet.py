@@ -1,0 +1,66 @@
+"""ResNet on CIFAR-10 (ref models/resnet/Train.scala).
+
+  python examples/train_resnet.py -f ./cifar10 --depth 20 -b 128
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--folder", default="./cifar10")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--depth", type=int, default=20)
+    p.add_argument("--learningRate", type=float, default=0.1)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weightDecay", type=float, default=1e-4)
+    p.add_argument("--maxEpoch", type=int, default=165)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--distributed", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import cifar, DataSet
+    from bigdl_tpu.dataset.image import (
+        ImgNormalizer, ImgToBatch, ImgRdmCropper, HFlip)
+    from bigdl_tpu.models.resnet import ResNetCifar
+    from bigdl_tpu.optim import Optimizer, max_epoch, every_epoch, Top1Accuracy
+    from bigdl_tpu.optim.optim_method import EpochSchedule, EpochStep
+    from bigdl_tpu.utils.table import T
+
+    try:
+        train_data = cifar.load(args.folder, training=True)
+        test_data = cifar.load(args.folder, training=False)
+    except FileNotFoundError:
+        logging.warning("no CIFAR bins in %s — using synthetic data", args.folder)
+        train_data, test_data = cifar.synthetic(2048), cifar.synthetic(512, seed=1)
+
+    norm = ImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)
+    train_ds = (DataSet.array(train_data, distributed=args.distributed)
+                >> norm >> ImgRdmCropper(32, 32, padding=4) >> HFlip()
+                >> ImgToBatch(args.batchSize))
+    test_ds = DataSet.array(test_data) >> norm >> ImgToBatch(args.batchSize)
+
+    model = ResNetCifar(depth=args.depth, class_num=10, shortcut_type="A")
+    optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
+    # the fb.resnet-style 81/122 epoch decay the reference uses
+    optimizer.set_state(T(learningRate=args.learningRate,
+                          momentum=args.momentum,
+                          weightDecay=args.weightDecay,
+                          dampening=0.0,
+                          nesterov=True,
+                          learningRateSchedule=EpochStep(81, 0.1)))
+    optimizer.set_end_when(max_epoch(args.maxEpoch))
+    optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

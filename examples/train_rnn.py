@@ -1,0 +1,76 @@
+"""SimpleRNN character/word language model (ref models/rnn/Train.scala +
+Utils: Dictionary, WordTokenizer, readSentence).
+
+  python examples/train_rnn.py -f input.txt --hiddenSize 40 --bptt 4
+Falls back to a small built-in corpus when the file is missing.
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+FALLBACK_CORPUS = """the quick brown fox jumps over the lazy dog
+a stitch in time saves nine
+all that glitters is not gold
+actions speak louder than words
+practice makes perfect every single day
+the early bird catches the worm
+better late than never they say
+birds of a feather flock together
+"""
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--dataFolder", default="./rnn_corpus.txt")
+    p.add_argument("-b", "--batchSize", type=int, default=4)
+    p.add_argument("--vocabSize", type=int, default=4000)
+    p.add_argument("--hiddenSize", type=int, default=40)
+    p.add_argument("--bptt", type=int, default=4)
+    p.add_argument("--learningRate", type=float, default=0.1)
+    p.add_argument("--maxEpoch", type=int, default=5)
+    p.add_argument("--seqLength", type=int, default=8)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import os
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.text import (
+        Dictionary, WordTokenizer, SentenceToLabeledSentence,
+        LabeledSentenceToSample)
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.models.rnn import SimpleRNN
+    from bigdl_tpu.optim import LocalOptimizer, max_epoch
+    from bigdl_tpu.utils.table import T
+
+    if os.path.exists(args.dataFolder):
+        with open(args.dataFolder) as f:
+            lines = f.readlines()
+    else:
+        logging.warning("no corpus at %s — using built-in sample", args.dataFolder)
+        lines = FALLBACK_CORPUS.strip().split("\n")
+
+    tokenized = list(WordTokenizer()(iter(lines)))
+    dictionary = Dictionary(tokenized, vocab_size=args.vocabSize)
+    vocab = dictionary.vocab_size() + 1  # + OOV bucket
+
+    ds = (DataSet.array(tokenized)
+          >> SentenceToLabeledSentence(dictionary)
+          >> LabeledSentenceToSample(n_input_dims=vocab,
+                                     fixed_length=args.seqLength)
+          >> SampleToBatch(args.batchSize))
+
+    model = SimpleRNN(input_size=vocab, hidden_size=args.hiddenSize,
+                      output_size=vocab, bptt_truncate=args.bptt)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True)
+    opt = LocalOptimizer(model, ds, crit)
+    opt.set_state(T(learningRate=args.learningRate))
+    opt.set_end_when(max_epoch(args.maxEpoch))
+    opt.optimize()
+
+
+if __name__ == "__main__":
+    main()

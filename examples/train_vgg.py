@@ -1,0 +1,60 @@
+"""VGG on CIFAR-10 (ref models/vgg/Train.scala), BASELINE config 2.
+
+  python examples/train_vgg.py -f ./cifar10 -b 128 --maxEpoch 90
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--folder", default="./cifar10")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--learningRate", type=float, default=0.01)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--weightDecay", type=float, default=0.0005)
+    p.add_argument("--maxEpoch", type=int, default=90)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--distributed", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import cifar, DataSet
+    from bigdl_tpu.dataset.image import (
+        ImgNormalizer, ImgToBatch, ImgRdmCropper, HFlip)
+    from bigdl_tpu.models.vgg import VggForCifar10
+    from bigdl_tpu.optim import Optimizer, max_epoch, every_epoch, Top1Accuracy
+    from bigdl_tpu.utils.table import T
+
+    try:
+        train_data = cifar.load(args.folder, training=True)
+        test_data = cifar.load(args.folder, training=False)
+    except FileNotFoundError:
+        logging.warning("no CIFAR bins in %s — using synthetic data", args.folder)
+        train_data, test_data = cifar.synthetic(2048), cifar.synthetic(512, seed=1)
+
+    norm = ImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)
+    train_ds = (DataSet.array(train_data, distributed=args.distributed)
+                >> norm >> ImgRdmCropper(32, 32, padding=4) >> HFlip()
+                >> ImgToBatch(args.batchSize))
+    test_ds = DataSet.array(test_data) >> norm >> ImgToBatch(args.batchSize)
+
+    model = VggForCifar10(class_num=10)
+    optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
+    optimizer.set_state(T(learningRate=args.learningRate,
+                          momentum=args.momentum,
+                          weightDecay=args.weightDecay))
+    optimizer.set_end_when(max_epoch(args.maxEpoch))
+    optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

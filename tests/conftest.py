@@ -8,16 +8,17 @@ TPU attached.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+# NOTE: this image pins JAX_PLATFORMS=axon via sitecustomize before any test
+# code runs, so the env-var route cannot win; jax.config can.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 import pytest
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 # XLA CPU may route f32 matmuls through AMX/bf16; pin full precision so
 # value tests compare against numpy exactly.  (On TPU the default bf16-pass
 # MXU precision is the intended fast path — production code does not set this.)

@@ -1,0 +1,192 @@
+"""Dataset/transformer tests (mirrors reference dataset/ suite: pipelines,
+SampleToBatch padding, batch-size division)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (
+    Sample, MiniBatch, DataSet, LocalArrayDataSet, ShardedDataSet,
+    SampleToBatch,
+)
+from bigdl_tpu.dataset.dataset import get_batch_size
+from bigdl_tpu.dataset.transformer import FuncTransformer, PreFetch, _pad_stack
+from bigdl_tpu.dataset.image import (
+    LabeledImage, ImgNormalizer, ImgCropper, ImgRdmCropper, HFlip,
+    ColorJitter, Lighting, ImgToBatch,
+)
+from bigdl_tpu.dataset import mnist, cifar
+from bigdl_tpu.dataset.text import (
+    Dictionary, WordTokenizer, SentenceToLabeledSentence,
+    LabeledSentenceToSample,
+)
+
+
+def make_samples(n=10, d=4):
+    rng = np.random.RandomState(0)
+    return [Sample(rng.randn(d).astype(np.float32), np.asarray([i % 3 + 1.0]))
+            for i in range(n)]
+
+
+class TestDataSet:
+    def test_local_array_eval_pass(self):
+        ds = LocalArrayDataSet(make_samples(10))
+        assert ds.size() == 10
+        assert len(list(ds.data(train=False))) == 10
+
+    def test_train_loops_forever(self):
+        ds = LocalArrayDataSet(make_samples(4))
+        it = ds.data(train=True)
+        got = [next(it) for _ in range(10)]
+        assert len(got) == 10
+
+    def test_transform_composition(self):
+        ds = (DataSet.array(make_samples(6))
+              >> FuncTransformer(lambda s: s)
+              >> SampleToBatch(2))
+        batches = list(ds.data(train=False))
+        assert len(batches) == 3
+        assert batches[0].data.shape == (2, 4)
+
+    def test_sharded(self):
+        ds = ShardedDataSet(make_samples(10), n_shards=2, shard_index=1)
+        assert ds.size() == 10
+        assert ds.shard_size() == 5
+
+    def test_get_batch_size_divisibility(self):
+        assert get_batch_size(128, 4) == 32
+        with pytest.raises(ValueError):
+            get_batch_size(100, 3)
+
+
+class TestSampleToBatch:
+    def test_basic(self):
+        batches = list(SampleToBatch(4)(iter(make_samples(10))))
+        assert [b.size() for b in batches] == [4, 4, 2]
+
+    def test_drop_last(self):
+        batches = list(SampleToBatch(4, drop_last=True)(iter(make_samples(10))))
+        assert [b.size() for b in batches] == [4, 4]
+
+    def test_padding(self):
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(n, 2).astype(np.float32),
+                          np.arange(n, dtype=np.float32))
+                   for n in (3, 5, 2)]
+        (b,) = SampleToBatch(3, feature_padding=0.0, label_padding=-1)(iter(samples))
+        assert b.data.shape == (3, 5, 2)
+        assert b.labels.shape == (3, 5)
+        assert b.labels[2, 2] == -1  # padded
+        np.testing.assert_allclose(b.data[2, 2:], 0.0)
+
+    def test_fixed_length(self):
+        samples = [Sample(np.ones((3, 2), np.float32), np.ones(3, np.float32))]
+        (b,) = SampleToBatch(1, feature_padding=0.0, label_padding=0,
+                             fixed_length=6)(iter(samples))
+        assert b.data.shape == (1, 6, 2)
+
+
+class TestImagePipeline:
+    def imgs(self, n=4, h=10, w=10, c=3):
+        rng = np.random.RandomState(0)
+        return [LabeledImage(rng.uniform(0, 255, (h, w, c)), i + 1)
+                for i in range(n)]
+
+    def test_normalizer(self):
+        out = list(ImgNormalizer(128.0, 64.0)(iter(self.imgs())))
+        assert out[0].data.mean() < 2.0
+
+    def test_cropper(self):
+        out = list(ImgCropper(6, 4)(iter(self.imgs())))
+        assert out[0].data.shape == (4, 6, 3)
+
+    def test_random_cropper_with_padding(self):
+        out = list(ImgRdmCropper(10, 10, padding=2)(iter(self.imgs())))
+        assert out[0].data.shape == (10, 10, 3)
+
+    def test_hflip_all(self):
+        base = self.imgs(1)[0].data.copy()
+        out = list(HFlip(1.1)(iter(self.imgs(1))))
+        np.testing.assert_allclose(out[0].data, base[:, ::-1])
+
+    def test_color_jitter_and_lighting_run(self):
+        out = list(Lighting()(ColorJitter()(iter(self.imgs()))))
+        assert len(out) == 4
+
+    def test_to_batch_chw(self):
+        (b,) = ImgToBatch(4)(iter(self.imgs()))
+        assert b.data.shape == (4, 3, 10, 10)
+        np.testing.assert_allclose(b.labels, [1, 2, 3, 4])
+
+    def test_grey_to_batch(self):
+        rng = np.random.RandomState(0)
+        imgs = [LabeledImage(rng.randn(8, 8), 1) for _ in range(2)]
+        (b,) = ImgToBatch(2)(iter(imgs))
+        assert b.data.shape == (2, 1, 8, 8)
+
+    def test_normalizer_from_dataset(self):
+        ds = DataSet.array(self.imgs(8))
+        norm = ImgNormalizer.from_dataset(ds)
+        out = list(norm(iter(self.imgs(2))))
+        assert abs(out[0].data.mean()) < 1.0
+
+
+class TestSynthReaders:
+    def test_mnist_synthetic(self):
+        data = mnist.synthetic(16)
+        assert len(data) == 16
+        assert data[0].data.shape == (28, 28)
+        assert 1 <= data[0].label <= 10
+
+    def test_cifar_synthetic(self):
+        data = cifar.synthetic(8)
+        assert data[0].data.shape == (32, 32, 3)
+
+    def test_mnist_idx_roundtrip(self, tmp_path):
+        import struct
+        imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+        labels = np.asarray([3, 7], np.uint8)
+        with open(tmp_path / "train-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 2, 28, 28))
+            f.write(imgs.tobytes())
+        with open(tmp_path / "train-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, 2))
+            f.write(labels.tobytes())
+        data = mnist.load(str(tmp_path), training=True)
+        assert len(data) == 2
+        assert data[0].label == 4.0  # 1-based
+        np.testing.assert_allclose(data[1].data, imgs[1])
+
+
+class TestTextPipeline:
+    def test_dictionary(self):
+        d = Dictionary([["a", "b", "a"], ["a", "c"]], vocab_size=2)
+        assert d.vocab_size() == 2
+        assert d.index("a") == 0
+        assert d.index("zzz") == 2  # OOV bucket
+
+    def test_tokenizer(self):
+        out = list(WordTokenizer()(iter(["Hello, World! don't"])))
+        assert out[0] == ["hello", "world", "don't"]
+
+    def test_lm_pipeline(self):
+        sentences = [["the", "cat", "sat"], ["the", "dog", "ran"]]
+        d = Dictionary(sentences)
+        pipeline = SentenceToLabeledSentence(d)
+        ls = list(pipeline(iter(sentences)))
+        assert ls[0].data_length() == 2
+
+    def test_one_hot_samples(self):
+        sentences = [["a", "b", "c", "d"]]
+        d = Dictionary(sentences)
+        ls = list(SentenceToLabeledSentence(d)(iter(sentences)))
+        samples = list(LabeledSentenceToSample(
+            n_input_dims=d.vocab_size() + 1, fixed_length=5)(iter(ls)))
+        s = samples[0]
+        assert s.feature.shape == (5, 5)
+        assert s.label.shape == (5,)
+        assert s.feature[0, d.index("a")] == 1.0
+
+
+class TestPreFetch:
+    def test_preserves_order(self):
+        out = list(PreFetch(2)(iter(range(20))))
+        assert out == list(range(20))

@@ -1,0 +1,199 @@
+"""Optimizer tests (mirrors reference optim/ suite: SGD/Adagrad/LBFGS
+convergence on toy problems, Trigger units, validation algebra)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.optim import (
+    SGD, Adagrad, LBFGS, Trigger, Top1Accuracy, Top5Accuracy, Loss,
+    AccuracyResult, Metrics,
+)
+from bigdl_tpu.optim.optim_method import Default, Step, Poly, EpochStep
+from bigdl_tpu.optim.trigger import (
+    every_epoch, several_iteration, max_epoch, max_iteration,
+)
+from bigdl_tpu.utils.table import T
+
+
+def quadratic_feval(x):
+    """f = sum((x-3)^2) on a pytree."""
+    loss = sum(((v - 3.0) ** 2).sum() for v in jax.tree_util.tree_leaves(x))
+    grads = jax.tree_util.tree_map(lambda v: 2 * (v - 3.0), x)
+    return loss, grads
+
+
+class TestSGD:
+    def test_converges_on_quadratic(self):
+        x = {"a": jnp.zeros(4), "b": jnp.ones((2, 2))}
+        sgd = SGD()
+        cfg = T(learningRate=0.1)
+        for _ in range(100):
+            x, _ = sgd.optimize(quadratic_feval, x, cfg, cfg)
+        for v in jax.tree_util.tree_leaves(x):
+            np.testing.assert_allclose(v, 3.0, atol=1e-3)
+
+    def test_momentum_faster_than_plain(self):
+        def run(cfg):
+            x = {"a": jnp.zeros(4)}
+            sgd = SGD()
+            for _ in range(30):
+                x, hist = sgd.optimize(quadratic_feval, x, cfg, cfg)
+            return float(quadratic_feval(x)[0])
+
+        plain = run(T(learningRate=0.02))
+        mom = run(T(learningRate=0.02, momentum=0.9, dampening=0.0))
+        assert mom < plain
+
+    def test_weight_decay_pulls_to_zero(self):
+        x = {"a": jnp.ones(4) * 5}
+        sgd = SGD()
+        cfg = T(learningRate=0.1, weightDecay=1.0)
+
+        def zero_grad(x):
+            return 0.0, jax.tree_util.tree_map(jnp.zeros_like, x)
+
+        for _ in range(50):
+            x, _ = sgd.optimize(zero_grad, x, cfg, cfg)
+        assert float(jnp.abs(x["a"]).max()) < 0.05
+
+    def test_pure_update_matches_optimize(self):
+        x0 = {"a": jnp.asarray([0.0, 1.0])}
+        sgd = SGD()
+        cfg = T(learningRate=0.1, momentum=0.9, dampening=0.0)
+        xt = x0
+        for _ in range(5):
+            xt, _ = sgd.optimize(quadratic_feval, xt, cfg, cfg)
+        xp = x0
+        st = sgd.init_state(x0)
+        hyper = {"lr": 0.1, "momentum": 0.9, "dampening": 0.0}
+        for _ in range(5):
+            _, g = quadratic_feval(xp)
+            xp, st = sgd.update(g, st, xp, hyper)
+        np.testing.assert_allclose(xt["a"], xp["a"], rtol=1e-5)
+
+
+class TestSchedules:
+    def test_default_decay(self):
+        cfg = T(learningRate=1.0, learningRateDecay=0.1)
+        st = T(evalCounter=10)
+        Default().update_hyper_parameter(cfg, st)
+        assert cfg["currentLearningRate"] == pytest.approx(-0.5)
+
+    def test_step(self):
+        cfg = T(learningRate=1.0)
+        st = T(evalCounter=25)
+        Step(10, 0.5).update_hyper_parameter(cfg, st)
+        assert cfg["currentLearningRate"] == pytest.approx(-0.25)
+
+    def test_poly(self):
+        cfg = T(learningRate=1.0)
+        st = T(evalCounter=50)
+        Poly(0.5, 100).update_hyper_parameter(cfg, st)
+        assert cfg["currentLearningRate"] == pytest.approx(-np.sqrt(0.5), rel=1e-5)
+
+    def test_epoch_step(self):
+        cfg = T(learningRate=1.0)
+        st = T(epoch=5)
+        EpochStep(2, 0.1).update_hyper_parameter(cfg, st)
+        assert cfg["currentLearningRate"] == pytest.approx(-0.01)
+
+
+class TestAdagrad:
+    def test_converges(self):
+        x = {"a": jnp.zeros(4)}
+        ag = Adagrad()
+        cfg = T(learningRate=1.0)
+        for _ in range(200):
+            x, _ = ag.optimize(quadratic_feval, x, cfg, cfg)
+        np.testing.assert_allclose(x["a"], 3.0, atol=1e-2)
+
+
+class TestLBFGS:
+    def test_quadratic_one_call(self):
+        x = {"a": jnp.zeros(6)}
+        lb = LBFGS()
+        cfg = T(maxIter=20)
+        x, hist = lb.optimize(quadratic_feval, x, cfg, cfg)
+        np.testing.assert_allclose(x["a"], 3.0, atol=1e-4)
+        assert hist[-1] < hist[0]
+
+    def test_rosenbrock(self):
+        def feval(x):
+            v = x["v"]
+            a, b = v[0], v[1]
+            loss = (1 - a) ** 2 + 100 * (b - a * a) ** 2
+            g = jax.grad(lambda w: (1 - w[0]) ** 2 + 100 * (w[1] - w[0] ** 2) ** 2)(v)
+            return loss, {"v": g}
+
+        x = {"v": jnp.zeros(2)}
+        lb = LBFGS()
+        cfg = T(maxIter=100)
+        x, hist = lb.optimize(feval, x, cfg, cfg)
+        np.testing.assert_allclose(np.asarray(x["v"]), [1.0, 1.0], atol=1e-2)
+
+
+class TestTriggers:
+    def test_max_epoch(self):
+        t = max_epoch(3)
+        assert not t(T(epoch=3))
+        assert t(T(epoch=4))
+
+    def test_max_iteration(self):
+        t = max_iteration(5)
+        assert not t(T(neval=5))
+        assert t(T(neval=6))
+
+    def test_every_epoch_fires_on_change(self):
+        t = every_epoch()
+        assert t(T(epoch=1))
+        assert not t(T(epoch=1))
+        assert t(T(epoch=2))
+
+    def test_several_iteration(self):
+        t = several_iteration(3)
+        assert not t(T(neval=1))
+        assert t(T(neval=3))
+        assert not t(T(neval=4))
+        assert t(T(neval=6))
+
+
+class TestValidation:
+    def test_top1(self):
+        out = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        tgt = jnp.asarray([2, 1, 1])
+        r = Top1Accuracy()(out, tgt)
+        assert r.correct == 2 and r.count == 3
+
+    def test_top5(self):
+        out = jnp.asarray(np.eye(6, dtype=np.float32))
+        tgt = jnp.asarray([1, 2, 3, 4, 5, 6])
+        r = Top5Accuracy()(out, tgt)
+        assert r.correct == 6
+
+    def test_result_algebra(self):
+        r = AccuracyResult(3, 10) + AccuracyResult(7, 10)
+        assert r.result() == (0.5, 20)
+
+    def test_loss_method(self):
+        import bigdl_tpu.nn as nn
+        m = Loss(nn.MSECriterion())
+        r = m(jnp.ones((4, 2)), jnp.zeros((4, 2)))
+        val, n = r.result()
+        assert val == pytest.approx(1.0)
+        assert n == 4
+
+
+class TestMetrics:
+    def test_set_add_mean_summary(self):
+        m = Metrics()
+        m.add("phase", 1.0)
+        m.add("phase", 3.0)
+        assert m.mean("phase") == pytest.approx(2.0)
+        assert "phase" in m.summary()
+
+    def test_timer(self):
+        m = Metrics()
+        with m.timer("t"):
+            pass
+        assert m.get("t")[1] == 1

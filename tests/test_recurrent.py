@@ -1,0 +1,118 @@
+"""Recurrence tests (ref GradientCheckerRNN + rnn specs)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Context
+
+
+def randn(*shape, seed=13):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape), jnp.float32)
+
+
+def test_rnn_shapes():
+    m = nn.Recurrent().add(nn.RnnCell(5, 7))
+    y = m.forward(randn(2, 4, 5))
+    assert y.shape == (2, 4, 7)
+
+
+def test_rnn_matches_manual_loop():
+    cell = nn.RnnCell(3, 4)
+    m = nn.Recurrent().add(cell)
+    x = randn(1, 5, 3)
+    y = np.asarray(m.forward(x))
+    # manual unroll
+    P = cell.params()["~"]
+    h = np.zeros((1, 4), np.float32)
+    for t in range(5):
+        pre = (np.asarray(x[:, t]) @ np.asarray(P["i2h"]).T + np.asarray(P["bias_i"]) +
+               h @ np.asarray(P["h2h"]).T + np.asarray(P["bias_h"]))
+        h = np.tanh(pre)
+        np.testing.assert_allclose(y[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_shapes_and_gates():
+    m = nn.Recurrent().add(nn.LSTMCell(5, 6))
+    y = m.forward(randn(3, 7, 5))
+    assert y.shape == (3, 7, 6)
+    assert np.all(np.abs(np.asarray(y)) <= 1.0)  # h = sig * tanh bounded
+
+
+def test_gru_shapes():
+    m = nn.Recurrent().add(nn.GRUCell(5, 6))
+    assert m.forward(randn(3, 7, 5)).shape == (3, 7, 6)
+
+
+def test_birecurrent_concat():
+    m = nn.BiRecurrent(nn.LSTMCell(4, 5), nn.LSTMCell(4, 5))
+    y = m.forward(randn(2, 6, 4))
+    assert y.shape == (2, 6, 10)
+
+
+def test_reverse_recurrent_flips():
+    cell = nn.RnnCell(3, 4)
+    fwd = nn.Recurrent().add(cell)
+    bwd = nn.Recurrent(reverse=True).add(cell)
+    x = randn(1, 5, 3)
+    yf = np.asarray(fwd.forward(x))
+    yb = np.asarray(bwd.forward(jnp.flip(x, axis=1)))
+    np.testing.assert_allclose(yf, yb[:, ::-1], rtol=1e-4, atol=1e-5)
+
+
+def test_bptt_truncation_stops_gradient():
+    """With truncation k, d loss(t<k) / d x(0) flows but gradients across
+    chunk boundaries are cut."""
+    x = randn(1, 8, 3)
+
+    def grad_wrt_x0(bptt):
+        m = nn.Recurrent(bptt_truncate=bptt).add(nn.RnnCell(3, 4))
+        params, state = m.params(), m.state()
+
+        def f(xin):
+            y, _ = m.apply(params, xin, state, Context(False, jax.random.PRNGKey(0)))
+            return y[:, -1].sum()  # loss at final timestep
+
+        return np.asarray(jax.grad(f)(x))[0, 0]
+
+    g_full = grad_wrt_x0(0)
+    g_trunc = grad_wrt_x0(4)
+    assert np.abs(g_full).max() > 0
+    np.testing.assert_allclose(g_trunc, 0.0, atol=1e-8)  # cut at boundary
+
+
+def test_time_distributed():
+    m = nn.TimeDistributed(nn.Linear(4, 2))
+    y = m.forward(randn(3, 5, 4))
+    assert y.shape == (3, 5, 2)
+
+
+def test_simple_rnn_model():
+    from bigdl_tpu.models.rnn import SimpleRNN
+    m = SimpleRNN(input_size=20, hidden_size=8, output_size=20)
+    y = m.forward(randn(2, 6, 20))
+    assert y.shape == (2, 6, 20)
+    np.testing.assert_allclose(np.exp(np.asarray(y)).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_bilstm_classifier():
+    from bigdl_tpu.models.rnn import BiLSTMClassifier
+    m = BiLSTMClassifier(10, 8, 5)
+    y = m.forward(randn(3, 6, 10))
+    assert y.shape == (3, 5)
+
+
+def test_recurrent_grad_flows_through_scan():
+    m = nn.Recurrent().add(nn.LSTMCell(3, 4))
+    x = randn(2, 5, 3)
+    params, state = m.params(), m.state()
+
+    def f(p):
+        y, _ = m.apply(p, x, state, Context(False, jax.random.PRNGKey(0)))
+        return (y ** 2).sum()
+
+    grads = jax.grad(f)(params)
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)

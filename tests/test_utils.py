@@ -1,0 +1,146 @@
+"""Utils tests (mirrors reference utils/ suite: Table, File round-trip,
+RandomGenerator determinism, TorchFile round-trip)."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.random import RandomGenerator, set_seed, RNG
+from bigdl_tpu.utils import file as File
+from bigdl_tpu.utils import torch_file
+import bigdl_tpu.nn as nn
+
+
+class TestTable:
+    def test_builder_1based(self):
+        t = T("a", "b", x=3)
+        assert t[1] == "a" and t[2] == "b" and t["x"] == 3
+        assert t.length() == 2
+
+    def test_insert_remove(self):
+        t = T(1, 2, 3)
+        t.insert(2, 99)
+        assert list(t) == [1, 99, 2, 3]
+        assert t.remove(2) == 99
+        assert list(t) == [1, 2, 3]
+        assert t.remove() == 3
+
+    def test_pytree(self):
+        import jax
+        t = T(jnp.ones(2), x=jnp.zeros(3))
+        leaves = jax.tree_util.tree_leaves(t)
+        assert len(leaves) == 2
+        t2 = jax.tree_util.tree_map(lambda v: v + 1, t)
+        np.testing.assert_allclose(t2[1], 2.0)
+        np.testing.assert_allclose(t2["x"], 1.0)
+
+    def test_eq_copy(self):
+        t = T(1, 2)
+        assert t == t.copy()
+
+
+class TestRandomGenerator:
+    def test_seeded_determinism(self):
+        a = RandomGenerator(42).uniform(0, 1, 5)
+        b = RandomGenerator(42).uniform(0, 1, 5)
+        np.testing.assert_allclose(a, b)
+
+    def test_randperm_1based(self):
+        p = RandomGenerator(1).randperm(10)
+        assert sorted(p) == list(range(1, 11))
+
+    def test_set_seed_reproduces_model_init(self):
+        set_seed(5)
+        w1 = np.asarray(nn.Linear(4, 4)._params["weight"])
+        set_seed(5)
+        w2 = np.asarray(nn.Linear(4, 4)._params["weight"])
+        np.testing.assert_allclose(w1, w2)
+
+    def test_key_stream_distinct(self):
+        k1, k2 = RNG.next_key(), RNG.next_key()
+        assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+
+
+class TestFile:
+    def test_pytree_roundtrip(self, tmp_path):
+        obj = {"a": jnp.ones((2, 3)), "b": [1, "x"], "t": T(jnp.zeros(2))}
+        p = str(tmp_path / "obj.bin")
+        File.save(obj, p)
+        back = File.load(p)
+        np.testing.assert_allclose(back["a"], 1.0)
+        assert back["b"] == [1, "x"]
+
+    def test_module_roundtrip(self, tmp_path):
+        m = nn.Sequential(nn.Linear(3, 4), nn.BatchNormalization(4))
+        m.forward(jnp.ones((8, 3)))  # populate BN stats
+        p = str(tmp_path / "model.bin")
+        File.save_module(m, p)
+        set_seed(99)
+        m2 = nn.Sequential(nn.Linear(3, 4), nn.BatchNormalization(4))
+        File.load_module_into(m2, p)
+        for a, b in zip(m.parameters()[0], m2.parameters()[0]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        np.testing.assert_allclose(
+            m._modules["1"]._buffers["running_mean"],
+            m2._modules["1"]._buffers["running_mean"])
+
+    def test_no_overwrite(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        File.save({"x": 1}, p)
+        with pytest.raises(FileExistsError):
+            File.save({"x": 2}, p, overwrite=False)
+
+
+class TestTorchFile:
+    def test_tensor_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        arr = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        torch_file.save(arr, p)
+        back = torch_file.load(p)
+        np.testing.assert_allclose(back, arr)
+
+    def test_double_tensor(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        arr = np.random.RandomState(0).randn(5).astype(np.float64)
+        torch_file.save(arr, p)
+        assert torch_file.load(p).dtype == np.float64
+
+    def test_table_roundtrip(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        torch_file.save({1: 1.5, 2: "hello", "key": True}, p)
+        back = torch_file.load(p)
+        assert back[1] == 1.5
+        assert back[2] == "hello"
+        assert back["key"] is True
+
+    def test_nested(self, tmp_path):
+        p = str(tmp_path / "t.t7")
+        inner = np.ones((2, 2), np.float32)
+        torch_file.save({1: {1: inner}}, p)
+        back = torch_file.load(p)
+        np.testing.assert_allclose(back[1][1], inner)
+
+    def test_load_module_weights(self, tmp_path):
+        """Emulate a saved Torch nn.Sequential{Linear,Linear} and load it."""
+        p = str(tmp_path / "m.t7")
+        w1 = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+        b1 = np.zeros(4, np.float32)
+        w2 = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+        b2 = np.ones(2, np.float32)
+        # write a fake torch object graph via the writer's table support +
+        # manual torch_typename markers
+        blob = {
+            "torch_typename": "nn.Sequential",
+            "modules": {1: {"torch_typename": "nn.Linear", "weight": w1, "bias": b1},
+                        2: {"torch_typename": "nn.Linear", "weight": w2, "bias": b2}},
+        }
+        # emulate: reader produces dicts with torch_typename; bypass file IO
+        model = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        mods = list(torch_file._iter_torch_modules(blob))
+        assert len(mods) == 2
+        # full path through load_module_weights requires a .t7; patch via save
+        torch_file.save({"modules": {1: {"torch_typename": "nn.Linear", "weight": w1, "bias": b1},
+                                     2: {"torch_typename": "nn.Linear", "weight": w2, "bias": b2}}}, p)
+        torch_file.load_module_weights(model, p)
+        np.testing.assert_allclose(np.asarray(model.get(1)._params["weight"]), w1)
+        np.testing.assert_allclose(np.asarray(model.get(3)._params["bias"]), b2)
