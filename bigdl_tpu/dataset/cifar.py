@@ -17,10 +17,10 @@ TRAIN_STD = (0.24703223517429462 * 255, 0.2434851308749409 * 255, 0.261587844420
 
 
 def load_bin(path):
-    raw = np.fromfile(path, np.uint8).reshape(-1, 3073)
-    labels = raw[:, 0].astype(np.float32)
-    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32)
-    return imgs, labels
+    from bigdl_tpu import native
+    raw = np.fromfile(path, np.uint8)
+    labels1, imgs = native.cifar_decode(raw)  # native or numpy fallback
+    return imgs, labels1 - 1.0
 
 
 def load(folder, training: bool = True):

@@ -76,15 +76,21 @@ def _resize(arr, h, w):
 
 class ImgNormalizer(Transformer):
     """Subtract mean, divide std, per channel (ref BGRImgNormalizer /
-    GreyImgNormalizer).  Means/stds are scalars or per-channel tuples."""
+    GreyImgNormalizer).  Means/stds are scalars or per-channel tuples.
+    Routes through the native hostops kernel when built (numpy fallback)."""
 
     def __init__(self, mean, std):
         self.mean = np.asarray(mean, np.float32)
         self.std = np.asarray(std, np.float32)
 
     def __call__(self, iterator):
+        from bigdl_tpu import native
+        use_native = native.is_loaded()
         for img in iterator:
-            img.data = (img.data - self.mean) / self.std
+            if use_native and img.data.ndim == 3 and self.mean.ndim <= 1:
+                img.data = native.normalize(img.data, self.mean, self.std)
+            else:
+                img.data = (img.data - self.mean) / self.std
             yield img
 
     @staticmethod
@@ -232,13 +238,14 @@ class ImgToBatch(Transformer):
         self.to_chw = to_chw
 
     def __call__(self, iterator):
+        from bigdl_tpu import native
         buf_x, buf_y = [], []
         for img in iterator:
             d = img.data
             if d.ndim == 2:
                 d = d[None]  # grey -> (1, H, W)
             elif self.to_chw:
-                d = np.transpose(d, (2, 0, 1))
+                d = native.hwc_to_chw(d)
             buf_x.append(d)
             buf_y.append(img.label)
             if len(buf_x) == self.batch_size:

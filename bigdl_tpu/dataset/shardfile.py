@@ -1,0 +1,124 @@
+"""Packed record shards — the Hadoop SequenceFile role
+(ref dataset/DataSet.SeqFileFolder :384-455 and
+models/utils/ImageNetSeqFileGenerator.scala: pre-pack many small image files
+into large sequential shards so training reads streams, not inodes).
+
+Format (little-endian):
+  header: magic b"BDTS" | u32 version | u64 record count
+  record: u32 label_len | label bytes (utf-8, e.g. "1012") |
+          u32 data_len  | data bytes (encoded image or raw array)
+
+``write_shards`` packs (key, bytes) pairs into N shards round-robin;
+``ShardFolder`` reads a directory of shards as a ByteRecord dataset
+(shardable across processes).
+"""
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import ByteRecord
+from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet, DataSet
+
+MAGIC = b"BDTS"
+VERSION = 1
+
+
+def write_shard(records, path):
+    """records: iterable of (label: float|str, data: bytes)."""
+    tmp = path + ".tmp"
+    n = 0
+    with open(tmp, "wb") as f:
+        f.write(MAGIC + struct.pack("<IQ", VERSION, 0))
+        for label, data in records:
+            key = str(label).encode()
+            f.write(struct.pack("<I", len(key)) + key)
+            f.write(struct.pack("<I", len(data)) + data)
+            n += 1
+        f.seek(len(MAGIC) + 4)
+        f.write(struct.pack("<Q", n))
+    os.replace(tmp, path)
+    return n
+
+
+def write_shards(records, out_dir, n_shards: int = 8, prefix: str = "shard"):
+    """Round-robin pack records into ``n_shards`` files
+    (the ImageNetSeqFileGenerator role)."""
+    os.makedirs(out_dir, exist_ok=True)
+    buckets = [[] for _ in range(n_shards)]
+    for i, rec in enumerate(records):
+        buckets[i % n_shards].append(rec)
+    paths = []
+    for i, bucket in enumerate(buckets):
+        p = os.path.join(out_dir, f"{prefix}-{i:05d}.bdts")
+        write_shard(bucket, p)
+        paths.append(p)
+    return paths
+
+
+def read_shard(path):
+    """Yield ByteRecord from one shard file."""
+    with open(path, "rb") as f:
+        head = f.read(len(MAGIC) + 12)
+        assert head[:4] == MAGIC, f"bad shard magic in {path}"
+        version, count = struct.unpack("<IQ", head[4:])
+        assert version == VERSION
+        for _ in range(count):
+            (klen,) = struct.unpack("<I", f.read(4))
+            key = f.read(klen).decode()
+            (dlen,) = struct.unpack("<I", f.read(4))
+            data = f.read(dlen)
+            try:
+                label = float(key)
+            except ValueError:
+                label = key
+            yield ByteRecord(data, label)
+
+
+class ShardFolder(LocalDataSet):
+    """Dataset over a directory of .bdts shards.  ``distributed=True``
+    assigns whole shards to processes (the partition-per-node layout of
+    CachedDistriDataSet)."""
+
+    def __init__(self, folder, distributed: bool = False):
+        import jax
+        self.paths = sorted(
+            os.path.join(folder, f) for f in os.listdir(folder)
+            if f.endswith(".bdts"))
+        if not self.paths:
+            raise FileNotFoundError(f"no .bdts shards under {folder}")
+        self._counts = []
+        for p in self.paths:
+            with open(p, "rb") as f:
+                head = f.read(len(MAGIC) + 12)
+                self._counts.append(struct.unpack("<IQ", head[4:])[1])
+        if distributed:
+            idx, nproc = jax.process_index(), jax.process_count()
+            self.local_paths = self.paths[idx::nproc]
+        else:
+            self.local_paths = list(self.paths)
+        self._order = list(range(len(self.local_paths)))
+
+    def size(self):
+        return sum(self._counts)
+
+    def shuffle(self):
+        from bigdl_tpu.utils.random import RNG
+        RNG.shuffle(self._order)
+        return self
+
+    def data(self, train: bool):
+        if train:
+            def looped():
+                while True:
+                    self.shuffle()
+                    for i in self._order:
+                        yield from read_shard(self.local_paths[i])
+            return looped()
+
+        def once():
+            for p in self.local_paths:
+                yield from read_shard(p)
+        return once()

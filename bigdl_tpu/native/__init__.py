@@ -1,0 +1,141 @@
+"""Native host-ops loader (the MKL.java role: build/extract + load + probe,
+ref native/jni/.../MKL.java:25-63 ``isMKLLoaded``).
+
+``lib()`` returns the ctypes library, building it with g++ on first use if
+needed; every wrapper falls back to numpy when unavailable — the
+reference's managed-fallback seam (DenseTensorMath MKL gates)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "hostops.cpp")
+_SO = os.path.join(_DIR, "libhostops.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def lib():
+    """The loaded library, or None (numpy fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            candidate = ctypes.CDLL(_SO)
+            if candidate.hostops_version() != 1:
+                return None
+            _lib = candidate
+        except Exception:
+            _lib = None
+    return _lib
+
+
+def is_loaded() -> bool:
+    return lib() is not None
+
+
+def _fp(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u8p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def normalize(img: np.ndarray, mean, std) -> np.ndarray:
+    """(x - mean) / std per channel over an HWC (or HW) image."""
+    img = np.ascontiguousarray(img, np.float32)
+    c = img.shape[-1] if img.ndim == 3 else 1
+    mean = np.ascontiguousarray(np.broadcast_to(np.asarray(mean, np.float32), (c,)))
+    std = np.ascontiguousarray(np.broadcast_to(np.asarray(std, np.float32), (c,)))
+    l = lib()
+    if l is None:
+        return (img - mean.reshape((1,) * (img.ndim - 1) + (c,))
+                if img.ndim == 3 else img - mean[0]) / (
+            std.reshape((1,) * (img.ndim - 1) + (c,)) if img.ndim == 3 else std[0])
+    out = np.empty_like(img)
+    l.hostops_normalize(_fp(img), _fp(out), ctypes.c_int64(img.size),
+                        _fp(mean), _fp(std), ctypes.c_int64(c))
+    return out
+
+
+def hwc_to_chw(img: np.ndarray) -> np.ndarray:
+    img = np.ascontiguousarray(img, np.float32)
+    l = lib()
+    if l is None or img.ndim != 3:
+        return np.transpose(img, (2, 0, 1)).copy() if img.ndim == 3 else img
+    h, w, c = img.shape
+    out = np.empty((c, h, w), np.float32)
+    l.hostops_hwc_to_chw(_fp(img), _fp(out), ctypes.c_int64(h),
+                         ctypes.c_int64(w), ctypes.c_int64(c))
+    return out
+
+
+def hflip(img: np.ndarray) -> np.ndarray:
+    img = np.ascontiguousarray(img, np.float32)
+    l = lib()
+    if l is None or img.ndim != 3:
+        return img[:, ::-1].copy()
+    h, w, c = img.shape
+    out = np.empty_like(img)
+    l.hostops_hflip(_fp(img), _fp(out), ctypes.c_int64(h), ctypes.c_int64(w),
+                    ctypes.c_int64(c))
+    return out
+
+
+def crop(img: np.ndarray, y0: int, x0: int, ch: int, cw: int) -> np.ndarray:
+    img = np.ascontiguousarray(img, np.float32)
+    l = lib()
+    if l is None or img.ndim != 3:
+        return img[y0:y0 + ch, x0:x0 + cw].copy()
+    h, w, c = img.shape
+    out = np.empty((ch, cw, c), np.float32)
+    l.hostops_crop(_fp(img), _fp(out), ctypes.c_int64(h), ctypes.c_int64(w),
+                   ctypes.c_int64(c), ctypes.c_int64(y0), ctypes.c_int64(x0),
+                   ctypes.c_int64(ch), ctypes.c_int64(cw))
+    return out
+
+
+def cifar_decode(raw: np.ndarray):
+    """n CIFAR records -> (labels 1-based f32 (n,), images HWC f32 (n,32,32,3))."""
+    raw = np.ascontiguousarray(raw, np.uint8).reshape(-1)
+    n = raw.size // 3073
+    l = lib()
+    if l is None:
+        rec = raw.reshape(n, 3073)
+        labels = rec[:, 0].astype(np.float32) + 1.0
+        imgs = rec[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1).astype(np.float32)
+        return labels, imgs
+    labels = np.empty(n, np.float32)
+    images = np.empty((n, 32, 32, 3), np.float32)
+    l.hostops_cifar_decode(_u8p(raw), _fp(labels), _fp(images), ctypes.c_int64(n))
+    return labels, images
+
+
+def u8_to_f32(raw: np.ndarray, scale: float = 1.0, shift: float = 0.0) -> np.ndarray:
+    raw = np.ascontiguousarray(raw, np.uint8)
+    l = lib()
+    if l is None:
+        return raw.astype(np.float32) * scale + shift
+    out = np.empty(raw.shape, np.float32)
+    l.hostops_u8_to_f32(_u8p(raw), _fp(out), ctypes.c_int64(raw.size),
+                        ctypes.c_float(scale), ctypes.c_float(shift))
+    return out

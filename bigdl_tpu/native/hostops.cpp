@@ -1,0 +1,109 @@
+// hostops — native host-side data-path kernels.
+//
+// Role in the framework: the reference keeps a native C layer for its
+// hot loops (native/mkl/src/main/c/jni/mkl.c — vector math + BLAS behind
+// JNI, with a pure-JVM fallback when the .so is missing).  On TPU the
+// *device* hot loops belong to XLA; what remains hot on the HOST is the
+// input pipeline (decode/normalize/augment feeding HBM).  This library is
+// that layer: C++ + OpenMP kernels exported with a plain C ABI, loaded via
+// ctypes (bigdl_tpu/native/__init__.py), with numpy fallbacks when the
+// library has not been built — the same graceful-degradation seam as
+// MKL.isMKLLoaded (MKL.java:46-63).
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC hostops.cpp -o libhostops.so
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+
+extern "C" {
+
+// normalize: out[i] = (in[i] - mean[i % c]) / std[i % c]
+// (the BGRImgNormalizer hot loop; c = channel count for HWC layout)
+void hostops_normalize(const float* in, float* out, int64_t n,
+                       const float* mean, const float* stddev, int64_t c) {
+#pragma omp parallel for
+    for (int64_t i = 0; i < n; ++i) {
+        const int64_t ch = i % c;
+        out[i] = (in[i] - mean[ch]) / stddev[ch];
+    }
+}
+
+// u8 -> f32 with scale + shift (image decode postprocessing)
+void hostops_u8_to_f32(const uint8_t* in, float* out, int64_t n,
+                       float scale, float shift) {
+#pragma omp parallel for
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = in[i] * scale + shift;
+    }
+}
+
+// HWC crop: src (h, w, c) -> dst (ch, cw, c) starting at (y0, x0)
+void hostops_crop(const float* src, float* dst, int64_t h, int64_t w,
+                  int64_t c, int64_t y0, int64_t x0, int64_t ch, int64_t cw) {
+#pragma omp parallel for
+    for (int64_t y = 0; y < ch; ++y) {
+        std::memcpy(dst + y * cw * c, src + ((y0 + y) * w + x0) * c,
+                    sizeof(float) * cw * c);
+    }
+}
+
+// horizontal flip, HWC in place-safe (src != dst)
+void hostops_hflip(const float* src, float* dst, int64_t h, int64_t w,
+                   int64_t c) {
+#pragma omp parallel for
+    for (int64_t y = 0; y < h; ++y) {
+        for (int64_t x = 0; x < w; ++x) {
+            std::memcpy(dst + (y * w + x) * c,
+                        src + (y * w + (w - 1 - x)) * c, sizeof(float) * c);
+        }
+    }
+}
+
+// HWC -> CHW transpose for a batch member (the ImgToBatch hot loop)
+void hostops_hwc_to_chw(const float* src, float* dst, int64_t h, int64_t w,
+                        int64_t c) {
+#pragma omp parallel for
+    for (int64_t k = 0; k < c; ++k) {
+        for (int64_t y = 0; y < h; ++y) {
+            for (int64_t x = 0; x < w; ++x) {
+                dst[(k * h + y) * w + x] = src[(y * w + x) * c + k];
+            }
+        }
+    }
+}
+
+// batched idx-ubyte (MNIST) decode: n images of rows*cols u8 -> f32
+void hostops_idx_decode(const uint8_t* in, float* out, int64_t n,
+                        int64_t px) {
+#pragma omp parallel for
+    for (int64_t i = 0; i < n * px; ++i) {
+        out[i] = static_cast<float>(in[i]);
+    }
+}
+
+// CIFAR binary record batch: n records of (1 label + 3072 CHW u8)
+// -> labels f32 (1-based), images f32 HWC
+void hostops_cifar_decode(const uint8_t* in, float* labels, float* images,
+                          int64_t n) {
+    const int64_t rec = 3073, hw = 1024;
+#pragma omp parallel for
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* r = in + i * rec;
+        labels[i] = static_cast<float>(r[0]) + 1.0f;
+        float* img = images + i * 3072;
+        // CHW planes -> HWC
+        for (int64_t y = 0; y < 32; ++y) {
+            for (int64_t x = 0; x < 32; ++x) {
+                const int64_t p = y * 32 + x;
+                img[p * 3 + 0] = static_cast<float>(r[1 + p]);
+                img[p * 3 + 1] = static_cast<float>(r[1 + hw + p]);
+                img[p * 3 + 2] = static_cast<float>(r[1 + 2 * hw + p]);
+            }
+        }
+    }
+}
+
+int hostops_version() { return 1; }
+
+}  // extern "C"

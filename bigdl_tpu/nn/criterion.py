@@ -34,7 +34,7 @@ class ClassNLLCriterion(Criterion):
     def apply_loss(self, input, target):
         if input.ndim == 1:
             input = input[None]
-            target = jnp.reshape(target, (1,))
+        target = jnp.reshape(target, (input.shape[0],))  # accept (B,) or (B,1)
         idx = jnp.asarray(target, jnp.int32) - 1
         picked = jnp.take_along_axis(input, idx[:, None], axis=1)[:, 0]
         if self.weights is not None:
@@ -288,9 +288,9 @@ class MultiMarginCriterion(Criterion):
 
     def apply_loss(self, input, target):
         if input.ndim == 1:
-            input, target = input[None], jnp.reshape(target, (1,))
+            input = input[None]
         n, d = input.shape
-        idx = jnp.asarray(target, jnp.int32) - 1
+        idx = jnp.asarray(jnp.reshape(target, (n,)), jnp.int32) - 1
         x_y = jnp.take_along_axis(input, idx[:, None], axis=1)  # (n,1)
         m = jnp.maximum(0.0, self.margin - x_y + input) ** self.p
         if self.weights is not None:

@@ -1,0 +1,1 @@
+from bigdl_tpu.ops import pallas_kernels

@@ -1,0 +1,100 @@
+"""Pallas TPU kernels (see /opt/skills/guides/pallas_guide.md).
+
+The device-side hot loops of the reference's native layer (mkl.c vector
+math / axpy / scal) compile through XLA; Pallas covers the cases where
+hand-fusion still wins:
+
+- ``fused_sgd``: momentum-SGD parameter update as ONE pass over HBM
+  (read p, g, v -> write p', v').  The unfused update streams the tensors
+  multiple times; for the flat multi-MB parameter vector of a large model
+  this is pure HBM bandwidth, exactly the regime a fused elementwise
+  kernel owns.  The reference's analogue is the fp16-compressed parallel
+  update loop (FP16CompressedTensor.parallel add/scal).
+
+On non-TPU backends the kernels run through the Pallas interpreter
+(``interpret=True``) so tests exercise the same code path on the CPU mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_BLOCK = 64 * 1024  # elements per grid step (256 KiB f32 — fits VMEM easily)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _sgd_kernel(p_ref, g_ref, v_ref, h_ref, p_out, v_out):
+    """v' = mom * v + (g + wd * p); p' = p - lr * v' (one VMEM pass).
+    h_ref holds [lr, momentum, weight_decay] in SMEM."""
+    lr = h_ref[0]
+    mom = h_ref[1]
+    wd = h_ref[2]
+    g = g_ref[:] + wd * p_ref[:]
+    v_new = mom * v_ref[:] + g
+    v_out[:] = v_new
+    p_out[:] = p_ref[:] - lr * v_new
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_sgd_flat(p, g, v, hyper3, interpret=False):
+    n = p.shape[0]
+    # pad to a whole number of blocks (grid must be static)
+    padded = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    if padded != n:
+        pad = padded - n
+        p = jnp.concatenate([p, jnp.zeros(pad, p.dtype)])
+        g = jnp.concatenate([g, jnp.zeros(pad, g.dtype)])
+        v = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
+    grid = padded // _BLOCK
+    p2, v2 = pl.pallas_call(
+        _sgd_kernel,
+        out_shape=(jax.ShapeDtypeStruct((padded,), p.dtype),
+                   jax.ShapeDtypeStruct((padded,), v.dtype)),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((_BLOCK,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_BLOCK,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(p, g, v, hyper3)
+    return p2[:n], v2[:n]
+
+
+def fused_sgd(params, grads, velocity, lr, momentum=0.0, weight_decay=0.0):
+    """Fused momentum-SGD update over pytrees.
+
+    Flattens each leaf to 1D and runs the single-pass Pallas kernel;
+    returns (new_params, new_velocity).  Uses the interpreter off-TPU.
+    """
+    interpret = not _on_tpu()
+    hyper3 = jnp.asarray([lr, momentum, weight_decay], jnp.float32)
+
+    def leaf(p, g, v):
+        shape = p.shape
+        p2, v2 = _fused_sgd_flat(p.reshape(-1), g.reshape(-1), v.reshape(-1),
+                                 hyper3, interpret=interpret)
+        return p2.reshape(shape), v2.reshape(shape)
+
+    flat = jax.tree_util.tree_map(leaf, params, grads, velocity)
+    new_p = jax.tree_util.tree_map(lambda pv: pv[0], flat,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    new_v = jax.tree_util.tree_map(lambda pv: pv[1], flat,
+                                   is_leaf=lambda x: isinstance(x, tuple))
+    return new_p, new_v

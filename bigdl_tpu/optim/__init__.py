@@ -16,6 +16,7 @@ from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.local_optimizer import LocalOptimizer, validate
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.predictor import Predictor, DLClassifier
 
 __all__ = [
     "OptimMethod", "SGD", "Adagrad", "LBFGS",
@@ -26,4 +27,5 @@ __all__ = [
     "ValidationMethod", "ValidationResult", "AccuracyResult", "LossResult",
     "Top1Accuracy", "Top5Accuracy", "Loss", "Metrics",
     "LocalOptimizer", "DistriOptimizer", "Optimizer", "validate",
+    "Predictor", "DLClassifier",
 ]

@@ -1,0 +1,61 @@
+"""Batch inference (the DLClassifier / Module.predict role:
+ref org/apache/spark/ml/DLClassifier.scala:37-140 and
+PythonBigDL.modelPredictRDD :231).
+
+The reference wraps a trained Module as a Spark ML Transformer for
+DataFrame batch scoring; here ``Predictor`` maps any array / iterable of
+features through a jit-compiled forward in fixed-size batches (the last
+partial batch is padded, then trimmed — keeping one compiled shape).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Context
+
+
+class Predictor:
+    def __init__(self, model, batch_size: int = 128):
+        self.model = model
+        self.batch_size = batch_size
+        params = model.params()
+        state = model.state()
+
+        @jax.jit
+        def fwd(x):
+            out, _ = model.apply(params, x, state,
+                                 Context(training=False, key=jax.random.PRNGKey(0)))
+            return out
+
+        self._fwd = fwd
+
+    def predict(self, features) -> np.ndarray:
+        """Forward all rows; returns stacked outputs (n, ...)."""
+        features = np.asarray(features)
+        n = features.shape[0]
+        outs = []
+        for start in range(0, n, self.batch_size):
+            chunk = features[start:start + self.batch_size]
+            pad = self.batch_size - chunk.shape[0]
+            if pad:
+                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, 0)])
+            out = np.asarray(self._fwd(jnp.asarray(chunk)))
+            outs.append(out[:out.shape[0] - pad] if pad else out)
+        return np.concatenate(outs)
+
+    def predict_class(self, features) -> np.ndarray:
+        """Argmax class, 1-based (the DLClassifier 'predict' column)."""
+        return self.predict(features).argmax(axis=-1) + 1
+
+
+class DLClassifier(Predictor):
+    """API-parity alias: ``transform(rows)`` returns (rows, predictions)
+    pairs, the DataFrame-ish shape of DLClassifier.process :72-130."""
+
+    def transform(self, rows):
+        feats = np.asarray([r[0] if isinstance(r, (tuple, list)) else r
+                            for r in rows])
+        preds = self.predict_class(feats)
+        return list(zip(rows, preds.tolist()))

@@ -65,10 +65,9 @@ def _topk_correct(output, target, k):
     """#samples whose 1-based target is within top-k of output rows
     (ref EvaluateMethods.scala:23)."""
     output = np.asarray(output)
-    target = np.asarray(target)
     if output.ndim == 1:
         output = output[None]
-        target = np.reshape(target, (1,))
+    target = np.reshape(np.asarray(target), (output.shape[0],))
     tgt0 = target.astype(np.int64) - 1
     topk = np.argsort(-output, axis=1)[:, :k]
     correct = (topk == tgt0[:, None]).any(axis=1).sum()

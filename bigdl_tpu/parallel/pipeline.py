@@ -1,0 +1,93 @@
+"""Pipeline parallelism — GPipe-style microbatch pipelining over a mesh axis.
+
+Absent in the reference (SURVEY.md §2.9: PP = NO); first-class here because
+pipeline schedules are a core TPU scaling strategy when a model exceeds one
+chip's HBM.
+
+Design (the shard_map ring formulation):
+- the repeated-block model is expressed as ONE stage function applied P
+  times (scan-over-layers), with each pipeline rank holding its stage's
+  parameters (stacked pytree sharded on the ``pipe`` axis, leading dim P);
+- microbatches stream through ranks with ``ppermute`` hops: at tick t,
+  rank r computes its stage on the activation it received at t-1 and
+  forwards the result around the ring — the classic GPipe fill/steady/drain
+  schedule, total ticks = n_micro + P - 1;
+- everything is one compiled region: XLA overlaps the ppermute hop with
+  the next microbatch's compute.
+
+``pipeline_apply`` returns the final-stage outputs for all microbatches in
+order.  Differentiable end-to-end (ppermute has a transpose rule), so the
+same function trains under ``jax.grad``.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
+                   axis: str = "pipe"):
+    """Run a P-stage pipeline over microbatches.
+
+    stage_fn(params_slice, x) -> y          (one stage's computation;
+                                             activation shapes preserved)
+    stage_params: pytree with leading dim P (stage-stacked), will be
+                  sharded over ``axis``.
+    x_micro: (M, micro_batch, ...) microbatched input (replicated).
+    Returns (M, micro_batch, ...) outputs of the last stage.
+    """
+    n_stage = mesh.shape[axis]
+
+    def ranked(params, x_all):
+        # inside shard_map: params has leading dim 1 (my stage), x_all is
+        # the full microbatch stack (replicated)
+        my_params = jax.tree_util.tree_map(lambda v: v[0], params)
+        rank = lax.axis_index(axis)
+        n_micro = x_all.shape[0]
+        n_ticks = n_micro + n_stage - 1
+        fwd = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+        micro_shape = x_all.shape[1:]
+        # pvary: scan carries must be device-varying over the pipe axis
+        buf = lax.pvary(jnp.zeros(micro_shape, x_all.dtype), (axis,))
+        outs = lax.pvary(jnp.zeros((n_micro,) + micro_shape, x_all.dtype),
+                         (axis,))
+
+        def tick(carry, t):
+            buf, outs = carry
+            # rank 0 injects microbatch t (when available)
+            inject = x_all[jnp.clip(t, 0, n_micro - 1)]
+            cur = jnp.where(rank == 0,
+                            jnp.where(t < n_micro, inject, jnp.zeros_like(inject)),
+                            buf)
+            y = stage_fn(my_params, cur)
+            # last rank emits microbatch (t - (P-1)) at tick t
+            out_idx = t - (n_stage - 1)
+            emit = (rank == n_stage - 1) & (out_idx >= 0)
+            upd = lax.dynamic_update_index_in_dim(
+                outs, y, jnp.maximum(out_idx, 0), 0)
+            outs = jnp.where(emit, upd, outs)
+            buf = lax.ppermute(y, axis, fwd)
+            return (buf, outs), None
+
+        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(n_ticks))
+        # every rank holds `outs`, but only the last rank's is real;
+        # broadcast it (max works since others are zero-initialized only if
+        # last rank wrote) — use psum of masked value for correctness
+        mask = (rank == n_stage - 1).astype(outs.dtype)
+        outs = lax.psum(outs * mask, axis)
+        return outs
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    f = jax.shard_map(ranked, mesh=mesh,
+                      in_specs=(pspec, P()), out_specs=P())
+    return f(stage_params, x_micro)
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] -> one tree with leading dim P."""
+    return jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *per_stage_params)

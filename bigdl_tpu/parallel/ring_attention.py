@@ -40,8 +40,12 @@ def _block_attn(q, k, v, q_off, k_off, causal, scale):
         qi = q_off + jnp.arange(tq)[:, None]
         ki = k_off + jnp.arange(tk)[None, :]
         s = jnp.where(qi >= ki, s, -jnp.inf)
-    m = s.max(axis=-1)                          # (B, H, Tq)
-    p = jnp.exp(s - lax.stop_gradient(m)[..., None])
+    # the running max is a numerical shift only — softmax is invariant to
+    # it, so it must be fully non-differentiable or the shift's gradient
+    # paths (here vs the alpha/beta rescales in the ring step) would have
+    # to cancel exactly; stop_gradient everywhere makes the grad exact
+    m = lax.stop_gradient(s.max(axis=-1))       # (B, H, Tq)
+    p = jnp.exp(s - m[..., None])
     p = jnp.where(jnp.isfinite(s), p, 0.0)      # fully-masked rows stay 0
     l = p.sum(axis=-1)                          # (B, H, Tq)
     pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,

@@ -1,0 +1,162 @@
+"""Caffe .caffemodel weight importer (ref utils/CaffeLoader.scala:40).
+
+The reference ships 96k LoC of protoc-generated Java (caffe/Caffe.java) to
+read NetParameter; here a ~100-line protobuf *wire-format* parser extracts
+exactly what weight-copy needs — layer names and blobs — with no protobuf
+dependency and no generated code.
+
+Field numbers (from caffe.proto):
+  NetParameter:    layers = 2 (V1LayerParameter), layer = 100 (LayerParameter)
+  V1LayerParameter: name = 4, blobs = 6
+  LayerParameter:   name = 1, type = 2, blobs = 7
+  BlobProto: num/channels/height/width = 1..4, data = 5 (packed or repeated
+             float), shape = 7 (BlobShape.dim = 1, repeated int64)
+
+``load(model, caffemodel_path)`` copies blobs onto modules by matched
+``set_name`` (the name-matched copy of CaffeLoader.copyParameters :127),
+with ``match_all`` enforcing full coverage (CaffeLoader.load :155).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def iter_fields(buf, start=0, end=None):
+    """Yield (field_number, wire_type, value) over a protobuf message.
+    value: varint int, 8-byte chunk, length-delimited bytes, or 4-byte chunk."""
+    pos = start
+    end = len(buf) if end is None else end
+    while pos < end:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wire == 2:
+            n, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + n]
+            pos += n
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _parse_blob(buf):
+    """BlobProto -> numpy array with caffe shape."""
+    dims_old = {}
+    shape = None
+    data = []
+    for field, wire, val in iter_fields(buf):
+        if field in (1, 2, 3, 4) and wire == 0:
+            dims_old[field] = val
+        elif field == 5:
+            if wire == 2:  # packed floats
+                data.append(np.frombuffer(val, np.float32))
+            else:  # unpacked single float
+                data.append(np.frombuffer(val, np.float32))
+        elif field == 7 and wire == 2:  # BlobShape
+            shape = [v for f, w, v in iter_fields(val) if f == 1 and w == 0]
+        elif field == 8 and wire == 2:  # double_data
+            data.append(np.frombuffer(val, np.float64).astype(np.float32))
+    arr = np.concatenate(data) if data else np.zeros(0, np.float32)
+    if shape:
+        return arr.reshape(shape)
+    if dims_old:
+        dims = [dims_old.get(i, 1) for i in (1, 2, 3, 4)]
+        # squeeze caffe's legacy 4D padding for FC layers
+        while len(dims) > 1 and dims[0] == 1:
+            dims = dims[1:]
+        try:
+            return arr.reshape(dims)
+        except ValueError:
+            return arr
+    return arr
+
+
+def _parse_layer(buf, name_field, blobs_field):
+    name = None
+    blobs = []
+    for field, wire, val in iter_fields(buf):
+        if field == name_field and wire == 2:
+            name = val.decode("utf-8", "replace")
+        elif field == blobs_field and wire == 2:
+            blobs.append(_parse_blob(val))
+    return name, blobs
+
+
+def read_caffemodel(path):
+    """Returns {layer_name: [blob arrays]} from a .caffemodel file."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    layers = {}
+    for field, wire, val in iter_fields(buf):
+        if wire != 2:
+            continue
+        if field == 2:       # V1LayerParameter
+            name, blobs = _parse_layer(val, name_field=4, blobs_field=6)
+        elif field == 100:   # LayerParameter
+            name, blobs = _parse_layer(val, name_field=1, blobs_field=7)
+        else:
+            continue
+        if name and blobs:
+            layers[name] = blobs
+    return layers
+
+
+def _named_param_modules(model):
+    out = {}
+
+    def visit(m):
+        if m._params and m.name:
+            out[m.name] = m
+        for c in m._modules.values():
+            visit(c)
+
+    visit(model)
+    return out
+
+
+def load(model, caffemodel_path, match_all: bool = True):
+    """Copy caffemodel weights onto ``model`` by layer name
+    (ref CaffeLoader.load :155; name matching :127)."""
+    import jax.numpy as jnp
+
+    blobs_by_name = read_caffemodel(caffemodel_path)
+    targets = _named_param_modules(model)
+    copied = set()
+    for name, module in targets.items():
+        if name not in blobs_by_name:
+            if match_all:
+                raise ValueError(
+                    f"module '{name}' has no blobs in the caffemodel "
+                    f"(available: {sorted(blobs_by_name)[:10]}...)")
+            continue
+        blobs = blobs_by_name[name]
+        pnames = [p for p in ("weight", "bias") if p in module._params]
+        for pname, blob in zip(pnames, blobs):
+            dst = module._params[pname]
+            src = np.asarray(blob, np.float32)
+            if src.shape != tuple(dst.shape):
+                src = src.reshape(dst.shape)
+            module._params[pname] = jnp.asarray(src, dst.dtype)
+        copied.add(name)
+    return model, copied

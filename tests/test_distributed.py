@@ -56,7 +56,8 @@ def test_reduce_scatter_all_gather_roundtrip():
     def ar(x):
         return coll.all_reduce(x, "data")
 
-    x = jnp.asarray(np.random.RandomState(0).randn(n * 4).astype(np.float32))
+    # local chunk (n elements) must divide by the shard count for tiled RS
+    x = jnp.asarray(np.random.RandomState(0).randn(n * n).astype(np.float32))
     np.testing.assert_allclose(rs_ag(x), ar(x), rtol=1e-5)
 
 

@@ -1,0 +1,120 @@
+"""Interop tests: caffemodel wire parser round-trip (we both write and read
+the wire format, like the reference tests CaffeLoader against fixture
+models), DLClassifier-style batch inference."""
+import struct
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import caffe_loader
+
+
+def _varint(v):
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(num, wire, payload):
+    return _varint((num << 3) | wire) + payload
+
+
+def _len_delim(num, data):
+    return _field(num, 2, _varint(len(data)) + data)
+
+
+def _blob(arr):
+    arr = np.asarray(arr, np.float32)
+    shape_msg = b"".join(_field(1, 0, _varint(d)) for d in arr.shape)
+    blob = _len_delim(7, shape_msg)  # BlobShape
+    blob += _len_delim(5, arr.tobytes())  # packed float data
+    return blob
+
+
+def _layer_v2(name, blobs):
+    msg = _len_delim(1, name.encode())
+    msg += _len_delim(2, b"Convolution")
+    for b in blobs:
+        msg += _len_delim(7, _blob(b))
+    return msg
+
+
+def _layer_v1(name, blobs):
+    msg = _len_delim(4, name.encode())
+    for b in blobs:
+        msg += _len_delim(6, _blob(b))
+    return msg
+
+
+class TestCaffeLoader:
+    def test_parse_new_format(self, tmp_path):
+        w = np.random.RandomState(0).randn(4, 3, 3, 3).astype(np.float32)
+        b = np.zeros(4, np.float32)
+        net = _len_delim(100, _layer_v2("conv1", [w, b]))
+        p = tmp_path / "m.caffemodel"
+        p.write_bytes(net)
+        layers = caffe_loader.read_caffemodel(str(p))
+        assert "conv1" in layers
+        np.testing.assert_allclose(layers["conv1"][0], w)
+
+    def test_parse_legacy_format(self, tmp_path):
+        w = np.ones((2, 5), np.float32)
+        net = _len_delim(2, _layer_v1("fc", [w]))
+        p = tmp_path / "legacy.caffemodel"
+        p.write_bytes(net)
+        layers = caffe_loader.read_caffemodel(str(p))
+        np.testing.assert_allclose(layers["fc"][0], w)
+
+    def test_load_into_model(self, tmp_path):
+        w = np.random.RandomState(1).randn(8, 3, 3, 3).astype(np.float32)
+        b = np.random.RandomState(2).randn(8).astype(np.float32)
+        fcw = np.random.RandomState(3).randn(10, 8).astype(np.float32)
+        fcb = np.zeros(10, np.float32)
+        net = (_len_delim(100, _layer_v2("conv1", [w, b])) +
+               _len_delim(100, _layer_v2("fc1", [fcw, fcb])))
+        p = tmp_path / "net.caffemodel"
+        p.write_bytes(net)
+
+        model = nn.Sequential(
+            nn.SpatialConvolution(3, 8, 3, 3).set_name("conv1"),
+            nn.ReLU(),
+            nn.SpatialAveragePooling(6, 6),
+            nn.Reshape([8]),
+            nn.Linear(8, 10).set_name("fc1"),
+        )
+        _, copied = caffe_loader.load(model, str(p))
+        assert copied == {"conv1", "fc1"}
+        np.testing.assert_allclose(np.asarray(model.get(1)._params["weight"]), w)
+        np.testing.assert_allclose(np.asarray(model.get(5)._params["weight"]), fcw)
+
+    def test_match_all_missing_raises(self, tmp_path):
+        net = _len_delim(100, _layer_v2("conv1", [np.ones((1, 1, 1, 1), np.float32)]))
+        p = tmp_path / "net.caffemodel"
+        p.write_bytes(net)
+        model = nn.Sequential(nn.Linear(2, 2).set_name("unknown_fc"))
+        with pytest.raises(ValueError):
+            caffe_loader.load(model, str(p))
+        _, copied = caffe_loader.load(model, str(p), match_all=False)
+        assert copied == set()
+
+
+class TestPredictor:
+    def test_batch_inference(self):
+        from bigdl_tpu.optim.predictor import Predictor
+        from bigdl_tpu.utils.random import set_seed
+        set_seed(1)
+        model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+        pred = Predictor(model, batch_size=8)
+        x = np.random.RandomState(0).randn(20, 4).astype(np.float32)
+        classes = pred.predict_class(x)
+        assert classes.shape == (20,)
+        assert set(np.unique(classes)).issubset({1, 2, 3})
+        probs = pred.predict(x)
+        assert probs.shape == (20, 3)

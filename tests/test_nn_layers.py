@@ -96,10 +96,11 @@ class TestPooling:
         np.testing.assert_allclose(y[0, 0], [[5, 7], [13, 15]])
 
     def test_ceil_mode(self):
+        # 6x6, k3 s2: floor (6-3)/2+1 = 2; ceil ceil(1.5)+1 = 3
         m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
-        assert m.forward(randn(1, 1, 7, 7)).shape == (1, 1, 4, 4)
+        assert m.forward(randn(1, 1, 6, 6)).shape == (1, 1, 3, 3)
         m2 = nn.SpatialMaxPooling(3, 3, 2, 2)
-        assert m2.forward(randn(1, 1, 7, 7)).shape == (1, 1, 3, 3)
+        assert m2.forward(randn(1, 1, 6, 6)).shape == (1, 1, 2, 2)
 
     def test_avg_pool_value(self):
         m = nn.SpatialAveragePooling(2, 2, 2, 2)

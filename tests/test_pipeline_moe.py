@@ -1,0 +1,106 @@
+"""Pipeline-parallel and expert-parallel tests on the 8-device CPU mesh."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.parallel.mesh import make_mesh
+from bigdl_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from bigdl_tpu.parallel.moe import (
+    top1_gating, moe_apply, moe_apply_sharded_tokens,
+)
+
+
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stages(n_stage, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rs.randn(d, d) * 0.3, jnp.float32),
+             "b": jnp.asarray(rs.randn(d) * 0.1, jnp.float32)}
+            for _ in range(n_stage)]
+
+
+class TestPipeline:
+    def test_matches_sequential(self):
+        n_stage, d, n_micro, mb = 4, 8, 6, 3
+        mesh = make_mesh({"pipe": n_stage}, jax.devices()[:n_stage])
+        stages = _make_stages(n_stage, d)
+        stacked = stack_stage_params(stages)
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(n_micro, mb, d), jnp.float32)
+
+        got = pipeline_apply(_stage_fn, stacked, x, mesh, "pipe")
+
+        want = x
+        for p in stages:
+            want = jax.vmap(lambda m: _stage_fn(p, m))(want)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_differentiable(self):
+        n_stage, d = 2, 4
+        mesh = make_mesh({"pipe": n_stage}, jax.devices()[:n_stage])
+        stacked = stack_stage_params(_make_stages(n_stage, d))
+        x = jnp.asarray(np.random.RandomState(2).randn(4, 2, d), jnp.float32)
+
+        def loss(params):
+            return (pipeline_apply(_stage_fn, params, x, mesh, "pipe") ** 2).sum()
+
+        g = jax.grad(loss)(stacked)
+        leaves = jax.tree_util.tree_leaves(g)
+        assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+        assert any(float(jnp.abs(l).max()) > 0 for l in leaves)
+
+
+class TestMoE:
+    def test_gating_capacity(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(16, 4), jnp.float32)
+        dispatch, combine = top1_gating(logits, 4, capacity=2)
+        assert dispatch.shape == (16, 4, 2)
+        # each expert slot holds at most one token
+        assert float(dispatch.sum(axis=0).max()) <= 1.0 + 1e-6
+        # each token dispatched at most once, with weight <= its gate
+        assert float(dispatch.sum(axis=(1, 2)).max()) <= 1.0 + 1e-6
+        assert np.all(np.asarray(combine) <= np.asarray(dispatch) + 1e-6)
+
+    def _params(self, e, d, h, seed=0):
+        rs = np.random.RandomState(seed)
+        return (jnp.asarray(rs.randn(d, e) * 0.5, jnp.float32),
+                jnp.asarray(rs.randn(e, d, h) * 0.3, jnp.float32),
+                jnp.asarray(rs.randn(e, h) * 0.1, jnp.float32),
+                jnp.asarray(rs.randn(e, h, d) * 0.3, jnp.float32),
+                jnp.asarray(rs.randn(e, d) * 0.1, jnp.float32))
+
+    def _dense_reference(self, router_w, w1, b1, w2, b2, x, capacity):
+        e = w1.shape[0]
+        dispatch, combine = top1_gating(x @ router_w, e, capacity)
+        expert_in = jnp.einsum("td,tec->ecd", x, dispatch)
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None])
+        out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None]
+        return jnp.einsum("ecd,tec->td", out, combine)
+
+    def test_replicated_tokens_matches_dense(self):
+        e, d, h, t = 8, 6, 12, 32
+        mesh = make_mesh({"expert": 8})
+        router_w, w1, b1, w2, b2 = self._params(e, d, h)
+        x = jnp.asarray(np.random.RandomState(3).randn(t, d), jnp.float32)
+        got = moe_apply(router_w, w1, b1, w2, b2, x, mesh, "expert",
+                        capacity_factor=2.0)
+        capacity = max(int(2.0 * t / e), 1)
+        want = self._dense_reference(router_w, w1, b1, w2, b2, x, capacity)
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_sharded_tokens_runs_and_grads(self):
+        e, d, h = 4, 6, 10
+        mesh = make_mesh({"data": 2, "expert": 4})
+        router_w, w1, b1, w2, b2 = self._params(e, d, h)
+        x = jnp.asarray(np.random.RandomState(4).randn(16, d), jnp.float32)
+
+        def loss(w1_):
+            y = moe_apply_sharded_tokens(router_w, w1_, b1, w2, b2, x, mesh)
+            return (y ** 2).sum()
+
+        l, g = jax.value_and_grad(loss)(w1)
+        assert np.isfinite(float(l))
+        assert np.isfinite(np.asarray(g)).all()

@@ -1,0 +1,24 @@
+"""Shard-file (SequenceFile role) tests."""
+import numpy as np
+
+from bigdl_tpu.dataset import shardfile
+
+
+def test_roundtrip(tmp_path):
+    records = [(float(i % 10 + 1), bytes([i % 256]) * (i + 1)) for i in range(37)]
+    paths = shardfile.write_shards(records, str(tmp_path), n_shards=4)
+    assert len(paths) == 4
+    ds = shardfile.ShardFolder(str(tmp_path))
+    assert ds.size() == 37
+    got = list(ds.data(train=False))
+    assert len(got) == 37
+    lens = sorted(len(r.data) for r in got)
+    assert lens == sorted(i + 1 for i in range(37))
+
+
+def test_train_loops(tmp_path):
+    records = [(1.0, b"x")] * 5
+    shardfile.write_shards(records, str(tmp_path), n_shards=2)
+    ds = shardfile.ShardFolder(str(tmp_path))
+    it = ds.data(train=True)
+    assert len([next(it) for _ in range(12)]) == 12
