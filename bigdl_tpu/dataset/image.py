@@ -74,6 +74,20 @@ def _resize(arr, h, w):
         return arr[ys][:, xs]
 
 
+class BytesToGreyImg(Transformer):
+    """Decode ByteRecord bytes to greyscale LabeledImage
+    (ref BytesToGreyImg.scala); ``row x col`` raw-u8 records."""
+
+    def __init__(self, row: int, col: int):
+        self.row = row
+        self.col = col
+
+    def __call__(self, iterator):
+        for rec in iterator:
+            arr = np.frombuffer(rec.data, np.uint8).astype(np.float32)
+            yield LabeledImage(arr.reshape(self.row, self.col), rec.label)
+
+
 class ImgNormalizer(Transformer):
     """Subtract mean, divide std, per channel (ref BGRImgNormalizer /
     GreyImgNormalizer).  Means/stds are scalars or per-channel tuples.

@@ -1,0 +1,59 @@
+"""Non-maximum suppression (ref nn/Nms.scala — helper used by the
+detection path next to RoiPooling).
+
+TPU-first formulation: fixed-iteration greedy NMS via ``lax.fori_loop`` on
+static shapes (returns a keep mask rather than a compacted index list, so
+it runs under jit); ``nms_indices`` gives the host-side compacted indices.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import numpy as np
+
+
+def _iou_matrix(boxes):
+    """boxes: (N, 4) [x1, y1, x2, y2] -> (N, N) IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1 + 1, 0) * jnp.maximum(y2 - y1 + 1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + 1, 0)
+    ih = jnp.maximum(iy2 - iy1 + 1, 0)
+    inter = iw * ih
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-9)
+
+
+def nms_mask(boxes, scores, threshold: float):
+    """Greedy NMS keep-mask, jit-compatible (static N iterations)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    iou = _iou_matrix(boxes)
+
+    def body(i, state):
+        keep, suppressed = state
+        idx = order[i]
+        is_live = ~suppressed[idx]
+        keep = keep.at[idx].set(is_live)
+        # suppress everything overlapping idx (only if idx is live)
+        over = iou[idx] > threshold
+        suppressed = suppressed | (over & is_live)
+        suppressed = suppressed.at[idx].set(suppressed[idx] | is_live)  # self
+        return keep, suppressed
+
+    keep0 = jnp.zeros(n, bool)
+    sup0 = jnp.zeros(n, bool)
+    keep, _ = lax.fori_loop(0, n, body, (keep0, sup0))
+    return keep
+
+
+def nms_indices(boxes, scores, threshold: float):
+    """Host-side: kept indices sorted by descending score (Nms.scala API)."""
+    keep = np.asarray(nms_mask(jnp.asarray(boxes), jnp.asarray(scores),
+                               threshold))
+    scores = np.asarray(scores)
+    idx = np.where(keep)[0]
+    return idx[np.argsort(-scores[idx])]

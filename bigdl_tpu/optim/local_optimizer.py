@@ -209,3 +209,23 @@ def validate(model, params, net_state, dataset, methods, batch_to_device=jnp.asa
             r = m(out, batch.labels)
             totals[i] = r if totals[i] is None else totals[i] + r
     return list(zip(methods, totals))
+
+
+def distri_validate(model, params, net_state, dataset, methods):
+    """Distributed evaluation (ref DistriValidator.scala:32): each process
+    evaluates its dataset shard, results merge across hosts via the
+    ValidationResult ``+`` algebra (the reference reduces driver-side)."""
+    local = validate(model, params, net_state, dataset, methods)
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+    merged = []
+    for method, result in local:
+        if hasattr(result, "correct"):
+            vec = np.asarray([result.correct, result.count], np.float32)
+        else:
+            vec = np.asarray([result.loss, result.count], np.float32)
+        total = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(vec))).sum(axis=0)
+        merged.append((method, type(result)(total[0], int(total[1]))))
+    return merged

@@ -4,3 +4,12 @@ from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils import file as File
 
 __all__ = ["Table", "T", "RandomGenerator", "Engine", "File"]
+
+
+def kth_largest(values, k):
+    """k-th largest element (1-based k) — quickselect role of
+    ref utils/Util.kthLargest (Util.scala:21), used there for the
+    straggler-drop threshold; kept for API parity."""
+    import numpy as np
+    arr = np.asarray(values).reshape(-1)
+    return float(np.partition(arr, len(arr) - k)[len(arr) - k])

@@ -1,0 +1,90 @@
+"""Model import + validation (ref example/loadmodel/ModelValidator.scala:37-146):
+load a BigDL-TPU / Torch .t7 / Caffe model and evaluate top-1/top-5.
+
+  python examples/model_validator.py -t caffe --model alexnet \
+      --modelPath net.caffemodel -f ./val_images
+  python examples/model_validator.py -t torch --model alexnet --modelPath net.t7
+  python examples/model_validator.py -t bigdl --modelPath snap.model
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+MODELS = {}
+
+
+def _register():
+    from bigdl_tpu.models.alexnet import AlexNet
+    from bigdl_tpu.models.inception import Inception_v1
+    from bigdl_tpu.models.vgg import Vgg_16
+    from bigdl_tpu.models.lenet import LeNet5
+    MODELS.update({
+        "alexnet": lambda: AlexNet(1000),
+        "inception": lambda: Inception_v1(1000),
+        "vgg16": lambda: Vgg_16(1000),
+        "lenet": lambda: LeNet5(10),
+    })
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-t", "--modelType", choices=["bigdl", "torch", "caffe"],
+                   required=True)
+    p.add_argument("--model", default="alexnet",
+                   help="architecture name (for torch/caffe weight import)")
+    p.add_argument("--modelPath", required=True)
+    p.add_argument("-f", "--folder", default=None,
+                   help="validation ImageFolder; synthetic eval if omitted")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    _register()
+
+    import numpy as np
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils import file as File
+    from bigdl_tpu.utils import torch_file, caffe_loader
+    from bigdl_tpu.optim import validate, Top1Accuracy, Top5Accuracy
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.image import (
+        BytesToImg, ImgCropper, ImgNormalizer, ImgToBatch)
+
+    if args.modelType == "bigdl":
+        blob = File.load(args.modelPath)
+        model = MODELS[args.model]()
+        model.load_params(blob["params"])
+        model.load_state(blob["state"])
+    elif args.modelType == "torch":
+        model = MODELS[args.model]()
+        torch_file.load_module_weights(model, args.modelPath, strict=False)
+    else:
+        model = MODELS[args.model]()
+        caffe_loader.load(model, args.modelPath, match_all=False)
+
+    if args.folder:
+        ds = (DataSet.image_folder(args.folder)
+              >> BytesToImg(256) >> ImgCropper(224, 224)
+              >> ImgNormalizer((123.0, 117.0, 104.0), (1.0, 1.0, 1.0))
+              >> ImgToBatch(args.batchSize))
+    else:
+        logging.warning("no folder given — evaluating on synthetic data")
+        from bigdl_tpu.dataset.image import LabeledImage
+        rng = np.random.RandomState(0)
+        data = [LabeledImage(rng.uniform(0, 255, (224, 224, 3)),
+                             rng.randint(1, 1001)) for _ in range(64)]
+        ds = (DataSet.array(data)
+              >> ImgNormalizer((123.0, 117.0, 104.0), (1.0, 1.0, 1.0))
+              >> ImgToBatch(args.batchSize))
+
+    results = validate(model, model.params(), model.state(), ds,
+                       [Top1Accuracy(), Top5Accuracy()])
+    for method, result in results:
+        logging.info("%s: %s", method, result)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Aux component tests: NMS, kth_largest, broadcast, grey decode,
+imagenet shard generator."""
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.nms import nms_indices, nms_mask, _iou_matrix
+from bigdl_tpu.utils import kth_largest
+
+
+class TestNms:
+    def test_iou(self):
+        boxes = jnp.asarray([[0, 0, 9, 9], [0, 0, 9, 9], [20, 20, 29, 29]],
+                            jnp.float32)
+        iou = np.asarray(_iou_matrix(boxes))
+        assert iou[0, 1] == 1.0
+        assert iou[0, 2] == 0.0
+
+    def test_suppresses_overlaps(self):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                           np.float32)
+        scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+        keep = nms_indices(boxes, scores, threshold=0.5)
+        assert list(keep) == [0, 2]
+
+    def test_keeps_all_disjoint(self):
+        boxes = np.asarray([[0, 0, 5, 5], [10, 10, 15, 15], [20, 20, 25, 25]],
+                           np.float32)
+        scores = np.asarray([0.1, 0.9, 0.5], np.float32)
+        keep = nms_indices(boxes, scores, threshold=0.3)
+        assert sorted(keep) == [0, 1, 2]
+
+
+def test_kth_largest():
+    assert kth_largest([3, 1, 4, 1, 5, 9, 2, 6], 1) == 9.0
+    assert kth_largest([3, 1, 4, 1, 5, 9, 2, 6], 3) == 5.0
+
+
+def test_replicate_to_mesh():
+    from bigdl_tpu.parallel.broadcast import replicate_to_mesh, model_broadcast
+    from bigdl_tpu.parallel.mesh import data_parallel_mesh
+    mesh = data_parallel_mesh()
+    m = nn.Linear(4, 2)
+    model_broadcast(m, mesh)
+    w = m._params["weight"]
+    assert len(w.sharding.device_set) == mesh.size  # replicated on all devices
+
+
+def test_bytes_to_grey():
+    from bigdl_tpu.dataset.image import BytesToGreyImg
+    from bigdl_tpu.dataset.sample import ByteRecord
+    raw = bytes(range(16))
+    out = list(BytesToGreyImg(4, 4)(iter([ByteRecord(raw, 3.0)])))
+    assert out[0].data.shape == (4, 4)
+    assert out[0].data[0, 1] == 1.0
+
+
+def test_imagenet_shard_generator(tmp_path):
+    from bigdl_tpu.dataset import imagenet_tools, shardfile
+    src = tmp_path / "imagenet"
+    for cls in ("n01", "n02"):
+        (src / cls).mkdir(parents=True)
+        for i in range(3):
+            (src / cls / f"img{i}.jpg").write_bytes(b"JPEG" + bytes([i]))
+    out = tmp_path / "shards"
+    paths, n_classes = imagenet_tools.generate(str(src), str(out), n_shards=2)
+    assert n_classes == 2 and len(paths) == 2
+    ds = shardfile.ShardFolder(str(out))
+    records = list(ds.data(train=False))
+    assert len(records) == 6
+    labels = sorted(set(r.label for r in records))
+    assert labels == [1.0, 2.0]
+
+
+def test_distri_validate_single_process():
+    from bigdl_tpu.optim.local_optimizer import distri_validate
+    from bigdl_tpu.optim import Top1Accuracy
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToBatch
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.randn(4).astype(np.float32), np.asarray([1.0]))
+               for _ in range(8)]
+    ds = DataSet.array(samples) >> SampleToBatch(4)
+    m = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    res = distri_validate(m, m.params(), m.state(), ds, [Top1Accuracy()])
+    assert res[0][1].count == 8
