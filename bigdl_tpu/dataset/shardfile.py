@@ -84,6 +84,7 @@ class ShardFolder(LocalDataSet):
 
     def __init__(self, folder, distributed: bool = False):
         import jax
+        self.distributed = distributed  # Optimizer factory dispatch hint
         self.paths = sorted(
             os.path.join(folder, f) for f in os.listdir(folder)
             if f.endswith(".bdts"))

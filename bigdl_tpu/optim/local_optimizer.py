@@ -48,6 +48,15 @@ class LocalOptimizer:
         self.checkpoint_trigger = None
         self.checkpoint_path = None
         self.metrics = Metrics()
+        self.remat = False
+
+    def set_gradient_checkpointing(self, enabled: bool = True):
+        """Rematerialize the forward inside backward (``jax.checkpoint``):
+        trades FLOPs for HBM — the TPU-native replacement for the
+        reference's shared-buffer memory tricks (SpatialShareConvolution,
+        ResNet.shareGradInput)."""
+        self.remat = enabled
+        return self
 
     # -- builder config (ref Optimizer.scala:66-124) ----------------------
     def set_state(self, state: Table):
@@ -98,11 +107,20 @@ class LocalOptimizer:
         static_hyper = self._hyper(None)
         del static_hyper["lr"]
 
+        remat = self.remat
+
         def step(params, net_state, opt_state, x, y, lr, key):
             hyper = dict(static_hyper, lr=lr)
 
             def loss_fn(p):
-                out, ns = model.apply(p, x, net_state, Context(training=True, key=key))
+                apply = model.apply
+                if remat:
+                    apply = jax.checkpoint(
+                        lambda p_, x_: model.apply(
+                            p_, x_, net_state, Context(training=True, key=key)))
+                    out, ns = apply(p, x)
+                else:
+                    out, ns = apply(p, x, net_state, Context(training=True, key=key))
                 return criterion.apply_loss(out, y), ns
 
             (loss, new_net_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)

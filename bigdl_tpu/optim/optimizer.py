@@ -20,6 +20,6 @@ def _root_dataset(ds):
 def Optimizer(model, dataset, criterion, **kwargs):
     """(ref Optimizer.apply :151-186)"""
     root = _root_dataset(dataset)
-    if isinstance(root, ShardedDataSet):
+    if isinstance(root, ShardedDataSet) or getattr(root, "distributed", False):
         return DistriOptimizer(model, dataset, criterion, **kwargs)
     return LocalOptimizer(model, dataset, criterion)

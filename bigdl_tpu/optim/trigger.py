@@ -60,3 +60,12 @@ def and_trigger(*triggers):
 
 def or_trigger(*triggers):
     return Trigger(lambda s: any(t(s) for t in triggers), "or")
+
+
+# PascalCase aliases matching the reference's Python API
+# (dl/src/main/python/optim/optimizer.py: MaxEpoch, MaxIteration, EveryEpoch,
+#  SeveralIteration)
+MaxEpoch = max_epoch
+MaxIteration = max_iteration
+EveryEpoch = every_epoch
+SeveralIteration = several_iteration

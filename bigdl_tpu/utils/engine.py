@@ -43,6 +43,19 @@ class _Engine:
         self._initialized = True
         return self
 
+    def init_distributed(self, coordinator_address: str = None,
+                         num_processes: int = None, process_id: int = None):
+        """Multi-host bring-up: one JAX process per TPU VM host (the Spark
+        executor role, SURVEY.md §2.9/§3.1).  Wraps
+        ``jax.distributed.initialize``; with no args, reads the standard
+        TPU metadata (works out of the box on Cloud TPU pods)."""
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs = dict(coordinator_address=coordinator_address,
+                          num_processes=num_processes, process_id=process_id)
+        jax.distributed.initialize(**kwargs)
+        return self.init()
+
     def _ensure_init(self):
         if not self._initialized:
             self.init()

@@ -1,0 +1,83 @@
+"""ResNet-50 on ImageNet with streaming shard input and optionally loaded
+Caffe weights (BASELINE config 5: "ResNet-50/ImageNet with Spark RDD->HBM
+streaming + loaded Caffe weights" — here shards stream through the host
+pipeline with background prefetch into device batches).
+
+  python -m bigdl_tpu.dataset.imagenet_tools -f ./imagenet/train -o ./shards
+  python examples/train_resnet50_imagenet.py -f ./shards \
+      [--caffeWeights resnet50.caffemodel] -b 256
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--shardFolder", default="./shards")
+    p.add_argument("-b", "--batchSize", type=int, default=256)
+    p.add_argument("--caffeWeights", default=None)
+    p.add_argument("--learningRate", type=float, default=0.1)
+    p.add_argument("--weightDecay", type=float, default=1e-4)
+    p.add_argument("--maxEpoch", type=int, default=90)
+    p.add_argument("--classNumber", type=int, default=1000)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.shardfile import ShardFolder
+    from bigdl_tpu.dataset.image import (
+        LabeledImage, BytesToImg, ImgRdmCropper, HFlip, ColorJitter,
+        Lighting, ImgNormalizer, ImgToBatch)
+    from bigdl_tpu.dataset.transformer import PreFetch
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.optim import (
+        Optimizer, DistriOptimizer, max_epoch, every_epoch)
+    from bigdl_tpu.optim.optim_method import EpochStep
+    from bigdl_tpu.utils.table import T
+    from bigdl_tpu.utils import caffe_loader
+
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        data = [LabeledImage(rng.uniform(0, 255, (224, 224, 3)),
+                             rng.randint(1, args.classNumber + 1))
+                for _ in range(args.batchSize * 2)]
+        train_ds = (DataSet.array(data, distributed=True)
+                    >> HFlip()
+                    >> ImgNormalizer((123.0, 117.0, 104.0), (58.4, 57.1, 57.4))
+                    >> ImgToBatch(args.batchSize) >> PreFetch(2))
+    else:
+        # streaming: shards -> decode -> augment -> batch, with a
+        # background prefetch thread overlapping host work and device steps
+        train_ds = (ShardFolder(args.shardFolder, distributed=True)
+                    >> BytesToImg(256)
+                    >> ImgRdmCropper(224, 224) >> HFlip()
+                    >> ColorJitter() >> Lighting()
+                    >> ImgNormalizer((123.0, 117.0, 104.0), (58.4, 57.1, 57.4))
+                    >> ImgToBatch(args.batchSize) >> PreFetch(2))
+
+    model = ResNet(depth=50, class_num=args.classNumber)
+    if args.caffeWeights:
+        _, copied = caffe_loader.load(model, args.caffeWeights, match_all=False)
+        logging.info("loaded caffe weights for %d layers", len(copied))
+
+    optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
+    optimizer.set_state(T(learningRate=args.learningRate,
+                          weightDecay=args.weightDecay,
+                          momentum=0.9, dampening=0.0, nesterov=True,
+                          learningRateSchedule=EpochStep(30, 0.1)))
+    optimizer.set_end_when(max_epoch(args.maxEpoch))
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()
