@@ -17,9 +17,30 @@ def _root_dataset(ds):
     return ds
 
 
-def Optimizer(model, dataset, criterion, **kwargs):
-    """(ref Optimizer.apply :151-186)"""
+def Optimizer(model, dataset=None, criterion=None, *, training_rdd=None,
+              optim_method=None, state=None, end_trigger=None,
+              batch_size=None, **kwargs):
+    """(ref Optimizer.apply :151-186) — also accepts the reference's
+    Python-API keyword signature (python/optim/optimizer.py):
+    Optimizer(model=..., training_rdd=samples, criterion=...,
+    optim_method=..., state=T(...), end_trigger=MaxEpoch(n), batch_size=b).
+    """
+    if training_rdd is not None:
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+        from bigdl_tpu.dataset.dataset import DataSet
+        if batch_size is None:
+            raise ValueError("batch_size is required with training_rdd")
+        dataset = (DataSet.array(list(training_rdd), distributed=True)
+                   >> SampleToBatch(batch_size, drop_last=True))
     root = _root_dataset(dataset)
     if isinstance(root, ShardedDataSet) or getattr(root, "distributed", False):
-        return DistriOptimizer(model, dataset, criterion, **kwargs)
-    return LocalOptimizer(model, dataset, criterion)
+        opt = DistriOptimizer(model, dataset, criterion, **kwargs)
+    else:
+        opt = LocalOptimizer(model, dataset, criterion)
+    if optim_method is not None:
+        opt.set_optim_method(optim_method)
+    if state is not None:
+        opt.set_state(state)
+    if end_trigger is not None:
+        opt.set_end_when(end_trigger)
+    return opt

@@ -266,3 +266,42 @@ def load_module_weights(model, path, strict: bool = True):
                     src = src.reshape(dst.shape)
                 tgt._params[name] = jnp.asarray(src, dst.dtype)
     return model
+
+
+_TORCH_CLASS_NAMES = {
+    "Linear": "nn.Linear",
+    "SpatialConvolution": "nn.SpatialConvolution",
+    "SpatialFullConvolution": "nn.SpatialFullConvolution",
+    "SpatialDilatedConvolution": "nn.SpatialDilatedConvolution",
+    "SpatialMaxPooling": "nn.SpatialMaxPooling",
+    "SpatialAveragePooling": "nn.SpatialAveragePooling",
+    "BatchNormalization": "nn.BatchNormalization",
+    "SpatialBatchNormalization": "nn.SpatialBatchNormalization",
+    "ReLU": "nn.ReLU", "Tanh": "nn.Tanh", "Sigmoid": "nn.Sigmoid",
+    "LogSoftMax": "nn.LogSoftMax", "SoftMax": "nn.SoftMax",
+    "Dropout": "nn.Dropout", "Reshape": "nn.Reshape", "View": "nn.View",
+    "Sequential": "nn.Sequential", "Concat": "nn.Concat",
+    "ConcatTable": "nn.ConcatTable", "ParallelTable": "nn.ParallelTable",
+    "Identity": "nn.Identity", "LookupTable": "nn.LookupTable",
+}
+
+
+def save_module(model, path):
+    """Export a module tree to .t7 (the saveTorch role,
+    ref AbstractModule.saveTorch :312 + TorchFile module registry :136-182).
+
+    Best-effort object graph: each module becomes a lua table with
+    ``torch_typename`` (mapped class name) + weight/bias + child
+    ``modules`` — readable back via ``load_module_weights``."""
+
+    def encode(m):
+        out = {"torch_typename": _TORCH_CLASS_NAMES.get(
+            type(m).__name__, f"nn.{type(m).__name__}")}
+        for pname, arr in m._params.items():
+            out[pname] = np.asarray(arr)
+        if m._modules:
+            out["modules"] = {i + 1: encode(c)
+                              for i, c in enumerate(m._modules.values())}
+        return out
+
+    save(encode(model), path)

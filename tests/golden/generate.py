@@ -1,0 +1,69 @@
+"""Golden fixture generator (the role of the reference's pre-generated
+Torch .t7 golden tensors, SURVEY.md §4/§7: CI has no live Torch, so goldens
+are pinned outputs that future changes must reproduce bit-for-bit on CPU).
+
+Run from repo root to (re)generate:  python tests/golden/generate.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def build_cases():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.random import set_seed
+    from bigdl_tpu.utils.table import T
+
+    cases = {}
+
+    def add(name, fn):
+        set_seed(1234)
+        cases[name] = np.asarray(fn(), np.float32)
+
+    x24 = jnp.asarray(np.random.RandomState(7).randn(2, 4), np.float32)
+    x_img = jnp.asarray(np.random.RandomState(8).randn(2, 3, 8, 8), np.float32)
+    x_seq = jnp.asarray(np.random.RandomState(9).randn(2, 5, 4), np.float32)
+
+    add("linear", lambda: nn.Linear(4, 3).forward(x24))
+    add("conv3x3", lambda: nn.SpatialConvolution(3, 4, 3, 3).forward(x_img))
+    add("full_conv", lambda: nn.SpatialFullConvolution(3, 2, 3, 3, 2, 2, 1, 1, 1, 1).forward(x_img))
+    add("maxpool", lambda: nn.SpatialMaxPooling(2, 2, 2, 2).forward(x_img))
+    add("avgpool_pad", lambda: nn.SpatialAveragePooling(
+        3, 3, 2, 2, 1, 1, count_include_pad=False).forward(x_img))
+    add("batchnorm_eval", lambda: (
+        nn.BatchNormalization(4).evaluate().forward(x24)))
+    add("lrn", lambda: nn.SpatialCrossMapLRN(3, 1.0, 0.75, 1.0).forward(x_img))
+    add("logsoftmax", lambda: nn.LogSoftMax().forward(x24))
+    add("rnn_seq", lambda: nn.Recurrent().add(nn.RnnCell(4, 3)).forward(x_seq))
+    add("lstm_seq", lambda: nn.Recurrent().add(nn.LSTMCell(4, 3)).forward(x_seq))
+    add("bilinear", lambda: nn.Bilinear(4, 4, 2).forward(
+        __import__("bigdl_tpu.utils.table", fromlist=["T"]).T(x24, x24)))
+    add("prelu", lambda: nn.PReLU(3).forward(x_img))
+    add("crossentropy", lambda: nn.CrossEntropyCriterion().forward(
+        x24, jnp.asarray([1, 3])))
+    add("grad_linear", lambda: _grad_linear(x24))
+    return cases
+
+
+def _grad_linear(x24):
+    import bigdl_tpu.nn as nn
+    m = nn.Linear(4, 3)
+    y = m.forward(x24)
+    m.backward(x24, jnp.ones_like(y))
+    return m._grads["weight"]
+
+
+if __name__ == "__main__":
+    out = os.path.join(os.path.dirname(__file__), "golden.npz")
+    cases = build_cases()
+    np.savez_compressed(out, **cases)
+    print(f"wrote {len(cases)} golden cases to {out}")

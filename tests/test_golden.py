@@ -1,0 +1,23 @@
+"""Golden regression tests — pinned layer outputs (the pre-generated
+golden-tensor strategy replacing the reference's live-Torch TH harness,
+SURVEY.md §4/§7).  Regenerate with ``python tests/golden/generate.py``
+after an INTENTIONAL numeric change.
+"""
+import os
+
+import numpy as np
+import pytest
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "golden.npz")
+
+
+@pytest.mark.skipif(not os.path.exists(GOLDEN), reason="no golden fixtures")
+def test_golden_outputs():
+    from tests.golden.generate import build_cases
+    want = np.load(GOLDEN)
+    got = build_cases()
+    assert set(got) == set(want.files)
+    for name in want.files:
+        np.testing.assert_allclose(
+            got[name], want[name], rtol=1e-5, atol=1e-6,
+            err_msg=f"golden mismatch for '{name}'")
