@@ -1,0 +1,86 @@
+"""Whole-model gradient checks (ref models/ModelGraientCheckSpec +
+GradientChecker over full models)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Context
+from bigdl_tpu.utils.random import set_seed
+
+
+def model_grad_check(model, criterion, x, target, n_probe=12, eps=1e-2):
+    """Central-difference check of d loss / d params on random coords."""
+    params, state = model.params(), model.state()
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x, state, Context(False, jax.random.PRNGKey(0)))
+        return criterion.apply_loss(out, target)
+
+    grads = jax.grad(loss_fn)(params)
+    flat, unravel = ravel_pytree(params)
+    gflat, _ = ravel_pytree(grads)
+    rng = np.random.RandomState(0)
+    idxs = rng.choice(flat.size, size=min(n_probe, flat.size), replace=False)
+    base = np.asarray(flat, np.float64)
+    max_err = 0.0
+    for i in idxs:
+        up, dn = base.copy(), base.copy()
+        up[i] += eps
+        dn[i] -= eps
+        fd = (float(loss_fn(unravel(jnp.asarray(up, jnp.float32)))) -
+              float(loss_fn(unravel(jnp.asarray(dn, jnp.float32))))) / (2 * eps)
+        g = float(gflat[i])
+        max_err = max(max_err, abs(fd - g) / max(abs(fd), abs(g), 1.0))
+    return max_err
+
+
+def test_lenet_grad_check():
+    set_seed(4)
+    from bigdl_tpu.models.lenet import LeNet5
+    model = LeNet5(10).evaluate()
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 1, 28, 28), jnp.float32)
+    t = jnp.asarray([1, 5])
+    err = model_grad_check(model, nn.ClassNLLCriterion(), x, t)
+    assert err < 5e-2
+
+
+def test_mlp_with_bn_dropout_eval_grad_check():
+    set_seed(4)
+    model = nn.Sequential(
+        nn.Linear(6, 12), nn.BatchNormalization(12), nn.ReLU(),
+        nn.Dropout(0.5), nn.Linear(12, 3), nn.LogSoftMax()).evaluate()
+    x = jnp.asarray(np.random.RandomState(2).randn(4, 6), jnp.float32)
+    t = jnp.asarray([1, 2, 3, 1])
+    err = model_grad_check(model, nn.ClassNLLCriterion(), x, t)
+    assert err < 5e-2
+
+
+def test_rnn_model_grad_check():
+    set_seed(4)
+    from bigdl_tpu.models.rnn import SimpleRNN
+    model = SimpleRNN(input_size=12, hidden_size=6, output_size=12,
+                      bptt_truncate=0).evaluate()
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 4, 12), jnp.float32)
+    t = jnp.asarray(np.random.RandomState(4).randint(1, 13, (2, 4)))
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    err = model_grad_check(model, crit, x, t)
+    assert err < 5e-2
+
+
+def test_resnet_block_grad_check():
+    set_seed(4)
+    from bigdl_tpu.models.resnet import basic_block
+    model = nn.Sequential(basic_block(4, 4)).evaluate()
+    x = jnp.asarray(np.random.RandomState(5).randn(2, 4, 6, 6), jnp.float32)
+
+    params, state = model.params(), model.state()
+
+    def loss_fn(p):
+        out, _ = model.apply(p, x, state, Context(False, jax.random.PRNGKey(0)))
+        return (out ** 2).sum()
+
+    g = jax.grad(loss_fn)(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
