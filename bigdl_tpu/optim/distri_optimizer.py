@@ -45,9 +45,14 @@ logger = logging.getLogger("bigdl_tpu.optim")
 
 class DistriOptimizer(LocalOptimizer):
     def __init__(self, model, dataset, criterion, mesh=None,
-                 drop_percentage: float = 0.0):
+                 drop_percentage: float = 0.0, tensor_parallel: bool = False):
+        """``tensor_parallel=True`` with a mesh containing a ``model`` axis
+        shards eligible weights (and their optimizer state) over that axis
+        via ``parallel.sharding.shard_params_rule`` — hybrid DP x TP with
+        the same user API as pure DP."""
         super().__init__(model, dataset, criterion)
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
+        self.tensor_parallel = tensor_parallel
         if drop_percentage:
             logger.warning(
                 "straggler drop (dropPercentage=%s) is a no-op on TPU: XLA "
@@ -63,6 +68,11 @@ class DistriOptimizer(LocalOptimizer):
         rep = NamedSharding(mesh, P())
         data = NamedSharding(mesh, P("data"))
         reps = lambda tree: jax.tree_util.tree_map(lambda _: rep, tree)
+        if self.tensor_parallel and "model" in mesh.axis_names:
+            from bigdl_tpu.parallel.sharding import shard_params_rule
+            rule = shard_params_rule(mesh, "model")
+            return (jax.tree_util.tree_map(rule, params), reps(net_state),
+                    jax.tree_util.tree_map(rule, opt_state), data)
         return reps(params), reps(net_state), reps(opt_state), data
 
     def _build_step(self):

@@ -168,3 +168,34 @@ def test_graft_entry_dryrun():
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
     m.dryrun_multichip(8)
+
+
+class TestTensorParallelTraining:
+    def test_dp_tp_hybrid_matches_dp(self):
+        """DP x TP training must produce the same numbers as pure DP —
+        sharding is a layout, not a semantic change."""
+        from bigdl_tpu.dataset import DataSet, SampleToBatch, Sample
+        from bigdl_tpu.optim import DistriOptimizer, max_iteration
+        from bigdl_tpu.parallel.mesh import hybrid_mesh
+        from bigdl_tpu.utils.random import set_seed
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(8).astype(np.float32),
+                          np.asarray([rng.randint(1, 5)], np.float32))
+                   for _ in range(64)]
+
+        def run(**kw):
+            set_seed(11)
+            model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                  nn.Linear(16, 4), nn.LogSoftMax())
+            ds = DataSet.array(samples) >> SampleToBatch(32)
+            opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), **kw)
+            opt.set_state(T(learningRate=0.1, momentum=0.9))
+            opt.set_end_when(max_iteration(4))
+            return opt.optimize()
+
+        m_dp = run()
+        m_tp = run(mesh=hybrid_mesh(dp=4, mp=2), tensor_parallel=True)
+        for a, b in zip(m_dp.parameters()[0], m_tp.parameters()[0]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
