@@ -45,14 +45,22 @@ logger = logging.getLogger("bigdl_tpu.optim")
 
 class DistriOptimizer(LocalOptimizer):
     def __init__(self, model, dataset, criterion, mesh=None,
-                 drop_percentage: float = 0.0, tensor_parallel: bool = False):
+                 drop_percentage: float = 0.0, tensor_parallel: bool = False,
+                 zero1: bool = False):
         """``tensor_parallel=True`` with a mesh containing a ``model`` axis
         shards eligible weights (and their optimizer state) over that axis
         via ``parallel.sharding.shard_params_rule`` — hybrid DP x TP with
-        the same user API as pure DP."""
+        the same user API as pure DP.
+
+        ``zero1=True`` shards optimizer state over the ``data`` axis
+        (ZeRO-1) — the direct analogue of the reference's owner-partition
+        update (each AllReduceParameter partition updates only its weight
+        slice, DistriOptimizer.scala:232); XLA moves the state shards as
+        needed and HBM per chip drops by ~|opt_state|*(1-1/N)."""
         super().__init__(model, dataset, criterion)
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.tensor_parallel = tensor_parallel
+        self.zero1 = zero1
         if drop_percentage:
             logger.warning(
                 "straggler drop (dropPercentage=%s) is a no-op on TPU: XLA "
@@ -73,6 +81,11 @@ class DistriOptimizer(LocalOptimizer):
             rule = shard_params_rule(mesh, "model")
             return (jax.tree_util.tree_map(rule, params), reps(net_state),
                     jax.tree_util.tree_map(rule, opt_state), data)
+        if self.zero1:
+            from bigdl_tpu.parallel.sharding import zero1_rule
+            zrule = zero1_rule(mesh, "data")
+            return (reps(params), reps(net_state),
+                    jax.tree_util.tree_map(zrule, opt_state), data)
         return reps(params), reps(net_state), reps(opt_state), data
 
     def _build_step(self):

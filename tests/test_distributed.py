@@ -199,3 +199,31 @@ class TestTensorParallelTraining:
         for a, b in zip(m_dp.parameters()[0], m_tp.parameters()[0]):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
+
+
+def test_zero1_matches_replicated():
+    """ZeRO-1 optimizer-state sharding must not change the numbers."""
+    from bigdl_tpu.dataset import DataSet, SampleToBatch, Sample
+    from bigdl_tpu.optim import DistriOptimizer, max_iteration
+    from bigdl_tpu.utils.random import set_seed
+
+    rng = np.random.RandomState(1)
+    samples = [Sample(rng.randn(8).astype(np.float32),
+                      np.asarray([rng.randint(1, 5)], np.float32))
+               for _ in range(64)]
+
+    def run(**kw):
+        set_seed(13)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4), nn.LogSoftMax())
+        ds = DataSet.array(samples) >> SampleToBatch(32)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), **kw)
+        opt.set_state(T(learningRate=0.1, momentum=0.9))
+        opt.set_end_when(max_iteration(4))
+        return opt.optimize()
+
+    m_rep = run()
+    m_z1 = run(zero1=True)
+    for a, b in zip(m_rep.parameters()[0], m_z1.parameters()[0]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
