@@ -77,10 +77,12 @@ class DistriOptimizer(LocalOptimizer):
         data = NamedSharding(mesh, P("data"))
         reps = lambda tree: jax.tree_util.tree_map(lambda _: rep, tree)
         if self.tensor_parallel and "model" in mesh.axis_names:
-            from bigdl_tpu.parallel.sharding import shard_params_rule
+            from bigdl_tpu.parallel.sharding import (shard_params_rule,
+                                                     zero1_tp_rule)
             rule = shard_params_rule(mesh, "model")
+            orule = zero1_tp_rule(mesh, "data", "model") if self.zero1 else rule
             return (jax.tree_util.tree_map(rule, params), reps(net_state),
-                    jax.tree_util.tree_map(rule, opt_state), data)
+                    jax.tree_util.tree_map(orule, opt_state), data)
         if self.zero1:
             from bigdl_tpu.parallel.sharding import zero1_rule
             zrule = zero1_rule(mesh, "data")

@@ -40,6 +40,15 @@ def ppermute(x, axis_name: str, perm):
     return lax.ppermute(x, axis_name, perm)
 
 
+def pvary(x, axis_names):
+    """Mark ``x`` device-varying over ``axis_names`` (shard_map scan carries
+    must keep a consistent varying type).  ``lax.pvary`` is deprecated in
+    jax>=0.9 in favor of ``lax.pcast(..., to='varying')``."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_names, to="varying")
+    return lax.pvary(x, axis_names)
+
+
 def ring_shift(x, axis_name: str, shift: int = 1):
     """Shift values around the axis ring by ``shift`` positions."""
     n = lax.axis_size(axis_name)

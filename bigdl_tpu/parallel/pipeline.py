@@ -26,6 +26,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from bigdl_tpu.parallel.collectives import pvary
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -53,9 +55,9 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
 
         micro_shape = x_all.shape[1:]
         # pvary: scan carries must be device-varying over the pipe axis
-        buf = lax.pvary(jnp.zeros(micro_shape, x_all.dtype), (axis,))
-        outs = lax.pvary(jnp.zeros((n_micro,) + micro_shape, x_all.dtype),
-                         (axis,))
+        buf = pvary(jnp.zeros(micro_shape, x_all.dtype), (axis,))
+        outs = pvary(jnp.zeros((n_micro,) + micro_shape, x_all.dtype),
+                     (axis,))
 
         def tick(carry, t):
             buf, outs = carry

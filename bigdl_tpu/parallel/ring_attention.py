@@ -24,6 +24,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from bigdl_tpu.parallel.collectives import pvary
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -83,9 +85,9 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False):
     b, _, h, d = q.shape
     # pvary: initial accumulators are device-varying over the ring axis
     # (shard_map scan carries must keep a consistent varying type)
-    m0 = lax.pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((b, h, t_local), jnp.float32), (axis_name,))
-    o0 = lax.pvary(jnp.zeros((b, t_local, h, d), jnp.float32), (axis_name,))
+    m0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), (axis_name,))
+    l0 = pvary(jnp.zeros((b, h, t_local), jnp.float32), (axis_name,))
+    o0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), (axis_name,))
     (k_f, v_f, m, l, o), _ = lax.scan(
         step, (k, v, m0, l0, o0), jnp.arange(n))
     l = jnp.maximum(l, 1e-20)

@@ -59,3 +59,25 @@ def zero1_rule(mesh: Mesh, data_axis: str = "data"):
         return NamedSharding(mesh, P())
 
     return rule
+
+
+def zero1_tp_rule(mesh: Mesh, data_axis: str = "data",
+                  model_axis: str = "model"):
+    """ZeRO-1 composed with tensor parallelism: optimizer-state leaves keep
+    the TP layout of their parameter (dim 0 over ``model`` where eligible)
+    and are additionally sharded over ``data`` — dim 1 for TP'd leaves,
+    dim 0 otherwise — where divisible."""
+    tp = shard_params_rule(mesh, model_axis)
+    dsize = mesh.shape[data_axis]
+
+    def rule(x):
+        s = tp(x)
+        if len(s.spec) and s.spec[0] == model_axis:
+            if x.ndim >= 2 and x.shape[1] % dsize == 0:
+                return NamedSharding(mesh, P(model_axis, data_axis))
+            return s
+        if x.ndim >= 1 and x.shape[0] % dsize == 0:
+            return NamedSharding(mesh, P(data_axis))
+        return s
+
+    return rule

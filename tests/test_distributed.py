@@ -222,8 +222,14 @@ def test_zero1_matches_replicated():
         opt.set_end_when(max_iteration(4))
         return opt.optimize()
 
+    from bigdl_tpu.parallel.mesh import hybrid_mesh
+
     m_rep = run()
     m_z1 = run(zero1=True)
-    for a, b in zip(m_rep.parameters()[0], m_z1.parameters()[0]):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-4, atol=1e-5)
+    # ZeRO-1 composed with tensor parallelism (zero1_tp_rule) must agree too
+    m_z1tp = run(mesh=hybrid_mesh(dp=4, mp=2), tensor_parallel=True,
+                 zero1=True)
+    for variant in (m_z1, m_z1tp):
+        for a, b in zip(m_rep.parameters()[0], variant.parameters()[0]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
