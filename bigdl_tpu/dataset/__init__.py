@@ -1,15 +1,51 @@
 from bigdl_tpu.dataset.sample import Sample, MiniBatch, ByteRecord, LabeledSentence
 from bigdl_tpu.dataset.transformer import (
-    Transformer, ChainedTransformer, Identity, SampleToBatch,
+    Transformer, ChainedTransformer, Identity, SampleToBatch, PreFetch,
 )
 from bigdl_tpu.dataset.dataset import (
     DataSet, LocalDataSet, LocalArrayDataSet, DistributedDataSet,
     ShardedDataSet,
 )
+from bigdl_tpu.dataset.image import (
+    LabeledImage, BytesToImg, BytesToBGRImg, BytesToGreyImg, ImgNormalizer,
+    ImgPixelNormalizer, ImgCropper, ImgRdmCropper, HFlip, ColorJitter,
+    Lighting, ImgToBatch, ImgToSample, MTLabeledImgToBatch,
+)
+from bigdl_tpu.dataset.text import (
+    Dictionary, WordTokenizer, SentenceToLabeledSentence,
+    LabeledSentenceToSample,
+)
+
+# Reference-name aliases (ref dataset/image/*.scala).  BytesToBGRImg above
+# is a real BGR decoder; the remaining layout-agnostic transformers (crop,
+# flip, normalize with caller-supplied per-channel constants) share one
+# implementation for BGR/RGB/grey arrays.
+GreyImgNormalizer = ImgNormalizer
+BGRImgNormalizer = ImgNormalizer
+BGRImgPixelNormalizer = ImgPixelNormalizer
+BGRImgCropper = ImgCropper
+BGRImgRdmCropper = ImgRdmCropper
+GreyImgCropper = ImgRdmCropper  # the reference's grey cropper is random-position
+BGRImgToBatch = ImgToBatch
+GreyImgToBatch = ImgToBatch
+BGRImgToSample = ImgToSample
+MTLabeledBGRImgToBatch = MTLabeledImgToBatch
+ColoJitter = ColorJitter  # reference spelling (dataset/image/ColoJitter.scala)
 
 __all__ = [
     "Sample", "MiniBatch", "ByteRecord", "LabeledSentence",
     "Transformer", "ChainedTransformer", "Identity", "SampleToBatch",
+    "PreFetch",
     "DataSet", "LocalDataSet", "LocalArrayDataSet", "DistributedDataSet",
     "ShardedDataSet",
+    "LabeledImage", "BytesToImg", "BytesToGreyImg", "ImgNormalizer",
+    "ImgPixelNormalizer", "ImgCropper", "ImgRdmCropper", "HFlip",
+    "ColorJitter", "Lighting", "ImgToBatch", "ImgToSample",
+    "MTLabeledImgToBatch",
+    "BytesToBGRImg", "GreyImgNormalizer", "BGRImgNormalizer",
+    "BGRImgPixelNormalizer", "BGRImgCropper", "GreyImgCropper",
+    "BGRImgRdmCropper", "BGRImgToBatch", "GreyImgToBatch", "BGRImgToSample",
+    "MTLabeledBGRImgToBatch", "ColoJitter",
+    "Dictionary", "WordTokenizer", "SentenceToLabeledSentence",
+    "LabeledSentenceToSample",
 ]

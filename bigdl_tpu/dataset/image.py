@@ -19,13 +19,18 @@ from bigdl_tpu.utils.random import RNG
 
 
 class LabeledImage:
-    """HWC float image + label (ref LabeledBGRImage image/Types.scala:246)."""
+    """HWC float image + label (ref LabeledBGRImage image/Types.scala:246).
 
-    __slots__ = ("data", "label")
+    ``order`` records the channel layout ("rgb" or "bgr") so layout-sensitive
+    transformers (ColorJitter, Lighting) pick correct per-channel weights
+    without the caller having to thread it through the pipeline."""
 
-    def __init__(self, data, label):
+    __slots__ = ("data", "label", "order")
+
+    def __init__(self, data, label, order: str = "rgb"):
         self.data = np.asarray(data, np.float32)
         self.label = float(label)
+        self.order = order
 
     @property
     def height(self):
@@ -47,31 +52,57 @@ def _decode_bytes(raw: bytes):
 
 
 class BytesToImg(Transformer):
-    """Decode ByteRecord bytes to LabeledImage, optional resize to
-    (scale_to, scale_to) (ref BytesToBGRImg; BGRImage.resize
-    image/Types.scala:278)."""
+    """Decode ByteRecord bytes to LabeledImage in RGB channel order,
+    optional resize to (scale_to, scale_to) (ref BytesToBGRImg;
+    BGRImage.resize image/Types.scala:278).  ``to_bgr=True`` flips channel
+    order to the reference's BGR so reference-ordered per-channel
+    constants (normalizer means/stds, jitter weights) apply unchanged."""
 
-    def __init__(self, scale_to: int = None):
+    def __init__(self, scale_to: int = None, to_bgr: bool = False):
         self.scale_to = scale_to
+        self.to_bgr = to_bgr
 
     def __call__(self, iterator):
         for rec in iterator:
             arr = _decode_bytes(rec.data)
             if self.scale_to is not None:
                 arr = _resize(arr, self.scale_to, self.scale_to)
-            yield LabeledImage(arr, rec.label)
+            if self.to_bgr:
+                arr = arr[..., ::-1].copy()
+            yield LabeledImage(arr, rec.label,
+                               order="bgr" if self.to_bgr else "rgb")
+
+
+class BytesToBGRImg(BytesToImg):
+    """Decode to BGR channel order exactly like the reference's
+    BytesToBGRImg (image/Types.scala:278 stores pixels BGR), so pipelines
+    ported with reference BGR mean/std tuples stay channel-correct."""
+
+    def __init__(self, scale_to: int = None):
+        super().__init__(scale_to=scale_to, to_bgr=True)
 
 
 def _resize(arr, h, w):
-    """Bilinear resize via PIL if present, else nearest with numpy."""
-    try:
-        from PIL import Image as PILImage
-        img = PILImage.fromarray(arr.astype(np.uint8))
-        return np.asarray(img.resize((w, h), PILImage.BILINEAR), np.float32)
-    except ImportError:  # pragma: no cover
-        ys = (np.arange(h) * arr.shape[0] / h).astype(int)
-        xs = (np.arange(w) * arr.shape[1] / w).astype(int)
-        return arr[ys][:, xs]
+    """Bilinear resize, pure numpy on float32 (no uint8 round-trip, so
+    normalized/negative pixel values survive).  Works on HW and HWC."""
+    arr = np.asarray(arr, np.float32)
+    H, W = arr.shape[:2]
+    if (H, W) == (h, w):
+        return arr
+    ys = np.linspace(0, H - 1, h, dtype=np.float32)
+    xs = np.linspace(0, W - 1, w, dtype=np.float32)
+    y0 = np.floor(ys).astype(np.intp)
+    x0 = np.floor(xs).astype(np.intp)
+    y1 = np.minimum(y0 + 1, H - 1)
+    x1 = np.minimum(x0 + 1, W - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    if arr.ndim == 3:
+        wy = wy[..., None]
+        wx = wx[..., None]
+    top = arr[y0][:, x0] * (1 - wx) + arr[y0][:, x1] * wx
+    bot = arr[y1][:, x0] * (1 - wx) + arr[y1][:, x1] * wx
+    return (top * (1 - wy) + bot * wy).astype(np.float32)
 
 
 class BytesToGreyImg(Transformer):
@@ -188,60 +219,95 @@ class HFlip(Transformer):
 
 class ColorJitter(Transformer):
     """Random brightness/contrast/saturation in random order
-    (ref ColoJitter.scala)."""
+    (ref ColoJitter.scala).  Channel layout is read from each image's
+    ``order`` (set by the decoders); pass ``channel_order`` only to
+    override it."""
 
     def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
-                 saturation: float = 0.4):
+                 saturation: float = 0.4, channel_order: str = None):
+        if channel_order not in (None, "bgr", "rgb"):
+            raise ValueError(f"channel_order must be bgr|rgb, got {channel_order}")
         self.brightness = brightness
         self.contrast = contrast
         self.saturation = saturation
+        self.channel_order = channel_order
 
-    def _grayscale(self, d):
-        # BGR weights as in the reference
-        g = 0.114 * d[..., 0] + 0.587 * d[..., 1] + 0.299 * d[..., 2]
+    def _grayscale(self, d, order):
+        # ITU-R 601 luma; weight per channel position depends on layout
+        r, g_, b = ((2, 1, 0) if order == "bgr" else (0, 1, 2))
+        g = 0.299 * d[..., r] + 0.587 * d[..., g_] + 0.114 * d[..., b]
         return g[..., None]
 
     def __call__(self, iterator):
         rng = RNG.np_rng()
         for img in iterator:
+            order = self.channel_order or getattr(img, "order", "rgb")
             ops = [self._do_brightness, self._do_contrast, self._do_saturation]
             rng.shuffle(ops)
             for op in ops:
-                img.data = op(img.data, rng)
+                img.data = op(img.data, rng, order)
             yield img
 
-    def _do_brightness(self, d, rng):
+    def _do_brightness(self, d, rng, order):
         alpha = 1.0 + rng.uniform(-self.brightness, self.brightness)
         return d * alpha
 
-    def _do_contrast(self, d, rng):
+    def _do_contrast(self, d, rng, order):
         alpha = 1.0 + rng.uniform(-self.contrast, self.contrast)
-        mean = self._grayscale(d).mean()
+        mean = self._grayscale(d, order).mean()
         return d * alpha + mean * (1 - alpha)
 
-    def _do_saturation(self, d, rng):
+    def _do_saturation(self, d, rng, order):
         alpha = 1.0 + rng.uniform(-self.saturation, self.saturation)
-        return d * alpha + self._grayscale(d) * (1 - alpha)
+        return d * alpha + self._grayscale(d, order) * (1 - alpha)
 
 
 class Lighting(Transformer):
     """PCA lighting noise with ImageNet eigen-decomposition
-    (ref Lighting.scala)."""
+    (ref Lighting.scala; values originate from fb.resnet.torch where rows
+    are RGB-ordered).  Row order follows each image's channel layout, so
+    BGR-decoded pipelines get the R/B components applied to the right
+    channels."""
 
     alphastd = 0.1
     eig_val = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
-    eig_vec = np.asarray([
+    eig_vec = np.asarray([  # rows: R, G, B
         [-0.5675, 0.7192, 0.4009],
         [-0.5808, -0.0045, -0.8140],
         [-0.5836, -0.6948, 0.4203]], np.float32)
 
+    def __init__(self, channel_order: str = None):
+        if channel_order not in (None, "bgr", "rgb"):
+            raise ValueError(f"channel_order must be bgr|rgb, got {channel_order}")
+        self.channel_order = channel_order
+
     def __call__(self, iterator):
         rng = RNG.np_rng()
         for img in iterator:
+            order = self.channel_order or getattr(img, "order", "rgb")
             alpha = rng.normal(0, self.alphastd, 3).astype(np.float32)
             shift = (self.eig_vec * alpha * self.eig_val).sum(axis=1)
+            if order == "bgr":
+                shift = shift[::-1]
             img.data = img.data + shift
             yield img
+
+
+def _img_to_nchw(data, to_chw):
+    """One LabeledImage array -> CHW (grey gets a singleton channel)."""
+    from bigdl_tpu import native
+    if data.ndim == 2:
+        return data[None]  # grey -> (1, H, W)
+    if to_chw:
+        return native.hwc_to_chw(data)
+    return data
+
+
+def _stack_batch(imgs, to_chw):
+    """LabeledImages -> one MiniBatch (shared by serial + MT batchers)."""
+    xs = [_img_to_nchw(img.data, to_chw) for img in imgs]
+    ys = [img.label for img in imgs]
+    return MiniBatch(np.stack(xs), np.asarray(ys, np.float32))
 
 
 class ImgToBatch(Transformer):
@@ -252,21 +318,90 @@ class ImgToBatch(Transformer):
         self.to_chw = to_chw
 
     def __call__(self, iterator):
-        from bigdl_tpu import native
-        buf_x, buf_y = [], []
+        buf = []
         for img in iterator:
-            d = img.data
-            if d.ndim == 2:
-                d = d[None]  # grey -> (1, H, W)
-            elif self.to_chw:
-                d = native.hwc_to_chw(d)
-            buf_x.append(d)
-            buf_y.append(img.label)
-            if len(buf_x) == self.batch_size:
-                yield MiniBatch(np.stack(buf_x), np.asarray(buf_y, np.float32))
-                buf_x, buf_y = [], []
-        if buf_x:
-            yield MiniBatch(np.stack(buf_x), np.asarray(buf_y, np.float32))
+            buf.append(img)
+            if len(buf) == self.batch_size:
+                yield _stack_batch(buf, self.to_chw)
+                buf = []
+        if buf:
+            yield _stack_batch(buf, self.to_chw)
+
+
+class MTLabeledImgToBatch(Transformer):
+    """Multi-threaded record->image->MiniBatch assembly (ref
+    MTLabeledBGRImgToBatch.scala:47: coreNumber cloned sub-pipelines feeding
+    a PreFetch queue).  ``transformer`` maps one upstream record to a
+    LabeledImage; it is applied concurrently across ``num_threads`` host
+    threads per batch, and finished batches are prefetched one deep so host
+    decode/augment overlaps device compute.  ``width``/``height`` fix the
+    batch buffer dims as in the reference: any image arriving at another
+    size is resized before stacking."""
+
+    def __init__(self, width: int, height: int, batch_size: int,
+                 transformer: Transformer, num_threads: int = None,
+                 to_chw: bool = True):
+        import os
+        import threading
+        self.width = width
+        self.height = height
+        self.batch_size = batch_size
+        self.transformer = transformer
+        self.num_threads = num_threads or min(8, os.cpu_count() or 1)
+        self.to_chw = to_chw
+        self._tls = threading.local()
+
+    def _thread_transformer(self):
+        # one cloned sub-pipeline per worker thread, as the reference does
+        # (MTLabeledBGRImgToBatch.scala:47): transformers with mutable
+        # instance state (preallocated buffers etc.) must not be shared
+        import copy
+        tls = self._tls
+        if getattr(tls, "transformer", None) is None:
+            tls.transformer = copy.deepcopy(self.transformer)
+        return tls.transformer
+
+    def _apply_one(self, rec):
+        out = list(self._thread_transformer()(iter([rec])))
+        if len(out) != 1:
+            raise ValueError(
+                "MTLabeledImgToBatch transformer must be 1-to-1 per record")
+        img = out[0]
+        h, w = img.data.shape[:2]
+        if (h, w) != (self.height, self.width):
+            img.data = _resize(img.data, self.height, self.width)
+        return img
+
+    def __call__(self, iterator):
+        from concurrent.futures import ThreadPoolExecutor
+
+        def batches():
+            buf = []
+            for rec in iterator:
+                buf.append(rec)
+                if len(buf) == self.batch_size:
+                    yield buf
+                    buf = []
+            if buf:
+                yield buf
+
+        def build(pool, raw):
+            return _stack_batch(list(pool.map(self._apply_one, raw)),
+                                self.to_chw)
+
+        # +2 threads run whole-batch assembly (at most 2 in flight) so all
+        # num_threads decode workers stay available — a blocked assembly
+        # task must never starve the per-record tasks it is waiting on.
+        with ThreadPoolExecutor(max_workers=self.num_threads + 2) as pool:
+            from collections import deque
+            futures = deque()
+            it = batches()
+            for raw in it:
+                futures.append(pool.submit(build, pool, raw))
+                if len(futures) >= 2:
+                    yield futures.popleft().result()
+            while futures:
+                yield futures.popleft().result()
 
 
 class ImgToSample(Transformer):

@@ -122,18 +122,37 @@ class PreFetch(Transformer):
 
         q = queue.Queue(maxsize=self.depth)
         _END = object()
+        stop = threading.Event()
+
+        def put(item):
+            # bounded put that gives up when the consumer is gone, so an
+            # abandoned iterator can't leave this thread blocked forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for item in iterator:
-                    q.put(item)
-            finally:
-                q.put(_END)
+                    if not put(item):
+                        return
+                put(_END)
+            except BaseException as e:  # propagate to the consumer
+                put(e)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()

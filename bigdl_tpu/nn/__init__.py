@@ -43,6 +43,7 @@ from bigdl_tpu.nn.reductions import (
     Mean, Sum, Max, Min, Index, Select, Narrow, MaskedSelect,
 )
 from bigdl_tpu.nn.dropout import Dropout, L1Penalty
+from bigdl_tpu.nn.nms import Nms, nms_mask, nms_indices
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTMCell, GRUCell, Recurrent, BiRecurrent, TimeDistributed,
 )

@@ -57,3 +57,18 @@ def nms_indices(boxes, scores, threshold: float):
     scores = np.asarray(scores)
     idx = np.where(keep)[0]
     return idx[np.argsort(-scores[idx])]
+
+
+class Nms:
+    """Stateful NMS helper matching the reference class shape (ref
+    nn/Nms.scala: construct once, call per proposal set)."""
+
+    def __init__(self, threshold: float = 0.7):
+        self.threshold = threshold
+
+    def __call__(self, boxes, scores):
+        return nms_indices(boxes, scores, self.threshold)
+
+    def keep_mask(self, boxes, scores):
+        """jit-compatible mask form for on-device detection heads."""
+        return nms_mask(boxes, scores, self.threshold)

@@ -10,10 +10,11 @@ from bigdl_tpu.optim.trigger import (
 )
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,
-    Top1Accuracy, Top5Accuracy, Loss,
+    Top1Accuracy, Top5Accuracy, Loss, EvaluateMethods,
 )
+from bigdl_tpu.optim.validator import Validator, LocalValidator, DistriValidator
 from bigdl_tpu.optim.metrics import Metrics
-from bigdl_tpu.optim.local_optimizer import LocalOptimizer, validate
+from bigdl_tpu.optim.local_optimizer import LocalOptimizer, validate, distri_validate
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.optim.optimizer import Optimizer
 from bigdl_tpu.optim.predictor import Predictor, DLClassifier
@@ -25,7 +26,8 @@ __all__ = [
     "Trigger", "every_epoch", "several_iteration", "max_epoch",
     "max_iteration", "min_loss",
     "ValidationMethod", "ValidationResult", "AccuracyResult", "LossResult",
-    "Top1Accuracy", "Top5Accuracy", "Loss", "Metrics",
+    "Top1Accuracy", "Top5Accuracy", "Loss", "EvaluateMethods", "Metrics",
+    "Validator", "LocalValidator", "DistriValidator",
     "LocalOptimizer", "DistriOptimizer", "Optimizer", "validate",
-    "Predictor", "DLClassifier",
+    "distri_validate", "Predictor", "DLClassifier",
 ]

@@ -74,6 +74,19 @@ def _topk_correct(output, target, k):
     return int(correct), int(output.shape[0])
 
 
+class EvaluateMethods:
+    """Raw tensor accuracy helpers (ref EvaluateMethods.scala:23): return
+    ``(correct, count)`` without the result-object wrapper."""
+
+    @staticmethod
+    def calc_accuracy(output, target):
+        return _topk_correct(output, target, 1)
+
+    @staticmethod
+    def calc_top5_accuracy(output, target):
+        return _topk_correct(output, target, 5)
+
+
 class Top1Accuracy(ValidationMethod):
     def __call__(self, output, target):
         return AccuracyResult(*_topk_correct(output, target, 1))

@@ -1,0 +1,53 @@
+"""Validator classes (ref optim/Validator.scala:24, LocalValidator.scala:30,
+DistriValidator.scala:32).
+
+The reference exposes evaluation as ``Validator(model, dataset).test(methods)``
+with a Local/Distri split chosen by dataset type; the computation itself lives
+in :func:`bigdl_tpu.optim.local_optimizer.validate` /
+:func:`~bigdl_tpu.optim.local_optimizer.distri_validate`.  These classes keep
+that API shape for users coming from the reference.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.optim.local_optimizer import validate, distri_validate
+
+
+class Validator:
+    """Evaluate a model over a dataset (ref Validator.scala:24).
+
+    ``Validator(model, dataset)`` picks Local vs Distri semantics the way the
+    reference's ``Validator()`` factory does (Validator.scala:44–52): a
+    dataset that reports itself as distributed/sharded evaluates with
+    cross-host result merging.
+    """
+
+    def __init__(self, model, dataset):
+        self.model = model
+        self.dataset = dataset
+
+    def _fn(self):
+        from bigdl_tpu.dataset.dataset import DistributedDataSet, ShardedDataSet
+        if isinstance(self.dataset, (DistributedDataSet, ShardedDataSet)):
+            return distri_validate
+        return validate
+
+    def test(self, methods, params=None, net_state=None):
+        """Run every ValidationMethod over the dataset; returns
+        ``[(method, result)]`` (ref Validator.test)."""
+        params = params if params is not None else self.model.params()
+        net_state = net_state if net_state is not None else self.model.state()
+        return self._fn()(self.model, params, net_state, self.dataset, methods)
+
+
+class LocalValidator(Validator):
+    """Single-process evaluation (ref LocalValidator.scala:30)."""
+
+    def _fn(self):
+        return validate
+
+
+class DistriValidator(Validator):
+    """Multi-host evaluation with result merge (ref DistriValidator.scala:32)."""
+
+    def _fn(self):
+        return distri_validate

@@ -2,8 +2,12 @@ from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.random import RandomGenerator
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils import file as File
+from bigdl_tpu.utils import torch_file as TorchFile
+from bigdl_tpu.utils import caffe_loader as CaffeLoader
+from bigdl_tpu.parallel.broadcast import model_broadcast as ModelBroadcast
 
-__all__ = ["Table", "T", "RandomGenerator", "Engine", "File"]
+__all__ = ["Table", "T", "RandomGenerator", "Engine", "File",
+           "TorchFile", "CaffeLoader", "ModelBroadcast", "kth_largest"]
 
 
 def kth_largest(values, k):

@@ -6,8 +6,16 @@ its golden tests.  On TPU we port the *reproducibility guarantee* (seeded
 determinism), not the generator (SURVEY.md §7 "hard parts"): host-side
 initialization uses a numpy MT19937 stream, device-side randomness (dropout)
 uses JAX's counter-based PRNG keyed off the same seed.
+
+Like the reference (RandomGenerator.scala:24-34 is a thread-local), host
+streams are per-thread: worker threads (MTLabeledImgToBatch, PreFetch
+pipelines) each get an independent stream derived from the global seed, so
+concurrent augmentation neither races on Mersenne state nor loses seeded
+determinism on the main thread.
 """
 from __future__ import annotations
+
+import threading
 
 import numpy as np
 import jax
@@ -17,10 +25,16 @@ class RandomGenerator:
     """Global, seedable RNG. ``RNG`` below is the process-wide instance."""
 
     def __init__(self, seed: int = 1):
+        self._tls = threading.local()
+        self._lock = threading.Lock()
         self.set_seed(seed)
 
     def set_seed(self, seed: int):
         self._seed = int(seed)
+        # bump epoch so previously-created thread streams reinitialize
+        self._epoch = getattr(self, "_epoch", 0) + 1
+        self._thread_counter = 0
+        self._main_thread = threading.get_ident()
         self._np = np.random.RandomState(self._seed)
         self._key_counter = 0
         return self
@@ -30,30 +44,44 @@ class RandomGenerator:
 
     # -- host-side (parameter init, shuffles) -----------------------------
     def uniform(self, a=0.0, b=1.0, size=None):
-        return self._np.uniform(a, b, size)
+        return self.np_rng().uniform(a, b, size)
 
     def normal(self, mean=0.0, stdv=1.0, size=None):
-        return self._np.normal(mean, stdv, size)
+        return self.np_rng().normal(mean, stdv, size)
 
     def bernoulli(self, p=0.5, size=None):
-        return (self._np.uniform(0.0, 1.0, size) < p).astype(np.float32)
+        return (self.np_rng().uniform(0.0, 1.0, size) < p).astype(np.float32)
 
     def randperm(self, n):
         """1-based random permutation, like Torch randperm."""
-        return self._np.permutation(n) + 1
+        return self.np_rng().permutation(n) + 1
 
     def shuffle(self, array):
-        self._np.shuffle(array)
+        self.np_rng().shuffle(array)
         return array
 
     def np_rng(self) -> np.random.RandomState:
-        return self._np
+        """This thread's stream: the seed stream on the seeding thread,
+        a derived independent stream on every other thread."""
+        if threading.get_ident() == self._main_thread:
+            return self._np
+        tls = self._tls
+        if getattr(tls, "epoch", None) != self._epoch:
+            with self._lock:
+                self._thread_counter += 1
+                ordinal = self._thread_counter
+            derived = (self._seed + 0x9E3779B1 * ordinal) % (2 ** 32)
+            tls.rng = np.random.RandomState(derived)
+            tls.epoch = self._epoch
+        return tls.rng
 
     # -- device-side key stream (dropout etc.) ----------------------------
     def next_key(self):
         """A fresh JAX PRNG key; successive calls give independent keys."""
-        self._key_counter += 1
-        return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._key_counter)
+        with self._lock:
+            self._key_counter += 1
+            counter = self._key_counter
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), counter)
 
 
 RNG = RandomGenerator(seed=1)

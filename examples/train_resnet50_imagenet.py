@@ -59,7 +59,7 @@ def main(argv=None):
         train_ds = (ShardFolder(args.shardFolder, distributed=True)
                     >> BytesToImg(256)
                     >> ImgRdmCropper(224, 224) >> HFlip()
-                    >> ColorJitter() >> Lighting()
+                    >> ColorJitter(channel_order="rgb") >> Lighting()
                     >> ImgNormalizer((123.0, 117.0, 104.0), (58.4, 57.1, 57.4))
                     >> ImgToBatch(args.batchSize) >> PreFetch(2))
 
