@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from bigdl_tpu import native
 from bigdl_tpu.dataset.sample import Sample
 from bigdl_tpu.dataset.transformer import Transformer, FuncTransformer
 from bigdl_tpu.dataset.sample import MiniBatch
@@ -295,7 +296,6 @@ class Lighting(Transformer):
 
 def _img_to_nchw(data, to_chw):
     """One LabeledImage array -> CHW (grey gets a singleton channel)."""
-    from bigdl_tpu import native
     if data.ndim == 2:
         return data[None]  # grey -> (1, H, W)
     if to_chw:

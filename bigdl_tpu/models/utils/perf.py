@@ -92,11 +92,12 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
                        in_shardings=(reps(params), reps(net_state),
                                      reps(opt_state), data_s, data_s, rep),
                        out_shardings=(reps(params), reps(net_state),
-                                      reps(opt_state), rep))
+                                      reps(opt_state), rep),
+                       donate_argnums=(0, 1, 2))
         x = jax.device_put(x, data_s)
         y = jax.device_put(y, data_s)
     else:
-        step = jax.jit(train_step)
+        step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
     compile_t0 = time.perf_counter()
     out = step(params, net_state, opt_state, x, y, key)

@@ -29,7 +29,10 @@ def _conv(x, w, stride, padding, *, lhs_dilation=None, rhs_dilation=None, groups
     # Both operands cast to the compute dtype (bf16 feeds the MXU at full
     # rate; accumulation is f32 inside the MXU regardless), output cast back.
     # No preferred_element_type: its VJP would pair an f32 cotangent with
-    # bf16 operands, which conv_general_dilated rejects.
+    # bf16 operands, which conv_general_dilated rejects — and a custom-VJP
+    # formulation with pet=f32 in all three convs, despite a 1.7x win on an
+    # isolated chained-conv microbench, measured 4-12% SLOWER end-to-end on
+    # Inception-v1/VGG-16 training steps (PERF_NOTES.md), so it was removed.
     p = policy()
     y = lax.conv_general_dilated(
         p.cast_compute(x), p.cast_compute(w),

@@ -115,10 +115,13 @@ class DistriOptimizer(LocalOptimizer):
         opt_state = self.optim_method.init_state(params)
         ps, ns, os_, data_s = self._shardings(params, net_state, opt_state)
         rep = NamedSharding(mesh, P())
+        # carried state is donated (buffers recycled in place); optimize()
+        # passes copies so the module's own arrays survive
         return jax.jit(
             step,
             in_shardings=(ps, ns, os_, data_s, data_s, rep, rep),
             out_shardings=(ps, ns, os_, rep),
+            donate_argnums=(0, 1, 2),
         )
 
     def _device_put_batch(self, x, y):
@@ -136,8 +139,8 @@ class DistriOptimizer(LocalOptimizer):
         state.get_or_update("epoch", 1)
         state.get_or_update("neval", 1)
 
-        params = self.model.params()
-        net_state = self.model.state()
+        params = jax.tree_util.tree_map(jnp.copy, self.model.params())
+        net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
         opt_state = self.optim_method.init_state(params)
         step_fn = self._build_step()
 

@@ -127,7 +127,10 @@ class LocalOptimizer:
             new_params, new_opt_state = method.update(grads, opt_state, params, hyper)
             return new_params, new_net_state, new_opt_state, loss
 
-        return jax.jit(step)
+        # donate the carried state: the old params/opt-state buffers are
+        # dead after each step, so XLA reuses them instead of allocating a
+        # second copy of the model per step
+        return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # -- main loop (ref LocalOptimizer.optimize :77) ----------------------
     def optimize(self):
@@ -135,8 +138,11 @@ class LocalOptimizer:
         state.get_or_update("epoch", 1)
         state.get_or_update("neval", 1)
 
-        params = self.model.params()
-        net_state = self.model.state()
+        # copy the model's arrays: the jit step donates its carried state,
+        # and donating the module's own buffers would leave the user's model
+        # holding deleted arrays mid-training
+        params = jax.tree_util.tree_map(jnp.copy, self.model.params())
+        net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
         opt_state = self.optim_method.init_state(params)
         step_fn = self._build_step()
 
@@ -201,8 +207,10 @@ class LocalOptimizer:
         if self.checkpoint_trigger is None or not self.checkpoint_trigger(state):
             return
         neval = state["neval"]
-        self.model.load_params(params)
-        self.model.load_state(net_state)
+        # load host copies: loading the live pytree would leave the module
+        # referencing buffers the next (donating) step deletes
+        self.model.load_params(jax.device_get(params))
+        self.model.load_state(jax.device_get(net_state))
         File.save_module(self.model, f"{self.checkpoint_path}/model.{neval}")
         File.save({"state": state, "opt_state": opt_state},
                   f"{self.checkpoint_path}/state.{neval}")

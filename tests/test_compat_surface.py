@@ -215,6 +215,38 @@ def test_prefetch_abandoned_consumer_unblocks_worker():
     assert threading.active_count() <= n_before
 
 
+def test_interrupted_training_after_checkpoint_leaves_model_usable(tmp_path):
+    """The jit step donates its carried buffers; a checkpoint must not load
+    the live (about-to-be-donated) arrays into the module, or an interrupt
+    after the next step leaves the user's model holding deleted buffers."""
+    import jax.numpy as jnp
+    from bigdl_tpu.optim import LocalOptimizer, several_iteration
+    from bigdl_tpu.utils.table import T
+
+    class Boom(Exception):
+        pass
+
+    def exploding_end(state):
+        if state["neval"] >= 3:  # one full step after the checkpoint fired
+            raise Boom()
+        return False
+
+    xs = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    ys = np.float32(np.random.RandomState(1).randint(1, 3, size=(16,)))
+    samples = [dataset.Sample(x, np.asarray([y], np.float32))
+               for x, y in zip(xs, ys)]
+    ds = dataset.DataSet.array(samples) >> dataset.SampleToBatch(8)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+    opt.set_state(T(learningRate=0.1))
+    opt.set_checkpoint(str(tmp_path), several_iteration(2))
+    opt.set_end_when(exploding_end)
+    with pytest.raises(Boom):
+        opt.optimize()
+    out = model.predict(jnp.asarray(xs))  # must not hit deleted arrays
+    assert np.asarray(out).shape == (16, 2)
+
+
 def test_validator_classes():
     import jax.numpy as jnp
     model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
