@@ -163,3 +163,12 @@ class DataSet:
             for f in sorted(os.listdir(d)):
                 records.append((os.path.join(d, f), float(li + 1)))
         return DataSet.array(records, distributed)
+
+    @staticmethod
+    def seq_file_folder(path, distributed: bool = False):
+        """Packed-shard streaming dataset — the Hadoop SequenceFile
+        ingestion role (ref DataSet.SeqFileFolder DataSet.scala:384-455);
+        shards are written by ``bigdl_tpu.dataset.shardfile.write_shards``
+        / ``imagenet_tools``."""
+        from bigdl_tpu.dataset.shardfile import ShardFolder
+        return ShardFolder(path, distributed=distributed)

@@ -98,6 +98,13 @@ class ShardFolder(LocalDataSet):
         if distributed:
             idx, nproc = jax.process_index(), jax.process_count()
             self.local_paths = self.paths[idx::nproc]
+            if not self.local_paths:
+                # an empty local slice would make the train iterator spin
+                # forever yielding nothing while peers wait at the collective
+                raise ValueError(
+                    f"process {idx}/{nproc} got no shards: {len(self.paths)} "
+                    f"shard files under {folder} < process count; repack with "
+                    f"write_shards(n_shards >= {nproc})")
         else:
             self.local_paths = list(self.paths)
         self._order = list(range(len(self.local_paths)))

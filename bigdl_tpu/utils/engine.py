@@ -23,6 +23,7 @@ class _Engine:
         self._node_number = 1
         self._core_number = 1
         self._mesh = None
+        self._singleton_fd = None
 
     # -- lifecycle (ref Engine.init Engine.scala:339) ---------------------
     def init(self, node_number: int | None = None, core_number: int | None = None,
@@ -34,10 +35,17 @@ class _Engine:
         (one process per TPU VM host — the Spark-executor role in the
         reference, DistriOptimizer.scala).
         """
+        # env-var topology (ref DL_NODE_NUMBER/DL_CORE_NUMBER consumed on
+        # executors, Engine.scala:234-264) wins over the live JAX topology
+        # so launchers can pin it the way scripts/bigdl.sh did
         if node_number is None:
-            node_number = jax.process_count()
+            env = os.environ.get("BIGDL_NODE_NUMBER",
+                                 os.environ.get("DL_NODE_NUMBER"))
+            node_number = int(env) if env else jax.process_count()
         if core_number is None:
-            core_number = jax.local_device_count()
+            env = os.environ.get("BIGDL_CORE_NUMBER",
+                                 os.environ.get("DL_CORE_NUMBER"))
+            core_number = int(env) if env else jax.local_device_count()
         self._node_number = int(node_number)
         self._core_number = int(core_number)
         self._initialized = True
@@ -59,6 +67,45 @@ class _Engine:
     def _ensure_init(self):
         if not self._initialized:
             self.init()
+
+    # -- singleton guard (ref Engine.checkSingleton Engine.scala:222-232) --
+    def check_singleton(self) -> bool:
+        """Detect a second training process contending for this host's TPU.
+
+        The reference guards against two BigDL tasks landing in one executor
+        JVM (they would corrupt the shared thread pools); the TPU analog is
+        two processes trying to own the same local chips.  Uses a pid lock
+        file per host; stale locks (dead pid) are reclaimed.  Disable with
+        ``BIGDL_CHECK_SINGLETON=0`` (the ``bigdl.check.singleton`` knob,
+        ref Optimizer.scala:63).
+        """
+        if os.environ.get("BIGDL_CHECK_SINGLETON", "1") == "0":
+            return True
+        if self._singleton_fd is not None:
+            return True  # this process already holds the lock
+        import fcntl
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            f"bigdl_tpu_engine_{jax.process_index()}.lock")
+        # flock on a long-lived fd: the kernel releases it when the process
+        # dies, so there are no stale locks and no pid-file TOCTOU races —
+        # exactly one live process can hold LOCK_EX at a time
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        os.truncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())  # diagnostics only
+        self._singleton_fd = fd  # keep open for the process lifetime
+        return True
+
+    def engine_type(self) -> str:
+        """Compute-backend tag (the reference returns MklBlas,
+        Engine.scala:273-289); here the backend is XLA on the visible
+        platform."""
+        return f"Xla:{jax.devices()[0].platform}"
 
     # -- topology queries (ref Engine.scala:234-264) ----------------------
     def node_number(self) -> int:
@@ -107,6 +154,8 @@ class _Engine:
         return self._mesh
 
     def reset(self):
+        if self._singleton_fd is not None:
+            os.close(self._singleton_fd)  # releases the flock
         self.__init__()
 
 

@@ -215,6 +215,29 @@ def test_prefetch_abandoned_consumer_unblocks_worker():
     assert threading.active_count() <= n_before
 
 
+def test_engine_parity_surface(monkeypatch):
+    from bigdl_tpu.utils.engine import _Engine
+    eng = _Engine()
+    # env-var topology wins (ref DL_NODE_NUMBER/DL_CORE_NUMBER)
+    monkeypatch.setenv("BIGDL_NODE_NUMBER", "4")
+    monkeypatch.setenv("BIGDL_CORE_NUMBER", "2")
+    eng.init()
+    assert eng.node_number() == 4 and eng.core_number() == 2
+    assert eng.engine_type().startswith("Xla:")
+    assert eng.check_singleton() is True  # this process holds/claims the lock
+    assert eng.check_singleton() is True  # idempotent for the same pid
+
+
+def test_seq_file_folder_roundtrip(tmp_path):
+    from bigdl_tpu.dataset.shardfile import write_shards
+    recs = [(float(i % 3 + 1), bytes([i] * 4)) for i in range(10)]
+    write_shards(iter(recs), str(tmp_path), n_shards=2)
+    ds = dataset.DataSet.seq_file_folder(str(tmp_path), distributed=False)
+    got = sorted((bytes(r.data), r.label) for r in ds.data(train=False))
+    want = sorted((d, l) for l, d in recs)
+    assert got == want
+
+
 def test_interrupted_training_after_checkpoint_leaves_model_usable(tmp_path):
     """The jit step donates its carried buffers; a checkpoint must not load
     the live (about-to-be-donated) arrays into the module, or an interrupt
