@@ -34,20 +34,29 @@ def _on_tpu() -> bool:
         return False
 
 
-def _sgd_kernel(p_ref, g_ref, v_ref, h_ref, p_out, v_out):
-    """v' = mom * v + (g + wd * p); p' = p - lr * v' (one VMEM pass).
-    h_ref holds [lr, momentum, weight_decay] in SMEM."""
-    lr = h_ref[0]
-    mom = h_ref[1]
-    wd = h_ref[2]
-    g = g_ref[:] + wd * p_ref[:]
-    v_new = mom * v_ref[:] + g
-    v_out[:] = v_new
-    p_out[:] = p_ref[:] - lr * v_new
+def _make_sgd_kernel(nesterov: bool):
+    def kernel(p_ref, g_ref, v_ref, h_ref, p_out, v_out):
+        """g~ = g + wd*p; with momentum: v' = mom*v + (1-damp)*g~ and
+        p' = p - lr*(g~ + mom*v' if nesterov else v'); with mom == 0 the
+        unfused path's semantics hold exactly — velocity untouched, step
+        = g~ (dampening ignored).  One VMEM pass.
+        h_ref holds [lr, momentum, weight_decay, dampening] in SMEM."""
+        lr, mom, wd, damp = h_ref[0], h_ref[1], h_ref[2], h_ref[3]
+        has_mom = (mom != 0.0).astype(p_ref.dtype)
+        g = g_ref[:] + wd * p_ref[:]
+        v_new = mom * v_ref[:] + (1.0 - has_mom * damp) * g
+        # mom==0: keep stored velocity, step with plain g
+        v_out[:] = has_mom * v_new + (1.0 - has_mom) * v_ref[:]
+        d = g + mom * v_new if nesterov else v_new
+        p_out[:] = p_ref[:] - lr * (has_mom * d + (1.0 - has_mom) * g)
+    return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _fused_sgd_flat(p, g, v, hyper3, interpret=False):
+_SGD_KERNELS = {False: _make_sgd_kernel(False), True: _make_sgd_kernel(True)}
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "nesterov"))
+def _fused_sgd_flat(p, g, v, hyper4, interpret=False, nesterov=False):
     n = p.shape[0]
     # pad to a whole number of blocks (grid must be static)
     padded = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
@@ -58,7 +67,7 @@ def _fused_sgd_flat(p, g, v, hyper3, interpret=False):
         v = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
     grid = padded // _BLOCK
     p2, v2 = pl.pallas_call(
-        _sgd_kernel,
+        _SGD_KERNELS[nesterov],
         out_shape=(jax.ShapeDtypeStruct((padded,), p.dtype),
                    jax.ShapeDtypeStruct((padded,), v.dtype)),
         grid=(grid,),
@@ -73,23 +82,25 @@ def _fused_sgd_flat(p, g, v, hyper3, interpret=False):
             pl.BlockSpec((_BLOCK,), lambda i: (i,), memory_space=pltpu.VMEM),
         ),
         interpret=interpret,
-    )(p, g, v, hyper3)
+    )(p, g, v, hyper4)
     return p2[:n], v2[:n]
 
 
-def fused_sgd(params, grads, velocity, lr, momentum=0.0, weight_decay=0.0):
+def fused_sgd(params, grads, velocity, lr, momentum=0.0, weight_decay=0.0,
+              dampening=0.0, nesterov=False):
     """Fused momentum-SGD update over pytrees.
 
     Flattens each leaf to 1D and runs the single-pass Pallas kernel;
     returns (new_params, new_velocity).  Uses the interpreter off-TPU.
     """
     interpret = not _on_tpu()
-    hyper3 = jnp.asarray([lr, momentum, weight_decay], jnp.float32)
+    hyper4 = jnp.asarray([lr, momentum, weight_decay, dampening], jnp.float32)
 
     def leaf(p, g, v):
         shape = p.shape
         p2, v2 = _fused_sgd_flat(p.reshape(-1), g.reshape(-1), v.reshape(-1),
-                                 hyper3, interpret=interpret)
+                                 hyper4, interpret=interpret,
+                                 nesterov=bool(nesterov))
         return p2.reshape(shape), v2.reshape(shape)
 
     flat = jax.tree_util.tree_map(leaf, params, grads, velocity)

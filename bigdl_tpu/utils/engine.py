@@ -90,7 +90,12 @@ class _Engine:
         # flock on a long-lived fd: the kernel releases it when the process
         # dies, so there are no stale locks and no pid-file TOCTOU races —
         # exactly one live process can hold LOCK_EX at a time
-        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        except PermissionError:
+            # lock file owned by another user on a shared host: someone
+            # else is (or was) using this host's chips — report contention
+            return False
         try:
             fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
         except OSError:

@@ -218,14 +218,17 @@ def test_prefetch_abandoned_consumer_unblocks_worker():
 def test_engine_parity_surface(monkeypatch):
     from bigdl_tpu.utils.engine import _Engine
     eng = _Engine()
-    # env-var topology wins (ref DL_NODE_NUMBER/DL_CORE_NUMBER)
-    monkeypatch.setenv("BIGDL_NODE_NUMBER", "4")
-    monkeypatch.setenv("BIGDL_CORE_NUMBER", "2")
-    eng.init()
-    assert eng.node_number() == 4 and eng.core_number() == 2
-    assert eng.engine_type().startswith("Xla:")
-    assert eng.check_singleton() is True  # this process holds/claims the lock
-    assert eng.check_singleton() is True  # idempotent for the same pid
+    try:
+        # env-var topology wins (ref DL_NODE_NUMBER/DL_CORE_NUMBER)
+        monkeypatch.setenv("BIGDL_NODE_NUMBER", "4")
+        monkeypatch.setenv("BIGDL_CORE_NUMBER", "2")
+        eng.init()
+        assert eng.node_number() == 4 and eng.core_number() == 2
+        assert eng.engine_type().startswith("Xla:")
+        assert eng.check_singleton() is True  # claims the host lock
+        assert eng.check_singleton() is True  # idempotent for this engine
+    finally:
+        eng.reset()  # release the flock so other tests/engines can claim it
 
 
 def test_seq_file_folder_roundtrip(tmp_path):
