@@ -25,5 +25,21 @@ Package layout (mirrors the reference's package inventory, SURVEY.md §2):
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("BIGDL_CPU_MESH"):
+    # virtual N-device CPU mesh for sharding tests without TPU hardware
+    # (the reference's local-SparkContext multi-node test trick, SURVEY.md
+    # §4; set by scripts/bigdl_tpu.sh --cpu-mesh N).  Must run before the
+    # first backend touch; a no-op with a warning if jax already started.
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+        _jax.config.update("jax_num_cpu_devices",
+                           int(_os.environ["BIGDL_CPU_MESH"]))
+    except RuntimeError as _e:  # backend already initialized
+        import warnings as _warnings
+        _warnings.warn(f"BIGDL_CPU_MESH ignored: {_e}")
+
 from bigdl_tpu.utils.table import Table, T  # noqa: F401
 from bigdl_tpu.utils.engine import Engine  # noqa: F401

@@ -1,0 +1,15 @@
+"""Mesh-distributed synthetic-data throughput CLI
+(ref models/utils/DistriOptimizerPerf.scala:41-138: inception_v1/v2,
+vgg16/19, default batch 128, -n nodes x -c cores -> here the device mesh).
+
+  python -m bigdl_tpu.models.utils.distri_optimizer_perf --model inception_v1 -b 128
+"""
+from bigdl_tpu.models.utils.perf import main as _main
+
+
+def main(argv=None):
+    return _main(argv, force_distributed=True)
+
+
+if __name__ == "__main__":
+    main()

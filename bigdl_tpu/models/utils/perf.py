@@ -128,7 +128,7 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
     }
 
 
-def main(argv=None):
+def main(argv=None, force_distributed=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", "-m", default="inception_v1")
     p.add_argument("--batchSize", "-b", type=int, default=128)
@@ -137,8 +137,10 @@ def main(argv=None):
     p.add_argument("--dataType", choices=["float", "bf16"], default="bf16")
     p.add_argument("--distributed", action="store_true")
     args = p.parse_args(argv)
+    distributed = (force_distributed if force_distributed is not None
+                   else args.distributed)
     result = run_perf(args.model, args.batchSize, args.iteration,
-                      args.warmup, args.distributed, args.dataType)
+                      args.warmup, distributed, args.dataType)
     print(json.dumps(result))
 
 

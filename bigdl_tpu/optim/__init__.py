@@ -16,7 +16,7 @@ from bigdl_tpu.optim.validator import Validator, LocalValidator, DistriValidator
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.local_optimizer import LocalOptimizer, validate, distri_validate
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
-from bigdl_tpu.optim.optimizer import Optimizer
+from bigdl_tpu.optim.optimizer import Optimizer, save_model, save_state
 from bigdl_tpu.optim.predictor import Predictor, DLClassifier
 
 __all__ = [
@@ -30,4 +30,5 @@ __all__ = [
     "Validator", "LocalValidator", "DistriValidator",
     "LocalOptimizer", "DistriOptimizer", "Optimizer", "validate",
     "distri_validate", "Predictor", "DLClassifier",
+    "save_model", "save_state",
 ]

@@ -44,3 +44,17 @@ def Optimizer(model, dataset=None, criterion=None, *, training_rdd=None,
     if end_trigger is not None:
         opt.set_end_when(end_trigger)
     return opt
+
+
+def save_model(model, path, overwrite: bool = True):
+    """(ref Optimizer.saveModel Optimizer.scala:137-143)"""
+    from bigdl_tpu.utils import file as File
+    File.save_module(model, path, overwrite=overwrite)
+    return path
+
+
+def save_state(state, path, overwrite: bool = True):
+    """(ref Optimizer.saveState Optimizer.scala:145-149)"""
+    from bigdl_tpu.utils import file as File
+    File.save(state, path, overwrite=overwrite)
+    return path

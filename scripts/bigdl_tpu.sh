@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Env-pinning wrapper (the scripts/bigdl.sh role, ref scripts/bigdl.sh:
+# exports the mandatory MKL envs and wraps any command).  The TPU-native
+# equivalents: topology pins for the Engine, XLA compile cache, and the
+# virtual CPU-mesh switch used for sharding tests on non-TPU hosts.
+#
+#   ./scripts/bigdl_tpu.sh [-n nodes] [-c cores] [--cpu-mesh N] -- cmd args...
+#
+# Examples:
+#   ./scripts/bigdl_tpu.sh -- python examples/train_lenet.py -b 128
+#   ./scripts/bigdl_tpu.sh --cpu-mesh 8 -- python -m pytest tests/test_distributed.py
+set -euo pipefail
+
+CPU_MESH=""
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -n) export BIGDL_NODE_NUMBER="$2"; shift 2 ;;
+    -c) export BIGDL_CORE_NUMBER="$2"; shift 2 ;;
+    --cpu-mesh) CPU_MESH="$2"; shift 2 ;;
+    --) shift; break ;;
+    *) echo "unknown flag $1 (use -n/-c/--cpu-mesh/--)" >&2; exit 2 ;;
+  esac
+done
+
+# persistent XLA compile cache: first compile of a big model is 20-40s,
+# later runs hit the cache
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$HOME/.cache/bigdl_tpu_xla}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
+
+if [[ -n "$CPU_MESH" ]]; then
+  # virtual device mesh on CPU — the reference's local-SparkContext
+  # multi-node test trick (DistriOptimizerSpec, SURVEY.md §4).
+  # BIGDL_CPU_MESH is honored by bigdl_tpu at import via jax.config, which
+  # wins even over a sitecustomize that pins another platform.  The env
+  # vars below cover plain jax programs only on hosts WITHOUT such a
+  # sitecustomize (jax.config updates beat env vars).
+  export BIGDL_CPU_MESH="$CPU_MESH"
+  export JAX_PLATFORMS=cpu
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${CPU_MESH}"
+fi
+
+exec "$@"

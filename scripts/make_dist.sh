@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Build a distributable package (the make-dist.sh role, ref make-dist.sh:
+# fat jars + python zip under dist/).  Produces a wheel under dist/ from
+# pyproject.toml; the C++ hostops source ships in the package and compiles
+# on first use (bigdl_tpu/native/__init__.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p dist
+if python -m pip wheel --no-deps -w dist .; then
+  :
+else
+  echo "wheel build FAILED (see errors above); packing a source archive instead" >&2
+  git archive --format=tar.gz -o dist/bigdl_tpu-src.tar.gz HEAD
+  exit 1
+fi
+ls -l dist/

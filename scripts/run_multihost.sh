@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Multi-host launcher template (the scripts/run.example.sh role, ref:
+# spark-submit wrapper with -n nodes / -o cores / -b batch).  On a Cloud
+# TPU pod slice, run the SAME command on every host VM; jax initializes
+# the pod topology from the TPU metadata (Engine.init_distributed).
+#
+#   ./scripts/run_multihost.sh -t TPU_NAME -z ZONE -- python examples/train_inception.py -b 1024
+#
+# For non-GCP clusters, export BIGDL_COORDINATOR (host:port of process 0),
+# BIGDL_NUM_PROCESSES and BIGDL_PROCESS_ID per host and call
+# Engine.init_distributed(coordinator_address=..., num_processes=...,
+# process_id=...) from your launcher instead.
+set -euo pipefail
+
+TPU_NAME="" ZONE=""
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    -t) TPU_NAME="$2"; shift 2 ;;
+    -z) ZONE="$2"; shift 2 ;;
+    --) shift; break ;;
+    *) echo "unknown flag $1 (use -t/-z/--)" >&2; exit 2 ;;
+  esac
+done
+[[ -n "$TPU_NAME" && -n "$ZONE" ]] || { echo "need -t TPU_NAME -z ZONE" >&2; exit 2; }
+
+# shell-quote each argument so spaces/quotes survive the ssh hop
+CMD="cd $(printf '%q' "$(pwd)") &&"
+for arg in "$@"; do CMD+=" $(printf '%q' "$arg")"; done
+
+exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "$CMD"
