@@ -37,7 +37,8 @@ if _os.environ.get("BIGDL_CPU_MESH"):
         _jax.config.update("jax_platforms", "cpu")
         _jax.config.update("jax_num_cpu_devices",
                            int(_os.environ["BIGDL_CPU_MESH"]))
-    except RuntimeError as _e:  # backend already initialized
+    except (RuntimeError, ValueError) as _e:
+        # backend already initialized, or a non-integer value
         import warnings as _warnings
         _warnings.warn(f"BIGDL_CPU_MESH ignored: {_e}")
 

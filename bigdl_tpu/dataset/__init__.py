@@ -29,6 +29,7 @@ GreyImgCropper = ImgRdmCropper  # the reference's grey cropper is random-positio
 BGRImgToBatch = ImgToBatch
 GreyImgToBatch = ImgToBatch
 BGRImgToSample = ImgToSample
+BGRImgToImageVector = ImgToSample  # MLlib DenseVector role -> Sample arrays
 MTLabeledBGRImgToBatch = MTLabeledImgToBatch
 ColoJitter = ColorJitter  # reference spelling (dataset/image/ColoJitter.scala)
 
@@ -45,7 +46,7 @@ __all__ = [
     "BytesToBGRImg", "GreyImgNormalizer", "BGRImgNormalizer",
     "BGRImgPixelNormalizer", "BGRImgCropper", "GreyImgCropper",
     "BGRImgRdmCropper", "BGRImgToBatch", "GreyImgToBatch", "BGRImgToSample",
-    "MTLabeledBGRImgToBatch", "ColoJitter",
+    "BGRImgToImageVector", "MTLabeledBGRImgToBatch", "ColoJitter",
     "Dictionary", "WordTokenizer", "SentenceToLabeledSentence",
     "LabeledSentenceToSample",
 ]

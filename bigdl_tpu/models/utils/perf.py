@@ -137,6 +137,9 @@ def main(argv=None, force_distributed=None):
     p.add_argument("--dataType", choices=["float", "bf16"], default="bf16")
     p.add_argument("--distributed", action="store_true")
     args = p.parse_args(argv)
+    if force_distributed is not None and args.distributed != force_distributed:
+        p.error("--distributed conflicts with this entry point; use "
+                "`python -m bigdl_tpu.models.utils.perf --distributed` instead")
     distributed = (force_distributed if force_distributed is not None
                    else args.distributed)
     result = run_perf(args.model, args.batchSize, args.iteration,

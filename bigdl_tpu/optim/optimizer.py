@@ -46,15 +46,17 @@ def Optimizer(model, dataset=None, criterion=None, *, training_rdd=None,
     return opt
 
 
-def save_model(model, path, overwrite: bool = True):
-    """(ref Optimizer.saveModel Optimizer.scala:137-143)"""
+def save_model(model, path, overwrite: bool = False):
+    """(ref Optimizer.saveModel Optimizer.scala:137-143; like the
+    reference, refuses to clobber an existing file unless asked)"""
     from bigdl_tpu.utils import file as File
     File.save_module(model, path, overwrite=overwrite)
     return path
 
 
-def save_state(state, path, overwrite: bool = True):
-    """(ref Optimizer.saveState Optimizer.scala:145-149)"""
+def save_state(state, path, overwrite: bool = False):
+    """(ref Optimizer.saveState Optimizer.scala:145-149; refuses to
+    clobber an existing file unless asked)"""
     from bigdl_tpu.utils import file as File
     File.save(state, path, overwrite=overwrite)
     return path

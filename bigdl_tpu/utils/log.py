@@ -1,0 +1,39 @@
+"""Logging configuration (the log4j.properties role,
+ref dl/src/main/resources/log4j.properties + the driver progress line
+Optimizer.header, Optimizer.scala:132-135).
+
+The reference configures log4j once per JVM; here ``init_logging`` sets up
+the root ``bigdl_tpu`` logger with the same shape of output: timestamped
+console lines, optional file sink, INFO default.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def init_logging(level=logging.INFO, log_file: str = None, fmt: str = _FORMAT):
+    """Configure the framework's loggers (idempotent)."""
+    logger = logging.getLogger("bigdl_tpu")
+    logger.setLevel(level)
+    if not any(isinstance(h, logging.StreamHandler) for h in logger.handlers):
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(h)
+    if log_file and not any(
+            isinstance(h, logging.FileHandler) and
+            getattr(h, "baseFilename", None) == log_file
+            for h in logger.handlers):
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(fh)
+    return logger
+
+
+def header(epoch: int, count: int, total: int, neval: int, wall: float) -> str:
+    """The reference's driver progress-line prefix
+    (Optimizer.header Optimizer.scala:132-135)."""
+    return (f"[Epoch {epoch} {count}/{total}][Iteration {neval}]"
+            f"[Wall Clock {wall:.6f}s]")

@@ -39,4 +39,5 @@ if [[ -n "$CPU_MESH" ]]; then
   export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=${CPU_MESH}"
 fi
 
+[[ $# -gt 0 ]] || { echo "no command given (usage: $0 [flags] -- cmd args...)" >&2; exit 2; }
 exec "$@"

@@ -45,12 +45,41 @@ def build_cases():
     add("logsoftmax", lambda: nn.LogSoftMax().forward(x24))
     add("rnn_seq", lambda: nn.Recurrent().add(nn.RnnCell(4, 3)).forward(x_seq))
     add("lstm_seq", lambda: nn.Recurrent().add(nn.LSTMCell(4, 3)).forward(x_seq))
-    add("bilinear", lambda: nn.Bilinear(4, 4, 2).forward(
-        __import__("bigdl_tpu.utils.table", fromlist=["T"]).T(x24, x24)))
+    add("bilinear", lambda: nn.Bilinear(4, 4, 2).forward(T(x24, x24)))
     add("prelu", lambda: nn.PReLU(3).forward(x_img))
     add("crossentropy", lambda: nn.CrossEntropyCriterion().forward(
         x24, jnp.asarray([1, 3])))
     add("grad_linear", lambda: _grad_linear(x24))
+
+    # second wave: dilated/grouped conv, pooling variants, embeddings,
+    # normalizations, criterions, recurrent cells
+    add("dilated_conv", lambda: nn.SpatialDilatedConvolution(
+        3, 4, 3, 3, 1, 1, 2, 2, 2, 2).forward(x_img))
+    add("grouped_conv", lambda: nn.SpatialConvolution(
+        4, 6, 3, 3, 1, 1, 1, 1, n_group=2).forward(
+            jnp.asarray(np.random.RandomState(10).randn(2, 4, 8, 8), np.float32)))
+    add("avgpool_incl", lambda: nn.SpatialAveragePooling(
+        3, 3, 2, 2, 1, 1, count_include_pad=True).forward(x_img))
+    add("maxpool_ceil", lambda: nn.SpatialMaxPooling(3, 3, 2, 2).ceil().forward(x_img))
+    add("lookup", lambda: nn.LookupTable(10, 5).forward(
+        jnp.asarray([[1, 4, 9], [2, 2, 7]])))
+    add("batchnorm_train", lambda: nn.BatchNormalization(4).training().forward(x24))
+    add("spatial_bn_eval", lambda: nn.SpatialBatchNormalization(3).evaluate().forward(x_img))
+    add("gru_seq", lambda: nn.Recurrent().add(nn.GRUCell(4, 3)).forward(x_seq))
+    add("time_distributed", lambda: nn.TimeDistributed(nn.Linear(4, 2)).forward(x_seq))
+    add("softmax2d", lambda: nn.SoftMax().forward(x24))
+    add("hardtanh", lambda: nn.HardTanh(-0.5, 0.5).forward(x24))
+    add("elu", lambda: nn.ELU(0.7).forward(x24))
+    add("mse", lambda: nn.MSECriterion().forward(x24, jnp.zeros_like(x24)))
+    add("bce", lambda: nn.BCECriterion().forward(
+        nn.Sigmoid().forward(x24), jnp.asarray(np.random.RandomState(11)
+                                               .randint(0, 2, (2, 4)), np.float32)))
+    add("smoothl1", lambda: nn.SmoothL1Criterion().forward(
+        x24, jnp.zeros_like(x24)))
+    add("margin", lambda: nn.MarginCriterion().forward(
+        nn.Tanh().forward(x24), jnp.asarray(np.random.RandomState(12)
+                                            .choice([-1.0, 1.0], (2, 4)), np.float32)))
+    add("cosine_dist", lambda: nn.CosineDistance().forward(T(x24, x24 + 1)))
     return cases
 
 
