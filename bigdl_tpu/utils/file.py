@@ -43,14 +43,62 @@ def load(path):
         return _to_jax(pickle.load(f))
 
 
+def _pickle_architecture(module):
+    """Pickle the module with its weight/buffer/grad dicts emptied: the
+    arrays live once in the checkpoint's params/state trees, and a class
+    rename only breaks these bytes — never the weight trees."""
+    stash = []
+
+    def strip(mod):
+        stash.append((mod, dict(mod._params), dict(mod._buffers),
+                      dict(mod._grads)))
+        mod._params.clear()
+        mod._buffers.clear()
+        mod._grads.clear()
+        for child in mod._modules.values():
+            strip(child)
+
+    strip(module)
+    try:
+        return pickle.dumps(module)
+    finally:
+        for mod, p, b, g in stash:
+            mod._params.update(p)
+            mod._buffers.update(b)
+            mod._grads.update(g)
+
+
 def save_module(module, path, overwrite: bool = True):
-    """Persist a module's (params, state) + class info."""
+    """Persist the full module — architecture AND weights (the
+    Module.save / Java-serialization role, ref AbstractModule.scala:306,
+    File.scala:63).  Weights are stored once, in portable numpy trees;
+    the architecture rides along as an opaque pickle so
+    ``load_module_into`` keeps working even if the class moves."""
     save({
-        "format": "bigdl_tpu.module.v1",
+        "format": "bigdl_tpu.module.v2",
         "cls": type(module).__name__,
+        "architecture": _pickle_architecture(module),
         "params": module.params(),
         "state": module.state(),
     }, path, overwrite=overwrite)
+
+
+def load_module(path):
+    """Reconstruct a module saved by ``save_module`` — architecture
+    included (ref Module.load Module.scala:27)."""
+    blob = load(path)
+    arch = blob.get("architecture")
+    if arch is None:
+        raise ValueError(
+            f"{path} is a weights-only (v1) checkpoint: build the "
+            f"architecture ({blob.get('cls')}) and use load_module_into")
+    module = pickle.loads(arch)
+    module.load_params(blob["params"])
+    module.load_state(blob["state"])
+    # recreate the grad slots the architecture pickle dropped
+    module.load_grads(
+        jax.tree_util.tree_map(np.zeros_like, blob["params"]))
+    return module
 
 
 def load_module_into(module, path):

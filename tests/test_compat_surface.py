@@ -273,6 +273,38 @@ def test_interrupted_training_after_checkpoint_leaves_model_usable(tmp_path):
     assert np.asarray(out).shape == (16, 2)
 
 
+def test_full_module_save_load(tmp_path):
+    """save_module persists architecture + weights; load_module rebuilds
+    without the caller constructing the model (ref Module.load)."""
+    import jax.numpy as jnp
+    from bigdl_tpu.utils import file as File
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                      nn.LogSoftMax())
+    path = str(tmp_path / "m.model")
+    File.save_module(m, path)
+    m2 = File.load_module(path)
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(m.forward(x)),
+                               np.asarray(m2.forward(x)), rtol=1e-6)
+
+
+def test_image_classification_example(tmp_path):
+    import subprocess
+    import sys as _sys
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.utils import file as File
+    path = str(tmp_path / "lenet.model")
+    File.save_module(LeNet5(10), path)
+    r = subprocess.run(
+        [_sys.executable, "examples/image_classification.py",
+         "--modelPath", path, "--grey"],
+        capture_output=True, text=True, timeout=280,
+        cwd=__file__.rsplit("/", 2)[0])
+    assert r.returncode == 0, r.stderr[-800:]
+    lines = [l for l in r.stdout.strip().splitlines() if "\t" in l]
+    assert len(lines) == 8  # 8 synthetic images classified
+
+
 def test_validator_classes():
     import jax.numpy as jnp
     model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
