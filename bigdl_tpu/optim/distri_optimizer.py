@@ -113,9 +113,12 @@ class DistriOptimizer(LocalOptimizer):
         model, criterion, method = self.model, self.criterion, self.optim_method
         static_hyper = self._hyper(None)
         del static_hyper["lr"]
+        has_scales = self._setup_lr_scales(static_hyper)
 
-        def step(params, net_state, opt_state, x, y, lr, key):
+        def step(params, net_state, opt_state, x, y, lr, key, lr_scales):
             hyper = dict(static_hyper, lr=lr)
+            if has_scales:
+                hyper["lr_scales"] = lr_scales
             if fold_axis is not None:
                 # independent dropout masks per replica (the reference's
                 # thread-local RNG per model clone)
@@ -144,11 +147,13 @@ class DistriOptimizer(LocalOptimizer):
 
     def _jit_step(self, step, ps, ns, os_, data_s):
         """Shared jit wiring: carried state is donated (buffers recycled in
-        place); optimize() passes copies so the module's arrays survive."""
+        place); optimize() passes copies so the module's arrays survive.
+        The trailing lr_scales argument rides replicated (prefix sharding
+        broadcasts over its pytree) and is never donated."""
         rep = NamedSharding(self.mesh, P())
         return jax.jit(
             step,
-            in_shardings=(ps, ns, os_, data_s, data_s, rep, rep),
+            in_shardings=(ps, ns, os_, data_s, data_s, rep, rep, rep),
             out_shardings=(ps, ns, os_, rep),
             donate_argnums=(0, 1, 2),
         )
@@ -184,7 +189,7 @@ class DistriOptimizer(LocalOptimizer):
         rep, data = P(), P("data")
         sharded = jax.shard_map(
             step, mesh=mesh,
-            in_specs=(rep, rep, rep, data, data, rep, rep),
+            in_specs=(rep, rep, rep, data, data, rep, rep, rep),
             out_specs=(rep, rep, rep, rep),
             check_vma=False,
         )
@@ -245,7 +250,8 @@ class DistriOptimizer(LocalOptimizer):
                 lr = self._current_lr()
                 key = RNG.next_key()
                 params, net_state, opt_state, loss = step_fn(
-                    params, net_state, opt_state, x, y, jnp.float32(lr), key)
+                    params, net_state, opt_state, x, y, jnp.float32(lr), key,
+                    self._lr_scales_arg)
                 loss = float(loss)
 
             step_time = self.metrics.mean("computing time average")

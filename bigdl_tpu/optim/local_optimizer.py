@@ -92,12 +92,32 @@ class LocalOptimizer:
             "dampening": float(s.get("dampening", s.get("momentum", 0.0))),
             "nesterov": bool(s.get("nesterov", False)),
             "lr_decay": float(s.get("learningRateDecay", 0.0)),
+            # per-param lr multipliers shaped like model.params()
+            # (ref SGD.scala "learningRates"); baked into the trace
+            "lr_scales": s.get("learningRates", None),
         }
 
     def _current_lr(self):
         schedule = self.state.get("learningRateSchedule", Default())
         schedule.update_hyper_parameter(self.state, self.state)
         return -self.state.get("currentLearningRate", -self.state.get("learningRate", 1e-3))
+
+    def _setup_lr_scales(self, static_hyper):
+        """Per-param lr multipliers flow in as a jit ARGUMENT (not a baked
+        constant, which would duplicate a model-sized tree in the
+        executable); a scalar dummy stands in when unused."""
+        has_scales = static_hyper.pop("lr_scales", None) is not None
+        if has_scales:
+            if not isinstance(self.optim_method, SGD):
+                raise ValueError(
+                    "state['learningRates'] (per-param lr scales) is only "
+                    f"supported by SGD, not {type(self.optim_method).__name__}"
+                    " — it would be silently ignored")
+            self._lr_scales_arg = jax.tree_util.tree_map(
+                jnp.asarray, self.state["learningRates"])
+        else:
+            self._lr_scales_arg = jnp.zeros(())
+        return has_scales
 
     def _build_step(self):
         model, criterion, method = self.model, self.criterion, self.optim_method
@@ -106,11 +126,14 @@ class LocalOptimizer:
         # only the scheduled lr flows in as a traced scalar.
         static_hyper = self._hyper(None)
         del static_hyper["lr"]
+        has_scales = self._setup_lr_scales(static_hyper)
 
         remat = self.remat
 
-        def step(params, net_state, opt_state, x, y, lr, key):
+        def step(params, net_state, opt_state, x, y, lr, key, lr_scales):
             hyper = dict(static_hyper, lr=lr)
+            if has_scales:
+                hyper["lr_scales"] = lr_scales
 
             def loss_fn(p):
                 apply = model.apply
@@ -129,7 +152,8 @@ class LocalOptimizer:
 
         # donate the carried state: the old params/opt-state buffers are
         # dead after each step, so XLA reuses them instead of allocating a
-        # second copy of the model per step
+        # second copy of the model per step (lr_scales is reused each call
+        # and must NOT be donated)
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     # -- main loop (ref LocalOptimizer.optimize :77) ----------------------
@@ -162,7 +186,8 @@ class LocalOptimizer:
             lr = self._current_lr()
             key = RNG.next_key()
             params, net_state, opt_state, loss = step_fn(
-                params, net_state, opt_state, x, y, jnp.float32(lr), key)
+                params, net_state, opt_state, x, y, jnp.float32(lr), key,
+                self._lr_scales_arg)
             loss = float(loss)  # syncs; keeps per-iter timing honest
             train_time = time.perf_counter() - train_start
 

@@ -122,7 +122,9 @@ class SGD(OptimMethod):
         mom = hyper.get("momentum", 0.0)
         damp = hyper.get("dampening", 0.0)
         nesterov = hyper.get("nesterov", False)
-        if self.fused:
+        lr_scales = hyper.get("lr_scales")  # per-param lr multipliers
+        # (ref SGD.scala "learningRates" Tensor: per-weight lr scaling)
+        if self.fused and lr_scales is None:
             # one-HBM-pass Pallas update (ops/pallas_kernels.fused_sgd);
             # matches the unfused math bit-for-bit per leaf
             from bigdl_tpu.ops.pallas_kernels import fused_sgd
@@ -139,6 +141,8 @@ class SGD(OptimMethod):
                         if nesterov else vel)
         else:
             step_dir = grads
+        if lr_scales is not None:
+            step_dir = _tree_map(lambda d, s: d * s, step_dir, lr_scales)
         new_params = _tree_map(lambda p, d: p - lr * d, params, step_dir)
         return new_params, {"velocity": vel}
 

@@ -51,10 +51,15 @@ def _pickle_architecture(module):
 
     def strip(mod):
         stash.append((mod, dict(mod._params), dict(mod._buffers),
-                      dict(mod._grads)))
+                      dict(mod._grads), mod.output, mod.grad_input,
+                      mod._last_key))
         mod._params.clear()
         mod._buffers.clear()
         mod._grads.clear()
+        # stale eager-mode activations must not ride into checkpoints
+        mod.output = None
+        mod.grad_input = None
+        mod._last_key = None
         for child in mod._modules.values():
             strip(child)
 
@@ -62,10 +67,13 @@ def _pickle_architecture(module):
     try:
         return pickle.dumps(module)
     finally:
-        for mod, p, b, g in stash:
+        for mod, p, b, g, out, gi, lk in stash:
             mod._params.update(p)
             mod._buffers.update(b)
             mod._grads.update(g)
+            mod.output = out
+            mod.grad_input = gi
+            mod._last_key = lk
 
 
 def save_module(module, path, overwrite: bool = True):

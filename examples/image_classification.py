@@ -22,6 +22,11 @@ def main(argv=None):
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--imageSize", type=int, default=28)
     p.add_argument("--grey", action="store_true", help="single-channel input")
+    p.add_argument("--mean", default=None,
+                   help="comma-separated per-channel mean, MUST match what "
+                        "the model was trained with (e.g. 123,117,104)")
+    p.add_argument("--std", default=None,
+                   help="comma-separated per-channel std (e.g. 58.4,57.1,57.4)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -49,8 +54,20 @@ def main(argv=None):
                     names.append(path)
         if not recs:
             p.error(f"no .jpg/.jpeg/.png/.bmp images found under {args.folder}")
-        pipeline = (BytesToImg(scale_to=s) >> ImgCropper(s, s)
-                    >> ImgToSample())
+        pipeline = BytesToImg(scale_to=s) >> ImgCropper(s, s)
+        if args.std is not None and args.mean is None:
+            p.error("--std requires --mean")
+        if args.mean is not None:
+            from bigdl_tpu.dataset import ImgNormalizer
+            mean = [float(v) for v in args.mean.split(",")]
+            std = ([float(v) for v in args.std.split(",")]
+                   if args.std is not None else [1.0] * len(mean))
+            pipeline = pipeline >> ImgNormalizer(mean, std)
+        else:
+            logging.warning(
+                "no --mean/--std given: feeding raw 0-255 pixels; pass the "
+                "normalization the model was trained with for real results")
+        pipeline = pipeline >> ImgToSample()
         feats = np.stack([smp.feature for smp in pipeline(iter(recs))])
         if args.grey:
             feats = feats.mean(axis=1, keepdims=True)

@@ -215,6 +215,7 @@ def test_prefetch_abandoned_consumer_unblocks_worker():
     assert threading.active_count() <= n_before
 
 
+@pytest.mark.serial  # env vars + the host-wide singleton lock file
 def test_engine_parity_surface(monkeypatch):
     from bigdl_tpu.utils.engine import _Engine
     eng = _Engine()
@@ -271,6 +272,38 @@ def test_interrupted_training_after_checkpoint_leaves_model_usable(tmp_path):
         opt.optimize()
     out = model.predict(jnp.asarray(xs))  # must not hit deleted arrays
     assert np.asarray(out).shape == (16, 2)
+
+
+def test_per_param_learning_rates():
+    """state['learningRates'] (ref SGD.scala learningRates tensor): a
+    params-shaped pytree of lr multipliers; zero freezes a layer."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.optim import LocalOptimizer, max_iteration
+    from bigdl_tpu.utils.table import T
+
+    xs = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    ys = np.float32(np.random.RandomState(1).randint(1, 3, size=(32,)))
+    samples = [dataset.Sample(x, np.asarray([y], np.float32))
+               for x, y in zip(xs, ys)]
+    ds = dataset.DataSet.array(samples) >> dataset.SampleToBatch(16)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2),
+                          nn.LogSoftMax())
+    before = jax.device_get(model.params())
+    # freeze the first Linear, train the second at full rate
+    scales = jax.tree_util.tree_map(np.ones_like, before)
+    scales["0"]["~"] = {k: np.zeros_like(v) for k, v in scales["0"]["~"].items()}
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+    opt.set_state(T(learningRate=0.5, learningRates=scales))
+    opt.set_end_when(max_iteration(3))
+    opt.optimize()
+    after = model.params()
+    for k, v in before["0"]["~"].items():
+        np.testing.assert_array_equal(np.asarray(after["0"]["~"][k]), v)
+    moved = any(not np.allclose(np.asarray(after["2"]["~"][k]),
+                                before["2"]["~"][k])
+                for k in before["2"]["~"])
+    assert moved
 
 
 def test_full_module_save_load(tmp_path):
