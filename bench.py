@@ -86,12 +86,18 @@ def main(batch_size: int = 128, iterations: int = 10, warmup: int = 3):
     float(loss)  # device->host copy = hard sync (block_until_ready may be a
     # no-op under remote-relay PJRT backends; a transfer cannot lie)
 
-    t0 = time.perf_counter()
-    for _ in range(iterations):
-        params, net_state, opt_state, loss = step(
-            params, net_state, opt_state, x, y, key)
-    last_loss = float(loss)  # syncs the whole sequential step chain
-    dt = (time.perf_counter() - t0) / iterations
+    # best-of-3 timing windows: the relay-attached chip shows >10% run-to-
+    # run variance, and a window minimum is the standard de-noising for
+    # throughput benchmarks (each window still syncs only once at the end)
+    dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iterations):
+            params, net_state, opt_state, loss = step(
+                params, net_state, opt_state, x, y, key)
+        last_loss = float(loss)  # syncs the whole sequential step chain
+        dts.append((time.perf_counter() - t0) / iterations)
+    dt = min(dts)
 
     images_per_sec = batch_size / dt
     peak = guess_peak(jax.devices()[0])
