@@ -294,7 +294,23 @@ class Module:
         return self
 
     def clone_module(self):
-        return copy.deepcopy(self)
+        # strip cached jitted fns BEFORE the copy: avoids deep-copying jax
+        # function wrappers (and depending on them supporting deepcopy)
+        stash = []
+
+        def pop_caches(mod):
+            cached = {k: mod.__dict__.pop(k) for k in list(mod.__dict__)
+                      if k.startswith("_cached_")}
+            stash.append((mod, cached))
+            for child in mod._modules.values():
+                pop_caches(child)
+
+        pop_caches(self)
+        try:
+            return copy.deepcopy(self)
+        finally:
+            for mod, cached in stash:
+                mod.__dict__.update(cached)
 
     def copy_status(self, src: "Module"):
         """Copy running-status buffers (e.g. BN stats) from ``src``

@@ -241,18 +241,30 @@ class LocalOptimizer:
                   f"{self.checkpoint_path}/state.{neval}")
 
 
+def _eval_fn(model):
+    """One jitted eval forward per model instance, cached on the model: a
+    fresh closure per validate() call would recompile at every validation
+    trigger.  (The model->fn->model cycle is ordinary gc fodder.)"""
+    fwd = getattr(model, "_cached_eval_fn", None)
+    if fwd is None:
+        from bigdl_tpu.nn.module import Context
+
+        @jax.jit
+        def fwd(p, s, x):
+            out, _ = model.apply(p, x, s,
+                                 Context(training=False, key=jax.random.PRNGKey(0)))
+            return out
+
+        model._cached_eval_fn = fwd
+    return fwd
+
+
 def validate(model, params, net_state, dataset, methods, batch_to_device=jnp.asarray):
     """Shared evaluation loop (ref Validator.scala:24 / LocalValidator.scala:30).
 
     Returns [(method, merged_result)].
     """
-    from bigdl_tpu.nn.module import Context
-
-    @jax.jit
-    def fwd(p, s, x):
-        out, _ = model.apply(p, x, s, Context(training=False, key=jax.random.PRNGKey(0)))
-        return out
-
+    fwd = _eval_fn(model)
     totals = [None] * len(methods)
     for batch in dataset.data(train=False):
         out = fwd(params, net_state, batch_to_device(batch.data))

@@ -50,9 +50,12 @@ def _pickle_architecture(module):
     stash = []
 
     def strip(mod):
+        # unpicklable/ephemeral attrs (cached jitted fns) leave entirely
+        cached = {k: mod.__dict__.pop(k) for k in list(mod.__dict__)
+                  if k.startswith("_cached_")}
         stash.append((mod, dict(mod._params), dict(mod._buffers),
                       dict(mod._grads), mod.output, mod.grad_input,
-                      mod._last_key))
+                      mod._last_key, cached))
         mod._params.clear()
         mod._buffers.clear()
         mod._grads.clear()
@@ -67,13 +70,14 @@ def _pickle_architecture(module):
     try:
         return pickle.dumps(module)
     finally:
-        for mod, p, b, g, out, gi, lk in stash:
+        for mod, p, b, g, out, gi, lk, cached in stash:
             mod._params.update(p)
             mod._buffers.update(b)
             mod._grads.update(g)
             mod.output = out
             mod.grad_input = gi
             mod._last_key = lk
+            mod.__dict__.update(cached)
 
 
 def save_module(module, path, overwrite: bool = True):
