@@ -26,6 +26,7 @@ be jnp arrays, Tables, or any pytree — everything here is pytree-polymorphic.
 """
 from __future__ import annotations
 
+import contextlib
 import copy
 import time
 from collections import OrderedDict
@@ -57,6 +58,29 @@ class Context:
             self.key = RNG.next_key()
         self.key, sub = jax.random.split(self.key)
         return sub
+
+
+@contextlib.contextmanager
+def stripped_caches(module):
+    """Temporarily remove ``_cached_*`` attrs (jitted fn wrappers) from the
+    module tree: they must never be deep-copied or pickled.  Shared by
+    ``Module.clone_module`` and checkpoint pickling
+    (utils/file._pickle_architecture)."""
+    stash = []
+
+    def pop(mod):
+        cached = {k: mod.__dict__.pop(k) for k in list(mod.__dict__)
+                  if k.startswith("_cached_")}
+        stash.append((mod, cached))
+        for child in mod._modules.values():
+            pop(child)
+
+    pop(module)
+    try:
+        yield
+    finally:
+        for mod, cached in stash:
+            mod.__dict__.update(cached)
 
 
 def _tree_zeros_like(tree):
@@ -296,21 +320,8 @@ class Module:
     def clone_module(self):
         # strip cached jitted fns BEFORE the copy: avoids deep-copying jax
         # function wrappers (and depending on them supporting deepcopy)
-        stash = []
-
-        def pop_caches(mod):
-            cached = {k: mod.__dict__.pop(k) for k in list(mod.__dict__)
-                      if k.startswith("_cached_")}
-            stash.append((mod, cached))
-            for child in mod._modules.values():
-                pop_caches(child)
-
-        pop_caches(self)
-        try:
+        with stripped_caches(self):
             return copy.deepcopy(self)
-        finally:
-            for mod, cached in stash:
-                mod.__dict__.update(cached)
 
     def copy_status(self, src: "Module"):
         """Copy running-status buffers (e.g. BN stats) from ``src``

@@ -241,21 +241,44 @@ class LocalOptimizer:
                   f"{self.checkpoint_path}/state.{neval}")
 
 
+def _model_fingerprint(model):
+    """Cheap structure+hyper fingerprint: module tree paths, class names,
+    and scalar attributes.  Guards the cached eval jit against in-place
+    architecture edits between validations (swap a layer, change a bound)."""
+    parts = []
+
+    hyper_types = (int, float, bool, str, bytes, type(None), tuple, list,
+                   np.integer, np.floating, np.bool_)
+
+    def walk(mod, path):
+        scalars = tuple(sorted(
+            (k, repr(v)) for k, v in mod.__dict__.items()
+            if isinstance(v, hyper_types) and not k.startswith("_cached_")))
+        parts.append((path, type(mod).__name__, scalars))
+        for name, child in mod._modules.items():
+            walk(child, f"{path}/{name}")
+
+    walk(model, "")
+    return tuple(parts)
+
+
 def _eval_fn(model):
     """One jitted eval forward per model instance, cached on the model: a
     fresh closure per validate() call would recompile at every validation
     trigger.  (The model->fn->model cycle is ordinary gc fodder.)"""
-    fwd = getattr(model, "_cached_eval_fn", None)
-    if fwd is None:
-        from bigdl_tpu.nn.module import Context
+    fp = _model_fingerprint(model)
+    cached = getattr(model, "_cached_eval_fn", None)
+    if cached is not None and cached[0] == fp:
+        return cached[1]
+    from bigdl_tpu.nn.module import Context
 
-        @jax.jit
-        def fwd(p, s, x):
-            out, _ = model.apply(p, x, s,
-                                 Context(training=False, key=jax.random.PRNGKey(0)))
-            return out
+    @jax.jit
+    def fwd(p, s, x):
+        out, _ = model.apply(p, x, s,
+                             Context(training=False, key=jax.random.PRNGKey(0)))
+        return out
 
-        model._cached_eval_fn = fwd
+    model._cached_eval_fn = (fp, fwd)
     return fwd
 
 

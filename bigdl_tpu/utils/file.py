@@ -47,15 +47,14 @@ def _pickle_architecture(module):
     """Pickle the module with its weight/buffer/grad dicts emptied: the
     arrays live once in the checkpoint's params/state trees, and a class
     rename only breaks these bytes — never the weight trees."""
+    from bigdl_tpu.nn.module import stripped_caches
+
     stash = []
 
     def strip(mod):
-        # unpicklable/ephemeral attrs (cached jitted fns) leave entirely
-        cached = {k: mod.__dict__.pop(k) for k in list(mod.__dict__)
-                  if k.startswith("_cached_")}
         stash.append((mod, dict(mod._params), dict(mod._buffers),
                       dict(mod._grads), mod.output, mod.grad_input,
-                      mod._last_key, cached))
+                      mod._last_key))
         mod._params.clear()
         mod._buffers.clear()
         mod._grads.clear()
@@ -66,18 +65,18 @@ def _pickle_architecture(module):
         for child in mod._modules.values():
             strip(child)
 
-    strip(module)
-    try:
-        return pickle.dumps(module)
-    finally:
-        for mod, p, b, g, out, gi, lk, cached in stash:
-            mod._params.update(p)
-            mod._buffers.update(b)
-            mod._grads.update(g)
-            mod.output = out
-            mod.grad_input = gi
-            mod._last_key = lk
-            mod.__dict__.update(cached)
+    with stripped_caches(module):  # unpicklable jitted-fn caches leave too
+        strip(module)
+        try:
+            return pickle.dumps(module)
+        finally:
+            for mod, p, b, g, out, gi, lk in stash:
+                mod._params.update(p)
+                mod._buffers.update(b)
+                mod._grads.update(g)
+                mod.output = out
+                mod.grad_input = gi
+                mod._last_key = lk
 
 
 def save_module(module, path, overwrite: bool = True):

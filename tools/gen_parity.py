@@ -1,0 +1,175 @@
+"""Regenerate PARITY.md — the SURVEY.md §2 inventory → `file:line` map.
+
+  python tools/gen_parity.py        # rewrites PARITY.md in place
+
+Checked by tests/test_parity_doc.py (references must resolve).
+"""
+import inspect
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+NN_NAMES = """Sequential Concat ConcatTable ParallelTable MapTable Bottle Recurrent TimeDistributed
+SpatialConvolution SpatialShareConvolution SpatialFullConvolution SpatialDilatedConvolution SpatialConvolutionMap
+SpatialMaxPooling SpatialAveragePooling SpatialBatchNormalization BatchNormalization SpatialCrossMapLRN
+SpatialContrastiveNormalization SpatialDivisiveNormalization SpatialSubtractiveNormalization SpatialZeroPadding RoiPooling Nms
+Linear Bilinear CMul CAdd Mul Add MulConstant AddConstant MM MV Cosine Euclidean LookupTable
+Mean Sum Max Min Index Select Narrow MaskedSelect
+ReLU ReLU6 PReLU RReLU LeakyReLU ELU Tanh TanhShrink Sigmoid LogSigmoid LogSoftMax SoftMax SoftMin SoftPlus
+SoftShrink SoftSign HardTanh HardShrink Threshold Clamp Abs Sqrt Square Power Exp Log GradientReversal
+CAddTable CSubTable CMulTable CDivTable CMaxTable CMinTable JoinTable SelectTable NarrowTable FlattenTable
+MixtureTable CriterionTable DotProduct PairwiseDistance CosineDistance
+Reshape InferReshape View Transpose Replicate Squeeze Unsqueeze Padding Contiguous Copy Identity Echo
+RnnCell LSTMCell GRUCell TimeDistributedCriterion Dropout L1Penalty
+ClassNLLCriterion CrossEntropyCriterion MSECriterion AbsCriterion BCECriterion DistKLDivCriterion
+ClassSimplexCriterion CosineEmbeddingCriterion HingeEmbeddingCriterion L1HingeEmbeddingCriterion
+MarginCriterion MarginRankingCriterion MultiCriterion ParallelCriterion MultiLabelMarginCriterion
+MultiLabelSoftMarginCriterion MultiMarginCriterion SmoothL1Criterion SmoothL1CriterionWithWeights
+SoftMarginCriterion SoftmaxWithCriterion L1Cost""".split()
+
+OPTIM_NAMES = ("Optimizer DistriOptimizer LocalOptimizer SGD Adagrad LBFGS "
+               "OptimMethod Trigger Top1Accuracy Top5Accuracy Loss "
+               "EvaluateMethods Metrics Validator LocalValidator "
+               "DistriValidator Predictor DLClassifier save_model "
+               "save_state").split()
+
+DATASET_NAMES = ("DataSet LocalDataSet DistributedDataSet ShardedDataSet "
+                 "Transformer ChainedTransformer SampleToBatch PreFetch "
+                 "Sample MiniBatch ByteRecord BytesToBGRImg BytesToGreyImg "
+                 "BGRImgNormalizer BGRImgPixelNormalizer BGRImgCropper "
+                 "BGRImgRdmCropper HFlip ColoJitter Lighting BGRImgToBatch "
+                 "MTLabeledBGRImgToBatch LabeledSentence "
+                 "LabeledSentenceToSample Dictionary WordTokenizer").split()
+
+UTILS_NAMES = ("Engine Table T File TorchFile CaffeLoader RandomGenerator "
+               "kth_largest ModelBroadcast").split()
+
+MODEL_NAMES = ("LeNet5 VggForCifar10 Vgg_16 Vgg_19 Inception_v1 "
+               "Inception_v1_NoAuxClassifier Inception_v2 ResNet ResNetCifar "
+               "Autoencoder SimpleRNN AlexNet AlexNet_OWT").split()
+
+
+def loc(obj):
+    if isinstance(obj, types.ModuleType):
+        return f"`{obj.__file__.split(ROOT + '/')[-1]}`"
+    try:
+        f = inspect.getsourcefile(obj).split(ROOT + "/")[-1]
+        return f"`{f}:{inspect.getsourcelines(obj)[1]}`"
+    except TypeError:
+        return "(builtin/alias)"
+
+
+def table(mod, names):
+    rows = []
+    for n in names:
+        obj = getattr(mod, n)
+        where = loc(obj)
+        if n == "Engine":
+            where = "`bigdl_tpu/utils/engine.py:20` (`_Engine` singleton instance)"
+        rows.append(f"| {n} | {where} |")
+    return "\n".join(rows)
+
+
+def main():
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as o
+    import bigdl_tpu.dataset as d
+    import bigdl_tpu.utils as u
+    import bigdl_tpu.models as m
+
+    doc = f"""# PARITY — SURVEY.md §2 component inventory → implementation
+
+Machine-generated name→`file:line` map (regenerate with
+``python tools/gen_parity.py``) so the reference's component inventory can
+be checked line by line.  Every name resolves from the package namespaces
+exactly as listed.  Reference citations live in each implementation's
+docstring.
+
+## §2.2 Tensor package
+
+The reference's 6.5k-LoC tensor layer dissolves into jnp + XLA by design
+(SURVEY.md §7 item 1).  What remains: `bigdl_tpu/tensor/__init__.py` —
+`DTypePolicy` (the TensorNumeric dtype role), `narrow`/`select`
+Torch-shape helpers.  Tensor *capabilities* (views, elementwise, BLAS) are
+jnp; the MKL-fallback seam maps to `bigdl_tpu/native/` (C++ hostops with
+numpy fallback, the MKL.java discovery/fallback role).
+
+## §2.3 NN package (nn/ — containers, layers, activations, criterions)
+
+| Component | Implementation |
+|---|---|
+{table(nn, NN_NAMES)}
+
+## §2.4 Dataset package
+
+| Component | Implementation |
+|---|---|
+{table(d, DATASET_NAMES)}
+
+Shard streaming (SeqFileFolder/ImageNetSeqFileGenerator roles):
+`bigdl_tpu/dataset/shardfile.py`, `bigdl_tpu/dataset/imagenet_tools.py`,
+`DataSet.seq_file_folder`.
+
+## §2.5 Parameters package (communication backend)
+
+| Reference component | TPU-native equivalent |
+|---|---|
+| AllReduceParameter reduce-scatter/all-gather | XLA all-reduce emitted by the jit train step (`bigdl_tpu/optim/distri_optimizer.py` `_core_step`); explicit collectives in `bigdl_tpu/parallel/collectives.py` |
+| FP16CompressedTensor / FP16SplitsCompressedTensor | `DistriOptimizer(gradient_compression="bf16")` — `bigdl_tpu/optim/distri_optimizer.py` `_build_step_compressed` (bf16 gradient all-reduce over the wire) |
+| per-partition weight update (owner slice) | `DistriOptimizer(zero1=True)` — `bigdl_tpu/parallel/sharding.py` `zero1_rule` |
+| syncPool / parallel fp16 add | XLA collective scheduling (no user-facing equivalent needed) |
+
+## §2.6 Optim package
+
+| Component | Implementation |
+|---|---|
+{table(o, OPTIM_NAMES)}
+
+## §2.7 Utils package
+
+| Component | Implementation |
+|---|---|
+{table(u, UTILS_NAMES)}
+
+Also: `bigdl_tpu/utils/log.py` (log4j.properties role),
+`bigdl_tpu/utils/profiler.py` (per-module times + jax.profiler traces),
+`Engine.check_singleton` (race-detection role, §5.2).
+
+## §2.8 Models & examples
+
+| Component | Implementation |
+|---|---|
+{table(m, MODEL_NAMES)}
+
+Train/Test mains: `examples/train_*.py`, `examples/model_validator.py`,
+`examples/image_classification.py`, `examples/text_classifier.py`.
+Perf CLIs: `bigdl_tpu/models/utils/perf.py` +
+`local_optimizer_perf.py` / `distri_optimizer_perf.py`.
+
+## §2.9 Parallelism strategies
+
+| Strategy | Status | Where |
+|---|---|---|
+| Data parallelism (inter+intra node) | YES | `DistriOptimizer` (mesh `data` axis; intra-node splitting dissolves into XLA, SURVEY §2.9) |
+| Parameter sharding all-reduce | YES | jit-emitted reduce-scatter/all-gather; `parallel/collectives.py` |
+| Gradient compression | YES | `gradient_compression="bf16"` |
+| Straggler mitigation | documented no-op | `DistriOptimizer(drop_percentage=...)` warns (bulk-synchronous XLA) |
+| Intra-op threading | YES (free) | XLA fusion |
+| Tensor parallelism | YES (beyond ref) | `parallel/sharding.py` + `tensor_parallel=True` |
+| Pipeline parallelism | YES (beyond ref) | `parallel/pipeline.py` |
+| Sequence/context parallelism | YES (beyond ref) | `parallel/ring_attention.py` |
+| Expert parallelism (MoE) | YES (beyond ref) | `parallel/moe.py` |
+| ZeRO-1 | YES (beyond ref) | `zero1=True` |
+| Per-param learning rates | YES | `T(learningRates=...)` in the jit SGD path |
+"""
+    out = os.path.join(ROOT, "PARITY.md")
+    with open(out, "w") as f:
+        f.write(doc)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
