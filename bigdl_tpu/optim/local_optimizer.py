@@ -249,11 +249,16 @@ def _model_fingerprint(model):
 
     hyper_types = (int, float, bool, str, bytes, type(None), tuple, list,
                    np.integer, np.floating, np.bool_)
+    # runtime-mutable attrs that don't change the compiled computation —
+    # including them would recompile on every eager call / mode flip
+    skip = {"forward_time", "backward_time", "training_mode", "output",
+            "grad_input", "_last_key", "name"}
 
     def walk(mod, path):
         scalars = tuple(sorted(
             (k, repr(v)) for k, v in mod.__dict__.items()
-            if isinstance(v, hyper_types) and not k.startswith("_cached_")))
+            if isinstance(v, hyper_types) and k not in skip and
+            not k.startswith("_cached_")))
         parts.append((path, type(mod).__name__, scalars))
         for name, child in mod._modules.items():
             walk(child, f"{path}/{name}")

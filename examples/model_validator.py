@@ -14,6 +14,14 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 MODELS = {}
 
+# per-architecture synthetic-eval input (HWC or HW) + class count
+INPUT_SHAPES = {
+    "alexnet": ((227, 227, 3), 1000),
+    "inception": ((224, 224, 3), 1000),
+    "vgg16": ((224, 224, 3), 1000),
+    "lenet": ((28, 28), 10),
+}
+
 
 def _register():
     from bigdl_tpu.models.alexnet import AlexNet
@@ -73,11 +81,14 @@ def main(argv=None):
     else:
         logging.warning("no folder given — evaluating on synthetic data")
         from bigdl_tpu.dataset.image import LabeledImage
+        shape, classes = INPUT_SHAPES.get(args.model, ((224, 224, 3), 1000))
         rng = np.random.RandomState(0)
-        data = [LabeledImage(rng.uniform(0, 255, (224, 224, 3)),
-                             rng.randint(1, 1001)) for _ in range(64)]
+        data = [LabeledImage(rng.uniform(0, 255, shape),
+                             rng.randint(1, classes + 1)) for _ in range(64)]
+        norm_mean = (123.0, 117.0, 104.0) if len(shape) == 3 else 33.0
+        norm_std = (1.0, 1.0, 1.0) if len(shape) == 3 else 78.0
         ds = (DataSet.array(data)
-              >> ImgNormalizer((123.0, 117.0, 104.0), (1.0, 1.0, 1.0))
+              >> ImgNormalizer(norm_mean, norm_std)
               >> ImgToBatch(args.batchSize))
 
     results = validate(model, model.params(), model.state(), ds,

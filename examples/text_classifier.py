@@ -12,10 +12,20 @@ import sys
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def build_model(class_num: int, seq_len: int = 100, embed_dim: int = 50):
+def build_model(class_num: int, seq_len: int = 200, embed_dim: int = 50):
     """(ref TextClassifier.buildModel :119-140): three conv5-relu-maxpool
-    stages on the (1, seq, embed) plane, then a linear head."""
+    stages on the (1, seq, embed) plane, then a linear head.  The
+    reference hardcodes the last pooling to 35 for its 1000-token
+    sequences; here the final pool consumes whatever extent remains, so
+    any seq_len that survives the first two stages (>= 149) works."""
     import bigdl_tpu.nn as nn
+    h1 = seq_len - 4          # conv kh=5
+    h2 = (h1 - 5) // 5 + 1    # pool 5/5
+    h3 = h2 - 4               # conv kh=5
+    h4 = (h3 - 5) // 5 + 1    # pool 5/5
+    h5 = h4 - 4               # conv kh=5
+    if h5 < 1:
+        raise ValueError(f"seqLength {seq_len} too short for 3 conv stages")
     m = nn.Sequential()
     m.add(nn.Reshape([1, seq_len, embed_dim]))
     m.add(nn.SpatialConvolution(1, 128, embed_dim, 5))   # kw=embed, kh=5
@@ -24,6 +34,9 @@ def build_model(class_num: int, seq_len: int = 100, embed_dim: int = 50):
     m.add(nn.SpatialConvolution(128, 128, 1, 5))
     m.add(nn.ReLU())
     m.add(nn.SpatialMaxPooling(1, 5, 1, 5))
+    m.add(nn.SpatialConvolution(128, 128, 1, 5))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(1, h5, 1, h5))            # ref: 35 @ seq 1000
     m.add(nn.Reshape([128]))
     m.add(nn.Linear(128, 100))
     m.add(nn.ReLU())
@@ -37,7 +50,7 @@ def main(argv=None):
     p.add_argument("-f", "--baseDir", default="./20news")
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--classNum", type=int, default=5)
-    p.add_argument("--seqLength", type=int, default=100)
+    p.add_argument("--seqLength", type=int, default=200)
     p.add_argument("--embedDim", type=int, default=50)
     p.add_argument("--learningRate", type=float, default=0.01)
     p.add_argument("--maxEpoch", type=int, default=3)

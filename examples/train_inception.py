@@ -40,6 +40,15 @@ def main(argv=None):
     from bigdl_tpu.optim.optim_method import Poly
     from bigdl_tpu.utils.table import T
 
+    import os
+    if not args.synthetic and not os.path.isdir(args.folder):
+        if args.folder != p.get_default("folder"):
+            # an explicitly-given path that doesn't exist is a user error,
+            # not a cue to burn cycles training on noise
+            p.error(f"image folder not found: {args.folder}")
+        logging.warning("no image folder at %s — falling back to synthetic "
+                        "data (DistriOptimizerPerf mode)", args.folder)
+        args.synthetic = True
     if args.synthetic:
         rng = np.random.RandomState(0)
         data = [LabeledImage(rng.uniform(0, 255, (256, 256, 3)),
