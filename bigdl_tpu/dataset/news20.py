@@ -1,0 +1,95 @@
+"""20 Newsgroups + GloVe ingestion (ref dl/src/main/python/dataset/news20.py:
+download_news20 :12, download_glove_w2v :24, get_news20 :38,
+get_glove_w2v).
+
+The reference downloads archives at call time; here ingestion reads
+already-extracted local copies (air-gapped TPU pods don't have egress from
+the trainer), with the same directory layouts:
+
+- ``20_newsgroups/<group>/<doc-id>`` — one file per post, label = 1-based
+  group index in sorted order (matching get_news20's ordering);
+- ``glove.6B/glove.6B.<dim>d.txt`` — space-separated word vectors.
+
+``embed_samples`` turns (text, label) pairs into padded embedded Samples
+the TextClassifier model consumes — the analyze/tokenize/normalize path
+of the reference's example/textclassification prepare_data.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+
+def get_news20(source_dir):
+    """[(text, 1-based label)] from an extracted 20_newsgroups tree
+    (ref news20.py get_news20 :38-52)."""
+    news_dir = os.path.join(source_dir, "20_newsgroups")
+    if not os.path.isdir(news_dir):
+        news_dir = source_dir  # already pointing at the class folders
+    texts = []
+    # a co-located glove.6B/ dir must not be mistaken for a class folder
+    groups = sorted(d for d in os.listdir(news_dir)
+                    if os.path.isdir(os.path.join(news_dir, d))
+                    and not d.startswith((".", "glove")))
+    if not groups:
+        raise FileNotFoundError(
+            f"no newsgroup class folders under {news_dir}; extract "
+            f"20news-19997.tar.gz there (the reference downloads it from "
+            f"qwone.com — this loader is offline by design)")
+    for label, name in enumerate(groups, start=1):
+        d = os.path.join(news_dir, name)
+        for fn in sorted(os.listdir(d)):
+            path = os.path.join(d, fn)
+            if os.path.isfile(path):
+                with open(path, "rb") as f:
+                    texts.append((f.read().decode("latin-1"), float(label)))
+    if not texts:
+        raise FileNotFoundError(
+            f"newsgroup folders under {news_dir} contain no documents "
+            f"({', '.join(groups[:3])}...) — incomplete extraction?")
+    return texts
+
+
+def get_glove_w2v(source_dir, dim: int = 100):
+    """{word: np.float32[dim]} from an extracted glove.6B directory
+    (ref news20.py get_glove_w2v)."""
+    path = os.path.join(source_dir, f"glove.6B.{dim}d.txt")
+    if not os.path.isfile(path):
+        alt = os.path.join(source_dir, "glove.6B", f"glove.6B.{dim}d.txt")
+        if os.path.isfile(alt):
+            path = alt
+        else:
+            raise FileNotFoundError(
+                f"no glove.6B.{dim}d.txt under {source_dir}; extract "
+                f"glove.6B.zip there (offline by design)")
+    w2v = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            w2v[parts[0]] = np.asarray(parts[1:], np.float32)
+    return w2v
+
+
+_TOKEN = re.compile(r"[a-z]+")
+
+
+def tokenize(text: str):
+    """Lowercase word tokens (the reference's analyzer: text_to_words)."""
+    return _TOKEN.findall(text.lower())
+
+
+def embed_samples(texts, w2v, seq_len: int = 1000, embed_dim: int = 100):
+    """(text, label) pairs -> Samples of (seq_len, embed_dim) float32
+    features with zero padding/truncation (ref prepare_data in
+    example/textclassification: tokens -> glove vectors -> pad)."""
+    from bigdl_tpu.dataset.sample import Sample
+    samples = []
+    for text, label in texts:
+        vecs = [w2v[t] for t in tokenize(text) if t in w2v][:seq_len]
+        feat = np.zeros((seq_len, embed_dim), np.float32)
+        if vecs:
+            feat[:len(vecs)] = np.stack(vecs)
+        samples.append(Sample(feat, np.asarray([label], np.float32)))
+    return samples

@@ -65,15 +65,29 @@ def main(argv=None):
     from bigdl_tpu.optim import LocalOptimizer, max_epoch, every_epoch, Top1Accuracy
     from bigdl_tpu.utils.table import T
 
-    # synthetic embedded documents: class-dependent mean in embedding space
-    rng = np.random.RandomState(0)
-    class_means = rng.randn(args.classNum, args.embedDim)
-    samples = []
-    for i in range(512):
-        c = i % args.classNum
-        doc = (rng.randn(args.seqLength, args.embedDim) * 0.5
-               + class_means[c]).astype(np.float32)
-        samples.append(Sample(doc, np.asarray([c + 1.0])))
+    import os
+    from bigdl_tpu.dataset import news20
+    if os.path.isdir(args.baseDir):
+        # real 20-newsgroups + GloVe (pre-extracted; ref news20.py)
+        texts = news20.get_news20(args.baseDir)
+        w2v = news20.get_glove_w2v(args.baseDir, dim=args.embedDim)
+        samples = news20.embed_samples(texts, w2v, args.seqLength,
+                                       args.embedDim)
+        args.classNum = int(max(s.label[0] for s in samples))
+        rng = np.random.RandomState(0)
+        rng.shuffle(samples)
+    else:
+        logging.warning("no data at %s — synthetic embedded documents",
+                        args.baseDir)
+        # class-dependent mean in embedding space
+        rng = np.random.RandomState(0)
+        class_means = rng.randn(args.classNum, args.embedDim)
+        samples = []
+        for i in range(512):
+            c = i % args.classNum
+            doc = (rng.randn(args.seqLength, args.embedDim) * 0.5
+                   + class_means[c]).astype(np.float32)
+            samples.append(Sample(doc, np.asarray([c + 1.0])))
 
     split = int(len(samples) * 0.8)
     train_ds = DataSet.array(samples[:split]) >> SampleToBatch(args.batchSize, drop_last=True)

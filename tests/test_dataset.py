@@ -190,3 +190,43 @@ class TestPreFetch:
     def test_preserves_order(self):
         out = list(PreFetch(2)(iter(range(20))))
         assert out == list(range(20))
+
+
+class TestNews20:
+    def _make_tree(self, tmp_path):
+        import os
+        for gi, group in enumerate(["alt.atheism", "sci.space"], start=1):
+            d = tmp_path / "20_newsgroups" / group
+            d.mkdir(parents=True)
+            for i in range(3):
+                (d / f"{10000 + i}").write_text(
+                    f"Subject: test {group}\n\nspace rocket alien word{gi}")
+        glove = tmp_path / "glove.6B"
+        glove.mkdir()
+        words = ["space", "rocket", "alien", "subject", "test", "word1", "word2"]
+        lines = [w + " " + " ".join(str(round(0.1 * (i + j), 3))
+                                    for j in range(4))
+                 for i, w in enumerate(words)]
+        (glove / "glove.6B.4d.txt").write_text("\n".join(lines) + "\n")
+        return tmp_path
+
+    def test_load_and_embed(self, tmp_path):
+        from bigdl_tpu.dataset import news20
+        root = self._make_tree(tmp_path)
+        texts = news20.get_news20(str(root))
+        assert len(texts) == 6
+        assert sorted({t[1] for t in texts}) == [1.0, 2.0]
+        w2v = news20.get_glove_w2v(str(root), dim=4)
+        assert w2v["space"].shape == (4,)
+        samples = news20.embed_samples(texts, w2v, seq_len=8, embed_dim=4)
+        assert len(samples) == 6
+        assert samples[0].feature.shape == (8, 4)
+        # "space" appears in every doc body -> some non-zero rows
+        assert any(np.abs(s.feature).sum() > 0 for s in samples)
+
+    def test_missing_tree_raises(self, tmp_path):
+        from bigdl_tpu.dataset import news20
+        with pytest.raises(FileNotFoundError):
+            news20.get_news20(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            news20.get_glove_w2v(str(tmp_path), dim=4)
