@@ -111,7 +111,10 @@ numpy fallback, the MKL.java discovery/fallback role).
 
 Shard streaming (SeqFileFolder/ImageNetSeqFileGenerator roles):
 `bigdl_tpu/dataset/shardfile.py`, `bigdl_tpu/dataset/imagenet_tools.py`,
-`DataSet.seq_file_folder`.
+`DataSet.seq_file_folder`.  20-newsgroups + GloVe ingestion (the Python
+news20.py role): `bigdl_tpu/dataset/news20.py` (offline, pre-extracted
+trees).  Built-in readers: `bigdl_tpu/dataset/mnist.py`,
+`bigdl_tpu/dataset/cifar.py`.
 
 ## §2.5 Parameters package (communication backend)
 
