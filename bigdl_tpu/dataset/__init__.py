@@ -8,8 +8,8 @@ from bigdl_tpu.dataset.dataset import (
 )
 from bigdl_tpu.dataset.image import (
     LabeledImage, BytesToImg, BytesToBGRImg, BytesToGreyImg, ImgNormalizer,
-    ImgPixelNormalizer, ImgCropper, ImgRdmCropper, HFlip, ColorJitter,
-    Lighting, ImgToBatch, ImgToSample, MTLabeledImgToBatch,
+    ImgPixelNormalizer, ImgCropper, BGRImgCropper, ImgRdmCropper, HFlip,
+    ColorJitter, Lighting, ImgToBatch, ImgToSample, MTLabeledImgToBatch,
 )
 from bigdl_tpu.dataset.text import (
     Dictionary, WordTokenizer, SentenceToLabeledSentence,
@@ -23,7 +23,6 @@ from bigdl_tpu.dataset.text import (
 GreyImgNormalizer = ImgNormalizer
 BGRImgNormalizer = ImgNormalizer
 BGRImgPixelNormalizer = ImgPixelNormalizer
-BGRImgCropper = ImgCropper
 BGRImgRdmCropper = ImgRdmCropper
 GreyImgCropper = ImgRdmCropper  # the reference's grey cropper is random-position
 BGRImgToBatch = ImgToBatch

@@ -169,18 +169,41 @@ class ImgPixelNormalizer(Transformer):
 
 
 class ImgCropper(Transformer):
-    """Center crop (ref BGRImgCropper with CropCenter)."""
+    """Positioned crop (ref BGRImgCropper.scala).  ``cropper_method`` is
+    ``"center"`` or ``"random"``; this spelling defaults to center (the
+    validation-pipeline choice), while the reference-named ``BGRImgCropper``
+    subclass defaults to random, matching the reference's
+    ``cropperMethod: CropperMethod = CropRandom`` default."""
 
-    def __init__(self, crop_width: int, crop_height: int):
+    def __init__(self, crop_width: int, crop_height: int,
+                 cropper_method: str = "center"):
+        if cropper_method not in ("center", "random"):
+            raise ValueError(
+                f"cropper_method must be center|random, got {cropper_method}")
         self.cw, self.ch = crop_width, crop_height
+        self.cropper_method = cropper_method
 
     def __call__(self, iterator):
         for img in iterator:
             h, w = img.data.shape[:2]
-            y0 = (h - self.ch) // 2
-            x0 = (w - self.cw) // 2
+            if self.cropper_method == "random":
+                y0 = RNG.np_rng().randint(0, h - self.ch + 1)
+                x0 = RNG.np_rng().randint(0, w - self.cw + 1)
+            else:
+                y0 = (h - self.ch) // 2
+                x0 = (w - self.cw) // 2
             img.data = img.data[y0:y0 + self.ch, x0:x0 + self.cw]
             yield img
+
+
+class BGRImgCropper(ImgCropper):
+    """Reference-named cropper: defaults to random position like
+    BGRImgCropper.scala (CropRandom); pass ``cropper_method="center"``
+    for validation pipelines."""
+
+    def __init__(self, crop_width: int, crop_height: int,
+                 cropper_method: str = "random"):
+        super().__init__(crop_width, crop_height, cropper_method)
 
 
 class ImgRdmCropper(Transformer):
@@ -266,9 +289,17 @@ class ColorJitter(Transformer):
 class Lighting(Transformer):
     """PCA lighting noise with ImageNet eigen-decomposition
     (ref Lighting.scala; values originate from fb.resnet.torch where rows
-    are RGB-ordered).  Row order follows each image's channel layout, so
-    BGR-decoded pipelines get the R/B components applied to the right
-    channels."""
+    are RGB-ordered).
+
+    Two intentional divergences from the reference (also noted in
+    PARITY.md), chosen to match fb.resnet.torch's original semantics
+    rather than reproduce reference quirks:
+
+    - alpha is drawn from ``normal(0, alphastd)`` (fb.resnet.torch), while
+      Lighting.scala:41 draws ``uniform(0, alphastd)``;
+    - the RGB-ordered shift row is flipped for BGR-decoded images so each
+      eigen-component lands on its own channel, while the reference applies
+      the RGB rows to BGR pixels unflipped."""
 
     alphastd = 0.1
     eig_val = np.asarray([0.2175, 0.0188, 0.0045], np.float32)

@@ -124,6 +124,13 @@ class PreFetch(Transformer):
         _END = object()
         stop = threading.Event()
 
+        class _Error:
+            # private sentinel so a pipeline that legitimately yields
+            # exception *objects* as data items is not confused with a
+            # worker failure
+            def __init__(self, exc):
+                self.exc = exc
+
         def put(item):
             # bounded put that gives up when the consumer is gone, so an
             # abandoned iterator can't leave this thread blocked forever
@@ -142,7 +149,7 @@ class PreFetch(Transformer):
                         return
                 put(_END)
             except BaseException as e:  # propagate to the consumer
-                put(e)
+                put(_Error(e))
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
@@ -151,8 +158,8 @@ class PreFetch(Transformer):
                 item = q.get()
                 if item is _END:
                     break
-                if isinstance(item, BaseException):
-                    raise item
+                if isinstance(item, _Error):
+                    raise item.exc
                 yield item
         finally:
             stop.set()

@@ -62,7 +62,11 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
     model = build()
     criterion = nn.ClassNLLCriterion()
     method = SGD()
-    params, net_state = model.params(), model.state()
+    # copy before the donating jit step — donate_argnums would otherwise
+    # leave the live module holding deleted buffers (same guard as
+    # LocalOptimizer/DistriOptimizer)
+    params = jax.tree_util.tree_map(jnp.copy, model.params())
+    net_state = jax.tree_util.tree_map(jnp.copy, model.state())
     opt_state = method.init_state(params)
     hyper = {"lr": 0.01, "momentum": 0.9, "dampening": 0.0,
              "weight_decay": 0.0, "nesterov": False}

@@ -167,6 +167,23 @@ Perf CLIs: `bigdl_tpu/models/utils/perf.py` +
 | Expert parallelism (MoE) | YES (beyond ref) | `parallel/moe.py` |
 | ZeRO-1 | YES (beyond ref) | `zero1=True` |
 | Per-param learning rates | YES | `T(learningRates=...)` in the jit SGD path |
+
+## Documented intentional divergences
+
+Deliberate behavior differences from the reference (not bugs; parity
+audits should not flag these):
+
+- `Lighting` (`bigdl_tpu/dataset/image.py`): alpha drawn from
+  `normal(0, alphastd)` per fb.resnet.torch, where Lighting.scala:41 draws
+  `uniform(0, alphastd)`; the RGB-ordered eigen rows are flipped for
+  BGR-decoded images, where the reference applies them unflipped.
+- `BGRImgCropper` defaults to random crop (reference default CropRandom);
+  the framework-native `ImgCropper` spelling defaults to center crop for
+  validation pipelines.
+- Straggler dropping is a documented no-op under bulk-synchronous XLA
+  collectives (SURVEY §7 hard parts).
+- RNG: seeded determinism is preserved, but streams are JAX counter-based
+  PRNG, not Torch's Mersenne-Twister (SURVEY §7 hard parts).
 """
     out = os.path.join(ROOT, "PARITY.md")
     with open(out, "w") as f:
