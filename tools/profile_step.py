@@ -1,0 +1,467 @@
+"""Per-op device profile of a training step (VERDICT round-1 item 1).
+
+Builds the same jitted train step as ``bench.py`` for a chosen model,
+captures a ``jax.profiler`` device trace, and joins the per-op device
+timings against the optimized HLO module's **metadata** (op_name +
+source_file, attached by XLA to every instruction) to attribute every
+microsecond of device time to (a) an op kind (conv fwd/bwd, pool fwd/bwd,
+matmul, rng, eltwise...) and (b) the framework module that emitted it
+(conv.py, pooling.py, normalization.py, ...).
+
+The reference's profiling analogue is per-module wall timers
+(AbstractModule.scala:125-136) and conv im2col/col2im counters
+(SpatialConvolution.scala:73-78); on TPU the per-op device trace is the
+honest equivalent because XLA fuses across module boundaries.
+
+Usage:  python tools/profile_step.py [inception|vgg16|lenet|resnet50] [batch]
+Writes ``PROFILE_<model>.md`` at the repo root and prints the table.
+"""
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import math
+import re
+import sys
+import tempfile
+
+
+# --------------------------------------------------------------- HLO parsing
+
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+_OPCODE_RE = re.compile(r"(?:^|[\s)])([a-z][a-z0-9\-]*)\(")
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
+
+
+class Instr:
+    __slots__ = ("name", "comp", "opcode", "shape", "operands", "op_name",
+                 "src", "line")
+
+
+def parse_hlo_module(hlo_text: str):
+    """Parse optimized HLO text into {instr_name: Instr} + entry name.
+
+    Handles tuple-typed instructions; opcode = first bare lowercase word
+    followed by '(' after the '=' (type annotations like T(8,128) are
+    uppercase; tuple-open parens are not preceded by letters).
+    """
+    instrs = {}
+    entry = None
+    cur = None
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if stripped.endswith("{") and "=" not in stripped.split("(")[0]:
+            mc = re.match(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(", stripped)
+            if mc:
+                cur = mc.group(2)
+                if mc.group(1):
+                    entry = cur
+                continue
+        md = _DEF_RE.match(line)
+        if not md or "=" not in line:
+            continue
+        name, rest = md.groups()
+        mo = _OPCODE_RE.search(rest)
+        if not mo:
+            continue
+        it = Instr()
+        it.name, it.comp, it.opcode = name, cur, mo.group(1)
+        ms = _SHAPE_RE.search(rest)
+        it.shape = [int(s) for s in ms.group(2).split(",") if s] if ms else []
+        # operand names: first (...) group after the opcode
+        ops = rest[mo.end():]
+        depth, buf = 1, []
+        for ch in ops:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            buf.append(ch)
+        it.operands = re.findall(r"%([\w.\-]+)", "".join(buf))
+        mm = re.search(r'op_name="([^"]*)"', rest)
+        it.op_name = mm.group(1) if mm else ""
+        mm = re.search(r'source_file="([^"]*)"', rest)
+        it.src = mm.group(1).split("/")[-1] if mm else ""
+        it.line = line
+        instrs[(cur, name)] = it
+    return instrs, entry
+
+
+def build_indexes(instrs):
+    """name -> Instr within each computation + global last-wins name map."""
+    by_comp = collections.defaultdict(dict)
+    for (comp, name), it in instrs.items():
+        by_comp[comp][name] = it
+    return by_comp
+
+
+def conv_flops(it, comp_map) -> float:
+    """FLOPs of a convolution Instr, resolving the rhs operand's shape.
+
+    Only exact for forward-form convs (rhs = OIHW/IOHW kernel with a
+    small spatial window).  Backward convs (weight-grad / data-grad in
+    transposed fb01 forms) must be corrected by matching against their
+    forward conv — see match_backward_convs().
+    """
+    out = math.prod(it.shape) if it.shape else 0
+    if not out or len(it.operands) < 2:
+        return 0.0
+    rhs_it = comp_map.get(it.operands[1])
+    rhs = rhs_it.shape if rhs_it is not None else []
+    dl = re.search(r"dim_labels=([\w]+_[\w]+->[\w]+)", it.line)
+    if not dl or not rhs:
+        return 0.0
+    rhs_labels = dl.group(1).split("_")[1].split("->")[0]
+    cin, kin = 1, 1
+    for dim, lab in zip(rhs, rhs_labels):
+        if lab == "i":
+            cin = dim
+        elif lab != "o":
+            kin *= dim
+    mb = re.search(r"batch_group_count=(\d+)", it.line)
+    bg = int(mb.group(1)) if mb else 1
+    return 2.0 * out * cin * kin * bg
+
+
+def forward_conv_table(instrs):
+    """All plausible forward convs in the module:
+    [(in_shape, k_shape, out_shape, flops)] (deduped)."""
+    by_comp = build_indexes(instrs)
+    seen = {}
+    for (comp, name), it in instrs.items():
+        if it.opcode != "convolution":
+            continue
+        cmap = by_comp[comp]
+        lhs_it = cmap.get(it.operands[0]) if it.operands else None
+        rhs_it = cmap.get(it.operands[1]) if len(it.operands) > 1 else None
+        if lhs_it is None or rhs_it is None:
+            continue
+        k = rhs_it.shape
+        # forward form: 4-d kernel with small spatial dims and the conv's
+        # batch dim matching lhs batch
+        if (len(k) == 4 and len(it.shape) == 4 and len(lhs_it.shape) == 4
+                and k[2] <= 11 and k[3] <= 11
+                and it.shape[0] == lhs_it.shape[0]):
+            fl = conv_flops(it, cmap)
+            key = (tuple(lhs_it.shape), tuple(sorted(k)), tuple(it.shape))
+            if fl:
+                seen[key] = (tuple(lhs_it.shape), tuple(k), tuple(it.shape), fl)
+    return list(seen.values())
+
+
+def match_backward_conv(it, comp_map, fwd_table):
+    """FLOPs for a backward conv by matching shapes to its forward conv:
+    weight-grad (out == kernel shape) or data-grad (out == input shape).
+    The MAC count of all three convs of one layer is identical."""
+    out = tuple(it.shape)
+    op_shapes = []
+    for nm in it.operands[:2]:
+        o = comp_map.get(nm)
+        op_shapes.append(tuple(o.shape) if o is not None else ())
+    for (ins, ks, outs, fl) in fwd_table:
+        if out == ks or tuple(sorted(out)) == tuple(sorted(ks)):
+            # weight-grad: operands are the layer's input + output grads
+            if set(op_shapes) <= {ins, outs} or not op_shapes:
+                return fl
+        if out == ins:
+            # data-grad: one operand is the kernel (possibly transposed)
+            for s in op_shapes:
+                if tuple(sorted(s)) == tuple(sorted(ks)):
+                    return fl
+    return 0.0
+
+
+def conv_sig(it, comp_map) -> str:
+    lhs_it = comp_map.get(it.operands[0]) if it.operands else None
+    rhs_it = comp_map.get(it.operands[1]) if len(it.operands) > 1 else None
+    win = re.search(r"window=\{([^}]*)\}", it.line)
+    dl = re.search(r"dim_labels=(\S+?)[, ]", it.line)
+    fmt = lambda s: ",".join(map(str, s)) if s else "?"
+    return "out[%s]<-lhs[%s]*rhs[%s] %s %s" % (
+        fmt(it.shape), fmt(lhs_it.shape if lhs_it else None),
+        fmt(rhs_it.shape if rhs_it else None),
+        win.group(1).split(" ")[0] if win else "",
+        dl.group(1) if dl else "")
+
+
+def categorize(opcode: str, op_name: str, src: str) -> str:
+    o = op_name
+    if opcode == "select-and-scatter" or "select_and_scatter" in o:
+        return "POOL-BWD"
+    if "conv_general_dilated" in o or opcode == "convolution":
+        if "transpose(" in o:
+            return "CONV-BWD"
+        return "CONV-FWD"
+    if opcode == "reduce-window" or "reduce_window" in o:
+        return "POOL-FWD(reduce_window)"
+    if opcode == "dot" or "dot_general" in o:
+        return "MATMUL"
+    if "threefry" in o or "random" in o or "_uniform" in o or "bernoulli" in o:
+        return "RNG"
+    if opcode in ("copy", "copy-start", "copy-done", "transpose", "bitcast"):
+        return "LAYOUT"
+    if opcode in ("all-reduce", "all-gather", "reduce-scatter"):
+        return "COLLECTIVE"
+    return "ELTWISE/OTHER"
+
+
+# ----------------------------------------------------------------- the step
+
+
+def build_step(model_name: str, batch: int):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import tensor as bt
+    from bigdl_tpu.nn.module import Context
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.utils.random import set_seed
+
+    set_seed(1)
+    bt.set_policy(bt.BF16_COMPUTE)
+
+    if model_name == "inception":
+        from bigdl_tpu.models.inception import Inception_v1
+        model = Inception_v1(class_num=1000)
+        xshape, nclass = (batch, 3, 224, 224), 1000
+    elif model_name == "vgg16":
+        from bigdl_tpu.models.vgg import Vgg_16
+        model = Vgg_16(class_num=1000)
+        xshape, nclass = (batch, 3, 224, 224), 1000
+    elif model_name == "resnet50":
+        from bigdl_tpu.models.resnet import ResNet
+        model = ResNet(class_num=1000, depth=50, dataset="imagenet")
+        xshape, nclass = (batch, 3, 224, 224), 1000
+    elif model_name == "lenet":
+        from bigdl_tpu.models.lenet import LeNet5
+        model = LeNet5(class_num=10)
+        xshape, nclass = (batch, 1, 28, 28), 10
+    else:
+        raise SystemExit("unknown model %s" % model_name)
+
+    criterion = nn.ClassNLLCriterion()
+    method = SGD()
+    params, net_state = model.params(), model.state()
+    opt_state = method.init_state(params)
+    hyper = {"lr": 0.01, "momentum": 0.9, "dampening": 0.0,
+             "weight_decay": 0.0001, "nesterov": False}
+
+    def train_step(params, net_state, opt_state, x, y, key):
+        def loss_fn(p):
+            out, ns = model.apply(p, x, net_state, Context(training=True, key=key))
+            return criterion.apply_loss(out, y), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new_params, new_opt = method.update(grads, opt_state, params, hyper)
+        return new_params, ns, new_opt, loss
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(*xshape), jnp.float32)
+    y = jnp.asarray(rs.randint(1, nclass + 1, (batch,)))
+    key = jax.random.PRNGKey(0)
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    return step, (params, net_state, opt_state, x, y, key)
+
+
+def measure_matmul_roofline() -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import time
+    a = jnp.asarray(np.random.RandomState(1).randn(8192, 8192) * 0.01,
+                    jnp.bfloat16)
+    mm = jax.jit(lambda v: (v @ a).astype(jnp.bfloat16) * 0.001)
+    z = mm(a)
+    float(jnp.sum(z).astype(jnp.float32))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        z = mm(z)
+    float(jnp.sum(z).astype(jnp.float32))
+    import time as _t
+    return 2 * 8192 ** 3 / ((time.perf_counter() - t0) / 10) / 1e12
+
+
+def profile(model_name="inception", batch=128, nsteps=5, step=None, args=None):
+    import jax
+
+    if step is None:
+        step, args = build_step(model_name, batch)
+    compiled = step.lower(*args).compile()
+    hlo_text = compiled.as_text()
+    instrs, entry = parse_hlo_module(hlo_text)
+    by_comp = build_indexes(instrs)
+    fwd_table = forward_conv_table(instrs)
+
+    fwd_max = max((f for (_, _, _, f) in fwd_table), default=0.0)
+
+    def conv_flops_checked(it, cmap):
+        matched = match_backward_conv(it, cmap, fwd_table)
+        if matched:
+            return matched
+        fl = conv_flops(it, cmap)
+        # an unmatched transposed form can overcount by contracting the
+        # full spatial extent; never report more than the largest fwd conv
+        return min(fl, fwd_max) if fl else 0.0
+
+    def comp_conv_info(comp_name, seen=None):
+        """(flops, sigs, op_names, srcs) of convs in a computation,
+        recursing into nested fusions."""
+        seen = seen or set()
+        if comp_name in seen:
+            return 0.0, [], [], []
+        seen.add(comp_name)
+        fl, sigs, onames, srcs = 0.0, [], [], []
+        cmap = by_comp.get(comp_name, {})
+        for it in cmap.values():
+            if it.opcode == "convolution":
+                fl += conv_flops_checked(it, cmap)
+                sigs.append(conv_sig(it, cmap))
+                onames.append(it.op_name)
+            if it.src:
+                srcs.append(it.src)
+            if it.opcode == "fusion":
+                mc = _CALLS_RE.search(it.line)
+                if mc:
+                    f2, s2, o2, r2 = comp_conv_info(mc.group(1), seen)
+                    fl += f2
+                    sigs += s2
+                    onames += o2
+                    srcs += r2
+        return fl, sigs, onames, srcs
+
+    total_flops = float(compiled.cost_analysis().get("flops", float("nan")))
+
+    params, net_state, opt_state, x, y, key = args
+    for _ in range(3):
+        params, net_state, opt_state, loss = step(
+            params, net_state, opt_state, x, y, key)
+    float(loss)
+
+    tmpdir = tempfile.mkdtemp(prefix="bigdl_prof_")
+    jax.profiler.start_trace(tmpdir)
+    for _ in range(nsteps):
+        params, net_state, opt_state, loss = step(
+            params, net_state, opt_state, x, y, key)
+    float(loss)
+    jax.profiler.stop_trace()
+
+    fn = sorted(glob.glob(tmpdir + "/plugins/profile/*/*.trace.json.gz"))[-1]
+    with gzip.open(fn) as f:
+        tr = json.load(f)
+    ev = tr["traceEvents"]
+    pids = {e["pid"]: e["args"]["name"] for e in ev
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    tids = {(e["pid"], e["tid"]): e["args"]["name"] for e in ev
+            if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    dev_pid = [p for p, n in pids.items() if "TPU" in n][0]
+
+    per_op = collections.Counter()
+    for e in ev:
+        if (e.get("ph") == "X" and e.get("pid") == dev_pid
+                and tids.get((e["pid"], e["tid"])) == "XLA Ops"):
+            per_op[e["name"]] += e.get("dur", 0)
+
+    roofline = measure_matmul_roofline()
+    entry_map = by_comp.get(entry, {})
+    rows = []
+    for name, us in per_op.items():
+        ms = us / 1e3 / nsteps
+        it = entry_map.get(name)
+        opcode = it.opcode if it else "?"
+        op_name = it.op_name if it else ""
+        src = it.src if it else ""
+        fl, sigs = 0.0, []
+        if it is not None and it.opcode == "fusion":
+            mc = _CALLS_RE.search(it.line)
+            if mc:
+                fl, sigs, conv_onames, srcs = comp_conv_info(mc.group(1))
+                if not op_name and conv_onames:
+                    op_name = conv_onames[0]
+                if not src and srcs:
+                    src = collections.Counter(srcs).most_common(1)[0][0]
+        elif it is not None and it.opcode == "convolution":
+            fl = conv_flops_checked(it, entry_map)
+            sigs = [conv_sig(it, entry_map)]
+        cat = categorize(opcode, op_name, src)
+        if fl and cat not in ("CONV-FWD", "CONV-BWD"):
+            cat = "CONV-BWD" if "transpose(" in op_name else "CONV-FWD"
+        tfs = fl / (ms / 1e3) / 1e12 if ms > 0 and fl else 0.0
+        rows.append({
+            "name": name, "category": cat, "ms": ms, "gflop": fl / 1e9,
+            "tflops": tfs,
+            "pct_roofline": 100.0 * tfs / roofline if tfs else 0.0,
+            "src": src, "op_name": op_name.replace("jit(train_step)/", ""),
+            "sigs": sigs,
+        })
+    rows.sort(key=lambda r: -r["ms"])
+    return rows, total_flops, roofline, tmpdir
+
+
+def report(rows, total_flops, roofline, model_name, batch, path=None):
+    total_ms = sum(r["ms"] for r in rows)
+    by_cat = collections.defaultdict(lambda: [0.0, 0.0])
+    by_src = collections.defaultdict(float)
+    for r in rows:
+        by_cat[r["category"]][0] += r["ms"]
+        by_cat[r["category"]][1] += r["gflop"]
+        by_src[r["src"] or "?"] += r["ms"]
+
+    lines = []
+    lines.append("# Per-op device profile — %s bs%d train step" % (model_name, batch))
+    lines.append("")
+    lines.append("Same-run matmul roofline: **%.1f TF/s**; XLA step FLOPs %.1f G; "
+                 "device-busy %.2f ms/step; device-busy TF/s %.1f."
+                 % (roofline, total_flops / 1e9, total_ms,
+                    total_flops / total_ms / 1e9))
+    lines.append("")
+    lines.append("## By op kind")
+    lines.append("")
+    lines.append("| kind | ms/step | % busy | GFLOP | achieved TF/s | % roofline |")
+    lines.append("|---|---|---|---|---|---|")
+    for cat, (ms, gf) in sorted(by_cat.items(), key=lambda kv: -kv[1][0]):
+        tfs = gf / ms / 1000 * 1e3 if ms else 0.0
+        tfs = gf / ms if ms else 0.0          # GFLOP/ms == TF/s
+        lines.append("| %s | %.2f | %.1f%% | %.1f | %.1f | %.0f%% |"
+                     % (cat, ms, 100 * ms / total_ms, gf, tfs,
+                        100 * tfs / roofline))
+    lines.append("")
+    lines.append("## By emitting module (source_file of the fusion root)")
+    lines.append("")
+    lines.append("| source | ms/step | % busy |")
+    lines.append("|---|---|---|")
+    for src, ms in sorted(by_src.items(), key=lambda kv: -kv[1]):
+        lines.append("| %s | %.2f | %.1f%% |" % (src, ms, 100 * ms / total_ms))
+    lines.append("")
+    lines.append("## Top ops")
+    lines.append("")
+    lines.append("| op | kind | ms/step | GFLOP | TF/s | %roof | source | op_name / conv |")
+    lines.append("|---|---|---|---|---|---|---|---|")
+    for r in rows[:45]:
+        what = r["sigs"][0] if r["sigs"] else r["op_name"]
+        lines.append("| %s | %s | %.3f | %.1f | %.1f | %.0f%% | %s | %s |" % (
+            r["name"], r["category"], r["ms"], r["gflop"], r["tflops"],
+            r["pct_roofline"], r["src"], what[:70]))
+    out = "\n".join(lines) + "\n"
+    if path:
+        with open(path, "w") as f:
+            f.write(out)
+    return out
+
+
+def main():
+    model_name = sys.argv[1] if len(sys.argv) > 1 else "inception"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    rows, total_flops, roofline, tmpdir = profile(model_name, batch)
+    path = "PROFILE_%s.md" % model_name
+    print(report(rows, total_flops, roofline, model_name, batch, path))
+    print("written:", path, " trace:", tmpdir)
+
+
+if __name__ == "__main__":
+    main()
