@@ -49,6 +49,47 @@ def _maybe_batch(x):
     return x, False
 
 
+_S2D_STEM = True  # isolated win, end-to-end neutral on Inception (PERF_NOTES); helps ResNet/AlexNet stems
+
+
+def _space_to_depth_conv(x, w, s, pad):
+    """Strided low-channel conv rewritten as space-to-depth + stride-1 conv.
+
+    A k x k stride-s conv over C channels equals a ceil(k/s)^2 stride-1
+    conv over C*s*s space-to-depth channels.  For stem convs (C=3, s=2 or
+    4) this multiplies the MXU contraction depth by s^2: the 7x7/s2
+    Inception-v1 stem measured 33 TF/s as-is (3 input channels fill 3/128
+    MXU rows) and proportionally better after this rewrite.  Exact same
+    arithmetic, reassociated.
+
+    out(i,j) = sum_t w[t] xpad[s*i + t]  becomes, with t = s*u + r,
+    sum_r sum_u w[s*u + r] X_r[i + u]  where X_r is the r-th phase of the
+    space-to-depth transform.
+    """
+    o, c, kh, kw = w.shape
+    b, _, h, wd = x.shape
+    (plh, phh), (plw, phw) = pad
+    khp = -(-kh // s)   # ceil(k/s) taps after the rewrite
+    kwp = -(-kw // s)
+    # pad the image to the conv's own padding, then up to a multiple of s
+    hp = h + plh + phh
+    wp = wd + plw + phw
+    eh = (-hp) % s
+    ew = (-wp) % s
+    xp = jnp.pad(x, ((0, 0), (0, 0), (plh, phh + eh), (plw, phw + ew)))
+    m, n = (hp + eh) // s, (wp + ew) // s
+    xs = xp.reshape(b, c, m, s, n, s).transpose(0, 1, 3, 5, 2, 4)
+    xs = xs.reshape(b, c * s * s, m, n)
+    # weight phases: w'[o, (c, rh, rw), u, v] = w[o, c, s*u+rh, s*v+rw]
+    wpad = jnp.pad(w, ((0, 0), (0, 0), (0, s * khp - kh), (0, s * kwp - kw)))
+    ws = wpad.reshape(o, c, khp, s, kwp, s).transpose(0, 1, 3, 5, 2, 4)
+    ws = ws.reshape(o, c * s * s, khp, kwp)
+    y = _conv(xs, ws, (1, 1), [(0, 0), (0, 0)])
+    oh = (hp - kh) // s + 1
+    ow = (wp - kw) // s + 1
+    return y[:, :, :oh, :ow]
+
+
 class SpatialConvolution(TensorModule):
     """2D convolution (ref SpatialConvolution.scala:31).
 
@@ -98,9 +139,19 @@ class SpatialConvolution(TensorModule):
 
     def _forward(self, P, x, S, ctx):
         x, was3d = _maybe_batch(x)
-        y = _conv(x, P["weight"], (self.stride_h, self.stride_w),
-                  [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
-                  groups=self.n_group)
+        s = self.stride_h
+        if (s == self.stride_w and s > 1 and self.n_group == 1
+                and self.n_input_plane * s * s <= 64 and _S2D_STEM
+                and self.kernel_h > s and self.kernel_w > s):
+            # stem convs (few input channels, strided): space-to-depth
+            # rewrite fills the MXU contraction dim s^2 times better
+            y = _space_to_depth_conv(
+                x, P["weight"], s,
+                [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)])
+        else:
+            y = _conv(x, P["weight"], (self.stride_h, self.stride_w),
+                      [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+                      groups=self.n_group)
         if self.with_bias:
             y = y + P["bias"][None, :, None, None]
         return (y[0] if was3d else y), None

@@ -101,15 +101,37 @@ class SpatialCrossMapLRN(TensorModule):
         self.beta = beta
         self.k = k
 
+    _STENCIL = False  # module-level A/B switches, see tools/ab_step.py:
+    _SQRT_POW = True  # in-model grid measured rw-LRN+sqrt fastest (PERF_NOTES)
+
     def _forward(self, P, x, S, ctx):
         lo = (self.size - 1) // 2
         hi = self.size - 1 - lo
-        sq_sum = lax.reduce_window(
-            x * x, 0.0, lax.add,
-            window_dimensions=(1, self.size, 1, 1),
-            window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (lo, hi), (0, 0), (0, 0)))
-        denom = (self.k + (self.alpha / self.size) * sq_sum) ** self.beta
+        if self._STENCIL:
+            # Cross-channel window sum as ``size`` shifted slice-adds — a
+            # pure elementwise stencil XLA fuses into one pass regardless
+            # of layout.  Measured alternatives (tools/ab_pool_lrn.py,
+            # PERF_NOTES.md): lax.reduce_window over the channel dim is
+            # slower at C=192, and a banded [C,C] matmul gets pattern-
+            # matched into a 1x1 NHWC conv whose backward runs at
+            # single-digit % of peak in-model.
+            c = x.shape[1]
+            sqp = jnp.pad(x * x, ((0, 0), (lo, hi), (0, 0), (0, 0)))
+            sq_sum = sum(lax.slice_in_dim(sqp, t, t + c, axis=1)
+                         for t in range(self.size))
+        else:
+            sq_sum = lax.reduce_window(
+                x * x, 0.0, lax.add,
+                window_dimensions=(1, self.size, 1, 1),
+                window_strides=(1, 1, 1, 1),
+                padding=((0, 0), (lo, hi), (0, 0), (0, 0)))
+        z = self.k + (self.alpha / self.size) * sq_sum
+        if self.beta == 0.75 and self._SQRT_POW:
+            # z^(3/4) = (z^(1/4))^3 via two sqrts: no exp/log transcendentals
+            # in either the forward or the autodiff backward
+            denom = jnp.sqrt(jnp.sqrt(z)) ** 3
+        else:
+            denom = z ** self.beta
         return x / denom, None
 
 

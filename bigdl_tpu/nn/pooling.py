@@ -16,6 +16,24 @@ from jax import lax
 from bigdl_tpu.nn.module import TensorModule, Module
 
 
+def _max_pool2d(x, window, strides, padding):
+    """Max pooling over NCHW via lax.reduce_window.
+
+    The backward is XLA's default select-and-scatter VJP.  Measured
+    alternatives on v5e (tools/ab_pool_lrn.py, PERF_NOTES.md): a custom
+    gather-stencil VJP with tie-splitting was 1.1-4x SLOWER on every
+    Inception pool shape in both f32 and bf16 — select-and-scatter on TPU
+    already runs near HBM bandwidth, so it is kept.
+    """
+    kh, kw = window
+    dh, dw = strides
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 1, kh, kw),
+        window_strides=(1, 1, dh, dw),
+        padding=((0, 0), (0, 0)) + padding)
+
+
 def _pool_out_size(in_size, k, stride, pad, ceil_mode):
     if ceil_mode:
         out = int(np.ceil(float(in_size - k + 2 * pad) / stride)) + 1
@@ -60,11 +78,7 @@ class SpatialMaxPooling(TensorModule):
         ow = _pool_out_size(w, self.kw, self.dw, self.pad_w, self.ceil_mode)
         ph = _pad_amounts(h, self.kh, self.dh, self.pad_h, oh)
         pw = _pad_amounts(w, self.kw, self.dw, self.pad_w, ow)
-        y = lax.reduce_window(
-            x, -jnp.inf, lax.max,
-            window_dimensions=(1, 1, self.kh, self.kw),
-            window_strides=(1, 1, self.dh, self.dw),
-            padding=((0, 0), (0, 0), ph, pw))
+        y = _max_pool2d(x, (self.kh, self.kw), (self.dh, self.dw), (ph, pw))
         return (y[0] if was3d else y), None
 
     def __repr__(self):
