@@ -1,15 +1,20 @@
-"""Benchmark: Inception-v1 synchronous-SGD training throughput.
+"""Benchmark: all five BASELINE.md configs, like the reference's
+DistriOptimizerPerf CLI (models/utils/DistriOptimizerPerf.scala:41-138:
+synthetic data, multi-model `-m` flag, default batch 128).
 
-The TPU-native counterpart of the reference's DistriOptimizerPerf CLI
-(models/utils/DistriOptimizerPerf.scala:41-138: synthetic data, inception_v1,
-default batch 128).  Prints ONE JSON line:
-  {"metric": ..., "value": images/sec, "unit": ..., "vs_baseline": ...}
+Prints ONE JSON line (driver contract): the headline metric is the
+Inception-v1 config; ``detail.configs`` carries all five entries
+(LeNet-5/MNIST, VGG-16/CIFAR-10, Inception-v1/ImageNet, Bi-LSTM text
+classifier, ResNet-50/ImageNet), each with step ms, records/s, MFU and
+the same-run measured matmul roofline.
 
 The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
 reported against the BASELINE.json north-star bar of 0.4 MFU:
 vs_baseline = achieved_MFU / 0.4 (>1.0 beats the target).  MFU uses XLA's
-own per-step FLOP count from compiled cost analysis and the chip's peak
-for the dtype in use.
+own per-step FLOP count from compiled cost analysis and the chip's
+datasheet peak for the dtype in use.
+
+Usage: python bench.py [substring]   # e.g. `python bench.py lenet`
 """
 from __future__ import annotations
 
@@ -36,78 +41,73 @@ def guess_peak(device) -> float:
     return 197e12  # default to v5e
 
 
-def main(batch_size: int = 128, iterations: int = 10, warmup: int = 3):
+def make_step(model, criterion):
     import jax
-    import jax.numpy as jnp
-
-    import bigdl_tpu.nn as nn
-    from bigdl_tpu import tensor as bt
-    from bigdl_tpu.models.inception import Inception_v1
     from bigdl_tpu.nn.module import Context
     from bigdl_tpu.optim.optim_method import SGD
-    from bigdl_tpu.utils.random import set_seed
 
-    set_seed(1)
-    bt.set_policy(bt.BF16_COMPUTE)  # matmuls/convs in bf16 on the MXU
-
-    model = Inception_v1(class_num=1000)
-    criterion = nn.ClassNLLCriterion()
     method = SGD()
-    params, net_state = model.params(), model.state()
-    opt_state = method.init_state(params)
     hyper = {"lr": 0.01, "momentum": 0.9, "dampening": 0.0,
              "weight_decay": 0.0001, "nesterov": False}
 
     def train_step(params, net_state, opt_state, x, y, key):
         def loss_fn(p):
-            out, ns = model.apply(p, x, net_state, Context(training=True, key=key))
+            out, ns = model.apply(p, x, net_state,
+                                  Context(training=True, key=key))
             return criterion.apply_loss(out, y), ns
-
         (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         new_params, new_opt = method.update(grads, opt_state, params, hyper)
         return new_params, ns, new_opt, loss
 
-    rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.randn(batch_size, 3, 224, 224), jnp.float32)
-    y = jnp.asarray(rs.randint(1, 1001, (batch_size,)))
-    key = jax.random.PRNGKey(0)
-
+    params, net_state = model.params(), model.state()
+    opt_state = method.init_state(params)
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-    try:
-        flops_per_step = float(
-            step.lower(params, net_state, opt_state, x, y, key)
-            .compile().cost_analysis()["flops"])
-    except Exception:
-        flops_per_step = float("nan")
+    return step, params, net_state, opt_state
 
+
+def bench_config(build, records_per_batch, warmup=3, iters=10, windows=3):
+    """Returns (records/s, step_ms, mfu, flops_per_step, loss)."""
+    import jax
+
+    model, criterion, x, y = build()
+    step, params, net_state, opt_state = make_step(model, criterion)
+    key = jax.random.PRNGKey(0)
+    try:
+        flops = float(step.lower(params, net_state, opt_state, x, y, key)
+                      .compile().cost_analysis()["flops"])
+    except Exception:
+        flops = float("nan")
     for _ in range(warmup):
         params, net_state, opt_state, loss = step(
             params, net_state, opt_state, x, y, key)
-    float(loss)  # device->host copy = hard sync (block_until_ready may be a
-    # no-op under remote-relay PJRT backends; a transfer cannot lie)
+    float(loss)  # device->host copy = hard sync (block_until_ready may be
+    # a no-op under remote-relay PJRT backends; a transfer cannot lie)
 
-    # best-of-3 timing windows: the relay-attached chip shows >10% run-to-
-    # run variance, and a window minimum is the standard de-noising for
-    # throughput benchmarks (each window still syncs only once at the end)
+    # best-of-N timing windows: the relay-attached chip shows >10% run-to-
+    # run variance; a window minimum is the standard de-noising (each
+    # window syncs once at the end)
     dts = []
-    for _ in range(3):
+    for _ in range(windows):
         t0 = time.perf_counter()
-        for _ in range(iterations):
+        for _ in range(iters):
             params, net_state, opt_state, loss = step(
                 params, net_state, opt_state, x, y, key)
-        last_loss = float(loss)  # syncs the whole sequential step chain
-        dts.append((time.perf_counter() - t0) / iterations)
+        last = float(loss)
+        dts.append((time.perf_counter() - t0) / iters)
     dt = min(dts)
-
-    images_per_sec = batch_size / dt
     peak = guess_peak(jax.devices()[0])
-    mfu = (flops_per_step / dt) / peak if np.isfinite(flops_per_step) else float("nan")
-    vs_baseline = mfu / 0.4 if np.isfinite(mfu) else 1.0
+    mfu = (flops / dt) / peak if np.isfinite(flops) else float("nan")
+    return records_per_batch / dt, dt * 1e3, mfu, flops, last
 
-    # measured achievable roofline on THIS chip/runtime (an 8192^3 bf16
-    # matmul chain) — contextualizes MFU when the runtime can't reach the
-    # datasheet peak (e.g. relay-attached chips)
-    a = jnp.asarray(np.random.RandomState(1).randn(8192, 8192) * 0.01, jnp.bfloat16)
+
+def measured_roofline():
+    """Achievable bf16 matmul TF/s on THIS chip/runtime right now (8192^3
+    chained) — contextualizes MFU when the runtime can't reach the
+    datasheet peak (e.g. relay-attached chips)."""
+    import jax
+    import jax.numpy as jnp
+    a = jnp.asarray(np.random.RandomState(1).randn(8192, 8192) * 0.01,
+                    jnp.bfloat16)
     mm = jax.jit(lambda v: (v @ a).astype(jnp.bfloat16) * 0.001)
     z = mm(a)
     float(jnp.sum(z).astype(jnp.float32))
@@ -115,25 +115,110 @@ def main(batch_size: int = 128, iterations: int = 10, warmup: int = 3):
     for _ in range(10):
         z = mm(z)
     float(jnp.sum(z).astype(jnp.float32))
-    roofline_tfs = 2 * 8192 ** 3 / ((time.perf_counter() - t0) / 10) / 1e12
+    return 2 * 8192 ** 3 / ((time.perf_counter() - t0) / 10) / 1e12
 
+
+def configs():
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+
+    rs = np.random.RandomState(0)
+
+    def imgs(batch, c, h, w, nclass):
+        x = jnp.asarray(rs.randn(batch, c, h, w), jnp.float32)
+        y = jnp.asarray(rs.randint(1, nclass + 1, (batch,)))
+        return x, y
+
+    def lenet():
+        from bigdl_tpu.models.lenet import LeNet5
+        x, y = imgs(512, 1, 28, 28, 10)
+        return LeNet5(class_num=10), nn.ClassNLLCriterion(), x, y
+
+    def vgg16_cifar():
+        from bigdl_tpu.models.vgg import VggForCifar10
+        x, y = imgs(128, 3, 32, 32, 10)
+        return VggForCifar10(class_num=10), nn.ClassNLLCriterion(), x, y
+
+    def inception():
+        from bigdl_tpu.models.inception import Inception_v1
+        x, y = imgs(128, 3, 224, 224, 1000)
+        return Inception_v1(class_num=1000), nn.ClassNLLCriterion(), x, y
+
+    def bilstm():
+        from bigdl_tpu.models.textclassifier import TextClassifierBiLSTM
+        batch, t, e = 128, 500, 200
+        x = jnp.asarray(rs.randn(batch, t, e), jnp.float32)
+        y = jnp.asarray(rs.randint(1, 21, (batch,)))
+        return (TextClassifierBiLSTM(20, e, hidden_size=128),
+                nn.ClassNLLCriterion(), x, y)
+
+    def resnet50():
+        from bigdl_tpu.models.resnet import ResNet
+        x, y = imgs(64, 3, 224, 224, 1000)
+        return ResNet(depth=50, class_num=1000), nn.ClassNLLCriterion(), x, y
+
+    # (name, build, records_per_batch, unit)
+    return [
+        ("LeNet-5 bs512 (MNIST, local)", lenet, 512, "images/sec"),
+        ("VGG-16 bs128 (CIFAR-10)", vgg16_cifar, 128, "images/sec"),
+        ("Inception-v1 bs128 (ImageNet sync-SGD)", inception, 128,
+         "images/sec"),
+        ("Bi-LSTM bs128 T500 (text classifier)", bilstm, 128 * 500,
+         "tokens/sec"),
+        ("ResNet-50 bs64 (ImageNet streaming cfg)", resnet50, 64,
+         "images/sec"),
+    ]
+
+
+def main():
+    import jax
+
+    from bigdl_tpu import tensor as bt
+    from bigdl_tpu.utils.random import set_seed
+
+    only = sys.argv[1] if len(sys.argv) > 1 else None
+    set_seed(1)
+    bt.set_policy(bt.BF16_COMPUTE)  # matmuls/convs in bf16 on the MXU
+
+    roof = measured_roofline()
+    entries = []
+    primary = None
+    for name, build, recs, unit in configs():
+        if only and only.lower() not in name.lower():
+            continue
+        rps, ms, mfu, flops, loss = bench_config(build, recs)
+        entry = {
+            "config": name, "unit": unit, "value": round(rps, 2),
+            "step_time_ms": round(ms, 3),
+            "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
+            "step_tflops": round(flops / (ms / 1e3) / 1e12, 1)
+            if np.isfinite(flops) else None,
+            "flops_per_step": flops, "loss": loss,
+        }
+        entries.append(entry)
+        if "Inception" in name:
+            primary = entry
+        print(json.dumps({"progress": name, "value": entry["value"],
+                          "unit": unit, "step_ms": entry["step_time_ms"]}),
+              file=sys.stderr)
+
+    if primary is None:
+        primary = entries[0]
+    vs_baseline = (primary["mfu"] / 0.4) if primary["mfu"] else 1.0
     print(json.dumps({
-        "metric": "images/sec/chip (Inception-v1 bs%d sync-SGD train)" % batch_size,
-        "value": round(images_per_sec, 2),
+        "metric": "images/sec/chip (Inception-v1 bs128 sync-SGD train)",
+        "value": primary["value"],
         "unit": "images/sec",
         "vs_baseline": round(vs_baseline, 4),
         "detail": {
-            "step_time_ms": round(dt * 1e3, 3),
-            "mfu": round(mfu, 4) if np.isfinite(mfu) else None,
-            "measured_matmul_roofline_tflops": round(roofline_tfs, 1),
-            "step_tflops": round(flops_per_step / dt / 1e12, 1),
-            "flops_per_step": flops_per_step,
+            "step_time_ms": primary["step_time_ms"],
+            "mfu": primary["mfu"],
+            "measured_matmul_roofline_tflops": round(roof, 1),
             "device": jax.devices()[0].device_kind,
-            "loss": last_loss,
+            "configs": entries,
         },
     }))
 
 
 if __name__ == "__main__":
-    bs = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    main(batch_size=bs)
+    main()

@@ -14,12 +14,14 @@ Format (little-endian):
 """
 from __future__ import annotations
 
+import io
 import os
 import struct
 
 import numpy as np
 
 from bigdl_tpu.dataset.sample import ByteRecord
+from bigdl_tpu.utils import fs
 from bigdl_tpu.dataset.dataset import LocalDataSet, ShardedDataSet, DataSet
 
 MAGIC = b"BDTS"
@@ -27,40 +29,41 @@ VERSION = 1
 
 
 def write_shard(records, path):
-    """records: iterable of (label: float|str, data: bytes)."""
-    tmp = path + ".tmp"
+    """records: iterable of (label: float|str, data: bytes).  ``path`` may
+    be a local path or any fsspec URL (remote stores get a full-buffer
+    upload; seek-back patching of the count happens in memory)."""
+    buf = io.BytesIO()
     n = 0
-    with open(tmp, "wb") as f:
-        f.write(MAGIC + struct.pack("<IQ", VERSION, 0))
-        for label, data in records:
-            key = str(label).encode()
-            f.write(struct.pack("<I", len(key)) + key)
-            f.write(struct.pack("<I", len(data)) + data)
-            n += 1
-        f.seek(len(MAGIC) + 4)
-        f.write(struct.pack("<Q", n))
-    os.replace(tmp, path)
+    buf.write(MAGIC + struct.pack("<IQ", VERSION, 0))
+    for label, data in records:
+        key = str(label).encode()
+        buf.write(struct.pack("<I", len(key)) + key)
+        buf.write(struct.pack("<I", len(data)) + data)
+        n += 1
+    buf.seek(len(MAGIC) + 4)
+    buf.write(struct.pack("<Q", n))
+    fs.write_bytes_atomic(path, buf.getvalue())
     return n
 
 
 def write_shards(records, out_dir, n_shards: int = 8, prefix: str = "shard"):
     """Round-robin pack records into ``n_shards`` files
     (the ImageNetSeqFileGenerator role)."""
-    os.makedirs(out_dir, exist_ok=True)
+    fs.makedirs(out_dir)
     buckets = [[] for _ in range(n_shards)]
     for i, rec in enumerate(records):
         buckets[i % n_shards].append(rec)
     paths = []
     for i, bucket in enumerate(buckets):
-        p = os.path.join(out_dir, f"{prefix}-{i:05d}.bdts")
+        p = fs.join(out_dir, f"{prefix}-{i:05d}.bdts")
         write_shard(bucket, p)
         paths.append(p)
     return paths
 
 
 def read_shard(path):
-    """Yield ByteRecord from one shard file."""
-    with open(path, "rb") as f:
+    """Yield ByteRecord from one shard file (local or fsspec URL)."""
+    with fs.open_file(path, "rb") as f:
         head = f.read(len(MAGIC) + 12)
         assert head[:4] == MAGIC, f"bad shard magic in {path}"
         version, count = struct.unpack("<IQ", head[4:])
@@ -86,13 +89,13 @@ class ShardFolder(LocalDataSet):
         import jax
         self.distributed = distributed  # Optimizer factory dispatch hint
         self.paths = sorted(
-            os.path.join(folder, f) for f in os.listdir(folder)
+            fs.join(folder, f) for f in fs.listdir(folder)
             if f.endswith(".bdts"))
         if not self.paths:
             raise FileNotFoundError(f"no .bdts shards under {folder}")
         self._counts = []
         for p in self.paths:
-            with open(p, "rb") as f:
+            with fs.open_file(p, "rb") as f:
                 head = f.read(len(MAGIC) + 12)
                 self._counts.append(struct.unpack("<IQ", head[4:])[1])
         if distributed:

@@ -1,0 +1,69 @@
+"""Text classifiers over word embeddings.
+
+- ``TextClassifierConv``: the reference's temporal conv net
+  (example/textclassification/TextClassifier.scala:119-140 — three
+  conv5-relu-maxpool stages as SpatialConvolution over the (1, seq,
+  embed) plane, then a linear head).
+- ``TextClassifierBiLSTM``: BASELINE.md config 4 — a bidirectional LSTM
+  (BiRecurrent(LSTMCell, LSTMCell), recurrence as lax.scan) with
+  mean-over-time pooling and the same linear head.  Not in the reference
+  (it has no LSTM — SURVEY.md §2.3 "Recurrent"); capability extension
+  required by the benchmark config.
+
+Both take pre-embedded input (batch, seq_len, embed_dim): the reference
+also embeds on the data side (GloVe lookup in the Spark pipeline,
+TextClassifier.scala; here dataset/news20.embed_samples).
+"""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def TextClassifierConv(class_num: int, seq_len: int = 200, embed_dim: int = 50):
+    """(ref TextClassifier.buildModel :119-140).  The reference hardcodes
+    the last pooling to 35 for its 1000-token sequences; here the final
+    pool consumes whatever extent remains, so any seq_len that survives
+    the first two stages (>= 149) works."""
+    h1 = seq_len - 4          # conv kh=5
+    h2 = (h1 - 5) // 5 + 1    # pool 5/5
+    h3 = h2 - 4               # conv kh=5
+    h4 = (h3 - 5) // 5 + 1    # pool 5/5
+    h5 = h4 - 4               # conv kh=5
+    if h5 < 1:
+        raise ValueError(f"seqLength {seq_len} too short for 3 conv stages")
+    m = nn.Sequential()
+    m.add(nn.Reshape([1, seq_len, embed_dim]))
+    m.add(nn.SpatialConvolution(1, 128, embed_dim, 5))   # kw=embed, kh=5
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(1, 5, 1, 5))
+    m.add(nn.SpatialConvolution(128, 128, 1, 5))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(1, 5, 1, 5))
+    m.add(nn.SpatialConvolution(128, 128, 1, 5))
+    m.add(nn.ReLU())
+    m.add(nn.SpatialMaxPooling(1, h5, 1, h5))            # ref: 35 @ seq 1000
+    m.add(nn.Reshape([128]))
+    m.add(nn.Linear(128, 100))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(100, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def TextClassifierBiLSTM(class_num: int, embed_dim: int = 50,
+                         hidden_size: int = 128):
+    """Bi-LSTM classifier (BASELINE.md config 4).
+
+    (B, T, E) -> BiRecurrent(LSTM fwd, LSTM bwd) -> (B, T, 2H)
+    -> mean over time -> Linear(2H, 100) -> ReLU -> Linear -> LogSoftMax.
+    Works for any sequence length (the head has no T dependence).
+    """
+    m = nn.Sequential()
+    m.add(nn.BiRecurrent(nn.LSTMCell(embed_dim, hidden_size),
+                         nn.LSTMCell(embed_dim, hidden_size)))
+    m.add(nn.Mean(1, n_input_dims=2))   # time = dim 1 of unbatched (T, 2H)
+    m.add(nn.Linear(2 * hidden_size, 100))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(100, class_num))
+    m.add(nn.LogSoftMax())
+    return m

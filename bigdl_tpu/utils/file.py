@@ -15,6 +15,8 @@ import pickle
 import jax
 import numpy as np
 
+from bigdl_tpu.utils import fs
+
 
 def _to_numpy(tree):
     return jax.tree_util.tree_map(
@@ -28,18 +30,16 @@ def _to_jax(tree):
 
 
 def save(obj, path, overwrite: bool = True):
-    """Save an arbitrary pytree (ref File.save File.scala:63)."""
-    if os.path.exists(path) and not overwrite:
+    """Save an arbitrary pytree (ref File.save File.scala:63).  ``path``
+    may be any fsspec URL (gs://, s3://, memory://) — the HDFS role of
+    File.scala:81-116 — or a plain local path (atomic tmp+rename)."""
+    if fs.exists(path) and not overwrite:
         raise FileExistsError(path)
-    tmp = path + ".tmp"
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(tmp, "wb") as f:
-        pickle.dump(_to_numpy(obj), f)
-    os.replace(tmp, path)
+    fs.write_bytes_atomic(path, pickle.dumps(_to_numpy(obj)))
 
 
 def load(path):
-    with open(path, "rb") as f:
+    with fs.open_file(path, "rb") as f:
         return _to_jax(pickle.load(f))
 
 

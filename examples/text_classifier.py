@@ -1,8 +1,11 @@
 """Text classifier (ref example/textclassification/TextClassifier.scala:119-140):
 a temporal conv net over word embeddings (the reference uses GloVe vectors +
-SpatialConvolution as 1D conv), 20-newsgroups-style classification.
+SpatialConvolution as 1D conv), 20-newsgroups-style classification — plus the
+Bi-LSTM variant of BASELINE.md config 4 (``--model lstm``:
+BiRecurrent(LSTMCell, LSTMCell) with recurrence as lax.scan).
 
   python examples/text_classifier.py -f ./20news --classNum 20
+  python examples/text_classifier.py --model lstm
 Falls back to a synthetic corpus when no data dir exists.
 """
 import argparse
@@ -10,39 +13,6 @@ import logging
 import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
-
-
-def build_model(class_num: int, seq_len: int = 200, embed_dim: int = 50):
-    """(ref TextClassifier.buildModel :119-140): three conv5-relu-maxpool
-    stages on the (1, seq, embed) plane, then a linear head.  The
-    reference hardcodes the last pooling to 35 for its 1000-token
-    sequences; here the final pool consumes whatever extent remains, so
-    any seq_len that survives the first two stages (>= 149) works."""
-    import bigdl_tpu.nn as nn
-    h1 = seq_len - 4          # conv kh=5
-    h2 = (h1 - 5) // 5 + 1    # pool 5/5
-    h3 = h2 - 4               # conv kh=5
-    h4 = (h3 - 5) // 5 + 1    # pool 5/5
-    h5 = h4 - 4               # conv kh=5
-    if h5 < 1:
-        raise ValueError(f"seqLength {seq_len} too short for 3 conv stages")
-    m = nn.Sequential()
-    m.add(nn.Reshape([1, seq_len, embed_dim]))
-    m.add(nn.SpatialConvolution(1, 128, embed_dim, 5))   # kw=embed, kh=5
-    m.add(nn.ReLU())
-    m.add(nn.SpatialMaxPooling(1, 5, 1, 5))
-    m.add(nn.SpatialConvolution(128, 128, 1, 5))
-    m.add(nn.ReLU())
-    m.add(nn.SpatialMaxPooling(1, 5, 1, 5))
-    m.add(nn.SpatialConvolution(128, 128, 1, 5))
-    m.add(nn.ReLU())
-    m.add(nn.SpatialMaxPooling(1, h5, 1, h5))            # ref: 35 @ seq 1000
-    m.add(nn.Reshape([128]))
-    m.add(nn.Linear(128, 100))
-    m.add(nn.ReLU())
-    m.add(nn.Linear(100, class_num))
-    m.add(nn.LogSoftMax())
-    return m
 
 
 def main(argv=None):
@@ -54,6 +24,10 @@ def main(argv=None):
     p.add_argument("--embedDim", type=int, default=50)
     p.add_argument("--learningRate", type=float, default=0.01)
     p.add_argument("--maxEpoch", type=int, default=3)
+    p.add_argument("--model", choices=["conv", "lstm"], default="conv",
+                   help="conv = reference temporal conv net; lstm = Bi-LSTM "
+                        "(BASELINE config 4)")
+    p.add_argument("--hiddenSize", type=int, default=128)
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -93,7 +67,14 @@ def main(argv=None):
     train_ds = DataSet.array(samples[:split]) >> SampleToBatch(args.batchSize, drop_last=True)
     val_ds = DataSet.array(samples[split:]) >> SampleToBatch(args.batchSize, drop_last=True)
 
-    model = build_model(args.classNum, args.seqLength, args.embedDim)
+    from bigdl_tpu.models.textclassifier import (TextClassifierConv,
+                                                  TextClassifierBiLSTM)
+    if args.model == "lstm":
+        model = TextClassifierBiLSTM(args.classNum, args.embedDim,
+                                     args.hiddenSize)
+    else:
+        model = TextClassifierConv(args.classNum, args.seqLength,
+                                   args.embedDim)
     opt = LocalOptimizer(model, train_ds, nn.ClassNLLCriterion())
     opt.set_state(T(learningRate=args.learningRate, momentum=0.9))
     opt.set_end_when(max_epoch(args.maxEpoch))

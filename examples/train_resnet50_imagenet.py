@@ -16,7 +16,9 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("-f", "--shardFolder", default="./shards")
+    p.add_argument("-f", "--shardFolder", default="./shards",
+                   help="local dir or fsspec URL (gs://bucket/shards, "
+                        "s3://..., memory://) of .bdts shards")
     p.add_argument("-b", "--batchSize", type=int, default=256)
     p.add_argument("--caffeWeights", default=None)
     p.add_argument("--learningRate", type=float, default=0.1)
