@@ -1,0 +1,70 @@
+"""Worker process for the multi-process CPU CI test
+(tests/test_multiprocess.py) — the reference's local-cluster simulation
+pattern (DistriOptimizerSpec.scala:40-42,104-116 runs Engine.init(4,4)
+against a local SparkContext; here each OS process is one "host" with 2
+virtual CPU devices, joined via jax.distributed).
+
+Usage: python multiproc_worker.py <process_id> <num_processes> <port>
+Prints one JSON line: {"process_id": i, "losses": [...], "psum": float}
+"""
+import json
+import sys
+
+
+def main():
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    import os
+    os.environ["BIGDL_CHECK_SINGLETON"] = "0"
+
+    from bigdl_tpu.utils.engine import Engine
+    if nproc > 1:
+        Engine.init_distributed(coordinator_address="localhost:%s" % port,
+                                num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc
+    assert jax.device_count() == 2 * nproc
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import DistriOptimizer, max_iteration
+    from bigdl_tpu.utils.table import T
+    from bigdl_tpu.utils.random import set_seed
+
+    # identical model init + data in every process
+    set_seed(5)
+    rng = np.random.RandomState(0)
+    n, d, classes = 16, 6, 3
+    w = rng.randn(d, classes) * 2
+    xs = rng.randn(n, d).astype(np.float32)
+    ys = (xs @ w).argmax(1) + 1.0
+    samples = [Sample(x, np.asarray([y])) for x, y in zip(xs, ys)]
+
+    # full-batch: every step sees the whole dataset regardless of process
+    # count, so the loss trajectory must match the single-process oracle
+    local_batch = n // nproc
+    ds = (DataSet.array(samples, distributed=(nproc > 1))
+          >> SampleToBatch(local_batch))
+
+    model = nn.Sequential(nn.Linear(d, 8), nn.Tanh(),
+                          nn.Linear(8, classes), nn.LogSoftMax())
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion())
+    opt.set_state(T(learningRate=0.5))
+    opt.set_end_when(max_iteration(6))
+
+    opt.optimize()
+    losses = [float(opt.state["loss"])]
+
+    psum = float(sum(np.abs(np.asarray(p)).sum()
+                     for p in jax.tree_util.tree_leaves(model.params())))
+    print(json.dumps({"process_id": pid, "losses": losses, "psum": psum}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Multi-process CPU CI for the multi-host path (VERDICT r1 item 5).
+
+Launches 2 jax.distributed processes (2 virtual CPU devices each, so a
+4-device global mesh spanning processes), runs Engine.init_distributed +
+DistriOptimizer with make_array_from_process_local_data, and asserts loss
+equivalence with a single-process DP run over the same full-batch data —
+the reference's local-cluster simulation pattern
+(DistriOptimizerSpec.scala:40-42,104-116, SURVEY.md §4).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "helpers",
+                      "multiproc_worker.py")
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_workers(nproc, port):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(i), str(nproc), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
+        for i in range(nproc)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_distri_optimizer_matches_single_process():
+    two = run_workers(2, free_port())
+    one = run_workers(1, free_port())
+
+    # both processes of the 2-proc run must agree exactly (replicated
+    # params, same global batch through the collective)
+    assert two[0]["losses"] == pytest.approx(two[1]["losses"], rel=1e-5)
+    assert two[0]["psum"] == pytest.approx(two[1]["psum"], rel=1e-5)
+
+    # and the 2-process trajectory must match single-process full-batch DP
+    # (identical data/model/seed; fp reassociation across the mesh only)
+    assert two[0]["losses"] == pytest.approx(one[0]["losses"], rel=1e-4)
+    assert two[0]["psum"] == pytest.approx(one[0]["psum"], rel=1e-4)
