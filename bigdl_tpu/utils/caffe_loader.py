@@ -135,13 +135,68 @@ def _named_param_modules(model):
     return out
 
 
-def load(model, caffemodel_path, match_all: bool = True):
+def read_prototxt(path):
+    """Parse a net .prototxt (protobuf TEXT format) minimally: returns
+    [{"name": ..., "type": ...}] for every layer/layers block, in order
+    (the deploy-net side of ref CaffeLoader.scala:40 — loadCaffe takes
+    defPath + modelPath and matches against the *definition*)."""
+    with open(path) as f:
+        text = f.read()
+    layers = []
+    i, n = 0, len(text)
+    import re
+    block_re = re.compile(r"\b(layer|layers)\s*\{")
+    kv_re = re.compile(r'\b(name|type)\s*:\s*(?:"([^"]*)"|(\w+))')
+    for m in block_re.finditer(text):
+        # find the matching close brace of this block
+        depth, j = 1, m.end()
+        while j < n and depth:
+            if text[j] == "{":
+                depth += 1
+            elif text[j] == "}":
+                depth -= 1
+            j += 1
+        body = text[m.end():j - 1]
+        # only top-level keys of the block (strip nested {...} bodies)
+        flat, d = [], 0
+        for ch in body:
+            if ch == "{":
+                d += 1
+            elif ch == "}":
+                d -= 1
+            elif d == 0:
+                flat.append(ch)
+        entry = {}
+        for key, quoted, bare in kv_re.findall("".join(flat)):
+            entry.setdefault(key, quoted or bare)
+        if "name" in entry:
+            layers.append(entry)
+    return layers
+
+
+def load(model, caffemodel_path, prototxt_path=None, match_all: bool = True):
     """Copy caffemodel weights onto ``model`` by layer name
-    (ref CaffeLoader.load :155; name matching :127)."""
+    (ref CaffeLoader.load :155; name matching :127).
+
+    ``prototxt_path``: when given, the net definition's layer list is the
+    contract — named model modules missing from the prototxt raise (they
+    could never be filled), like the reference's defPath-driven matching.
+    Blob shapes are always cross-validated: a blob whose element count
+    differs from the destination parameter raises with both shapes, never
+    a silent mis-reshape; benign layout differences (e.g. Caffe's
+    (1,1,out,in) InnerProduct blobs) are reshaped."""
     import jax.numpy as jnp
 
     blobs_by_name = read_caffemodel(caffemodel_path)
     targets = _named_param_modules(model)
+    if prototxt_path is not None:
+        proto_names = {l["name"] for l in read_prototxt(prototxt_path)}
+        unknown = set(targets) - proto_names
+        if unknown:
+            raise ValueError(
+                "model modules %s are not layers of %s (prototxt layers: "
+                "%s...)" % (sorted(unknown), prototxt_path,
+                            sorted(proto_names)[:10]))
     copied = set()
     for name, module in targets.items():
         if name not in blobs_by_name:
@@ -152,9 +207,18 @@ def load(model, caffemodel_path, match_all: bool = True):
             continue
         blobs = blobs_by_name[name]
         pnames = [p for p in ("weight", "bias") if p in module._params]
+        if len(blobs) < len(pnames):
+            raise ValueError(
+                f"layer '{name}': caffemodel has {len(blobs)} blobs but the "
+                f"module needs {len(pnames)} ({pnames})")
         for pname, blob in zip(pnames, blobs):
             dst = module._params[pname]
             src = np.asarray(blob, np.float32)
+            if src.size != dst.size:
+                raise ValueError(
+                    f"layer '{name}' {pname}: caffemodel blob shape "
+                    f"{src.shape} ({src.size} elems) does not match the "
+                    f"module parameter {tuple(dst.shape)} ({dst.size} elems)")
             if src.shape != tuple(dst.shape):
                 src = src.reshape(dst.shape)
             module._params[pname] = jnp.asarray(src, dst.dtype)

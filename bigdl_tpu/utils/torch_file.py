@@ -258,31 +258,99 @@ def load_module_weights(model, path, strict: bool = True):
             f"module count mismatch: .t7 has {len(torch_mods)} parameterized "
             f"layers, model has {len(targets)}")
     for tm, tgt in zip(torch_mods, targets):
-        for name in ("weight", "bias"):
+        names = ("weight", "bias") + tuple(
+            k for k in tgt._params if k not in ("weight", "bias"))
+        for name in names:
             if name in tm and tm[name] is not None and name in tgt._params:
                 src = np.asarray(tm[name])
                 dst = tgt._params[name]
+                if src.size != dst.size:
+                    raise ValueError(
+                        f".t7 field '{name}' has {src.size} elems; module "
+                        f"parameter expects {tuple(dst.shape)}")
                 if src.shape != tuple(dst.shape):
                     src = src.reshape(dst.shape)
                 tgt._params[name] = jnp.asarray(src, dst.dtype)
     return model
 
 
+# Export class-name registry, mirroring the reference's
+# (TorchFile.scala:136-182 maps TYPE_* tags <-> module classes).  Names not
+# listed export as nn.<ClassName>.
 _TORCH_CLASS_NAMES = {
     "Linear": "nn.Linear",
     "SpatialConvolution": "nn.SpatialConvolution",
+    "SpatialShareConvolution": "nn.SpatialConvolution",
     "SpatialFullConvolution": "nn.SpatialFullConvolution",
     "SpatialDilatedConvolution": "nn.SpatialDilatedConvolution",
+    "SpatialConvolutionMap": "nn.SpatialConvolutionMap",
     "SpatialMaxPooling": "nn.SpatialMaxPooling",
     "SpatialAveragePooling": "nn.SpatialAveragePooling",
     "BatchNormalization": "nn.BatchNormalization",
     "SpatialBatchNormalization": "nn.SpatialBatchNormalization",
-    "ReLU": "nn.ReLU", "Tanh": "nn.Tanh", "Sigmoid": "nn.Sigmoid",
+    "SpatialCrossMapLRN": "nn.SpatialCrossMapLRN",
+    "SpatialZeroPadding": "nn.SpatialZeroPadding",
+    "ReLU": "nn.ReLU", "ReLU6": "nn.ReLU6", "Tanh": "nn.Tanh",
+    "Sigmoid": "nn.Sigmoid", "Threshold": "nn.Threshold",
+    "PReLU": "nn.PReLU", "LeakyReLU": "nn.LeakyReLU", "ELU": "nn.ELU",
+    "HardTanh": "nn.HardTanh", "Clamp": "nn.HardTanh",
+    "SoftPlus": "nn.SoftPlus", "SoftSign": "nn.SoftSign",
+    "Power": "nn.Power", "Sqrt": "nn.Sqrt", "Square": "nn.Square",
+    "Abs": "nn.Abs", "Exp": "nn.Exp", "Log": "nn.Log",
     "LogSoftMax": "nn.LogSoftMax", "SoftMax": "nn.SoftMax",
+    "SoftMin": "nn.SoftMin", "LogSigmoid": "nn.LogSigmoid",
     "Dropout": "nn.Dropout", "Reshape": "nn.Reshape", "View": "nn.View",
+    "Transpose": "nn.Transpose", "Replicate": "nn.Replicate",
+    "Squeeze": "nn.Squeeze", "Unsqueeze": "nn.Unsqueeze",
+    "Contiguous": "nn.Contiguous", "Copy": "nn.Copy", "Padding": "nn.Padding",
     "Sequential": "nn.Sequential", "Concat": "nn.Concat",
     "ConcatTable": "nn.ConcatTable", "ParallelTable": "nn.ParallelTable",
+    "MapTable": "nn.MapTable", "Bottle": "nn.Bottle",
+    "CAddTable": "nn.CAddTable", "CSubTable": "nn.CSubTable",
+    "CMulTable": "nn.CMulTable", "CDivTable": "nn.CDivTable",
+    "CMaxTable": "nn.CMaxTable", "CMinTable": "nn.CMinTable",
+    "JoinTable": "nn.JoinTable", "SelectTable": "nn.SelectTable",
+    "NarrowTable": "nn.NarrowTable", "FlattenTable": "nn.FlattenTable",
+    "MixtureTable": "nn.MixtureTable", "DotProduct": "nn.DotProduct",
+    "PairwiseDistance": "nn.PairwiseDistance",
+    "CosineDistance": "nn.CosineDistance",
+    "CMul": "nn.CMul", "CAdd": "nn.CAdd", "Mul": "nn.Mul", "Add": "nn.Add",
+    "MulConstant": "nn.MulConstant", "AddConstant": "nn.AddConstant",
+    "MM": "nn.MM", "MV": "nn.MV", "Cosine": "nn.Cosine",
+    "Euclidean": "nn.Euclidean", "Bilinear": "nn.Bilinear",
+    "Mean": "nn.Mean", "Sum": "nn.Sum", "Max": "nn.Max", "Min": "nn.Min",
+    "Select": "nn.Select", "Narrow": "nn.Narrow",
     "Identity": "nn.Identity", "LookupTable": "nn.LookupTable",
+    "Recurrent": "nn.Recurrent", "TimeDistributed": "nn.TimeDistributed",
+}
+
+# constructor attributes exported per class so a Lua-side loader (or our
+# own load_module) can rebuild geometry — the serialized-field role of the
+# reference registry (kW/kH/dW/dH/padW/padH etc.)
+_EXPORT_ATTRS = {
+    "SpatialConvolution": [("kernel_w", "kW"), ("kernel_h", "kH"),
+                           ("stride_w", "dW"), ("stride_h", "dH"),
+                           ("pad_w", "padW"), ("pad_h", "padH"),
+                           ("n_input_plane", "nInputPlane"),
+                           ("n_output_plane", "nOutputPlane"),
+                           ("n_group", "nGroup")],
+    "SpatialMaxPooling": [("kw", "kW"), ("kh", "kH"), ("dw", "dW"),
+                          ("dh", "dH"), ("pad_w", "padW"), ("pad_h", "padH"),
+                          ("ceil_mode", "ceil_mode")],
+    "SpatialAveragePooling": [("kw", "kW"), ("kh", "kH"), ("dw", "dW"),
+                              ("dh", "dH"), ("pad_w", "padW"),
+                              ("pad_h", "padH"),
+                              ("count_include_pad", "count_include_pad")],
+    "BatchNormalization": [("n_output", "nOutput"), ("eps", "eps"),
+                           ("momentum", "momentum"), ("affine", "affine")],
+    "SpatialBatchNormalization": [("n_output", "nOutput"), ("eps", "eps"),
+                                  ("momentum", "momentum"),
+                                  ("affine", "affine")],
+    "SpatialCrossMapLRN": [("size", "size"), ("alpha", "alpha"),
+                           ("beta", "beta"), ("k", "k")],
+    "Linear": [("input_size", "inputSize"), ("output_size", "outputSize")],
+    "Dropout": [("p", "p")],
+    "LookupTable": [("n_index", "nIndex"), ("n_output", "nOutput")],
 }
 
 
@@ -295,10 +363,15 @@ def save_module(model, path):
     ``modules`` — readable back via ``load_module_weights``."""
 
     def encode(m):
-        out = {"torch_typename": _TORCH_CLASS_NAMES.get(
-            type(m).__name__, f"nn.{type(m).__name__}")}
+        cls = type(m).__name__
+        out = {"torch_typename": _TORCH_CLASS_NAMES.get(cls, f"nn.{cls}")}
         for pname, arr in m._params.items():
             out[pname] = np.asarray(arr)
+        for bname, arr in m._buffers.items():
+            out[bname] = np.asarray(arr)
+        for attr, lua_name in _EXPORT_ATTRS.get(cls, []):
+            if hasattr(m, attr):
+                out[lua_name] = getattr(m, attr)
         if m._modules:
             out["modules"] = {i + 1: encode(c)
                               for i, c in enumerate(m._modules.values())}

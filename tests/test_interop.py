@@ -118,3 +118,116 @@ class TestPredictor:
         assert set(np.unique(classes)).issubset({1, 2, 3})
         probs = pred.predict(x)
         assert probs.shape == (20, 3)
+
+
+class TestT7ZooRoundTrip:
+    """save_module -> load_module_weights round-trip per model-zoo model
+    (ref TorchFile registry TorchFile.scala:136-182 + SaveObjSpec)."""
+
+    @pytest.mark.parametrize("build,shape", [
+        (lambda: __import__("bigdl_tpu.models.lenet", fromlist=["LeNet5"])
+         .LeNet5(10), (2, 1, 28, 28)),
+        (lambda: __import__("bigdl_tpu.models.vgg",
+                            fromlist=["VggForCifar10"])
+         .VggForCifar10(10), (2, 3, 32, 32)),
+        (lambda: __import__("bigdl_tpu.models.resnet",
+                            fromlist=["ResNetCifar"])
+         .ResNetCifar(depth=20, class_num=10), (2, 3, 32, 32)),
+        (lambda: __import__("bigdl_tpu.models.alexnet", fromlist=["AlexNet"])
+         .AlexNet(100), (2, 3, 227, 227)),
+        (lambda: __import__("bigdl_tpu.models.autoencoder",
+                            fromlist=["Autoencoder"])
+         .Autoencoder(32), (2, 1, 28, 28)),
+        (lambda: __import__("bigdl_tpu.models.inception",
+                            fromlist=["Inception_v1"])
+         .Inception_v1(50), (1, 3, 224, 224)),
+    ], ids=["lenet", "vgg-cifar", "resnet20", "alexnet", "autoencoder",
+            "inception-v1"])
+    def test_roundtrip(self, tmp_path, build, shape):
+        from bigdl_tpu.utils import torch_file
+        from bigdl_tpu.utils.random import set_seed
+
+        set_seed(11)
+        m1 = build()
+        p = tmp_path / "m.t7"
+        torch_file.save_module(m1, str(p))
+
+        set_seed(12)          # different init: loaded weights must win
+        m2 = build()
+        torch_file.load_module_weights(m2, str(p))
+        m1.evaluate()
+        m2.evaluate()
+        x = np.random.RandomState(0).randn(*shape).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(m1.forward(x)),
+                                   np.asarray(m2.forward(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_rnn_roundtrip(self, tmp_path):
+        from bigdl_tpu.models.textclassifier import TextClassifierBiLSTM
+        from bigdl_tpu.utils import torch_file
+        from bigdl_tpu.utils.random import set_seed
+
+        set_seed(11)
+        m1 = TextClassifierBiLSTM(4, embed_dim=6, hidden_size=5)
+        p = tmp_path / "m.t7"
+        torch_file.save_module(m1, str(p))
+        set_seed(12)
+        m2 = TextClassifierBiLSTM(4, embed_dim=6, hidden_size=5)
+        torch_file.load_module_weights(m2, str(p))
+        m1.evaluate()
+        m2.evaluate()
+        x = np.random.RandomState(0).randn(2, 9, 6).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(m1.forward(x)),
+                                   np.asarray(m2.forward(x)),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestCaffePrototxt:
+    def _model_and_blob(self, tmp_path, wshape=(4, 3, 3, 3), bshape=(4,)):
+        rng = np.random.RandomState(0)
+        w = rng.randn(*wshape).astype(np.float32)
+        b = rng.randn(*bshape).astype(np.float32)
+        layer = (_len_delim(1, b"conv1")
+                 + _len_delim(7, _blob(w)) + _len_delim(7, _blob(b)))
+        p = tmp_path / "net.caffemodel"
+        p.write_bytes(_len_delim(100, layer))
+        model = nn.Sequential(
+            nn.SpatialConvolution(3, 4, 3, 3).set_name("conv1"))
+        return model, str(p)
+
+    def test_prototxt_parse(self, tmp_path):
+        proto = tmp_path / "deploy.prototxt"
+        proto.write_text('''
+name: "TinyNet"
+layer {
+  name: "conv1"
+  type: "Convolution"
+  convolution_param { num_output: 4 kernel_size: 3 }
+}
+layer { name: "relu1" type: "ReLU" }
+layers { name: "legacy_fc" type: INNER_PRODUCT }
+''')
+        layers = caffe_loader.read_prototxt(str(proto))
+        assert [l["name"] for l in layers] == ["conv1", "relu1", "legacy_fc"]
+        assert layers[0]["type"] == "Convolution"
+        # nested convolution_param keys must not leak into the layer entry
+        assert "num_output" not in layers[0]
+
+    def test_load_with_prototxt_validates_names(self, tmp_path):
+        model, cp = self._model_and_blob(tmp_path)
+        proto = tmp_path / "deploy.prototxt"
+        proto.write_text('layer { name: "conv1" type: "Convolution" }')
+        _, copied = caffe_loader.load(model, cp, prototxt_path=str(proto))
+        assert copied == {"conv1"}
+
+        bad = nn.Sequential(
+            nn.SpatialConvolution(3, 4, 3, 3).set_name("convX"))
+        with pytest.raises(ValueError, match="not layers of"):
+            caffe_loader.load(bad, cp, prototxt_path=str(proto),
+                              match_all=False)
+
+    def test_blob_shape_mismatch_raises(self, tmp_path):
+        # weight blob for a DIFFERENT geometry: must raise, not mis-reshape
+        model, cp = self._model_and_blob(tmp_path, wshape=(4, 3, 5, 5))
+        with pytest.raises(ValueError, match="does not match"):
+            caffe_loader.load(model, cp)
