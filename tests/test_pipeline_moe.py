@@ -104,3 +104,48 @@ class TestMoE:
         l, g = jax.value_and_grad(loss)(w1)
         assert np.isfinite(float(l))
         assert np.isfinite(np.asarray(g)).all()
+
+
+def test_pipeline_remat_memory_and_equivalence():
+    """VERDICT r1 item 8: remat-per-stage composes with the pipeline and
+    measurably cuts compiled temp memory for the backward; gradients are
+    unchanged."""
+    from functools import partial
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(_jax.devices()[:4]), ("pipe",))
+    rng = np.random.RandomState(0)
+    d, n_micro, micro_b, depth = 32, 8, 4, 3
+
+    # each stage: a small MLP whose internal activations dominate memory
+    def stage_fn(p, x):
+        h = x
+        for i in range(depth):
+            h = jnp.tanh(h @ p[i])
+        return h
+
+    per_stage = [np.stack([rng.randn(d, d).astype(np.float32) * 0.1
+                           for _ in range(depth)]) for _ in range(4)]
+    stacked = stack_stage_params([p for p in per_stage])
+    x = jnp.asarray(rng.randn(n_micro, micro_b, d).astype(np.float32))
+
+    def loss(params, x, remat):
+        return (pipeline_apply(stage_fn, params, x, mesh, "pipe",
+                               remat=remat) ** 2).sum()
+
+    g_plain = jax.jit(jax.grad(partial(loss, remat=False)))
+    g_remat = jax.jit(jax.grad(partial(loss, remat=True)))
+
+    gp = g_plain(stacked, x)
+    gr = g_remat(stacked, x)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gr),
+                               rtol=1e-5, atol=1e-6)
+
+    mp = g_plain.lower(stacked, x).compile().memory_analysis()
+    mr = g_remat.lower(stacked, x).compile().memory_analysis()
+    assert mr.temp_size_in_bytes < mp.temp_size_in_bytes, (
+        mr.temp_size_in_bytes, mp.temp_size_in_bytes)
+    print("pipeline temp bytes: plain=%d remat=%d (%.2fx)" % (
+        mp.temp_size_in_bytes, mr.temp_size_in_bytes,
+        mp.temp_size_in_bytes / max(mr.temp_size_in_bytes, 1)))
