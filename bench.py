@@ -106,8 +106,10 @@ def measured_roofline():
     datasheet peak (e.g. relay-attached chips)."""
     import jax
     import jax.numpy as jnp
-    a = jnp.asarray(np.random.RandomState(1).randn(8192, 8192) * 0.01,
-                    jnp.bfloat16)
+    # probe matrix generated ON DEVICE: a cold-connection 256 MB
+    # host->device transfer has been observed to wedge the relay tunnel
+    a = (jax.random.normal(jax.random.PRNGKey(1), (8192, 8192),
+                           jnp.bfloat16) * 0.01)
     mm = jax.jit(lambda v: (v @ a).astype(jnp.bfloat16) * 0.001)
     z = mm(a)
     float(jnp.sum(z).astype(jnp.float32))
@@ -180,12 +182,12 @@ def main():
     set_seed(1)
     bt.set_policy(bt.BF16_COMPUTE)  # matmuls/convs in bf16 on the MXU
 
-    roof = measured_roofline()
     entries = []
     primary = None
     for name, build, recs, unit in configs():
         if only and only.lower() not in name.lower():
             continue
+        print("benching: %s" % name, file=sys.stderr, flush=True)
         rps, ms, mfu, flops, loss = bench_config(build, recs)
         entry = {
             "config": name, "unit": unit, "value": round(rps, 2),
@@ -202,6 +204,8 @@ def main():
                           "unit": unit, "step_ms": entry["step_time_ms"]}),
               file=sys.stderr)
 
+    print("measuring matmul roofline", file=sys.stderr, flush=True)
+    roof = measured_roofline()
     if primary is None:
         primary = entries[0]
     vs_baseline = (primary["mfu"] / 0.4) if primary["mfu"] else 1.0
