@@ -9,7 +9,8 @@ from bigdl_tpu.dataset.dataset import (
 from bigdl_tpu.dataset.image import (
     LabeledImage, BytesToImg, BytesToBGRImg, BytesToGreyImg, ImgNormalizer,
     ImgPixelNormalizer, ImgCropper, BGRImgCropper, ImgRdmCropper, HFlip,
-    ColorJitter, Lighting, ImgToBatch, ImgToSample, MTLabeledImgToBatch,
+    ColorJitter, Lighting, ImgToBatch, ImgToSample, ImgToImageVector,
+    MTLabeledImgToBatch,
 )
 from bigdl_tpu.dataset.text import (
     Dictionary, WordTokenizer, SentenceToLabeledSentence,
@@ -28,7 +29,7 @@ GreyImgCropper = ImgRdmCropper  # the reference's grey cropper is random-positio
 BGRImgToBatch = ImgToBatch
 GreyImgToBatch = ImgToBatch
 BGRImgToSample = ImgToSample
-BGRImgToImageVector = ImgToSample  # MLlib DenseVector role -> Sample arrays
+BGRImgToImageVector = ImgToImageVector  # MLlib DenseVector role: flat HWC vectors
 MTLabeledBGRImgToBatch = MTLabeledImgToBatch
 ColoJitter = ColorJitter  # reference spelling (dataset/image/ColoJitter.scala)
 
@@ -45,7 +46,7 @@ __all__ = [
     "BytesToBGRImg", "GreyImgNormalizer", "BGRImgNormalizer",
     "BGRImgPixelNormalizer", "BGRImgCropper", "GreyImgCropper",
     "BGRImgRdmCropper", "BGRImgToBatch", "GreyImgToBatch", "BGRImgToSample",
-    "BGRImgToImageVector", "MTLabeledBGRImgToBatch", "ColoJitter",
+    "BGRImgToImageVector", "ImgToImageVector", "MTLabeledBGRImgToBatch", "ColoJitter",
     "Dictionary", "WordTokenizer", "SentenceToLabeledSentence",
     "LabeledSentenceToSample",
 ]

@@ -449,3 +449,17 @@ class ImgToSample(Transformer):
             elif self.to_chw:
                 d = np.transpose(d, (2, 0, 1))
             yield Sample(d, np.asarray([img.label], np.float32))
+
+
+class ImgToImageVector(Transformer):
+    """LabeledImage -> flat float vector Sample
+    (ref BGRImgToImageVector.scala: the MLlib DenseVector bridge feeding
+    DLClassifier pipelines — here the "DataFrame" is any columnar store of
+    flat vectors, so the output is a 1-D feature Sample in the image's
+    interleaved HWC float layout, exactly the reference's
+    ``toDenseVector`` ordering)."""
+
+    def __call__(self, iterator):
+        for img in iterator:
+            vec = np.ascontiguousarray(img.data, np.float32).reshape(-1)
+            yield Sample(vec, np.asarray([img.label], np.float32))

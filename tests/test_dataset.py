@@ -230,3 +230,14 @@ class TestNews20:
             news20.get_news20(str(tmp_path))
         with pytest.raises(FileNotFoundError):
             news20.get_glove_w2v(str(tmp_path), dim=4)
+
+
+def test_bgr_img_to_image_vector():
+    """ref BGRImgToImageVector.scala: flat HWC float vector per image."""
+    from bigdl_tpu.dataset import BGRImgToImageVector
+    from bigdl_tpu.dataset.image import LabeledImage
+    img = LabeledImage(np.arange(24, dtype=np.float32).reshape(2, 4, 3), 3.0)
+    (s,) = list(BGRImgToImageVector()([img]))
+    assert s.feature.shape == (24,)
+    np.testing.assert_allclose(s.feature, np.arange(24, dtype=np.float32))
+    assert s.label[0] == 3.0
