@@ -1,4 +1,4 @@
-from bigdl_tpu.models import lenet, vgg, inception, resnet, autoencoder, rnn, alexnet
+from bigdl_tpu.models import lenet, vgg, inception, resnet, autoencoder, rnn, alexnet, textclassifier
 from bigdl_tpu.models.lenet import LeNet5
 from bigdl_tpu.models.vgg import VggForCifar10, Vgg_16, Vgg_19
 from bigdl_tpu.models.inception import (
@@ -8,10 +8,13 @@ from bigdl_tpu.models.resnet import ResNet, ResNetCifar, basic_block, bottleneck
 from bigdl_tpu.models.autoencoder import Autoencoder
 from bigdl_tpu.models.rnn import SimpleRNN, BiLSTMClassifier
 from bigdl_tpu.models.alexnet import AlexNet, AlexNet_OWT
+from bigdl_tpu.models.textclassifier import (TextClassifierConv,
+                                             TextClassifierBiLSTM)
 
 __all__ = [
     "LeNet5", "VggForCifar10", "Vgg_16", "Vgg_19",
     "Inception_v1", "Inception_v1_NoAuxClassifier", "Inception_v2",
     "ResNet", "ResNetCifar", "basic_block", "bottleneck",
     "Autoencoder", "SimpleRNN", "BiLSTMClassifier", "AlexNet", "AlexNet_OWT",
+    "TextClassifierConv", "TextClassifierBiLSTM",
 ]

@@ -20,12 +20,8 @@ def SimpleRNN(input_size: int = 4000, hidden_size: int = 40,
 
 
 def BiLSTMClassifier(input_size: int, hidden_size: int, class_num: int):
-    """Bi-LSTM text classifier (BASELINE config 4): BiRecurrent(LSTM) over
-    (N, T, D), mean-pool time, linear head."""
-    return nn.Sequential(
-        nn.BiRecurrent(nn.LSTMCell(input_size, hidden_size),
-                       nn.LSTMCell(input_size, hidden_size)),
-        nn.Mean(1, n_input_dims=2),  # mean over time: (N, T, 2H) -> (N, 2H)
-        nn.Linear(2 * hidden_size, class_num),
-        nn.LogSoftMax(),
-    )
+    """Bi-LSTM text classifier (BASELINE config 4).  Canonical builder:
+    models/textclassifier.TextClassifierBiLSTM (used by the example, the
+    bench, and the convergence test); this alias keeps the round-1 name."""
+    from bigdl_tpu.models.textclassifier import TextClassifierBiLSTM
+    return TextClassifierBiLSTM(class_num, input_size, hidden_size)

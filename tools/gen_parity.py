@@ -23,7 +23,7 @@ SoftShrink SoftSign HardTanh HardShrink Threshold Clamp Abs Sqrt Square Power Ex
 CAddTable CSubTable CMulTable CDivTable CMaxTable CMinTable JoinTable SelectTable NarrowTable FlattenTable
 MixtureTable CriterionTable DotProduct PairwiseDistance CosineDistance
 Reshape InferReshape View Transpose Replicate Squeeze Unsqueeze Padding Contiguous Copy Identity Echo
-RnnCell LSTMCell GRUCell TimeDistributedCriterion Dropout L1Penalty
+RnnCell LSTMCell GRUCell BiRecurrent TimeDistributedCriterion Dropout L1Penalty
 ClassNLLCriterion CrossEntropyCriterion MSECriterion AbsCriterion BCECriterion DistKLDivCriterion
 ClassSimplexCriterion CosineEmbeddingCriterion HingeEmbeddingCriterion L1HingeEmbeddingCriterion
 MarginCriterion MarginRankingCriterion MultiCriterion ParallelCriterion MultiLabelMarginCriterion
@@ -41,7 +41,7 @@ DATASET_NAMES = ("DataSet LocalDataSet DistributedDataSet ShardedDataSet "
                  "Sample MiniBatch ByteRecord BytesToBGRImg BytesToGreyImg "
                  "BGRImgNormalizer BGRImgPixelNormalizer BGRImgCropper "
                  "BGRImgRdmCropper HFlip ColoJitter Lighting BGRImgToBatch "
-                 "MTLabeledBGRImgToBatch LabeledSentence "
+                 "MTLabeledBGRImgToBatch BGRImgToImageVector LabeledSentence "
                  "LabeledSentenceToSample Dictionary WordTokenizer").split()
 
 UTILS_NAMES = ("Engine Table T File TorchFile CaffeLoader RandomGenerator "
@@ -49,7 +49,8 @@ UTILS_NAMES = ("Engine Table T File TorchFile CaffeLoader RandomGenerator "
 
 MODEL_NAMES = ("LeNet5 VggForCifar10 Vgg_16 Vgg_19 Inception_v1 "
                "Inception_v1_NoAuxClassifier Inception_v2 ResNet ResNetCifar "
-               "Autoencoder SimpleRNN AlexNet AlexNet_OWT").split()
+               "Autoencoder SimpleRNN AlexNet AlexNet_OWT "
+               "TextClassifierConv TextClassifierBiLSTM").split()
 
 
 def loc(obj):
