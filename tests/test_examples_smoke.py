@@ -1,0 +1,45 @@
+"""Smoke tests for the example CLIs — the user-facing front door
+(ref models/*/Train.scala mains; each example falls back to synthetic
+data when its dataset folder is absent, so these run in CI).
+
+Each main() is invoked in-process with tiny shapes/epochs on the CPU
+mesh; the assertion is "trains/validates end-to-end without raising".
+"""
+import sys
+
+import pytest
+
+
+def run_example(module_name, argv):
+    import importlib
+    mod = importlib.import_module(module_name)
+    mod.main(argv)
+
+
+@pytest.mark.parametrize("module,argv", [
+    ("examples.train_lenet",
+     ["--folder", "/nonexistent", "--batchSize", "32", "--maxEpoch", "1"]),
+    ("examples.train_vgg",
+     ["--folder", "/nonexistent", "--batchSize", "16", "--maxEpoch", "1"]),
+    ("examples.train_autoencoder",
+     ["--folder", "/nonexistent", "--batchSize", "32", "--maxEpoch", "1"]),
+    ("examples.train_rnn",
+     ["--dataFolder", "/nonexistent", "--batchSize", "8", "--maxEpoch", "1",
+      "--seqLength", "12", "--hiddenSize", "16", "--vocabSize", "32"]),
+    ("examples.text_classifier",
+     ["--baseDir", "/nonexistent", "--batchSize", "16", "--maxEpoch", "1",
+      "--seqLength", "150", "--embedDim", "8", "--classNum", "3"]),
+    ("examples.text_classifier",
+     ["--baseDir", "/nonexistent", "--model", "lstm", "--batchSize", "16",
+      "--maxEpoch", "1", "--seqLength", "20", "--embedDim", "8",
+      "--classNum", "3", "--hiddenSize", "8"]),
+    ("examples.train_inception",
+     # batch must divide the 8-device mesh (Utils.getBatchSize rule)
+     ["--synthetic", "--batchSize", "8", "--maxIteration", "2",
+      "--classNumber", "10"]),
+], ids=["lenet", "vgg", "autoencoder", "rnn", "textconv", "textlstm",
+        "inception"])
+def test_example_trains(module, argv, monkeypatch, tmp_path):
+    monkeypatch.chdir(tmp_path)  # checkpoints etc. land in tmp
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
+    run_example(module, argv)
