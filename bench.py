@@ -215,17 +215,29 @@ def run_one(only: str):
         }), flush=True)
 
 
-def _subprocess_json(arg, timeout_s, retries=2, retry_sleep=60):
+_BENCH_DEADLINE = time.monotonic() + float(
+    os.environ.get("BIGDL_BENCH_DEADLINE_S", 45 * 60))
+
+
+def _subprocess_json(arg, timeout_s, retries=2, retry_sleep=45):
     """Run ``python bench.py <arg>`` with a hard timeout; the relay tunnel
     backing this chip occasionally wedges a stream mid-compile (PERF_NOTES
     "Relay operations note"), and a wedged in-process XLA call can never be
-    cancelled — a supervised subprocess can."""
+    cancelled — a supervised subprocess can.  A global deadline
+    (BIGDL_BENCH_DEADLINE_S, default 45 min) bounds the whole run so a
+    dead relay yields a partial result instead of an unbounded stall."""
     import subprocess
     for attempt in range(retries):
+        budget = _BENCH_DEADLINE - time.monotonic()
+        if budget <= 30:
+            print("bench deadline reached; skipping %r" % arg,
+                  file=sys.stderr, flush=True)
+            return []
         try:
             out = subprocess.run(
                 [sys.executable, "-u", os.path.abspath(__file__), arg],
-                capture_output=True, text=True, timeout=timeout_s)
+                capture_output=True, text=True,
+                timeout=min(timeout_s, budget))
             lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
             if out.returncode == 0 and lines:
                 return [json.loads(l) for l in lines]
@@ -233,8 +245,8 @@ def _subprocess_json(arg, timeout_s, retries=2, retry_sleep=60):
                 arg, out.returncode, attempt + 1, out.stderr[-500:]),
                 file=sys.stderr, flush=True)
         except subprocess.TimeoutExpired:
-            print("bench subprocess %r timed out after %ds (attempt %d)"
-                  % (arg, timeout_s, attempt + 1), file=sys.stderr, flush=True)
+            print("bench subprocess %r timed out (attempt %d)"
+                  % (arg, attempt + 1), file=sys.stderr, flush=True)
         time.sleep(retry_sleep)
     return []
 
