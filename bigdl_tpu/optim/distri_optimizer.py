@@ -85,6 +85,15 @@ class DistriOptimizer(LocalOptimizer):
         """Accepted for API parity; see class docstring (no-op)."""
         return self
 
+    def _maybe_checkpoint(self, params, net_state, opt_state, state):
+        # params are replicated, so exactly one process writes — the
+        # reference gathers slices to the driver and saves once
+        # (getModel + File.save, DistriOptimizer.scala:320-342); writing
+        # from every host would race on a shared checkpoint path.
+        if jax.process_index() != 0:
+            return
+        super()._maybe_checkpoint(params, net_state, opt_state, state)
+
     def _shardings(self, params, net_state, opt_state):
         mesh = self.mesh
         rep = NamedSharding(mesh, P())

@@ -4,15 +4,19 @@ pattern (DistriOptimizerSpec.scala:40-42,104-116 runs Engine.init(4,4)
 against a local SparkContext; here each OS process is one "host" with 2
 virtual CPU devices, joined via jax.distributed).
 
-Usage: python multiproc_worker.py <process_id> <num_processes> <port>
-Prints one JSON line: {"process_id": i, "losses": [...], "psum": float}
+Usage: python multiproc_worker.py <process_id> <num_processes> <port> [ckpt_dir]
+Prints one JSON line:
+  {"process_id": i, "losses": [...], "psum": float,
+   "ckpt_files": [...], "resumed_loss": float}
 """
 import json
+import os as _os
 import sys
 
 
 def main():
     pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -54,16 +58,35 @@ def main():
 
     model = nn.Sequential(nn.Linear(d, 8), nn.Tanh(),
                           nn.Linear(8, classes), nn.LogSoftMax())
+    from bigdl_tpu.optim import several_iteration
     opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion())
     opt.set_state(T(learningRate=0.5))
     opt.set_end_when(max_iteration(6))
+    if ckpt_dir:
+        opt.set_checkpoint(ckpt_dir, several_iteration(3))
 
     opt.optimize()
     losses = [float(opt.state["loss"])]
 
     psum = float(sum(np.abs(np.asarray(p)).sum()
                      for p in jax.tree_util.tree_leaves(model.params())))
-    print(json.dumps({"process_id": pid, "losses": losses, "psum": psum}))
+
+    out = {"process_id": pid, "losses": losses, "psum": psum}
+    if ckpt_dir:
+        out["ckpt_files"] = sorted(_os.listdir(ckpt_dir))
+        # resume: fresh model from the newest checkpoint, 2 more steps —
+        # every process reads the same files process 0 wrote
+        from bigdl_tpu.utils import file as File
+        nevals = sorted(int(f.split(".")[-1]) for f in out["ckpt_files"]
+                        if f.startswith("model."))
+        m2 = File.load_module(_os.path.join(ckpt_dir,
+                                            "model.%d" % nevals[-1]))
+        opt2 = DistriOptimizer(m2, ds, nn.ClassNLLCriterion())
+        opt2.set_state(T(learningRate=0.5))
+        opt2.set_end_when(max_iteration(2))
+        opt2.optimize()
+        out["resumed_loss"] = float(opt2.state["loss"])
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -28,11 +28,12 @@ def free_port():
     return port
 
 
-def run_workers(nproc, port):
+def run_workers(nproc, port, ckpt_dir=None):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
+    extra = [str(ckpt_dir)] if ckpt_dir else []
     procs = [subprocess.Popen(
-        [sys.executable, WORKER, str(i), str(nproc), str(port)],
+        [sys.executable, WORKER, str(i), str(nproc), str(port)] + extra,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True)
         for i in range(nproc)]
     outs = []
@@ -57,3 +58,21 @@ def test_two_process_distri_optimizer_matches_single_process():
     # (identical data/model/seed; fp reassociation across the mesh only)
     assert two[0]["losses"] == pytest.approx(one[0]["losses"], rel=1e-4)
     assert two[0]["psum"] == pytest.approx(one[0]["psum"], rel=1e-4)
+
+
+@pytest.mark.slow
+def test_two_process_checkpoint_written_once_and_resumable(tmp_path):
+    """Only process 0 writes checkpoints (the reference's driver-side
+    getModel+save, DistriOptimizer.scala:320-342); every process can
+    resume from them and the resumed runs agree."""
+    ck = tmp_path / "ckpts"
+    ck.mkdir()
+    outs = run_workers(2, free_port(), ckpt_dir=ck)
+    files = outs[0]["ckpt_files"]
+    assert any(f.startswith("model.") for f in files), files
+    assert any(f.startswith("state.") for f in files), files
+    # no duplicate/temp leftovers from a second writer
+    assert len([f for f in files if f.endswith(".tmp")]) == 0
+    assert outs[0]["ckpt_files"] == outs[1]["ckpt_files"]
+    assert outs[0]["resumed_loss"] == pytest.approx(outs[1]["resumed_loss"],
+                                                    rel=1e-5)
