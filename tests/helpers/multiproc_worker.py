@@ -72,6 +72,18 @@ def main():
                      for p in jax.tree_util.tree_leaves(model.params())))
 
     out = {"process_id": pid, "losses": losses, "psum": psum}
+
+    # cross-process validation merge (ref DistriValidator.scala:32): each
+    # process sees its shard; merged counts must cover the GLOBAL set
+    from bigdl_tpu.optim import Top1Accuracy
+    from bigdl_tpu.optim.local_optimizer import distri_validate
+    val_ds = (DataSet.array(samples, distributed=(nproc > 1))
+              >> SampleToBatch(local_batch))
+    res = distri_validate(model, model.params(), model.state(),
+                          val_ds, [Top1Accuracy()])
+    acc = res[0][1]
+    out["val_count"] = int(acc.count)
+    out["val_correct"] = int(acc.correct)
     if ckpt_dir:
         out["ckpt_files"] = sorted(_os.listdir(ckpt_dir))
         # resume: fresh model from the newest checkpoint, 2 more steps —

@@ -40,7 +40,9 @@ def run_workers(nproc, port, ckpt_dir=None):
     for p in procs:
         out, err = p.communicate(timeout=600)
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+        jlines = [l for l in out.splitlines() if l.startswith("{")]
+        assert jlines, f"no JSON line in worker stdout:\n{out}\n{err[-1500:]}"
+        outs.append(json.loads(jlines[-1]))
     return outs
 
 
@@ -76,3 +78,6 @@ def test_two_process_checkpoint_written_once_and_resumable(tmp_path):
     assert outs[0]["ckpt_files"] == outs[1]["ckpt_files"]
     assert outs[0]["resumed_loss"] == pytest.approx(outs[1]["resumed_loss"],
                                                     rel=1e-5)
+    # DistriValidator merge: both processes report the same GLOBAL totals
+    assert outs[0]["val_count"] == outs[1]["val_count"] == 16
+    assert outs[0]["val_correct"] == outs[1]["val_correct"]
