@@ -18,6 +18,13 @@ Writes ``PROFILE_<model>.md`` at the repo root and prints the table.
 """
 from __future__ import annotations
 
+import os as _os
+import sys as _sys
+
+_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+if _REPO_ROOT not in _sys.path:
+    _sys.path.insert(0, _REPO_ROOT)  # run without an installed package
+
 import collections
 import glob
 import gzip
