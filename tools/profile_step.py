@@ -244,7 +244,7 @@ def build_step(model_name: str, batch: int):
         xshape, nclass = (batch, 3, 224, 224), 1000
     elif model_name == "resnet50":
         from bigdl_tpu.models.resnet import ResNet
-        model = ResNet(class_num=1000, depth=50, dataset="imagenet")
+        model = ResNet(depth=50, class_num=1000)
         xshape, nclass = (batch, 3, 224, 224), 1000
     elif model_name == "lenet":
         from bigdl_tpu.models.lenet import LeNet5
