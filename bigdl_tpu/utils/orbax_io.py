@@ -1,0 +1,58 @@
+"""Orbax checkpoint adapter (SURVEY.md §5.4 "TPU equivalent: orbax-style
+checkpoint of (params pytree, opt state, step)").
+
+The native checkpoint format (utils/file.py: portable pickle, local or
+fsspec URL) stays the default — it is dependency-free and carries the
+architecture.  This adapter writes the *weight trees* in the ecosystem-
+standard Orbax/TensorStore layout instead, so bigdl_tpu checkpoints can be
+consumed by other JAX stacks (and vice versa): sharded, async-capable,
+multi-host-aware persistence of (params, net_state, opt_state, step).
+
+    from bigdl_tpu.utils import orbax_io
+    orbax_io.save(path, model.params(), model.state(), opt_state, step=12)
+    params, net_state, opt_state, step = orbax_io.restore(path)
+"""
+from __future__ import annotations
+
+import os
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.StandardCheckpointer()
+
+
+def save(path, params, net_state=None, opt_state=None, step: int = 0,
+         force: bool = True):
+    """Write (params, net_state, opt_state, step) as one Orbax checkpoint.
+
+    ``path`` must be a directory path (absolute local path or gs:// URL —
+    TensorStore handles remote stores natively, the HDFS role)."""
+    path = os.path.abspath(path) if "://" not in str(path) else str(path)
+    ckptr = _checkpointer()
+    tree = {"params": params, "net_state": net_state or {},
+            "opt_state": opt_state or {}, "step": step}
+    ckptr.save(path, tree, force=force)
+    ckptr.wait_until_finished()
+    return path
+
+
+def restore(path):
+    """Returns (params, net_state, opt_state, step)."""
+    path = os.path.abspath(path) if "://" not in str(path) else str(path)
+    tree = _checkpointer().restore(path)
+    return (tree["params"], tree["net_state"], tree["opt_state"],
+            int(tree["step"]))
+
+
+def save_module(module, path, step: int = 0):
+    """Module-level convenience: persists the weight/buffer trees (the
+    architecture itself is code — rebuild it and ``load_module``)."""
+    return save(path, module.params(), module.state(), step=step)
+
+
+def load_module(module, path):
+    params, net_state, _, step = restore(path)
+    module.load_params(params)
+    module.load_state(net_state)
+    return module, step

@@ -183,3 +183,32 @@ class TestRemoteFS:
         got = list(ds.data(train=False))
         assert len(got) == 20
         assert {r.data for r in got} == {b"payload-%d" % i for i in range(20)}
+
+
+class TestOrbaxIO:
+    """Ecosystem-standard checkpoint layout (SURVEY.md §5.4 orbax note)."""
+
+    def test_roundtrip_module_and_opt_state(self, tmp_path):
+        import numpy as np
+        import jax.numpy as jnp
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.optim.optim_method import SGD
+        from bigdl_tpu.utils import orbax_io
+
+        m = nn.Sequential(nn.Linear(4, 3), nn.Tanh(), nn.Linear(3, 2))
+        method = SGD()
+        opt_state = method.init_state(m.params())
+        p = str(tmp_path / "ckpt")
+        orbax_io.save(p, m.params(), m.state(), opt_state, step=7)
+
+        params, net_state, opt2, step = orbax_io.restore(p)
+        assert step == 7
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(m.params()),
+                        jax.tree_util.tree_leaves(params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        m2 = nn.Sequential(nn.Linear(4, 3), nn.Tanh(), nn.Linear(3, 2))
+        m2, step2 = orbax_io.load_module(m2, p)
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(m.forward(x)),
+                                   np.asarray(m2.forward(x)), rtol=1e-6)
