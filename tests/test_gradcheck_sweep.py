@@ -225,3 +225,34 @@ def test_multi_and_parallel_criterion_fd():
     mc = nn.MultiCriterion()
     mc.add(nn.MSECriterion(), 0.5).add(nn.AbsCriterion(), 2.0)
     crit_fd(mc, randn(3, 4), randn(3, 4))
+
+
+def test_index_gather_and_grad_fd():
+    src = randn(5, 4)
+    idx = jnp.asarray([2.0, 5.0, 1.0])  # 1-based
+    mod = nn.Index(1)
+    y = np.asarray(mod.forward(T(src, idx)))
+    np.testing.assert_allclose(y, np.asarray(src)[[1, 4, 0]])
+
+    def scalar(s):
+        out, _ = mod.apply({}, T(s, idx), {},
+                           Context(False, jax.random.PRNGKey(0)))
+        return (out * 0.5).sum()
+
+    g = np.asarray(jax.grad(scalar)(src), np.float64)
+    s0 = np.asarray(src, np.float64)
+    eps = 1e-3
+    for i in RS.choice(s0.size, size=10, replace=False):
+        ix = np.unravel_index(i, s0.shape)
+        sp = s0.copy(); sp[ix] += eps
+        sm = s0.copy(); sm[ix] -= eps
+        fd = (float(scalar(jnp.asarray(sp, jnp.float32))) -
+              float(scalar(jnp.asarray(sm, jnp.float32)))) / (2 * eps)
+        assert abs(fd - g[ix]) <= 1e-2
+
+
+def test_masked_select_eager_semantics():
+    src = randn(3, 4)
+    mask = jnp.asarray((np.asarray(src) > 0).astype(np.float32))
+    out = np.asarray(nn.MaskedSelect().forward(T(src, mask)))
+    np.testing.assert_allclose(out, np.asarray(src)[np.asarray(src) > 0])
