@@ -29,7 +29,7 @@ GreyImgCropper = ImgRdmCropper  # the reference's grey cropper is random-positio
 BGRImgToBatch = ImgToBatch
 GreyImgToBatch = ImgToBatch
 BGRImgToSample = ImgToSample
-BGRImgToImageVector = ImgToImageVector  # MLlib DenseVector role: flat HWC vectors
+BGRImgToImageVector = ImgToImageVector  # MLlib DenseVector role: planar CHW, RGB plane order
 MTLabeledBGRImgToBatch = MTLabeledImgToBatch
 ColoJitter = ColorJitter  # reference spelling (dataset/image/ColoJitter.scala)
 

@@ -455,11 +455,17 @@ class ImgToImageVector(Transformer):
     """LabeledImage -> flat float vector Sample
     (ref BGRImgToImageVector.scala: the MLlib DenseVector bridge feeding
     DLClassifier pipelines — here the "DataFrame" is any columnar store of
-    flat vectors, so the output is a 1-D feature Sample in the image's
-    interleaved HWC float layout, exactly the reference's
-    ``toDenseVector`` ordering)."""
+    flat vectors).  The reference's ``copyTo(..., toRGB=true)``
+    (image/Types.scala:154-164) writes a *planar CHW* vector with the BGR
+    interleaved channels flipped to RGB plane order (plane 0 = R, 1 = G,
+    2 = B); this transformer emits exactly that layout for 3-channel
+    images.  Greyscale (2-D) images flatten as-is."""
 
     def __call__(self, iterator):
         for img in iterator:
-            vec = np.ascontiguousarray(img.data, np.float32).reshape(-1)
+            d = np.asarray(img.data, np.float32)
+            if d.ndim == 3 and d.shape[2] == 3:
+                # HWC BGR -> CHW (B,G,R planes) -> reverse planes -> RGB
+                d = np.transpose(d, (2, 0, 1))[::-1]
+            vec = np.ascontiguousarray(d, np.float32).reshape(-1)
             yield Sample(vec, np.asarray([img.label], np.float32))

@@ -28,37 +28,83 @@ MAGIC = b"BDTS"
 VERSION = 1
 
 
+class _ShardWriter:
+    """Incremental single-shard writer.  Local paths stream to a ``.tmp``
+    file (GB-scale shards never live in memory) finished by an atomic
+    rename; fsspec URLs buffer in memory (object stores need whole-object
+    upload) and go out through ``fs.write_bytes_atomic``."""
+
+    def __init__(self, path):
+        self.path = path
+        self.n = 0
+        self._local = not fs.is_url(path)
+        if self._local:
+            fs.makedirs(os.path.dirname(os.path.abspath(path)))
+            self._tmp = path + ".tmp"
+            self._f = open(self._tmp, "w+b")
+        else:
+            self._f = io.BytesIO()
+        self._f.write(MAGIC + struct.pack("<IQ", VERSION, 0))
+
+    def append(self, label, data):
+        key = str(label).encode()
+        self._f.write(struct.pack("<I", len(key)) + key)
+        self._f.write(struct.pack("<I", len(data)) + data)
+        self.n += 1
+
+    def close(self):
+        self._f.seek(len(MAGIC) + 4)
+        self._f.write(struct.pack("<Q", self.n))
+        if self._local:
+            self._f.close()
+            os.replace(self._tmp, self.path)
+        else:
+            fs.write_bytes_atomic(self.path, self._f.getvalue())
+        return self.n
+
+    def abort(self):
+        """Drop the partial shard (no stale .tmp survives a failed run)."""
+        self._f.close()
+        if self._local:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+
+
 def write_shard(records, path):
     """records: iterable of (label: float|str, data: bytes).  ``path`` may
-    be a local path or any fsspec URL (remote stores get a full-buffer
-    upload; seek-back patching of the count happens in memory)."""
-    buf = io.BytesIO()
-    n = 0
-    buf.write(MAGIC + struct.pack("<IQ", VERSION, 0))
-    for label, data in records:
-        key = str(label).encode()
-        buf.write(struct.pack("<I", len(key)) + key)
-        buf.write(struct.pack("<I", len(data)) + data)
-        n += 1
-    buf.seek(len(MAGIC) + 4)
-    buf.write(struct.pack("<Q", n))
-    fs.write_bytes_atomic(path, buf.getvalue())
-    return n
+    be a local path or any fsspec URL; see _ShardWriter for the two
+    streaming strategies."""
+    w = _ShardWriter(path)
+    try:
+        for label, data in records:
+            w.append(label, data)
+    except BaseException:
+        w.abort()
+        raise
+    return w.close()
 
 
 def write_shards(records, out_dir, n_shards: int = 8, prefix: str = "shard"):
     """Round-robin pack records into ``n_shards`` files
-    (the ImageNetSeqFileGenerator role)."""
+    (the ImageNetSeqFileGenerator role).  Streams: each record goes
+    straight to its shard writer, so the full dataset is never resident
+    in memory."""
     fs.makedirs(out_dir)
-    buckets = [[] for _ in range(n_shards)]
-    for i, rec in enumerate(records):
-        buckets[i % n_shards].append(rec)
-    paths = []
-    for i, bucket in enumerate(buckets):
-        p = fs.join(out_dir, f"{prefix}-{i:05d}.bdts")
-        write_shard(bucket, p)
-        paths.append(p)
-    return paths
+    writers = [
+        _ShardWriter(fs.join(out_dir, f"{prefix}-{i:05d}.bdts"))
+        for i in range(n_shards)]
+    try:
+        for i, (label, data) in enumerate(records):
+            writers[i % n_shards].append(label, data)
+    except BaseException:
+        for w in writers:
+            w.abort()
+        raise
+    for w in writers:
+        w.close()
+    return [w.path for w in writers]
 
 
 def read_shard(path):

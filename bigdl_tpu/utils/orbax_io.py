@@ -30,8 +30,10 @@ def save(path, params, net_state=None, opt_state=None, step: int = 0,
     TensorStore handles remote stores natively, the HDFS role)."""
     path = os.path.abspath(path) if "://" not in str(path) else str(path)
     ckptr = _checkpointer()
-    tree = {"params": params, "net_state": net_state or {},
-            "opt_state": opt_state or {}, "step": step}
+    tree = {"params": params,
+            "net_state": net_state if net_state is not None else {},
+            "opt_state": opt_state if opt_state is not None else {},
+            "step": step}
     ckptr.save(path, tree, force=force)
     ckptr.wait_until_finished()
     return path

@@ -225,7 +225,8 @@ def save(obj, path):
 def _iter_torch_modules(obj):
     """Yield torch module dicts (depth-first) from a loaded .t7 object."""
     if isinstance(obj, dict):
-        if "torch_typename" in obj and ("weight" in obj or "bias" in obj):
+        if "torch_typename" in obj and ("weight" in obj or "bias" in obj
+                                        or "running_mean" in obj):
             yield obj
         modules = obj.get("modules")
         if isinstance(modules, dict):
@@ -237,9 +238,9 @@ def _iter_torch_modules(obj):
 
 
 def load_module_weights(model, path, strict: bool = True):
-    """Copy weight/bias from a saved Torch module tree onto ``model`` by
-    traversal order of parameterized layers (the registry role of
-    TorchFile.scala:136-182)."""
+    """Copy weight/bias AND buffers (BN running stats) from a saved Torch
+    module tree onto ``model`` by traversal order of parameterized layers
+    (the registry role of TorchFile.scala:136-182)."""
     import jax.numpy as jnp
     from bigdl_tpu.nn.module import Module, Container
 
@@ -247,7 +248,7 @@ def load_module_weights(model, path, strict: bool = True):
     torch_mods = list(_iter_torch_modules(blob))
 
     def leaves(m):
-        if m._params:
+        if m._params or m._buffers:
             yield m
         for c in m._modules.values():
             yield from leaves(c)
@@ -257,20 +258,38 @@ def load_module_weights(model, path, strict: bool = True):
         raise ValueError(
             f"module count mismatch: .t7 has {len(torch_mods)} parameterized "
             f"layers, model has {len(targets)}")
+
+    def copy_into(store, name, tm):
+        if name in tm and tm[name] is not None and name in store:
+            src = np.asarray(tm[name])
+            dst = store[name]
+            if src.size != dst.size:
+                raise ValueError(
+                    f".t7 field '{name}' has {src.size} elems; module "
+                    f"expects {tuple(dst.shape)}")
+            if src.shape != tuple(dst.shape):
+                src = src.reshape(dst.shape)
+            store[name] = jnp.asarray(src, dst.dtype)
+
+    skipped = []
     for tm, tgt in zip(torch_mods, targets):
         names = ("weight", "bias") + tuple(
             k for k in tgt._params if k not in ("weight", "bias"))
         for name in names:
-            if name in tm and tm[name] is not None and name in tgt._params:
-                src = np.asarray(tm[name])
-                dst = tgt._params[name]
-                if src.size != dst.size:
-                    raise ValueError(
-                        f".t7 field '{name}' has {src.size} elems; module "
-                        f"parameter expects {tuple(dst.shape)}")
-                if src.shape != tuple(dst.shape):
-                    src = src.reshape(dst.shape)
-                tgt._params[name] = jnp.asarray(src, dst.dtype)
+            copy_into(tgt._params, name, tm)
+        for name in tuple(tgt._buffers):
+            copy_into(tgt._buffers, name, tm)
+        for name in tuple(tgt._params) + tuple(tgt._buffers):
+            if tm.get(name) is None:
+                skipped.append(f"{type(tgt).__name__}.{name}")
+    if skipped:
+        # not fatal even under strict: e.g. legacy torch files store
+        # running_std instead of running_var — but never silent
+        import warnings
+        warnings.warn(
+            f".t7 file lacks {len(skipped)} field(s) kept at their "
+            f"in-model values: {', '.join(skipped[:8])}"
+            + ("..." if len(skipped) > 8 else ""))
     return model
 
 

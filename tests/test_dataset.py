@@ -233,11 +233,17 @@ class TestNews20:
 
 
 def test_bgr_img_to_image_vector():
-    """ref BGRImgToImageVector.scala: flat HWC float vector per image."""
+    """ref BGRImgToImageVector.scala: planar CHW float vector, BGR
+    interleaved input flipped to RGB plane order
+    (copyTo(toRGB=true), image/Types.scala:154-164)."""
     from bigdl_tpu.dataset import BGRImgToImageVector
     from bigdl_tpu.dataset.image import LabeledImage
-    img = LabeledImage(np.arange(24, dtype=np.float32).reshape(2, 4, 3), 3.0)
+    hwc = np.arange(24, dtype=np.float32).reshape(2, 4, 3)
+    img = LabeledImage(hwc, 3.0)
     (s,) = list(BGRImgToImageVector()([img]))
     assert s.feature.shape == (24,)
-    np.testing.assert_allclose(s.feature, np.arange(24, dtype=np.float32))
+    # plane 0 = interleaved channel 2 (R), plane 1 = G, plane 2 = B
+    want = np.concatenate([hwc[:, :, 2].ravel(), hwc[:, :, 1].ravel(),
+                           hwc[:, :, 0].ravel()])
+    np.testing.assert_allclose(s.feature, want)
     assert s.label[0] == 3.0

@@ -149,6 +149,12 @@ class TestT7ZooRoundTrip:
 
         set_seed(11)
         m1 = build()
+        x = np.random.RandomState(0).randn(*shape).astype(np.float32)
+        # one training-mode forward first so BN running stats move off
+        # their defaults — the round-trip must carry buffers, not just
+        # weights (eval-mode forward below consumes the running stats)
+        m1.training()
+        m1.forward(x)
         p = tmp_path / "m.t7"
         torch_file.save_module(m1, str(p))
 
@@ -157,7 +163,6 @@ class TestT7ZooRoundTrip:
         torch_file.load_module_weights(m2, str(p))
         m1.evaluate()
         m2.evaluate()
-        x = np.random.RandomState(0).randn(*shape).astype(np.float32)
         np.testing.assert_allclose(np.asarray(m1.forward(x)),
                                    np.asarray(m2.forward(x)),
                                    rtol=1e-5, atol=1e-5)
