@@ -92,18 +92,21 @@ def write_shards(records, out_dir, n_shards: int = 8, prefix: str = "shard"):
     straight to its shard writer, so the full dataset is never resident
     in memory."""
     fs.makedirs(out_dir)
-    writers = [
-        _ShardWriter(fs.join(out_dir, f"{prefix}-{i:05d}.bdts"))
-        for i in range(n_shards)]
+    writers = []
     try:
+        for i in range(n_shards):
+            writers.append(
+                _ShardWriter(fs.join(out_dir, f"{prefix}-{i:05d}.bdts")))
         for i, (label, data) in enumerate(records):
             writers[i % n_shards].append(label, data)
+        for w in writers:
+            w.close()
+            w.closed = True
     except BaseException:
         for w in writers:
-            w.abort()
+            if not getattr(w, "closed", False):
+                w.abort()
         raise
-    for w in writers:
-        w.close()
     return [w.path for w in writers]
 
 

@@ -330,7 +330,11 @@ class SmoothL1CriterionWithWeights(Criterion):
                       0.5 * d * d * self.sigma2,
                       ad - 0.5 / self.sigma2) * out_w
         s = l.sum()
-        return s / self.num if self.num > 0 else s
+        if self.num > 0:
+            return s / self.num
+        # ref SmoothL1CriterionWithWeights.scala:100: sum / input.size(1)
+        # (the batch dimension) when num is unset
+        return s / input.shape[0]
 
 
 class SoftMarginCriterion(Criterion):

@@ -160,18 +160,27 @@ class SpatialSubtractiveNormalization(TensorModule):
         self.kernel = kernel / (kernel.sum() * n_input_plane)
         self.kh, self.kw = self.kernel.shape
 
-    def _local_mean(self, x):
+    def _conv_sum(self, x):
+        """Zero-padded cross-channel correlation with the normalized kernel:
+        the reference's ``meanestimator`` conv stage
+        (SpatialZeroPadding + SpatialConvolution(C,1) + Replicate,
+        SpatialSubtractiveNormalization.scala:69-78) — one map shared by
+        all channels, returned broadcastable as (N,1,H,W)."""
         n, c, h, w = x.shape
         k = jnp.asarray(self.kernel)[None, None].repeat(c, axis=1)  # (1,C,kh,kw)
         ph, pw = (self.kh - 1) // 2, (self.kw - 1) // 2
         pad = [(ph, self.kh - 1 - ph), (pw, self.kw - 1 - pw)]
-        mean = lax.conv_general_dilated(
+        return lax.conv_general_dilated(
             x, k, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        # normalize by the actually-covered kernel mass near borders (coef map)
-        ones = jnp.ones((1, c, h, w), x.dtype)
-        coef = lax.conv_general_dilated(
-            ones, k, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        return mean / coef
+
+    def _coef(self, x):
+        """Border-mass map: the conv applied to ones
+        (the reference's ``coef``, SpatialSubtractiveNormalization.scala:112-121)."""
+        ones = jnp.ones((1,) + x.shape[1:], x.dtype)
+        return self._conv_sum(ones)
+
+    def _local_mean(self, x):
+        return self._conv_sum(x) / self._coef(x)
 
     def _forward(self, P, x, S, ctx):
         was3d = x.ndim == 3
@@ -182,7 +191,12 @@ class SpatialSubtractiveNormalization(TensorModule):
 
 
 class SpatialDivisiveNormalization(TensorModule):
-    """Divide by local std-dev estimate (ref SpatialDivisiveNormalization.scala)."""
+    """Divide by the coef-adjusted local std-dev estimate, floored by
+    Threshold(threshold, thresval)
+    (ref SpatialDivisiveNormalization.scala:114-136:
+    ``localstds = sqrt(conv(x^2))``, ``adjustedstds = localstds / coef``,
+    ``out = x / Threshold(adjustedstds)``; the division by the border
+    mass happens AFTER the sqrt, and there is no mean-std clause)."""
 
     def __init__(self, n_input_plane: int = 1, kernel=None,
                  threshold: float = 1e-4, thresval: float = 1e-4):
@@ -195,11 +209,9 @@ class SpatialDivisiveNormalization(TensorModule):
         was3d = x.ndim == 3
         if was3d:
             x = x[None]
-        local_var = self.sub._local_mean(x * x)
-        local_std = jnp.sqrt(jnp.maximum(local_var, 0.0))
-        mean_std = local_std.mean(axis=(1, 2, 3), keepdims=True)
-        denom = jnp.maximum(local_std, mean_std)
-        denom = jnp.where(denom < self.threshold, self.thresval, denom)
+        local_std = jnp.sqrt(jnp.maximum(self.sub._conv_sum(x * x), 0.0))
+        adjusted = local_std / self.sub._coef(x)
+        denom = jnp.where(adjusted > self.threshold, adjusted, self.thresval)
         y = x / denom
         return (y[0] if was3d else y), None
 

@@ -134,9 +134,12 @@ class SpatialAveragePooling(TensorModule):
 class RoiPooling(Module):
     """Region-of-interest max pooling (ref RoiPooling.scala:363).
 
-    Input: Table(features (N,C,H,W), rois (R,5) rows [batchIdx(1-based),
-    x1, y1, x2, y2] in input-image coords scaled by ``spatial_scale``).
-    Output: (R, C, pooled_h, pooled_w).
+    Input: Table(features (N,C,H,W), rois (R,5) rows [batchIdx(0-based,
+    like the reference: RoiPooling.scala ``roiBatchInd >= 0 &&
+    dataSize(0) > roiBatchInd``), x1, y1, x2, y2] in input-image coords
+    scaled by ``spatial_scale``).  Output: (R, C, pooled_h, pooled_w).
+    Coordinate rounding is the reference's ``Math.round`` = floor(x+0.5)
+    (round-half-up, not banker's rounding).
 
     TPU-first note: the reference loops over variable-sized bins; here each
     ROI bin is computed by masked max over the full feature map, keeping
@@ -153,11 +156,11 @@ class RoiPooling(Module):
         data, rois = x[1], x[2]
         n, c, h, w = data.shape
         r = rois.shape[0]
-        batch_idx = jnp.asarray(rois[:, 0], jnp.int32) - 1
-        x1 = jnp.round(rois[:, 1] * self.spatial_scale)
-        y1 = jnp.round(rois[:, 2] * self.spatial_scale)
-        x2 = jnp.round(rois[:, 3] * self.spatial_scale)
-        y2 = jnp.round(rois[:, 4] * self.spatial_scale)
+        batch_idx = jnp.asarray(rois[:, 0], jnp.int32)
+        x1 = jnp.floor(rois[:, 1] * self.spatial_scale + 0.5)
+        y1 = jnp.floor(rois[:, 2] * self.spatial_scale + 0.5)
+        x2 = jnp.floor(rois[:, 3] * self.spatial_scale + 0.5)
+        y2 = jnp.floor(rois[:, 4] * self.spatial_scale + 0.5)
         roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
         roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
         bin_w = roi_w / self.pooled_w

@@ -19,6 +19,15 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# Persistent XLA compilation cache: the suite is dominated by XLA
+# recompiles (each parametrized crosscheck compiles fresh); warm runs pull
+# the executable from disk instead.  Threshold 0 = cache every compile.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), os.pardir,
+                          ".xla_cache")
+os.makedirs(_CACHE_DIR, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 # XLA CPU may route f32 matmuls through AMX/bf16; pin full precision so
 # value tests compare against numpy exactly.  (On TPU the default bf16-pass
 # MXU precision is the intended fast path — production code does not set this.)

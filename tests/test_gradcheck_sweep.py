@@ -136,8 +136,8 @@ def test_conv_map_param_grads_fd():
 
 def test_roi_pooling_feature_grad_fd():
     feats = randn(2, 3, 8, 8)
-    rois = jnp.asarray(np.array([[1, 0, 0, 6, 6], [2, 2, 2, 7, 7]],
-                                np.float32))
+    rois = jnp.asarray(np.array([[0, 0, 0, 6, 6], [1, 2, 2, 7, 7]],
+                                np.float32))  # 0-based batch idx (ref)
     mod = nn.RoiPooling(3, 3, 1.0)
 
     def scalar(f):
