@@ -70,9 +70,15 @@ class LogSigmoid(_Elementwise):
 
 
 class LogSoftMax(_Elementwise):
-    """Over the last dim for 1D/2D input, matching Torch LogSoftMax."""
+    """Over the last dim for 1D/2D input, matching Torch LogSoftMax.
+
+    Always computed in f32: under the BF16_ACT policy the incoming logits
+    are bfloat16, and log-probabilities need the f32 mantissa (the loss
+    path is tiny, so the upcast is free)."""
 
     def _fn(self, x, ctx):
+        if x.dtype in (jnp.bfloat16, jnp.float16):
+            x = x.astype(jnp.float32)
         return jax.nn.log_softmax(x, axis=-1)
 
 

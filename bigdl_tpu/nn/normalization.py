@@ -55,9 +55,12 @@ class BatchNormalization(TensorModule):
         bshape = [1] * x.ndim
         bshape[1 if x.ndim > 2 else -1] = self.n_output
         new_S = None
+        # statistics ALWAYS accumulate in f32: under the BF16_ACT policy x
+        # is bfloat16 and a bf16 mean over N*H*W elements loses the tail
+        x32 = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
         if ctx.training:
-            mean = x.mean(axis=axes)
-            var = x.var(axis=axes)
+            mean = x32.mean(axis=axes)
+            var = x32.var(axis=axes)
             n = x.size / self.n_output
             unbiased = var * (n / max(n - 1, 1.0))
             new_S = {
@@ -71,7 +74,9 @@ class BatchNormalization(TensorModule):
         if self.affine:
             scale = scale * P["weight"]
             shift = shift * P["weight"] + P["bias"]
-        y = x * scale.reshape(bshape) + shift.reshape(bshape)
+        # scale/shift are f32; keep the big (N,C,H,W) buffer in x's dtype
+        y = (x * scale.astype(x.dtype).reshape(bshape)
+             + shift.astype(x.dtype).reshape(bshape))
         return (y[0] if was_unbatched else y), new_S
 
     def __repr__(self):

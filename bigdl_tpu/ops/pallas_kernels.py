@@ -20,6 +20,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -169,3 +170,161 @@ def lstm_scan(zx, wht, h0, c0, interpret=False):
                         pltpu.VMEM((b, h), jnp.float32)],
         interpret=interpret,
     )(zx, wht, h0, c0)
+
+
+# ------------------------------------------------------------- max pooling
+#
+# XLA's reduce_window forward and especially its select-and-scatter VJP
+# run far below HBM bandwidth on v5e (PROFILE_inception.md round 3: pool
+# fwd+bwd = 7.9 ms of a 40 ms Inception step at ZERO useful FLOPs, while
+# an isolated streaming op moves the same bytes ~5x faster).  These
+# kernels compute the same maxpool (and its first-max-wins gradient, the
+# select-and-scatter tie rule) as a handful of VMEM slice/max/add passes.
+#
+# Layout: NCHW collapsed to (N*C, H, W) rows; grid over row-blocks, each
+# block (BC, H, W) resident in VMEM with W on lanes and H on sublanes.
+# STRIDE-1 windows only: every window read/write is then a unit-stride
+# VMEM slice (Mosaic forbids strided slices and the reshape that a
+# phase-decomposition of strided pools would need); strided pools stay
+# on the XLA path, whose select-and-scatter cost is acceptable there
+# because strided windows barely overlap.
+
+
+def _mp_out_size(size, k, s, pl_, ph_):
+    return (size + pl_ + ph_ - k) // s + 1
+
+
+def _maxpool_fwd_kernel(x_ref, y_ref, *, kh, kw, pads):
+    (plh, phh), (plw, phw) = pads
+    # compute in f32: this Mosaic target lacks bf16 vector compares
+    x = x_ref[:].astype(jnp.float32)
+    neg = jnp.asarray(-jnp.inf, jnp.float32)
+    xp = jnp.pad(x, ((0, 0), (plh, phh), (plw, phw)), constant_values=neg)
+    bc = x.shape[0]
+    oh = x.shape[1] + plh + phh - kh + 1
+    ow = x.shape[2] + plw + phw - kw + 1
+    y = None
+    for i in range(kh):
+        for j in range(kw):
+            s = lax.slice(xp, (0, i, j), (bc, i + oh, j + ow))
+            y = s if y is None else jnp.maximum(y, s)
+    y_ref[:] = y.astype(y_ref.dtype)
+
+
+def _maxpool_bwd_kernel(x_ref, g_ref, dx_ref, *, kh, kw, pads):
+    """First-max-wins gradient (select-and-scatter scan order: row-major
+    over window offsets)."""
+    (plh, phh), (plw, phw) = pads
+    # compute in f32: this Mosaic target lacks bf16 vector compares
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    neg = jnp.asarray(-jnp.inf, jnp.float32)
+    xp = jnp.pad(x, ((0, 0), (plh, phh), (plw, phw)), constant_values=neg)
+    bc, hp, wp = xp.shape
+    oh, ow = g.shape[1], g.shape[2]
+    y = None
+    for i in range(kh):
+        for j in range(kw):
+            s = lax.slice(xp, (0, i, j), (bc, i + oh, j + ow))
+            y = s if y is None else jnp.maximum(y, s)
+    accp = jnp.zeros((bc, hp, wp), jnp.float32)
+    claimed = jnp.zeros(g.shape, jnp.bool_)
+    for i in range(kh):
+        for j in range(kw):
+            # re-slice instead of caching all kh*kw windows: keeps the
+            # kernel's live VMEM set to ~6 frames
+            s = lax.slice(xp, (0, i, j), (bc, i + oh, j + ow))
+            m = (s == y) & ~claimed
+            claimed = claimed | m
+            contrib = g * m.astype(jnp.float32)
+            accp = accp + lax.pad(contrib, jnp.asarray(0, jnp.float32),
+                                  ((0, 0, 0), (i, hp - oh - i, 0),
+                                   (j, wp - ow - j, 0)))
+    dx_ref[:] = lax.slice(accp, (0, plh, plw),
+                          (bc, plh + x.shape[1], plw + x.shape[2])
+                          ).astype(dx_ref.dtype)
+
+
+def _pick_bc(nc, h, w, dtype, arrays=8):
+    """Largest row-block that divides nc and keeps ~arrays copies of the
+    (BC, H, W) frame under the ~16 MB scoped-VMEM budget (with margin
+    for Mosaic's own temporaries)."""
+    budget = 6 * 1024 * 1024
+    lanes = -(-(w + 4) // 128) * 128  # Mosaic pads the lane dim to 128
+    # frames are upcast to f32 inside the kernels regardless of input dtype
+    del dtype
+    per_row = (h + 4) * lanes * 4 * arrays
+    bc = max(1, min(nc, budget // max(per_row, 1)))
+    while nc % bc:
+        bc -= 1
+    return bc
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("window", "strides", "pads", "interpret"))
+def _maxpool_fwd_call(x, window, strides, pads, interpret=False):
+    n, c, h, w = x.shape
+    kh, kw = window
+    assert strides == (1, 1), "pallas maxpool2d is stride-1 only"
+    oh = _mp_out_size(h, kh, 1, *pads[0])
+    ow = _mp_out_size(w, kw, 1, *pads[1])
+    nc = n * c
+    bc = _pick_bc(nc, h, w, x.dtype)
+    xr = x.reshape(nc, h, w)
+    y = pl.pallas_call(
+        functools.partial(_maxpool_fwd_kernel, kh=kh, kw=kw, pads=pads),
+        grid=(nc // bc,),
+        in_specs=[pl.BlockSpec((bc, h, w), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bc, oh, ow), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nc, oh, ow), x.dtype),
+        interpret=interpret,
+    )(xr)
+    return y.reshape(n, c, oh, ow)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("window", "strides", "pads", "interpret"))
+def _maxpool_bwd_call(x, g, window, strides, pads, interpret=False):
+    n, c, h, w = x.shape
+    kh, kw = window
+    assert strides == (1, 1), "pallas maxpool2d is stride-1 only"
+    nc = n * c
+    oh, ow = g.shape[2], g.shape[3]
+    bc = _pick_bc(nc, h, w, x.dtype, arrays=8)
+    dx = pl.pallas_call(
+        functools.partial(_maxpool_bwd_kernel, kh=kh, kw=kw, pads=pads),
+        grid=(nc // bc,),
+        in_specs=[pl.BlockSpec((bc, h, w), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((bc, oh, ow), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((bc, h, w), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nc, h, w), x.dtype),
+        interpret=interpret,
+    )(x.reshape(nc, h, w), g.reshape(nc, oh, ow))
+    return dx.reshape(n, c, h, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def maxpool2d(x, window, strides, pads, interpret=False):
+    """NCHW maxpool with Pallas forward AND first-max backward.
+
+    ``pads`` = ((lo_h, hi_h), (lo_w, hi_w)) explicit amounts (Torch
+    ceil-mode handled by the caller, nn/pooling.py).  Gradient tie rule
+    matches XLA select-and-scatter (first max in row-major window order).
+    """
+    return _maxpool_fwd_call(x, window, strides, pads, interpret)
+
+
+def _maxpool_vjp_fwd(x, window, strides, pads, interpret=False):
+    return _maxpool_fwd_call(x, window, strides, pads, interpret), x
+
+
+def _maxpool_vjp_bwd(window, strides, pads, interpret, x, g):
+    return (_maxpool_bwd_call(x, g, window, strides, pads, interpret),)
+
+
+maxpool2d.defvjp(_maxpool_vjp_fwd, _maxpool_vjp_bwd)

@@ -49,6 +49,11 @@ class DTypePolicy:
 
 FP32 = DTypePolicy()
 BF16_COMPUTE = DTypePolicy(compute_dtype=jnp.bfloat16)
+# Full bf16 activation flow: conv/matmul OUTPUTS stay bf16, so every
+# downstream buffer (pool windows, ReLU, BN apply, concat, LAYOUT copies)
+# moves half the HBM bytes.  Params, gradients, BN statistics and the
+# loss stay f32 (BN accumulates in f32 explicitly; LogSoftMax upcasts).
+BF16_ACT = DTypePolicy(compute_dtype=jnp.bfloat16, output_dtype=jnp.bfloat16)
 
 _POLICY = FP32
 

@@ -1,7 +1,9 @@
 """Pallas kernel tests (interpret mode on the CPU mesh)."""
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from bigdl_tpu.ops.pallas_kernels import fused_sgd
 
@@ -62,3 +64,38 @@ def test_fused_sgd_nonaligned_size():
     v = {"x": jnp.zeros(100)}
     p2, v2 = fused_sgd(p, g, v, lr=1.0)
     np.testing.assert_allclose(np.asarray(p2["x"]), np.arange(100.0) - 1.0)
+
+
+class TestPallasMaxPool:
+    """Stride-1 Pallas maxpool (ops/pallas_kernels.maxpool2d): exact
+    forward + first-max-wins gradient vs reduce_window/select-and-scatter
+    autodiff, including tie positions (coarsely quantized inputs).  Kept
+    as measured evidence — NOT wired into nn/pooling.py (10-50x slower
+    than the XLA emitter on TPU, PERF_NOTES round 3)."""
+
+    @pytest.mark.parametrize("shape,win,pads", [
+        ((2, 4, 14, 14), (3, 3), ((1, 1), (1, 1))),
+        ((1, 2, 8, 8), (3, 3), ((1, 1), (1, 1))),
+        ((2, 3, 10, 12), (2, 2), ((0, 1), (1, 0))),
+    ])
+    def test_fwd_bwd_vs_xla(self, shape, win, pads):
+        from bigdl_tpu.ops.pallas_kernels import maxpool2d
+        interpret = jax.devices()[0].platform != "tpu"
+
+        def ref_pool(x):
+            return lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 1) + win, (1, 1, 1, 1),
+                ((0, 0), (0, 0)) + pads)
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(np.round(rs.randn(*shape) * 2) / 2, jnp.float32)
+        y_ref = ref_pool(x)
+        y = maxpool2d(x, win, (1, 1), pads, interpret)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref))
+
+        g = jnp.asarray(rs.randn(*y_ref.shape).astype(np.float32))
+        d_ref = jax.grad(lambda v: (ref_pool(v) * g).sum())(x)
+        d = jax.grad(
+            lambda v: (maxpool2d(v, win, (1, 1), pads, interpret) * g).sum())(x)
+        np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref),
+                                   rtol=1e-5, atol=1e-5)

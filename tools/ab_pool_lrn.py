@@ -170,6 +170,91 @@ def run_lrn_ab(batch=128, dtype=jnp.float32):
         print(row)
 
 
+
+
+# ------------------------------------------------- shifted-slices maxpool
+
+def shift_pool(x, window, strides, padding):
+    """Maxpool as a folded maximum over kh*kw strided shifted slices —
+    pure eltwise ops the fuser can handle, no reduce_window/select-and-
+    scatter emitter.  Autodiff backward = chain of eltwise select grads."""
+    kh, kw = window
+    dh, dw = strides
+    (plh, phh), (plw, phw) = padding
+    neg = jnp.asarray(-jnp.inf, x.dtype)
+    xp = jnp.pad(x, ((0, 0), (0, 0), (plh, phh), (plw, phw)),
+                 constant_values=neg)
+    b, c, hp, wp = xp.shape
+    oh = (hp - kh) // dh + 1
+    ow = (wp - kw) // dw + 1
+    y = None
+    for i in range(kh):
+        for j in range(kw):
+            s = lax.slice(xp, (0, 0, i, j),
+                          (b, c, i + (oh - 1) * dh + 1, j + (ow - 1) * dw + 1),
+                          (1, 1, dh, dw))
+            y = s if y is None else jnp.maximum(y, s)
+    return y
+
+
+def run_pool_variant_ab(candidate, label, batch=128, dtype=jnp.float32):
+    """A/B an alternative maxpool implementation vs the shipped
+    reduce_window/select-and-scatter path on every Inception pool shape.
+
+    NOTE (round 3): this chained-fori_loop harness serializes on its
+    dependency chain (~280 GB/s ceiling vs 662+ GB/s isolated), so treat
+    small deltas as noise — use tools/profile_step._trace_device_ops for
+    sub-ms decisions (PERF_NOTES "Round-3 MFU attack")."""
+    rs = np.random.RandomState(0)
+    from bigdl_tpu.nn.pooling import _max_pool2d
+    print("%-34s %10s %10s" % ("maxpool case", "s&s ms", label + " ms"))
+    tot_a = tot_b = 0.0
+    for shape, window, strides, padding in pool_cases(batch):
+        x = jnp.asarray(np.maximum(rs.randn(*shape), 0), dtype)
+
+        def loss_sas(v):
+            return (_max_pool2d(v, window, strides, padding)
+                    .astype(jnp.float32) ** 2).sum()
+
+        def loss_cand(v):
+            return (candidate(v, window, strides, padding)
+                    .astype(jnp.float32) ** 2).sum()
+
+        ta = timeit_grad(jax.grad(loss_sas), x)
+        tb = timeit_grad(jax.grad(loss_cand), x)
+        tot_a += ta
+        tot_b += tb
+        print("%-34s %10.3f %10.3f" % (
+            "%s k%s s%s" % (shape, window, strides), ta, tb))
+    print("%-34s %10.3f %10.3f" % ("TOTAL", tot_a, tot_b))
+
+
+def sep_pool(x, window, strides, padding):
+    """Separable maxpool: 1-D row-window max then 1-D column-window max.
+    max is associative so the result is exact; each pass gives the
+    emitter a tiny 1-D window, and the VJP becomes two 1-D
+    select-and-scatters."""
+    kh, kw = window
+    dh, dw = strides
+    (plh, phh), (plw, phw) = padding
+    y = lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 1, 1, kw), window_strides=(1, 1, 1, dw),
+        padding=((0, 0), (0, 0), (0, 0), (plw, phw)))
+    return lax.reduce_window(
+        y, -jnp.inf, lax.max,
+        window_dimensions=(1, 1, kh, 1), window_strides=(1, 1, dh, 1),
+        padding=((0, 0), (0, 0), (plh, phh), (0, 0)))
+
+
+def run_shift_ab(batch=128, dtype=jnp.float32):
+    run_pool_variant_ab(shift_pool, "shift", batch, dtype)
+
+
+def run_sep_ab(batch=128, dtype=jnp.float32):
+    run_pool_variant_ab(sep_pool, "sep", batch, dtype)
+
+
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
     dtype = jnp.bfloat16 if (len(sys.argv) > 2 and sys.argv[2] == "bf16") else jnp.float32
@@ -177,3 +262,7 @@ if __name__ == "__main__":
         run_pool_ab(dtype=dtype)
     if which in ("lrn", "all"):
         run_lrn_ab(dtype=dtype)
+    if which in ("shift", "all"):
+        run_shift_ab(dtype=dtype)
+    if which in ("sep", "all"):
+        run_sep_ab(dtype=dtype)

@@ -29,7 +29,6 @@ import collections
 import glob
 import gzip
 import json
-import math
 import re
 import sys
 import tempfile
@@ -240,7 +239,12 @@ def build_step(model_name: str, batch: int):
     from bigdl_tpu.utils.random import set_seed
 
     set_seed(1)
-    bt.set_policy(bt.BF16_COMPUTE)
+    import os as _o
+    pol = _o.environ.get("BIGDL_POLICY", "BF16_COMPUTE")
+    if pol not in ("FP32", "BF16_COMPUTE", "BF16_ACT"):
+        raise SystemExit("BIGDL_POLICY must be one of FP32/BF16_COMPUTE/"
+                         "BF16_ACT, got %r" % pol)
+    bt.set_policy(getattr(bt, pol))
 
     if model_name == "inception":
         from bigdl_tpu.models.inception import Inception_v1
