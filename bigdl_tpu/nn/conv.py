@@ -49,8 +49,6 @@ def _maybe_batch(x):
     return x, False
 
 
-
-
 _S2D_STEM = True  # isolated win, end-to-end neutral on Inception (PERF_NOTES); helps ResNet/AlexNet stems
 
 

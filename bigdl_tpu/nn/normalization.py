@@ -55,10 +55,10 @@ class BatchNormalization(TensorModule):
         bshape = [1] * x.ndim
         bshape[1 if x.ndim > 2 else -1] = self.n_output
         new_S = None
-        # statistics ALWAYS accumulate in f32: under the BF16_ACT policy x
-        # is bfloat16 and a bf16 mean over N*H*W elements loses the tail
-        x32 = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
         if ctx.training:
+            # statistics accumulate in f32: under the BF16_ACT policy x is
+            # bfloat16 and a bf16 mean over N*H*W elements loses the tail
+            x32 = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
             mean = x32.mean(axis=axes)
             var = x32.var(axis=axes)
             n = x.size / self.n_output

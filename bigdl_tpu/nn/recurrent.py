@@ -110,14 +110,15 @@ class LSTMCell(Cell):
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
         return h_new, (h_new, c_new)
 
-    # NOTE (measured, PERF_NOTES round 2): splitting the cell gemm into a
-    # precomputed (N*T, D) input projection + an (N, H) recurrent gemm in
-    # the scan body ran 40% SLOWER than this single concat-gemm per step on
-    # v5e (21.3 vs 15.3 ms fwd at B128 T500 D200 H128) — the per-step cost
-    # is launch/latency-dominated, so shrinking the matmul buys nothing and
-    # the projected activations add 260 MB of HBM traffic.  A full Pallas
+    # NOTE (round-3 correction): round 2 measured a hoisted input
+    # projection "40% slower" with the chained wall-clock harness; the
+    # device-clock trace reverses that verdict — the hoisted projection
+    # is FASTER and ships in BiRecurrent._apply_fused_lstm (PERF_NOTES
+    # round 3 "LSTM").  The single-direction path here keeps the
+    # concat-gemm body (simplest form; the win comes from direction
+    # batching, which needs the bidirectional wrapper).  A full Pallas
     # scan kernel (ops/pallas_kernels.lstm_scan) measured within 1% of
-    # lax.scan.  Both alternatives retired; lax.scan over this cell stands.
+    # lax.scan and stays retired.
 
 
 class GRUCell(Cell):
@@ -213,11 +214,77 @@ class BiRecurrent(Container):
         self.add(Recurrent(bptt_truncate).add(cell_fwd))
         self.add(Recurrent(bptt_truncate, reverse=True).add(cell_bwd))
 
+    def _fused_lstm_eligible(self):
+        cf = self.modules[0].cell
+        cb = self.modules[1].cell
+        return (type(cf) is LSTMCell and type(cb) is LSTMCell
+                and cf.input_size == cb.input_size
+                and cf.hidden_size == cb.hidden_size
+                and self.modules[0].bptt_truncate <= 0
+                and self.modules[1].bptt_truncate <= 0)
+
     def apply(self, params, x, state, ctx):
+        if self._fused_lstm_eligible():
+            y = self._apply_fused_lstm(params, x, ctx)
+            return y, state
         yf, sf = self.modules[0].apply(params["0"], x, state["0"], ctx)
         yb, sb = self.modules[1].apply(params["1"], x, state["1"], ctx)
         y = jnp.concatenate([yf, yb], axis=-1) if self.merge == "concat" else yf + yb
         return y, {"~": state.get("~", {}), "0": sf, "1": sb}
+
+    def _apply_fused_lstm(self, params, x, ctx):
+        """Both directions in ONE scan with the input projection hoisted
+        out: per timestep only one direction-batched (2, N, H) x
+        (2, H, 4H) recurrent gemm; the (T*N, D) x (D, 4H) input
+        projection runs as one big MXU matmul outside the loop.
+
+        Measured on the BASELINE Bi-LSTM config (B128 T500 D200 H128,
+        v5e, DEVICE-clock trace timing): two-scan 13.75 ms/step ->
+        direction-batched concat-gemm 11.70 -> + hoisted projection
+        ~10.1 ms (1.36x).  The remaining floor is the serial recurrence
+        itself: gemm-only scan body = 1.3 us/step, full cell = 3.5
+        us/step fwd; see PERF_NOTES round 3 "LSTM".  Exact same math as
+        the two-scan path (equivalence-tested incl. gradients).
+
+        NOTE: round 2 rejected the hoisted projection as "40% slower" —
+        that measurement came from the chained-wall-clock harness whose
+        serialization noise exceeded the effect; the device-clock trace
+        reverses the verdict."""
+        cf = self.modules[0].cell
+        p = policy()
+        n, t = x.shape[0], x.shape[1]
+        hdim = cf.hidden_size
+        d = cf.input_size
+        w2 = jnp.stack([params["0"]["0"]["~"]["w"],
+                        params["1"]["0"]["~"]["w"]])      # (2, 4H, D+H)
+        b2 = jnp.stack([params["0"]["0"]["~"]["bias"],
+                        params["1"]["0"]["~"]["bias"]])
+        wx = p.cast_compute(jnp.swapaxes(w2[:, :, :d], 1, 2))  # (2, D, 4H)
+        wh = p.cast_compute(jnp.swapaxes(w2[:, :, d:], 1, 2))  # (2, H, 4H)
+        xs = jnp.swapaxes(x, 0, 1)                        # (T, N, D)
+        xs2 = jnp.stack([xs, jnp.flip(xs, axis=0)], axis=1)  # (T, 2, N, D)
+        # input projection for every timestep in one batched matmul
+        zx = lax.dot_general(p.cast_compute(xs2), wx,
+                             (((3,), (1,)), ((1,), (0,))),
+                             preferred_element_type=jnp.float32)
+        zx = jnp.swapaxes(zx, 0, 1) + b2[:, None]         # (T, 2, N, 4H)
+        z0 = jnp.zeros((2, n, hdim))
+
+        def step(carry, zx_t):
+            h, c = carry
+            z = zx_t + lax.dot_general(p.cast_compute(h), wh,
+                                       (((2,), (1,)), ((0,), (0,))),
+                                       preferred_element_type=jnp.float32)
+            z = z.astype(p.output_dtype)
+            h_new, hc = LSTMCell._gates(z, c)
+            return hc, h_new
+
+        _, outs = lax.scan(step, (z0, z0), zx)            # (T, 2, N, H)
+        yf = jnp.swapaxes(outs[:, 0], 0, 1)               # (N, T, H)
+        yb = jnp.swapaxes(jnp.flip(outs[:, 1], axis=0), 0, 1)
+        if self.merge == "concat":
+            return jnp.concatenate([yf, yb], axis=-1)
+        return yf + yb
 
 
 class TimeDistributed(Container):

@@ -245,14 +245,13 @@ def _maxpool_bwd_kernel(x_ref, g_ref, dx_ref, *, kh, kw, pads):
                           ).astype(dx_ref.dtype)
 
 
-def _pick_bc(nc, h, w, dtype, arrays=8):
-    """Largest row-block that divides nc and keeps ~arrays copies of the
-    (BC, H, W) frame under the ~16 MB scoped-VMEM budget (with margin
-    for Mosaic's own temporaries)."""
+def _pick_bc(nc, h, w, arrays=8):
+    """Largest row-block that divides nc and keeps ~arrays f32 copies of
+    the (BC, H, W) frame under a 6 MB budget — deliberately well under
+    the ~16 MB scoped-VMEM limit to leave room for Mosaic's own
+    temporaries (frames are upcast to f32 inside the kernels)."""
     budget = 6 * 1024 * 1024
     lanes = -(-(w + 4) // 128) * 128  # Mosaic pads the lane dim to 128
-    # frames are upcast to f32 inside the kernels regardless of input dtype
-    del dtype
     per_row = (h + 4) * lanes * 4 * arrays
     bc = max(1, min(nc, budget // max(per_row, 1)))
     while nc % bc:
@@ -269,7 +268,7 @@ def _maxpool_fwd_call(x, window, strides, pads, interpret=False):
     oh = _mp_out_size(h, kh, 1, *pads[0])
     ow = _mp_out_size(w, kw, 1, *pads[1])
     nc = n * c
-    bc = _pick_bc(nc, h, w, x.dtype)
+    bc = _pick_bc(nc, h, w)
     xr = x.reshape(nc, h, w)
     y = pl.pallas_call(
         functools.partial(_maxpool_fwd_kernel, kh=kh, kw=kw, pads=pads),
@@ -292,7 +291,7 @@ def _maxpool_bwd_call(x, g, window, strides, pads, interpret=False):
     assert strides == (1, 1), "pallas maxpool2d is stride-1 only"
     nc = n * c
     oh, ow = g.shape[2], g.shape[3]
-    bc = _pick_bc(nc, h, w, x.dtype, arrays=8)
+    bc = _pick_bc(nc, h, w, arrays=8)
     dx = pl.pallas_call(
         functools.partial(_maxpool_bwd_kernel, kh=kh, kw=kw, pads=pads),
         grid=(nc // bc,),

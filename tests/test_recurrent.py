@@ -116,3 +116,40 @@ def test_recurrent_grad_flows_through_scan():
     leaves = jax.tree_util.tree_leaves(grads)
     assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
     assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
+
+
+def test_bilstm_fused_matches_two_scan():
+    """The direction-batched single-scan Bi-LSTM path must match the
+    two-scan reference path exactly (same params, same input)."""
+    from bigdl_tpu.nn.module import Context
+    import jax
+
+    from bigdl_tpu.utils.random import set_seed
+    set_seed(5)
+    m = nn.BiRecurrent(nn.LSTMCell(6, 5), nn.LSTMCell(6, 5))
+    assert m._fused_lstm_eligible()
+    x = jnp.asarray(np.random.RandomState(1).randn(3, 7, 6), np.float32)
+    ctx = Context(training=False, key=jax.random.PRNGKey(0))
+    params, state = m.params(), m.state()
+    y_fused = m._apply_fused_lstm(params, x, ctx)
+    yf, _ = m.modules[0].apply(params["0"], x, state["0"], ctx)
+    yb, _ = m.modules[1].apply(params["1"], x, state["1"], ctx)
+    y_ref = jnp.concatenate([yf, yb], axis=-1)
+    np.testing.assert_allclose(np.asarray(y_fused), np.asarray(y_ref),
+                               rtol=1e-5, atol=1e-6)
+
+    # gradients agree too
+    def loss_fused(p):
+        return (m._apply_fused_lstm(p, x, ctx) ** 2).sum()
+
+    def loss_ref(p):
+        a, _ = m.modules[0].apply(p["0"], x, state["0"], ctx)
+        b, _ = m.modules[1].apply(p["1"], x, state["1"], ctx)
+        return (jnp.concatenate([a, b], axis=-1) ** 2).sum()
+
+    g1 = jax.grad(loss_fused)(params)
+    g2 = jax.grad(loss_ref)(params)
+    for l1, l2 in zip(jax.tree_util.tree_leaves(g1),
+                      jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-4, atol=1e-5)

@@ -239,8 +239,7 @@ def build_step(model_name: str, batch: int):
     from bigdl_tpu.utils.random import set_seed
 
     set_seed(1)
-    import os as _o
-    pol = _o.environ.get("BIGDL_POLICY", "BF16_COMPUTE")
+    pol = _os.environ.get("BIGDL_POLICY", "BF16_COMPUTE")
     if pol not in ("FP32", "BF16_COMPUTE", "BF16_ACT"):
         raise SystemExit("BIGDL_POLICY must be one of FP32/BF16_COMPUTE/"
                          "BF16_ACT, got %r" % pol)
