@@ -49,9 +49,10 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
     mesh (tests/test_pipeline_moe.py::test_pipeline_remat_memory):
     compiled temp memory for a 4-stage x 3-layer-MLP pipeline drops 2.4x.
     GPipe liveness caveat: even with remat, boundary activations for all
-    in-flight microbatches are saved per tick — a 1F1B schedule (not
-    implemented) would cap that at n_stage instead of n_micro + P - 1;
-    docs/distributed.md records the cost model.
+    in-flight microbatches are saved per tick — O(n_micro + P - 1) per
+    stage.  ``pipeline_train_1f1b`` below implements the 1F1B schedule,
+    which bounds that at ~2(P-1)+1 independent of n_micro;
+    docs/distributed.md records both cost models.
     """
     n_stage = mesh.shape[axis]
     if remat:
@@ -106,3 +107,117 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
 def stack_stage_params(per_stage_params):
     """[stage0_tree, stage1_tree, ...] -> one tree with leading dim P."""
     return jax.tree_util.tree_map(lambda *vs: jnp.stack(vs), *per_stage_params)
+
+
+def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
+                        mesh: Mesh, axis: str = "pipe"):
+    """1F1B pipeline schedule: forward and backward interleaved so each
+    stage keeps at most ~2*(P-1)+1 in-flight microbatch activations —
+    independent of the microbatch count — where GPipe's autodiff keeps
+    n_micro + P - 1 per stage (pipeline_apply docstring).
+
+    Schedule (combined tick k, stage r, P = n_stage):
+      - forward of microbatch  mf = k - r
+      - backward of microbatch mb = k - (2*(P-1) - r)
+    so the last stage backwards a microbatch the same tick it forwards
+    it (loss cotangent computed in place), and stage r's backward runs
+    one tick before stage r-1's — the activation gradient rides the
+    reverse ring.  Total ticks = n_micro + 2*(P-1).
+
+    Residuals: only each stage's INPUT activation per in-flight
+    microbatch is buffered (circular buffer, depth 2*P); the stage is
+    recomputed inside ``jax.vjp`` at backward time — the same
+    fwd+recompute+bwd = 3 stage evaluations per microbatch per stage
+    that GPipe-with-remat costs, but with the bounded buffer.
+
+    stage_fn(params_slice, x) -> y   (activation shapes preserved)
+    loss_fn(y_last, target) -> scalar (per microbatch; mean over
+    microbatches is applied here)
+    Returns (mean_loss, grads) with grads shaped like ``stage_params``
+    (leading dim P, stage-sharded like the input).
+    """
+    n_stage = mesh.shape[axis]
+    n_micro = x_micro.shape[0]
+    depth = 2 * n_stage  # circular residual buffer, >= max in-flight + 1
+
+    def ranked(params, x_all, t_all):
+        my_params = jax.tree_util.tree_map(lambda v: v[0], params)
+        rank = lax.axis_index(axis)
+        n_ticks = n_micro + 2 * (n_stage - 1)
+        fwd_ring = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+        bwd_ring = [(i, (i - 1) % n_stage) for i in range(n_stage)]
+
+        micro_shape = x_all.shape[1:]
+        zeros_micro = jnp.zeros(micro_shape, x_all.dtype)
+        buf_fwd = pvary(zeros_micro, (axis,))          # fwd ring carry
+        buf_bwd = pvary(zeros_micro, (axis,))          # bwd ring carry
+        resid = pvary(jnp.zeros((depth,) + micro_shape, x_all.dtype),
+                      (axis,))                         # saved stage inputs
+        # my_params are already device-varying (stage-sharded), so zeros
+        # derived from them are too — no pvary needed (pcast would reject)
+        grad_acc = jax.tree_util.tree_map(jnp.zeros_like, my_params)
+        loss_acc = pvary(jnp.zeros((), jnp.float32), (axis,))
+
+        def tick(carry, k):
+            buf_fwd, buf_bwd, resid, grad_acc, loss_acc = carry
+
+            # ---------------- forward half ----------------
+            mf = k - rank
+            f_valid = (mf >= 0) & (mf < n_micro)
+            inject = x_all[jnp.clip(mf, 0, n_micro - 1)]
+            cur = jnp.where(rank == 0, inject, buf_fwd)
+            y = stage_fn(my_params, cur)
+            resid = lax.dynamic_update_index_in_dim(
+                resid, jnp.where(f_valid, cur, zeros_micro),
+                jnp.maximum(mf, 0) % depth, 0)
+            buf_fwd_next = lax.ppermute(
+                jnp.where(f_valid, y, jnp.zeros_like(y)), axis, fwd_ring)
+
+            # ---------------- backward half ----------------
+            mb = k - (2 * (n_stage - 1) - rank)
+            b_valid = (mb >= 0) & (mb < n_micro)
+            x_saved = resid[jnp.maximum(mb, 0) % depth]
+            tgt = t_all[jnp.clip(mb, 0, n_micro - 1)]
+            is_last = rank == n_stage - 1
+
+            # ONE stage vjp per tick: recompute the stage forward, then
+            # pick the cotangent — the loss gradient (last stage; from a
+            # cheap vjp of loss_fn alone on the recomputed y) or the
+            # incoming activation gradient off the reverse ring.  Static
+            # structure on every rank/tick, 3 stage evals per microbatch
+            # total (fwd half + recompute + bwd) as documented.
+            y_re, stage_vjp = jax.vjp(stage_fn, my_params, x_saved)
+            loss_val, loss_vjp = jax.vjp(
+                lambda yy: loss_fn(yy, tgt) / n_micro, y_re)
+            one = pvary(jnp.ones((), loss_val.dtype), (axis,))
+            (dy,) = loss_vjp(one)
+            cot = jnp.where(is_last, dy, buf_bwd)
+            gp, gx = stage_vjp(cot)
+
+            # jnp.where masking (NOT multiply-by-mask): a vjp evaluated
+            # on the zeroed residual of a fill/drain tick may be
+            # non-finite, and NaN * 0 would poison the accumulator
+            grad_acc = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(b_valid, g,
+                                               jnp.zeros_like(g)),
+                grad_acc, gp)
+            loss_acc = loss_acc + jnp.where(
+                is_last & b_valid, loss_val.astype(jnp.float32), 0.0)
+            buf_bwd_next = lax.ppermute(
+                jnp.where(b_valid, gx, jnp.zeros_like(gx)), axis, bwd_ring)
+
+            return (buf_fwd_next, buf_bwd_next, resid, grad_acc,
+                    loss_acc), None
+
+        carry = (buf_fwd, buf_bwd, resid, grad_acc, loss_acc)
+        carry, _ = lax.scan(tick, carry, jnp.arange(n_ticks))
+        _, _, _, grad_acc, loss_acc = carry
+        loss = lax.psum(loss_acc, axis)  # only last rank contributed
+        grads = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
+        return loss, grads
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    f = jax.shard_map(ranked, mesh=mesh,
+                      in_specs=(pspec, P(), P()),
+                      out_specs=(P(), pspec))
+    return f(stage_params, x_micro, t_micro)
