@@ -212,7 +212,11 @@ class DistriOptimizer(LocalOptimizer):
     def _state_trees(self):
         params = self.model.params()
         net_state = self.model.state()
-        opt_state = self.optim_method.init_state(params)
+        if self._resume_opt_state is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                               self._resume_opt_state)
+        else:
+            opt_state = self.optim_method.init_state(params)
         return params, net_state, opt_state
 
     def _build_step(self):
@@ -240,7 +244,11 @@ class DistriOptimizer(LocalOptimizer):
 
         params = jax.tree_util.tree_map(jnp.copy, self.model.params())
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
-        opt_state = self.optim_method.init_state(params)
+        if self._resume_opt_state is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                               self._resume_opt_state)
+        else:
+            opt_state = self.optim_method.init_state(params)
         step_fn = self._build_step()
 
         count = 0
@@ -255,7 +263,10 @@ class DistriOptimizer(LocalOptimizer):
                 x, y = self._device_put_batch(batch.data, batch.labels)
                 global_b = x.shape[0]
 
-            with self.metrics.timer("computing time average"):
+            # distributed: summary() adds the per-process breakdown, the
+            # reference's "computing time for each node" accumulator
+            with self.metrics.timer("computing time average",
+                                    distributed=True):
                 lr = self._current_lr()
                 key = RNG.next_key()
                 params, net_state, opt_state, loss = step_fn(

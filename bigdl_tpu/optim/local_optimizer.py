@@ -49,6 +49,7 @@ class LocalOptimizer:
         self.checkpoint_path = None
         self.metrics = Metrics()
         self.remat = False
+        self._resume_opt_state = None
 
     def set_gradient_checkpointing(self, enabled: bool = True):
         """Rematerialize the forward inside backward (``jax.checkpoint``):
@@ -65,6 +66,16 @@ class LocalOptimizer:
 
     def set_optim_method(self, method: OptimMethod):
         self.optim_method = method
+        return self
+
+    def set_optim_state(self, opt_state):
+        """Restore the optimizer's internal state (momentum velocity
+        etc.) from a ``state.N`` snapshot's ``opt_state`` entry — without
+        this a momentum run resumes with zeroed velocity and diverges
+        from the uninterrupted trajectory (ref: state Table + internal
+        buffers both persist through Optimizer.saveState,
+        OptimMethod.scala clearHistory/state)."""
+        self._resume_opt_state = opt_state
         return self
 
     def set_end_when(self, end_when):
@@ -167,7 +178,11 @@ class LocalOptimizer:
         # holding deleted arrays mid-training
         params = jax.tree_util.tree_map(jnp.copy, self.model.params())
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
-        opt_state = self.optim_method.init_state(params)
+        if self._resume_opt_state is not None:
+            opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                               self._resume_opt_state)
+        else:
+            opt_state = self.optim_method.init_state(params)
         step_fn = self._build_step()
 
         count = 0

@@ -2,9 +2,12 @@
 
 Named counters for per-iteration phase breakdown ("computing time for each
 node", "aggregate gradient time", "get weights average" —
-DistriOptimizer.scala:114-118).  The reference aggregates via Spark
-accumulators; here values are host-side floats (per-process), merged across
-hosts by the distributed optimizer when needed.
+DistriOptimizer.scala:114-118).  The reference keeps THREE kinds of entry
+(Metrics.scala: local / aggregate / distributed, where "distributed"
+carries one value per node via Spark accumulators); here values are
+host-side floats per process, and entries marked distributed gather one
+mean per jax process on demand (the accumulator role is
+``multihost_utils.process_allgather``).
 """
 from __future__ import annotations
 
@@ -17,14 +20,19 @@ class Metrics:
     def __init__(self):
         self._sums = defaultdict(float)
         self._counts = defaultdict(int)
+        self._distributed = set()
 
-    def set(self, name: str, value: float):
+    def set(self, name: str, value: float, distributed: bool = False):
         self._sums[name] = value
         self._counts[name] = 1
+        if distributed:
+            self._distributed.add(name)
 
-    def add(self, name: str, value: float):
+    def add(self, name: str, value: float, distributed: bool = False):
         self._sums[name] += value
         self._counts[name] += 1
+        if distributed:
+            self._distributed.add(name)
 
     def get(self, name: str):
         return self._sums[name], self._counts[name]
@@ -32,20 +40,48 @@ class Metrics:
     def mean(self, name: str) -> float:
         return self._sums[name] / max(self._counts[name], 1)
 
+    def per_node(self, name: str):
+        """One mean per jax PROCESS (the reference's per-node accumulator
+        readout, Metrics.scala "computing time for each node" consumed by
+        DistriOptimizer.scala:114-118).  Single-process: a 1-list."""
+        import jax
+        local = self.mean(name)
+        if jax.process_count() == 1:
+            return [local]
+        import numpy as np
+        from jax.experimental import multihost_utils
+        vals = multihost_utils.process_allgather(
+            np.asarray(local, np.float64))
+        return [float(v) for v in np.asarray(vals).reshape(-1)]
+
     @contextmanager
-    def timer(self, name: str):
+    def timer(self, name: str, distributed: bool = False):
         t0 = time.perf_counter()
         yield
-        self.add(name, time.perf_counter() - t0)
+        self.add(name, time.perf_counter() - t0, distributed=distributed)
 
-    def summary(self, unit_scale: float = 1.0) -> str:
-        """(ref Metrics.summary) one line per metric, averaged."""
+    def summary(self, unit_scale: float = 1.0,
+                per_node: bool = False) -> str:
+        """(ref Metrics.summary) one line per metric, averaged.
+
+        ``per_node=True`` adds the per-process breakdown for entries
+        marked distributed.  CAUTION: that path calls
+        ``process_allgather`` — a COLLECTIVE, so with per_node=True every
+        jax process must call summary() at the same point or the callers
+        deadlock (same contract as any collective).  The default is
+        purely local and safe to call from one process."""
         lines = ["========== Metrics Summary =========="]
         for name in sorted(self._sums):
             lines.append(f"{name} : {self.mean(name) * unit_scale}")
+            if per_node and name in self._distributed:
+                nodes = self.per_node(name)
+                if len(nodes) > 1:
+                    per = ", ".join(f"{v * unit_scale:.6g}" for v in nodes)
+                    lines.append(f"  per node : [{per}]")
         lines.append("=====================================")
         return "\n".join(lines)
 
     def reset(self):
         self._sums.clear()
         self._counts.clear()
+        self._distributed.clear()

@@ -135,6 +135,13 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
     microbatches is applied here)
     Returns (mean_loss, grads) with grads shaped like ``stage_params``
     (leading dim P, stage-sharded like the input).
+
+    Caveat: x_micro / t_micro are REPLICATED onto every rank (in_specs
+    P()), so per-device input+target memory is still O(n_micro) even
+    though live activations are bounded — the schedule's win is the
+    activation term, which dominates for real models (activations >>
+    one microbatch of input).  Sharding the operands over the pipe axis
+    with per-rank injection would close that too.
     """
     n_stage = mesh.shape[axis]
     n_micro = x_micro.shape[0]

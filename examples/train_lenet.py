@@ -62,7 +62,10 @@ def main(argv=None):
     state = T(learningRate=args.learningRate, momentum=args.momentum,
               weightDecay=args.weightDecay)
     if args.state:
-        state.update(File.load(args.state)["state"])
+        blob = File.load(args.state)
+        state.update(blob["state"])
+        if blob.get("opt_state") is not None:
+            optimizer.set_optim_state(blob["opt_state"])  # momentum etc.
     optimizer.set_state(state)
     optimizer.set_end_when(max_epoch(args.maxEpoch))
     optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])

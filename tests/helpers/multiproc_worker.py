@@ -4,10 +4,18 @@ pattern (DistriOptimizerSpec.scala:40-42,104-116 runs Engine.init(4,4)
 against a local SparkContext; here each OS process is one "host" with 2
 virtual CPU devices, joined via jax.distributed).
 
-Usage: python multiproc_worker.py <process_id> <num_processes> <port> [ckpt_dir]
+Usage: python multiproc_worker.py <process_id> <num_processes> <port>
+           [ckpt_dir] [--die-at N] [--resume]
 Prints one JSON line:
   {"process_id": i, "losses": [...], "psum": float,
    "ckpt_files": [...], "resumed_loss": float}
+
+``--die-at N``: this worker calls os._exit(1) once neval reaches N — the
+mid-training failure of the drill (the reference's fail-fast story:
+spark.task.maxFailures=1, lenet Train.scala:46 — a failed task kills the
+job; restart resumes from the checkpoint).
+``--resume``: load the newest model.N/state.N from ckpt_dir before
+training, so the run continues from the recorded neval.
 """
 import json
 import os as _os
@@ -15,8 +23,17 @@ import sys
 
 
 def main():
-    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
+    argv = list(sys.argv[1:])
+    die_at = None
+    if "--die-at" in argv:
+        i = argv.index("--die-at")
+        die_at = int(argv[i + 1])
+        del argv[i:i + 2]
+    resume = "--resume" in argv
+    if resume:
+        argv.remove("--resume")
+    pid, nproc, port = int(argv[0]), int(argv[1]), argv[2]
+    ckpt_dir = argv[3] if len(argv) > 3 else None
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -59,10 +76,40 @@ def main():
     model = nn.Sequential(nn.Linear(d, 8), nn.Tanh(),
                           nn.Linear(8, classes), nn.LogSoftMax())
     from bigdl_tpu.optim import several_iteration
+    from bigdl_tpu.optim.trigger import Trigger
+    from bigdl_tpu.utils import file as File
+
+    # momentum makes the drill honest: resuming without the optimizer
+    # velocity would visibly diverge from the uninterrupted oracle
+    start_state = T(learningRate=0.5, momentum=0.9)
+    resume_opt = None
+    if resume:
+        # continue from the newest snapshot pair (model.N + state.N):
+        # state carries neval, so max_iteration(6) resumes mid-count
+        nevals = sorted(int(f.split(".")[-1])
+                        for f in _os.listdir(ckpt_dir)
+                        if f.startswith("model."))
+        latest = nevals[-1]
+        model = File.load_module(_os.path.join(ckpt_dir,
+                                               "model.%d" % latest))
+        st = File.load(_os.path.join(ckpt_dir, "state.%d" % latest))
+        start_state.update(st["state"])
+        resume_opt = st.get("opt_state")
+
     opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion())
-    opt.set_state(T(learningRate=0.5))
-    opt.set_end_when(max_iteration(6))
-    if ckpt_dir:
+    opt.set_state(start_state)
+    if resume_opt is not None:
+        opt.set_optim_state(resume_opt)
+    if die_at is not None:
+        def die_or_end(s):
+            if s.get("neval", 0) >= die_at:
+                sys.stdout.flush()
+                _os._exit(1)   # simulated mid-training crash
+            return s.get("neval", 0) > 6
+        opt.set_end_when(Trigger(die_or_end, "die-at-%d" % die_at))
+    else:
+        opt.set_end_when(max_iteration(6))
+    if ckpt_dir and not resume:
         opt.set_checkpoint(ckpt_dir, several_iteration(3))
 
     opt.optimize()
@@ -71,7 +118,11 @@ def main():
     psum = float(sum(np.abs(np.asarray(p)).sum()
                      for p in jax.tree_util.tree_leaves(model.params())))
 
-    out = {"process_id": pid, "losses": losses, "psum": psum}
+    out = {"process_id": pid, "losses": losses, "psum": psum,
+           # per-node metric breakdown (ref Metrics.scala "computing time
+           # for each node"): one entry per process
+           "compute_per_node": opt.metrics.per_node(
+               "computing time average")}
 
     # cross-process validation merge (ref DistriValidator.scala:32): each
     # process sees its shard; merged counts must cover the GLOBAL set
@@ -86,6 +137,7 @@ def main():
     out["val_correct"] = int(acc.correct)
     if ckpt_dir:
         out["ckpt_files"] = sorted(_os.listdir(ckpt_dir))
+    if ckpt_dir and not resume:
         # resume: fresh model from the newest checkpoint, 2 more steps —
         # every process reads the same files process 0 wrote
         from bigdl_tpu.utils import file as File

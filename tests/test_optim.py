@@ -197,3 +197,20 @@ class TestMetrics:
         with m.timer("t"):
             pass
         assert m.get("t")[1] == 1
+
+
+def test_metrics_per_node_and_distributed_summary():
+    """ref Metrics.scala local/aggregate/distributed entries: entries
+    marked distributed expose a per-process breakdown (single process:
+    a 1-list) and the summary stays well-formed."""
+    from bigdl_tpu.optim.metrics import Metrics
+    m = Metrics()
+    m.add("aggregate gradient time", 0.5)
+    m.add("computing time average", 1.5, distributed=True)
+    m.add("computing time average", 2.5, distributed=True)
+    assert m.per_node("computing time average") == [2.0]
+    s = m.summary()
+    assert "computing time average : 2.0" in s
+    assert "aggregate gradient time : 0.5" in s
+    m.reset()
+    assert m.per_node("x") == [0.0]
