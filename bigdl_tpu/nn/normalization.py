@@ -108,8 +108,18 @@ class SpatialCrossMapLRN(TensorModule):
 
     _STENCIL = False  # module-level A/B switches, see tools/ab_step.py:
     _SQRT_POW = True  # in-model grid measured rw-LRN+sqrt fastest (PERF_NOTES)
+    # Fused Pallas LRN (ops/pallas_kernels.lrn_channel) measured SLOWER
+    # than this XLA path on the v5e (538 vs 808-852 us fwd+bwd on the
+    # Inception C64 56x56 shape, device-clock) — XLA's channel
+    # reduce_window + fusions already run well here, unlike its maxpool
+    # emitter.  Kernel kept as tested evidence; off by default.
+    _PALLAS = False
 
     def _forward(self, P, x, S, ctx):
+        if self._PALLAS and x.ndim == 4:
+            from bigdl_tpu.ops.pallas_kernels import lrn_channel, _on_tpu
+            return lrn_channel(x, self.size, self.alpha, self.beta, self.k,
+                               not _on_tpu()), None
         lo = (self.size - 1) // 2
         hi = self.size - 1 - lo
         if self._STENCIL:

@@ -327,3 +327,103 @@ def _maxpool_vjp_bwd(window, strides, pads, interpret, x, g):
 
 
 maxpool2d.defvjp(_maxpool_vjp_fwd, _maxpool_vjp_bwd)
+
+
+# ---------------------------------------------------------------- LRN
+#
+# Cross-channel LRN (y = x / (k + alpha/n * sum_win x^2)^beta) costs
+# ~5.6 ms of the Inception-v1 step through XLA (channel-window
+# reduce_window + the backward's mul/div fusions, PROFILE_inception.md
+# round 3).  Unlike the maxpool case, LRN maps PERFECTLY onto Mosaic's
+# (sublane, lane) model: collapse HW onto lanes and put C on sublanes —
+# the size-5 channel window becomes five unit-stride sublane slices, no
+# lane padding waste, no strided slicing.  Forward and the closed-form
+# backward
+#   dx = dy z^-b - (2 a b / n) x * sum_win(dy x z^(-b-1))
+# are each ONE pass over the block (backward recomputes z from x).
+
+
+def _lrn_zpow(sq_sum, size, alpha, beta, k):
+    z = k + (alpha / size) * sq_sum
+    if beta == 0.75:
+        zb = jnp.sqrt(jnp.sqrt(z))
+        return z, zb * zb * zb            # z^0.75 without exp/log
+    return z, z ** beta
+
+
+def _lrn_win_sum(v, size, adjoint=False):
+    """Sum over the size-window centred on each channel (sublane dim 0 of
+    a (C, T) block), zero padding.  ``adjoint=True`` sums over the
+    TRANSPOSED window (pad (hi, lo) instead of (lo, hi)) — required in
+    the backward for even sizes, where the window is asymmetric."""
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+    if adjoint:
+        lo, hi = hi, lo
+    c = v.shape[0]
+    vp = jnp.pad(v, ((lo, hi), (0, 0)))
+    acc = None
+    for s in range(size):
+        sl = lax.slice(vp, (s, 0), (s + c, v.shape[1]))
+        acc = sl if acc is None else acc + sl
+    return acc
+
+
+def _lrn_fwd_kernel(x_ref, y_ref, *, size, alpha, beta, k):
+    x = x_ref[0].astype(jnp.float32)        # (C, T)
+    _, zpow = _lrn_zpow(_lrn_win_sum(x * x, size), size, alpha, beta, k)
+    y_ref[0] = (x / zpow).astype(y_ref.dtype)
+
+
+def _lrn_bwd_kernel(x_ref, g_ref, dx_ref, *, size, alpha, beta, k):
+    x = x_ref[0].astype(jnp.float32)
+    g = g_ref[0].astype(jnp.float32)
+    z, zpow = _lrn_zpow(_lrn_win_sum(x * x, size), size, alpha, beta, k)
+    u = g * x / (zpow * z)                  # dy x z^(-b-1)
+    dx = (g / zpow - (2.0 * alpha * beta / size) * x
+          * _lrn_win_sum(u, size, adjoint=True))
+    dx_ref[0] = dx.astype(dx_ref.dtype)
+
+
+def _lrn_call(kernel, args, out_dtype, size, alpha, beta, k,
+              interpret=False):
+    x = args[0]
+    n, c, h, w = x.shape
+    hw = h * w
+    t = min(3200, -(-hw // 128) * 128)  # multiple of 128 (lane alignment)
+    # ragged final block is safe: the channel window never crosses lanes,
+    # so out-of-bounds lanes compute garbage that the store drops
+    flat = [a.reshape(n, c, hw) for a in args]
+    y = pl.pallas_call(
+        functools.partial(kernel, size=size, alpha=alpha, beta=beta, k=k),
+        grid=(n, -(-hw // t)),
+        in_specs=[pl.BlockSpec((1, c, t), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM)] * len(flat),
+        out_specs=pl.BlockSpec((1, c, t), lambda i, j: (i, 0, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, c, hw), out_dtype),
+        interpret=interpret,
+    )(*flat)
+    return y.reshape(n, c, h, w)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_channel(x, size, alpha, beta, k, interpret=False):
+    """Fused cross-channel LRN with a hand-written one-pass backward.
+    NCHW, any H*W — ragged lane blocks are safe because the channel
+    window never crosses lanes (out-of-bounds lanes are dropped on
+    store)."""
+    return _lrn_call(_lrn_fwd_kernel, (x,), x.dtype, size, alpha, beta, k,
+                     interpret)
+
+
+def _lrn_vjp_fwd(x, size, alpha, beta, k, interpret=False):
+    return lrn_channel(x, size, alpha, beta, k, interpret), x
+
+
+def _lrn_vjp_bwd(size, alpha, beta, k, interpret, x, g):
+    return (_lrn_call(_lrn_bwd_kernel, (x, g), x.dtype, size, alpha, beta,
+                      k, interpret),)
+
+
+lrn_channel.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)

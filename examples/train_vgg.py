@@ -17,6 +17,8 @@ def main(argv=None):
     p.add_argument("--momentum", type=float, default=0.9)
     p.add_argument("--weightDecay", type=float, default=0.0005)
     p.add_argument("--maxEpoch", type=int, default=90)
+    p.add_argument("--maxIteration", type=int, default=None,
+                   help="stop after N iterations (smoke/perf runs)")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--distributed", action="store_true")
     args = p.parse_args(argv)
@@ -28,7 +30,8 @@ def main(argv=None):
     from bigdl_tpu.dataset.image import (
         ImgNormalizer, ImgToBatch, ImgRdmCropper, HFlip)
     from bigdl_tpu.models.vgg import VggForCifar10
-    from bigdl_tpu.optim import Optimizer, max_epoch, every_epoch, Top1Accuracy
+    from bigdl_tpu.optim import (Optimizer, max_epoch, max_iteration,
+                                 every_epoch, Top1Accuracy)
     from bigdl_tpu.utils.table import T
 
     try:
@@ -49,7 +52,10 @@ def main(argv=None):
     optimizer.set_state(T(learningRate=args.learningRate,
                           momentum=args.momentum,
                           weightDecay=args.weightDecay))
-    optimizer.set_end_when(max_epoch(args.maxEpoch))
+    if args.maxIteration:
+        optimizer.set_end_when(max_iteration(args.maxIteration))
+    else:
+        optimizer.set_end_when(max_epoch(args.maxEpoch))
     optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, every_epoch())

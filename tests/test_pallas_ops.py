@@ -99,3 +99,43 @@ class TestPallasMaxPool:
             lambda v: (maxpool2d(v, win, (1, 1), pads, interpret) * g).sum())(x)
         np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestPallasLRN:
+    """Fused cross-channel LRN kernel (ops/pallas_kernels.lrn_channel):
+    forward + closed-form backward vs the XLA reduce_window formulation,
+    incl. ragged H*W not divisible by 128.  Evidence kernel — measured
+    slower than XLA's path on v5e, so SpatialCrossMapLRN keeps
+    _PALLAS=False (see the class comment + PERF_NOTES round 3)."""
+
+    @pytest.mark.parametrize("shape,pars", [
+        ((2, 8, 16, 8), (5, 1.0, 0.75, 1.0)),
+        ((2, 6, 16, 16), (3, 2e-4, 0.9, 2.0)),
+        ((2, 8, 7, 9), (5, 1.0, 0.75, 1.0)),      # ragged lanes
+        ((2, 8, 16, 8), (4, 1.0, 0.75, 1.0)),     # EVEN size: asymmetric
+    ])                                             # adjoint window in bwd
+    def test_fwd_bwd_vs_xla(self, shape, pars):
+        from bigdl_tpu.ops.pallas_kernels import lrn_channel
+        size, alpha, beta, k = pars
+        interpret = jax.devices()[0].platform != "tpu"
+
+        def ref_lrn(x):
+            lo = (size - 1) // 2
+            hi = size - 1 - lo
+            sq = lax.reduce_window(x * x, 0.0, lax.add, (1, size, 1, 1),
+                                   (1, 1, 1, 1),
+                                   ((0, 0), (lo, hi), (0, 0), (0, 0)))
+            return x / (k + alpha / size * sq) ** beta
+
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(*shape), jnp.float32)
+        y = lrn_channel(x, size, alpha, beta, k, interpret)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref_lrn(x)),
+                                   rtol=1e-5, atol=1e-6)
+        g = jnp.asarray(rs.randn(*shape), jnp.float32)
+        d_ref = jax.grad(lambda v: (ref_lrn(v) * g).sum())(x)
+        d = jax.grad(
+            lambda v: (lrn_channel(v, size, alpha, beta, k, interpret)
+                       * g).sum())(x)
+        np.testing.assert_allclose(np.asarray(d), np.asarray(d_ref),
+                                   rtol=1e-4, atol=1e-5)
