@@ -110,7 +110,8 @@ def stack_stage_params(per_stage_params):
 
 
 def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
-                        mesh: Mesh, axis: str = "pipe"):
+                        mesh: Mesh, axis: str = "pipe",
+                        shard_inputs: bool = False):
     """1F1B pipeline schedule: forward and backward interleaved so each
     stage keeps at most ~2*(P-1)+1 in-flight microbatch activations —
     independent of the microbatch count — where GPipe's autodiff keeps
@@ -136,20 +137,39 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
     Returns (mean_loss, grads) with grads shaped like ``stage_params``
     (leading dim P, stage-sharded like the input).
 
-    Caveat: x_micro / t_micro are REPLICATED onto every rank (in_specs
-    P()), so per-device input+target memory is still O(n_micro) even
-    though live activations are bounded — the schedule's win is the
-    activation term, which dominates for real models (activations >>
-    one microbatch of input).  Sharding the operands over the pipe axis
-    with per-rank injection would close that too.
+    Operand memory: by default x_micro / t_micro are REPLICATED onto
+    every rank (in_specs P()), so per-device input+target memory is
+    O(n_micro) even though live activations are bounded.
+    ``shard_inputs=True`` shards both over the pipe axis instead
+    (n_micro must divide by P): each rank stores n_micro/P microbatches
+    and the owner delivers the tick's microbatch with ONE masked psum
+    (same for the target on the backward side) — O(n_micro/P) operand
+    memory for two extra microbatch-sized collectives per tick.
     """
     n_stage = mesh.shape[axis]
     n_micro = x_micro.shape[0]
     depth = 2 * n_stage  # circular residual buffer, >= max in-flight + 1
+    if shard_inputs and n_micro % n_stage:
+        raise ValueError(f"shard_inputs requires n_micro ({n_micro}) "
+                         f"divisible by the pipe axis ({n_stage})")
+    per = n_micro // n_stage if shard_inputs else n_micro
 
     def ranked(params, x_all, t_all):
         my_params = jax.tree_util.tree_map(lambda v: v[0], params)
         rank = lax.axis_index(axis)
+
+        def fetch(arr, m):
+            # microbatch m of a possibly pipe-sharded (per, mb, ...)
+            # array.  m MUST be a global (rank-independent) index: with
+            # shard_inputs the owning rank contributes its slice and the
+            # psum delivers it everywhere — a rank-dependent m would make
+            # each rank contribute for a DIFFERENT microbatch and the sum
+            # would be garbage.
+            if not shard_inputs:
+                return arr[jnp.clip(m, 0, n_micro - 1)]
+            local = arr[jnp.clip(m - rank * per, 0, per - 1)]
+            mine = (m // per == rank) & (m >= 0) & (m < n_micro)
+            return lax.psum(local * mine.astype(local.dtype), axis)
         n_ticks = n_micro + 2 * (n_stage - 1)
         fwd_ring = [(i, (i + 1) % n_stage) for i in range(n_stage)]
         bwd_ring = [(i, (i - 1) % n_stage) for i in range(n_stage)]
@@ -171,7 +191,8 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
             # ---------------- forward half ----------------
             mf = k - rank
             f_valid = (mf >= 0) & (mf < n_micro)
-            inject = x_all[jnp.clip(mf, 0, n_micro - 1)]
+            # global index: rank 0 is the only consumer and its mf == k
+            inject = fetch(x_all, k)
             cur = jnp.where(rank == 0, inject, buf_fwd)
             y = stage_fn(my_params, cur)
             resid = lax.dynamic_update_index_in_dim(
@@ -184,7 +205,9 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
             mb = k - (2 * (n_stage - 1) - rank)
             b_valid = (mb >= 0) & (mb < n_micro)
             x_saved = resid[jnp.maximum(mb, 0) % depth]
-            tgt = t_all[jnp.clip(mb, 0, n_micro - 1)]
+            # global index: the last rank is the only consumer of the
+            # target and its mb == k - (P-1)
+            tgt = fetch(t_all, k - (n_stage - 1))
             is_last = rank == n_stage - 1
 
             # ONE stage vjp per tick: recompute the stage forward, then
@@ -224,7 +247,8 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
         return loss, grads
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    xspec = P(axis) if shard_inputs else P()
     f = jax.shard_map(ranked, mesh=mesh,
-                      in_specs=(pspec, P(), P()),
+                      in_specs=(pspec, xspec, xspec),
                       out_specs=(P(), pspec))
     return f(stage_params, x_micro, t_micro)

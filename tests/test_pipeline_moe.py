@@ -246,3 +246,24 @@ class Test1F1B:
         growth_gpipe = g32 / max(g8, 1)
         assert growth_1f1b < growth_gpipe, (
             f"1F1B grew {growth_1f1b:.2f}x vs GPipe {growth_gpipe:.2f}x")
+
+    def test_shard_inputs_matches_replicated(self):
+        """shard_inputs=True (operands pipe-sharded, owner delivers by
+        masked psum) must produce the identical loss and gradients."""
+        f1b, mesh, stages, stacked, x, t = self._setup(n_micro=8, mb=4)
+        l_rep, g_rep = f1b(_stage_fn, self._loss_fn, stacked, x, t,
+                           mesh, "pipe")
+        l_sh, g_sh = f1b(_stage_fn, self._loss_fn, stacked, x, t,
+                         mesh, "pipe", shard_inputs=True)
+        np.testing.assert_allclose(float(l_sh), float(l_rep), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g_sh),
+                        jax.tree_util.tree_leaves(g_rep)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_shard_inputs_requires_divisibility(self):
+        from bigdl_tpu.parallel.pipeline import pipeline_train_1f1b
+        f1b, mesh, stages, stacked, x, t = self._setup(n_micro=6, mb=2)
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_train_1f1b(_stage_fn, self._loss_fn, stacked, x, t,
+                                mesh, "pipe", shard_inputs=True)
