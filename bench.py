@@ -42,7 +42,9 @@ def guess_peak(device) -> float:
     return 197e12  # default to v5e
 
 
-def make_step(model, criterion):
+def _raw_step(model, criterion):
+    """The un-jitted per-step train function shared by make_step (one
+    dispatch per step) and make_chunk_step (scanned device-side loop)."""
     import jax
     from bigdl_tpu.nn.module import Context
     from bigdl_tpu.optim.optim_method import SGD
@@ -62,29 +64,81 @@ def make_step(model, criterion):
 
     params, net_state = model.params(), model.state()
     opt_state = method.init_state(params)
+    return train_step, params, net_state, opt_state
+
+
+def make_step(model, criterion):
+    import jax
+    train_step, params, net_state, opt_state = _raw_step(model, criterion)
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
     return step, params, net_state, opt_state
 
 
-def bench_config(build, records_per_batch, warmup=3, iters=10, windows=3,
-                 flops_override=None):
-    """Returns (records/s, step_ms, mfu, flops_per_step, loss)."""
+def make_chunk_step(model, criterion, n_steps):
+    """A device-side training loop: ONE dispatch runs ``n_steps`` train
+    steps via lax.scan, each consuming a DISTINCT minibatch from a
+    stacked (n_steps, B, ...) device array — the TPU-native host-loop
+    pattern (the optimizer exposes it as set_iterations_per_dispatch).
+    Small models are relay/dispatch-latency-bound per call (VGG-CIFAR:
+    4.7 ms device work inside a 25.7 ms wall step); amortizing the fixed
+    cost over n_steps recovers the device-limited rate."""
     import jax
+    from jax import lax
+
+    train_step, params, net_state, opt_state = _raw_step(model, criterion)
+
+    def one(carry, xyk):
+        x, y, key = xyk
+        p, ns, o, loss = train_step(*carry, x, y, key)
+        return (p, ns, o), loss
+
+    def chunk(params, net_state, opt_state, xs, ys, key):
+        keys = jax.random.split(key, n_steps)
+        (params, net_state, opt_state), losses = lax.scan(
+            one, (params, net_state, opt_state), (xs, ys, keys))
+        return params, net_state, opt_state, losses[-1]
+
+    step = jax.jit(chunk, donate_argnums=(0, 1, 2))
+    return step, params, net_state, opt_state
+
+
+def bench_config(build, records_per_batch, warmup=3, iters=10, windows=3,
+                 flops_override=None, steps_per_dispatch=8):
+    """Returns (records/s, step_ms, mfu, flops_per_step, loss).
+
+    Trains with the device-side loop (``steps_per_dispatch`` scanned
+    steps per dispatch over DISTINCT stacked minibatches) — what a real
+    prefetching training loop on this hardware does; the per-call relay
+    latency otherwise dominates the small configs."""
+    import jax
+    import jax.numpy as jnp
 
     model, criterion, x, y = build()
-    step, params, net_state, opt_state = make_step(model, criterion)
+    n = steps_per_dispatch
+    # distinct batch per scanned step: vary the shared synthetic batch
+    # with a cheap per-step perturbation (content does not affect timing;
+    # training semantics stay honest — every step sees different data)
+    rs = np.random.RandomState(7)
+    xs = jnp.stack([jnp.asarray(np.asarray(x)
+                                * (1.0 + 0.01 * rs.randn()), x.dtype)
+                    for _ in range(n)])
+    ys = jnp.stack([y] * n)
+    step, params, net_state, opt_state = make_chunk_step(model, criterion, n)
     key = jax.random.PRNGKey(0)
     if flops_override is not None:
         flops = float(flops_override)
     else:
         try:
-            flops = float(step.lower(params, net_state, opt_state, x, y, key)
-                          .compile().cost_analysis()["flops"])
+            # XLA cost analysis counts a lax.scan body ONCE, so the
+            # chunk's number is already the per-step count
+            flops = float(
+                step.lower(params, net_state, opt_state, xs, ys, key)
+                .compile().cost_analysis()["flops"])
         except Exception:
             flops = float("nan")
     for _ in range(warmup):
         params, net_state, opt_state, loss = step(
-            params, net_state, opt_state, x, y, key)
+            params, net_state, opt_state, xs, ys, key)
     float(loss)  # device->host copy = hard sync (block_until_ready may be
     # a no-op under remote-relay PJRT backends; a transfer cannot lie)
 
@@ -96,9 +150,9 @@ def bench_config(build, records_per_batch, warmup=3, iters=10, windows=3,
         t0 = time.perf_counter()
         for _ in range(iters):
             params, net_state, opt_state, loss = step(
-                params, net_state, opt_state, x, y, key)
+                params, net_state, opt_state, xs, ys, key)
         last = float(loss)
-        dts.append((time.perf_counter() - t0) / iters)
+        dts.append((time.perf_counter() - t0) / (iters * n))
     dt = min(dts)
     peak = guess_peak(jax.devices()[0])
     mfu = (flops / dt) / peak if np.isfinite(flops) else float("nan")

@@ -41,6 +41,15 @@ def test_every_config_builds_and_traces(bench):
             out = jax.eval_shape(step, params, net_state, opt_state, x, y,
                                  jax.random.PRNGKey(0))
             assert out[-1].shape == (), name   # scalar loss
+            # the path bench_config actually runs: the scanned chunk
+            import jax.numpy as jnp
+            n = 2
+            xs = jnp.stack([x] * n)
+            ys = jnp.stack([y] * n)
+            cstep, cp, cns, cos = bench.make_chunk_step(model, criterion, n)
+            cout = jax.eval_shape(cstep, cp, cns, cos, xs, ys,
+                                  jax.random.PRNGKey(0))
+            assert cout[-1].shape == (), name
             assert recs > 0 and unit.endswith("/sec"), name
     finally:
         bt.set_policy(bt.FP32)

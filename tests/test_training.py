@@ -159,3 +159,77 @@ class TestBiLSTMClassifier:
         res = validate(trained, trained.params(), trained.state(), val,
                        [Top1Accuracy()])
         assert res[0][1].result()[0] > 0.6  # chance = 1/3
+
+
+def test_iterations_per_dispatch_matches_single_step():
+    """The device-side n-step loop (set_iterations_per_dispatch) must
+    reproduce the single-step trajectory exactly on a deterministic
+    model: same params, same loss, same neval after the same number of
+    iterations."""
+    import numpy as np
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import LocalOptimizer, max_iteration
+    from bigdl_tpu.utils.table import T
+    from bigdl_tpu.utils.random import set_seed
+
+    rs = np.random.RandomState(0)
+    xs = rs.randn(24, 5).astype(np.float32)
+    ys = (rs.randint(0, 3, 24) + 1).astype(np.float32)
+    samples = [Sample(x, np.asarray([y])) for x, y in zip(xs, ys)]
+
+    def run(n_disp):
+        set_seed(3)
+        ds = DataSet.array(samples) >> SampleToBatch(8)
+        model = nn.Sequential(nn.Linear(5, 6), nn.Tanh(),
+                              nn.Linear(6, 3), nn.LogSoftMax())
+        opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=0.2, momentum=0.9))
+        opt.set_end_when(max_iteration(6))
+        if n_disp > 1:
+            opt.set_iterations_per_dispatch(n_disp)
+        opt.optimize()
+        return model.params(), opt.state
+
+    p1, s1 = run(1)
+    p3, s3 = run(3)
+    assert s1["neval"] == s3["neval"]
+    assert s1["loss"] == pytest.approx(s3["loss"], rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p3)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_iterations_per_dispatch_triggers_still_fire(tmp_path):
+    """Periodic neval triggers whose period is coprime with the dispatch
+    size must still fire (probed across each chunk's neval interval):
+    several_iteration(10) with n=8 would otherwise never hit
+    neval % 10 == 0."""
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import (LocalOptimizer, max_iteration,
+                                 several_iteration)
+    from bigdl_tpu.utils.table import T
+    from bigdl_tpu.utils.random import set_seed
+    import os
+
+    set_seed(4)
+    rs = np.random.RandomState(1)
+    samples = [Sample(rs.randn(4).astype(np.float32),
+                      np.asarray([float(i % 2 + 1)], np.float32))
+               for i in range(16)]
+    ds = DataSet.array(samples) >> SampleToBatch(8)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+    opt.set_state(T(learningRate=0.1))
+    opt.set_iterations_per_dispatch(8)
+    opt.set_end_when(max_iteration(24))
+    opt.set_checkpoint(str(tmp_path), several_iteration(10))
+    opt.optimize()
+    files = sorted(os.listdir(tmp_path))
+    assert any(f.startswith("model.") for f in files), files
