@@ -85,14 +85,16 @@ class DistriOptimizer(LocalOptimizer):
         """Accepted for API parity; see class docstring (no-op)."""
         return self
 
-    def _maybe_checkpoint(self, params, net_state, opt_state, state):
+    def _maybe_checkpoint(self, params, net_state, opt_state, state,
+                          force=False):
         # params are replicated, so exactly one process writes — the
         # reference gathers slices to the driver and saves once
         # (getModel + File.save, DistriOptimizer.scala:320-342); writing
         # from every host would race on a shared checkpoint path.
         if jax.process_index() != 0:
             return
-        super()._maybe_checkpoint(params, net_state, opt_state, state)
+        super()._maybe_checkpoint(params, net_state, opt_state, state,
+                                  force=force)
 
     def _shardings(self, params, net_state, opt_state):
         mesh = self.mesh
@@ -158,11 +160,27 @@ class DistriOptimizer(LocalOptimizer):
         """Shared jit wiring: carried state is donated (buffers recycled in
         place); optimize() passes copies so the module's arrays survive.
         The trailing lr_scales argument rides replicated (prefix sharding
-        broadcasts over its pytree) and is never donated."""
+        broadcasts over its pytree) and is never donated.
+
+        With ``iters_per_dispatch > 1`` the step is wrapped in a
+        lax.scan over stacked (n, B, ...) batches — same device-side
+        training loop as LocalOptimizer (set_iterations_per_dispatch),
+        batch sharded over "data" on dim 1."""
         rep = NamedSharding(self.mesh, P())
+        n = self.iters_per_dispatch
+        if n <= 1:
+            return jax.jit(
+                step,
+                in_shardings=(ps, ns, os_, data_s, data_s, rep, rep, rep),
+                out_shardings=(ps, ns, os_, rep),
+                donate_argnums=(0, 1, 2),
+            )
+
+        chunk_data_s = NamedSharding(self.mesh, P(None, "data"))
         return jax.jit(
-            step,
-            in_shardings=(ps, ns, os_, data_s, data_s, rep, rep, rep),
+            self._scan_chunk(step, n),
+            in_shardings=(ps, ns, os_, chunk_data_s, chunk_data_s,
+                          rep, rep, rep),
             out_shardings=(ps, ns, os_, rep),
             donate_argnums=(0, 1, 2),
         )
@@ -227,10 +245,13 @@ class DistriOptimizer(LocalOptimizer):
         ps, ns, os_, data_s = self._shardings(params, net_state, opt_state)
         return self._jit_step(step, ps, ns, os_, data_s)
 
-    def _device_put_batch(self, x, y):
-        """Assemble the global sharded batch from this process's local shard."""
+    def _device_put_batch(self, x, y, stacked: bool = False):
+        """Assemble the global sharded batch from this process's local
+        shard.  ``stacked=True``: (n, local_B, ...) chunk for the
+        device-side loop — sharded over "data" on dim 1."""
         mesh = self.mesh
-        sharding = NamedSharding(mesh, P("data"))
+        spec = P(None, "data") if stacked else P("data")
+        sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
             return (jax.device_put(jnp.asarray(x), sharding),
                     jax.device_put(jnp.asarray(y), sharding))
@@ -257,11 +278,17 @@ class DistriOptimizer(LocalOptimizer):
         n_dev = self.mesh.size
         wall_start = time.perf_counter()
 
+        n_disp = self.iters_per_dispatch
         while not self.end_when(state):
             with self.metrics.timer("data fetch time"):
-                batch = next(data_iter)
-                x, y = self._device_put_batch(batch.data, batch.labels)
-                global_b = x.shape[0]
+                if n_disp <= 1:
+                    batch = next(data_iter)
+                    x, y = self._device_put_batch(batch.data, batch.labels)
+                    global_b = x.shape[0]
+                else:
+                    xh, yh = self._next_chunk(data_iter, n_disp)
+                    x, y = self._device_put_batch(xh, yh, stacked=True)
+                    global_b = x.shape[0] * x.shape[1]
 
             # distributed: summary() adds the per-process breakdown, the
             # reference's "computing time for each node" accumulator
@@ -272,26 +299,40 @@ class DistriOptimizer(LocalOptimizer):
                 params, net_state, opt_state, loss = step_fn(
                     params, net_state, opt_state, x, y, jnp.float32(lr), key,
                     self._lr_scales_arg)
-                loss = float(loss)
+                loss = float(loss[-1]) if n_disp > 1 else float(loss)
 
             step_time = self.metrics.mean("computing time average")
             count += global_b
-            state["neval"] = state["neval"] + 1
+            state["neval"] = state["neval"] + n_disp
             state["loss"] = loss
-            state["evalCounter"] = state.get("evalCounter", 0) + 1
+            state["evalCounter"] = state.get("evalCounter", 0) + n_disp
             logger.info(
                 "Epoch %d %d/%d loss %.6f lr %.5g throughput %.1f records/s "
                 "on %d devices", state["epoch"], count, epoch_size, loss, lr,
                 global_b / max(step_time, 1e-9), n_dev)
 
-            if count >= epoch_size:
-                state["epoch"] = state["epoch"] + 1
-                count = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+            if n_disp <= 1:
+                if count >= epoch_size:
+                    state["epoch"] = state["epoch"] + 1
+                    count = 0
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
+            else:
+                while count >= epoch_size:
+                    state["epoch"] = state["epoch"] + 1
+                    count -= epoch_size
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
 
-            self._maybe_validate(params, net_state, state)
-            self._maybe_checkpoint(params, net_state, opt_state, state)
+            if n_disp > 1:
+                if self._fired_within(self.validation_trigger, state, n_disp):
+                    self._maybe_validate(params, net_state, state, force=True)
+                if self._fired_within(self.checkpoint_trigger, state, n_disp):
+                    self._maybe_checkpoint(params, net_state, opt_state,
+                                           state, force=True)
+            else:
+                self._maybe_validate(params, net_state, state)
+                self._maybe_checkpoint(params, net_state, opt_state, state)
 
         # gather (replicated -> host) and write back, ref getModel :475-499
         self.model.load_params(jax.device_get(params))

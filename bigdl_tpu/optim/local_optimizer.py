@@ -182,7 +182,12 @@ class LocalOptimizer:
         n = self.iters_per_dispatch
         if n <= 1:
             return jax.jit(step, donate_argnums=(0, 1, 2))
+        return jax.jit(self._scan_chunk(step, n), donate_argnums=(0, 1, 2))
 
+    @staticmethod
+    def _scan_chunk(step, n):
+        """Wrap a per-step train fn in the device-side n-step loop
+        (shared by Local and Distri builders)."""
         from jax import lax
 
         def chunk(params, net_state, opt_state, xs, ys, lr, key, lr_scales):
@@ -198,7 +203,19 @@ class LocalOptimizer:
                 body, (params, net_state, opt_state), (xs, ys, keys))
             return params, net_state, opt_state, losses
 
-        return jax.jit(chunk, donate_argnums=(0, 1, 2))
+        return chunk
+
+    @staticmethod
+    def _next_chunk(data_iter, n):
+        """Draw n uniform-shape batches and stack them host-side."""
+        batches = [next(data_iter) for _ in range(n)]
+        shapes = {np.asarray(b_.data).shape for b_ in batches}
+        if len(shapes) != 1:
+            raise ValueError(
+                "iterations_per_dispatch needs uniform batch shapes "
+                f"within a chunk, got {shapes}")
+        return (np.stack([b_.data for b_ in batches]),
+                np.stack([b_.labels for b_ in batches]))
 
     # -- main loop (ref LocalOptimizer.optimize :77) ----------------------
     def optimize(self):
@@ -231,14 +248,8 @@ class LocalOptimizer:
                 x = jnp.asarray(batch.data)
                 y = jnp.asarray(batch.labels)
             else:
-                batches = [next(data_iter) for _ in range(n_disp)]
-                shapes = {np.asarray(b_.data).shape for b_ in batches}
-                if len(shapes) != 1:
-                    raise ValueError(
-                        "iterations_per_dispatch needs uniform batch shapes "
-                        f"within a chunk, got {shapes}")
-                x = jnp.asarray(np.stack([b_.data for b_ in batches]))
-                y = jnp.asarray(np.stack([b_.labels for b_ in batches]))
+                xh, yh = self._next_chunk(data_iter, n_disp)
+                x, y = jnp.asarray(xh), jnp.asarray(yh)
             fetch_time = time.perf_counter() - fetch_start
 
             train_start = time.perf_counter()
@@ -266,12 +277,21 @@ class LocalOptimizer:
                 state["epoch"], count, epoch_size, loss, lr,
                 b / max(train_time + fetch_time, 1e-9), fetch_time, train_time)
 
-            while count >= epoch_size:
-                # a large chunk can span several epochs of a small dataset
-                state["epoch"] = state["epoch"] + 1
-                count -= epoch_size
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+            if n_disp <= 1:
+                # single-step semantics unchanged: the leftover count came
+                # from the discarded iterator, so it resets
+                if count >= epoch_size:
+                    state["epoch"] = state["epoch"] + 1
+                    count = 0
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
+            else:
+                while count >= epoch_size:
+                    # a large chunk can span several epochs of a small set
+                    state["epoch"] = state["epoch"] + 1
+                    count -= epoch_size
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
 
             if n_disp > 1:
                 # periodic neval triggers (several_iteration(k)) must not
