@@ -311,28 +311,10 @@ class DistriOptimizer(LocalOptimizer):
                 "on %d devices", state["epoch"], count, epoch_size, loss, lr,
                 global_b / max(step_time, 1e-9), n_dev)
 
-            if n_disp <= 1:
-                if count >= epoch_size:
-                    state["epoch"] = state["epoch"] + 1
-                    count = 0
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-            else:
-                while count >= epoch_size:
-                    state["epoch"] = state["epoch"] + 1
-                    count -= epoch_size
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-
-            if n_disp > 1:
-                if self._fired_within(self.validation_trigger, state, n_disp):
-                    self._maybe_validate(params, net_state, state, force=True)
-                if self._fired_within(self.checkpoint_trigger, state, n_disp):
-                    self._maybe_checkpoint(params, net_state, opt_state,
-                                           state, force=True)
-            else:
-                self._maybe_validate(params, net_state, state)
-                self._maybe_checkpoint(params, net_state, opt_state, state)
+            count, data_iter = self._advance_epochs(state, count,
+                                                    epoch_size, n_disp,
+                                                    data_iter)
+            self._fire_triggers(params, net_state, opt_state, state, n_disp)
 
         # gather (replicated -> host) and write back, ref getModel :475-499
         self.model.load_params(jax.device_get(params))

@@ -277,41 +277,50 @@ class LocalOptimizer:
                 state["epoch"], count, epoch_size, loss, lr,
                 b / max(train_time + fetch_time, 1e-9), fetch_time, train_time)
 
-            if n_disp <= 1:
-                # single-step semantics unchanged: the leftover count came
-                # from the discarded iterator, so it resets
-                if count >= epoch_size:
-                    state["epoch"] = state["epoch"] + 1
-                    count = 0
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-            else:
-                while count >= epoch_size:
-                    # a large chunk can span several epochs of a small set
-                    state["epoch"] = state["epoch"] + 1
-                    count -= epoch_size
-                    self.dataset.shuffle()
-                    data_iter = self.dataset.data(train=True)
-
-            if n_disp > 1:
-                # periodic neval triggers (several_iteration(k)) must not
-                # be skipped just because neval jumps by n per dispatch:
-                # fire if the trigger would have fired at ANY intermediate
-                # iteration of this chunk (at most once per dispatch)
-                if self._fired_within(self.validation_trigger, state, n_disp):
-                    self._maybe_validate(params, net_state, state,
-                                         force=True)
-                if self._fired_within(self.checkpoint_trigger, state, n_disp):
-                    self._maybe_checkpoint(params, net_state, opt_state,
-                                           state, force=True)
-            else:
-                self._maybe_validate(params, net_state, state)
-                self._maybe_checkpoint(params, net_state, opt_state, state)
+            count, data_iter = self._advance_epochs(state, count,
+                                                    epoch_size, n_disp,
+                                                    data_iter)
+            self._fire_triggers(params, net_state, opt_state, state, n_disp)
 
         self.model.load_params(params)
         self.model.load_state(net_state)
         logger.info("Training finished in %.1fs", time.perf_counter() - wall_start)
         return self.model
+
+    def _advance_epochs(self, state, count, epoch_size, n_disp, data_iter):
+        """Epoch rollover shared by both optimizers' loops.  Single-step
+        keeps the historical semantics (leftover count resets — it came
+        from the discarded iterator); a chunk can span several epochs of
+        a small dataset, so it rolls the epoch counter through."""
+        if n_disp <= 1:
+            if count >= epoch_size:
+                state["epoch"] = state["epoch"] + 1
+                count = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+            return count, data_iter
+        while count >= epoch_size:
+            state["epoch"] = state["epoch"] + 1
+            count -= epoch_size
+            self.dataset.shuffle()
+            data_iter = self.dataset.data(train=True)
+        return count, data_iter
+
+    def _fire_triggers(self, params, net_state, opt_state, state, n_disp):
+        """Dispatch-granularity trigger firing shared by both loops.
+        Periodic neval triggers (several_iteration(k)) must not be
+        skipped because neval jumps by n per dispatch: fire if the
+        trigger would have fired at ANY intermediate iteration of the
+        chunk (at most once per dispatch)."""
+        if n_disp > 1:
+            if self._fired_within(self.validation_trigger, state, n_disp):
+                self._maybe_validate(params, net_state, state, force=True)
+            if self._fired_within(self.checkpoint_trigger, state, n_disp):
+                self._maybe_checkpoint(params, net_state, opt_state, state,
+                                       force=True)
+        else:
+            self._maybe_validate(params, net_state, state)
+            self._maybe_checkpoint(params, net_state, opt_state, state)
 
     @staticmethod
     def _fired_within(trig, state, n):
