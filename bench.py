@@ -316,9 +316,18 @@ def _subprocess_json(arg, timeout_s, retries=1, retry_sleep=10):
             print("bench subprocess %r rc=%d (attempt %d): %s" % (
                 arg, out.returncode, attempt + 1, out.stderr[-500:]),
                 file=sys.stderr, flush=True)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
             print("bench subprocess %r timed out (attempt %d)"
                   % (arg, attempt + 1), file=sys.stderr, flush=True)
+            # salvage whatever the child already printed: a wedge AFTER
+            # a config's entry line (e.g. in the in-band roofline probe)
+            # must not cost the measured config
+            partial = e.stdout or b""
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            lines = [l for l in partial.splitlines() if l.startswith("{")]
+            if lines:
+                return [json.loads(l) for l in lines]
         if attempt < retries:        # no pointless sleep after the last try
             time.sleep(retry_sleep)
     return []

@@ -43,7 +43,11 @@ def _register():
 
 def run_perf(model_name: str, batch_size: int, iterations: int,
              warmup: int = 3, distributed: bool = False,
-             data_type: str = "bf16") -> dict:
+             data_type: str = "bf16", iters_per_dispatch: int = 1) -> dict:
+    """``iters_per_dispatch > 1`` uses the device-side training loop
+    (n scanned steps per dispatch over distinct stacked minibatches, the
+    set_iterations_per_dispatch feature) — on dispatch-latency-bound
+    setups this reports the device-limited rate."""
     import jax
     import jax.numpy as jnp
     import bigdl_tpu.nn as nn
@@ -81,8 +85,29 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
         return new_params, ns, new_opt, loss
 
     rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.randn(batch_size, *shape), jnp.float32)
-    y = jnp.asarray(rs.randint(1, n_classes + 1, (batch_size,)))
+    n_disp = max(1, int(iters_per_dispatch))
+    if n_disp > 1:
+        from jax import lax
+        per_step = train_step
+
+        def train_step(params, net_state, opt_state, xs, ys, key):
+            keys = jax.random.split(key, n_disp)
+
+            def body(carry, xyk):
+                p, ns, o = carry
+                bx, by, k = xyk
+                p, ns, o, loss = per_step(p, ns, o, bx, by, k)
+                return (p, ns, o), loss
+
+            (params, net_state, opt_state), losses = lax.scan(
+                body, (params, net_state, opt_state), (xs, ys, keys))
+            return params, net_state, opt_state, losses[-1]
+
+        x = jnp.asarray(rs.randn(n_disp, batch_size, *shape), jnp.float32)
+        y = jnp.asarray(rs.randint(1, n_classes + 1, (n_disp, batch_size)))
+    else:
+        x = jnp.asarray(rs.randn(batch_size, *shape), jnp.float32)
+        y = jnp.asarray(rs.randint(1, n_classes + 1, (batch_size,)))
     key = jax.random.PRNGKey(0)
 
     if distributed:
@@ -90,7 +115,8 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
         from bigdl_tpu.parallel.mesh import data_parallel_mesh
         mesh = data_parallel_mesh()
         rep = NamedSharding(mesh, P())
-        data_s = NamedSharding(mesh, P("data"))
+        data_s = NamedSharding(
+            mesh, P(None, "data") if n_disp > 1 else P("data"))
         reps = lambda tree: jax.tree_util.tree_map(lambda _: rep, tree)
         step = jax.jit(train_step,
                        in_shardings=(reps(params), reps(net_state),
@@ -118,11 +144,12 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
     for _ in range(iterations):
         params, net_state, opt_state, loss = step(params, net_state, opt_state, x, y, key)
     last_loss = float(loss)  # syncs the sequential step chain
-    dt = (time.perf_counter() - t0) / iterations
+    dt = (time.perf_counter() - t0) / (iterations * n_disp)
 
     return {
         "model": model_name,
         "batch_size": batch_size,
+        "iters_per_dispatch": n_disp,
         "distributed": distributed,
         "devices": jax.device_count() if distributed else 1,
         "step_time_ms": round(dt * 1e3, 3),
@@ -139,6 +166,8 @@ def main(argv=None, force_distributed=None):
     p.add_argument("--iteration", "-i", type=int, default=10)
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--dataType", choices=["float", "bf16"], default="bf16")
+    p.add_argument("--iterationsPerDispatch", type=int, default=1,
+                   help="device-side loop: n scanned steps per dispatch")
     p.add_argument("--distributed", action="store_true")
     args = p.parse_args(argv)
     if force_distributed is not None and args.distributed != force_distributed:
@@ -147,7 +176,8 @@ def main(argv=None, force_distributed=None):
     distributed = (force_distributed if force_distributed is not None
                    else args.distributed)
     result = run_perf(args.model, args.batchSize, args.iteration,
-                      args.warmup, distributed, args.dataType)
+                      args.warmup, distributed, args.dataType,
+                      iters_per_dispatch=args.iterationsPerDispatch)
     print(json.dumps(result))
 
 

@@ -69,3 +69,28 @@ def test_summary_line_contract(bench):
 def test_summary_line_survives_empty(bench):
     d = json.loads(bench._summary_line([], None, None, "unknown"))
     assert d["value"] == 0 and "vs_baseline" in d
+
+
+def test_subprocess_timeout_salvages_printed_entries(tmp_path, monkeypatch):
+    """A child that wedges AFTER printing a config entry (e.g. in the
+    in-band roofline probe) must not cost the measured config: the
+    timeout handler parses the captured partial stdout."""
+    import textwrap
+    import bench as b
+    import importlib
+    importlib.reload(b)
+    fake = tmp_path / "fake_child.py"
+    fake.write_text(textwrap.dedent("""
+        import json, time
+        print(json.dumps({"config": "Inception-v1 fake", "value": 1.0}),
+              flush=True)
+        time.sleep(600)
+    """))
+    real = b.os.path.abspath(b.__file__)
+    orig = b.os.path.abspath
+    monkeypatch.setattr(
+        b.os.path, "abspath",
+        lambda p: str(fake) if orig(p) == real else orig(p))
+    monkeypatch.setattr(b, "_BENCH_DEADLINE", b.time.monotonic() + 600)
+    out = b._subprocess_json("x", timeout_s=3, retries=0)
+    assert out and out[0]["config"] == "Inception-v1 fake"

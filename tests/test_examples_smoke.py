@@ -47,3 +47,15 @@ def test_example_trains(module, argv, monkeypatch, tmp_path):
     monkeypatch.chdir(tmp_path)  # checkpoints etc. land in tmp
     sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
     run_example(module, argv)
+
+
+def test_perf_cli_iters_per_dispatch():
+    """The Perf harness's device-side loop path builds and runs (CPU
+    mesh): result carries the chunk size and a finite loss."""
+    from bigdl_tpu.models.utils.perf import run_perf
+    import math
+    res = run_perf("lenet5", 8, 1, warmup=1, data_type="float",
+                   iters_per_dispatch=2)
+    assert res["iters_per_dispatch"] == 2
+    assert math.isfinite(res["loss"])
+    assert res["throughput_records_per_sec"] > 0
