@@ -13,6 +13,8 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("-f", "--folder", default="./mnist")
     p.add_argument("-b", "--batchSize", type=int, default=150)
+    p.add_argument("--iterationsPerDispatch", type=int, default=1,
+                   help="device-side loop: n scanned steps per dispatch")
     p.add_argument("--learningRate", type=float, default=0.01)
     p.add_argument("--maxEpoch", type=int, default=10)
     args = p.parse_args(argv)
@@ -46,6 +48,7 @@ def main(argv=None):
     opt = LocalOptimizer(model, ds, nn.MSECriterion())
     opt.set_state(T(learningRate=args.learningRate, momentum=0.9))
     opt.set_end_when(max_epoch(args.maxEpoch))
+    opt.set_iterations_per_dispatch(args.iterationsPerDispatch)
     opt.optimize()
 
 

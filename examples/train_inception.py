@@ -16,6 +16,8 @@ def main(argv=None):
     p.add_argument("-f", "--folder", default="./imagenet",
                    help="ImageFolder layout (class subdirs) or shard files")
     p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--iterationsPerDispatch", type=int, default=1,
+                   help="device-side loop: n scanned steps per dispatch")
     p.add_argument("--learningRate", type=float, default=0.0898)
     p.add_argument("--weightDecay", type=float, default=0.0001)
     p.add_argument("--maxIteration", type=int, default=62000)
@@ -76,6 +78,7 @@ def main(argv=None):
     optimizer.set_end_when(max_iteration(args.maxIteration))
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, several_iteration(620))
+    optimizer.set_iterations_per_dispatch(args.iterationsPerDispatch)
     optimizer.optimize()
 
 

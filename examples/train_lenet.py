@@ -19,6 +19,8 @@ def main(argv=None):
     p.add_argument("-f", "--folder", default="./mnist",
                    help="folder with train/t10k idx files")
     p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--iterationsPerDispatch", type=int, default=1,
+                   help="device-side loop: n scanned steps per dispatch")
     p.add_argument("--learningRate", type=float, default=0.05)
     p.add_argument("--momentum", type=float, default=0.0)
     p.add_argument("--weightDecay", type=float, default=0.0)
@@ -71,6 +73,7 @@ def main(argv=None):
     optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, every_epoch())
+    optimizer.set_iterations_per_dispatch(args.iterationsPerDispatch)
     optimizer.optimize()
 
 

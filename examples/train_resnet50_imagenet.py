@@ -20,6 +20,8 @@ def main(argv=None):
                    help="local dir or fsspec URL (gs://bucket/shards, "
                         "s3://..., memory://) of .bdts shards")
     p.add_argument("-b", "--batchSize", type=int, default=256)
+    p.add_argument("--iterationsPerDispatch", type=int, default=1,
+                   help="device-side loop: n scanned steps per dispatch")
     p.add_argument("--caffeWeights", default=None)
     p.add_argument("--learningRate", type=float, default=0.1)
     p.add_argument("--weightDecay", type=float, default=1e-4)
@@ -78,6 +80,7 @@ def main(argv=None):
     optimizer.set_end_when(max_epoch(args.maxEpoch))
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, every_epoch())
+    optimizer.set_iterations_per_dispatch(args.iterationsPerDispatch)
     optimizer.optimize()
 
 

@@ -25,6 +25,8 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("-f", "--dataFolder", default="./rnn_corpus.txt")
     p.add_argument("-b", "--batchSize", type=int, default=4)
+    p.add_argument("--iterationsPerDispatch", type=int, default=1,
+                   help="device-side loop: n scanned steps per dispatch")
     p.add_argument("--vocabSize", type=int, default=4000)
     p.add_argument("--hiddenSize", type=int, default=40)
     p.add_argument("--bptt", type=int, default=4)
@@ -69,6 +71,7 @@ def main(argv=None):
     opt = LocalOptimizer(model, ds, crit)
     opt.set_state(T(learningRate=args.learningRate))
     opt.set_end_when(max_epoch(args.maxEpoch))
+    opt.set_iterations_per_dispatch(args.iterationsPerDispatch)
     opt.optimize()
 
 

@@ -18,7 +18,8 @@ def run_example(module_name, argv):
 
 @pytest.mark.parametrize("module,argv", [
     ("examples.train_lenet",
-     ["--folder", "/nonexistent", "--batchSize", "32", "--maxEpoch", "1"]),
+     ["--folder", "/nonexistent", "--batchSize", "32", "--maxEpoch", "1",
+      "--iterationsPerDispatch", "4"]),
     ("examples.train_vgg",
      # --maxIteration caps the synthetic epoch: a full 2048-sample epoch
      # of VGG-16 on the CPU mesh costs ~17 min and dominated the whole
