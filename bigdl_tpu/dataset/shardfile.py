@@ -37,6 +37,7 @@ class _ShardWriter:
     def __init__(self, path):
         self.path = path
         self.n = 0
+        self.closed = False
         self._local = not fs.is_url(path)
         if self._local:
             fs.makedirs(os.path.dirname(os.path.abspath(path)))
@@ -60,6 +61,7 @@ class _ShardWriter:
             os.replace(self._tmp, self.path)
         else:
             fs.write_bytes_atomic(self.path, self._f.getvalue())
+        self.closed = True
         return self.n
 
     def abort(self):
@@ -101,10 +103,9 @@ def write_shards(records, out_dir, n_shards: int = 8, prefix: str = "shard"):
             writers[i % n_shards].append(label, data)
         for w in writers:
             w.close()
-            w.closed = True
     except BaseException:
         for w in writers:
-            if not getattr(w, "closed", False):
+            if not w.closed:
                 w.abort()
         raise
     return [w.path for w in writers]

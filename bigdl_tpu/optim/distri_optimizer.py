@@ -228,13 +228,12 @@ class DistriOptimizer(LocalOptimizer):
                               reps(opt_state), data_s)
 
     def _state_trees(self):
+        # used only to derive sharding specs: opt_state as abstract
+        # ShapeDtypeStructs (the rules read .ndim/.shape), so building the
+        # step never materializes a second model-sized state tree in HBM
         params = self.model.params()
         net_state = self.model.state()
-        if self._resume_opt_state is not None:
-            opt_state = jax.tree_util.tree_map(jnp.asarray,
-                                               self._resume_opt_state)
-        else:
-            opt_state = self.optim_method.init_state(params)
+        opt_state = jax.eval_shape(self.optim_method.init_state, params)
         return params, net_state, opt_state
 
     def _build_step(self):
@@ -265,11 +264,7 @@ class DistriOptimizer(LocalOptimizer):
 
         params = jax.tree_util.tree_map(jnp.copy, self.model.params())
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
-        if self._resume_opt_state is not None:
-            opt_state = jax.tree_util.tree_map(jnp.asarray,
-                                               self._resume_opt_state)
-        else:
-            opt_state = self.optim_method.init_state(params)
+        opt_state = self._initial_opt_state(params)
         step_fn = self._build_step()
 
         count = 0

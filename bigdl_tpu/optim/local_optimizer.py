@@ -96,6 +96,16 @@ class LocalOptimizer:
         self.end_when = end_when
         return self
 
+    def _initial_opt_state(self, params):
+        """Fresh optimizer state, or the restored snapshot from
+        set_optim_state.  The snapshot is COPIED: the donating jit step
+        would otherwise delete the caller's buffers after one dispatch
+        (same guard as the params/net_state copies in optimize())."""
+        if self._resume_opt_state is not None:
+            return jax.tree_util.tree_map(lambda v: jnp.array(v),
+                                          self._resume_opt_state)
+        return self.optim_method.init_state(params)
+
     def set_validation(self, trigger, dataset, methods):
         self.validation_trigger = trigger
         self.validation_dataset = dataset
@@ -228,11 +238,7 @@ class LocalOptimizer:
         # holding deleted arrays mid-training
         params = jax.tree_util.tree_map(jnp.copy, self.model.params())
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
-        if self._resume_opt_state is not None:
-            opt_state = jax.tree_util.tree_map(jnp.asarray,
-                                               self._resume_opt_state)
-        else:
-            opt_state = self.optim_method.init_state(params)
+        opt_state = self._initial_opt_state(params)
         step_fn = self._build_step()
 
         count = 0
