@@ -19,7 +19,10 @@ def bench():
 
 
 def test_all_five_configs_present(bench):
-    names = [c[0] for c in bench.configs()]
+    cfgs = bench.configs()
+    names = [c[0] for c in cfgs]
+    for c in cfgs:
+        assert len(c) == 6, f"config tuple arity changed: {c[0]}"
     for want in ("LeNet", "VGG-16", "Inception", "Bi-LSTM", "ResNet-50"):
         assert any(want in n for n in names), (want, names)
 
@@ -32,7 +35,7 @@ def test_every_config_builds_and_traces(bench):
     set_seed(1)
     bt.set_policy(bt.BF16_COMPUTE)
     try:
-        for name, build, recs, unit, aflops in bench.configs():
+        for name, build, recs, unit, aflops, n_disp in bench.configs():
             model, criterion, x, y = build()
             step, params, net_state, opt_state = bench.make_step(
                 model, criterion)
