@@ -378,7 +378,9 @@ def main():
     roof, device = None, "unknown"
     # headline (Inception) FIRST so a driver kill at any point still
     # leaves the number that matters on stdout
-    for key in ("inception", "resnet", "lenet", "vgg-16", "bi-lstm"):
+    # headline first; bi-lstm before the fast tail configs (it is the
+    # most wedge-prone and must not be the one the deadline reaps)
+    for key in ("inception", "resnet", "bi-lstm", "lenet", "vgg-16"):
         t0 = time.monotonic()
         print("benching: %s" % key, file=sys.stderr, flush=True)
         got = _subprocess_json(key, timeout_s=300)
