@@ -46,7 +46,9 @@ logger = logging.getLogger("bigdl_tpu.optim")
 class DistriOptimizer(LocalOptimizer):
     def __init__(self, model, dataset, criterion, mesh=None,
                  drop_percentage: float = 0.0, tensor_parallel: bool = False,
-                 zero1: bool = False, gradient_compression: str = None):
+                 zero1: bool = False, gradient_compression: str = None,
+                 pipeline_stages: int = None, pipeline_schedule: str = "1f1b",
+                 pipeline_microbatches: int = None):
         """``tensor_parallel=True`` with a mesh containing a ``model`` axis
         shards eligible weights (and their optimizer state) over that axis
         via ``parallel.sharding.shard_params_rule`` — hybrid DP x TP with
@@ -63,15 +65,54 @@ class DistriOptimizer(LocalOptimizer):
         bits before crossing the network): the step is built with
         ``shard_map`` so each device computes local grads, casts them to
         bf16, and the cross-device all-reduce moves bf16 — halving
-        ICI/DCN gradient traffic — before the f32 update."""
+        ICI/DCN gradient traffic — before the f32 update.
+
+        ``pipeline_stages=P`` trains a ``Sequential`` model with pipeline
+        parallelism over a ``pipe`` mesh axis — the model is stage-
+        partitioned automatically (``parallel/pipeline_model.py``) and the
+        batch streams through as ``pipeline_microbatches`` microbatches
+        (default 2·P) under ``pipeline_schedule``: ``"1f1b"`` (bounded
+        activation memory) or ``"gpipe"`` (optionally with
+        ``set_gradient_checkpointing``).  Same front door as every other
+        distribution mode (ref Optimizer.scala:151-186).  Stage sharding
+        owns the whole mesh, so it composes with none of
+        tensor_parallel/zero1/gradient_compression — and gradients never
+        cross ranks under PP (each stage's grads stay home), so there is
+        no wire to compress."""
         super().__init__(model, dataset, criterion)
         if gradient_compression not in (None, "bf16"):
             raise ValueError("gradient_compression must be None or 'bf16'")
-        if gradient_compression and (tensor_parallel or zero1):
+        if pipeline_stages is not None:
+            if tensor_parallel or zero1 or gradient_compression:
+                raise ValueError(
+                    "pipeline_stages owns the mesh; it does not combine "
+                    "with tensor_parallel/zero1/gradient_compression")
+            if pipeline_schedule not in ("1f1b", "gpipe"):
+                raise ValueError("pipeline_schedule must be '1f1b' or "
+                                 "'gpipe'")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "pipeline_stages requires a single-process runtime: "
+                    "multi-host PP needs globally identical batches and a "
+                    "cross-host stage gather, neither of which the "
+                    "sharded-dataset feeding path provides")
+            if mesh is None:
+                from bigdl_tpu.parallel.mesh import make_mesh
+                mesh = make_mesh({"pipe": pipeline_stages})
+            if "pipe" not in mesh.axis_names or \
+                    mesh.shape["pipe"] != pipeline_stages:
+                raise ValueError(
+                    f"mesh needs a 'pipe' axis of size {pipeline_stages}, "
+                    f"got {dict(mesh.shape)}")
+        elif gradient_compression and (tensor_parallel or zero1):
             raise NotImplementedError(
                 "gradient_compression composes with pure data parallelism, "
                 "not tensor_parallel/zero1")
         self.gradient_compression = gradient_compression
+        self.pipeline_stages = pipeline_stages
+        self.pipeline_schedule = pipeline_schedule
+        self.pipeline_microbatches = pipeline_microbatches
+        self._pipe_plan = None
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.tensor_parallel = tensor_parallel
         self.zero1 = zero1
@@ -85,16 +126,38 @@ class DistriOptimizer(LocalOptimizer):
         """Accepted for API parity; see class docstring (no-op)."""
         return self
 
+    def _maybe_validate(self, params, net_state, state, force=False):
+        # triggers first (every_epoch is stateful — probe exactly once),
+        # THEN the pipeline unpack: validation consumes module-tree
+        # pytrees, but unpacking the stage-stacked arrays is a full-model
+        # host gather that must not run on every non-firing iteration
+        if not force and (self.validation_trigger is None
+                          or not self.validation_trigger(state)):
+            return
+        if self._pipe_plan is not None:
+            params = self._pipe_plan.unpack_params(params)
+            net_state = self._pipe_plan.unpack_state(net_state)
+        super()._maybe_validate(params, net_state, state, force=True)
+
     def _maybe_checkpoint(self, params, net_state, opt_state, state,
                           force=False):
+        if not force and (self.checkpoint_trigger is None
+                          or not self.checkpoint_trigger(state)):
+            return
         # params are replicated, so exactly one process writes — the
         # reference gathers slices to the driver and saves once
         # (getModel + File.save, DistriOptimizer.scala:320-342); writing
         # from every host would race on a shared checkpoint path.
         if jax.process_index() != 0:
             return
+        if self._pipe_plan is not None:
+            # unpack only when actually firing (full-model host gather);
+            # opt_state stays stage-stacked — a resumed run re-packs the
+            # same partition, so set_optim_state round-trips
+            params = self._pipe_plan.unpack_params(params)
+            net_state = self._pipe_plan.unpack_state(net_state)
         super()._maybe_checkpoint(params, net_state, opt_state, state,
-                                  force=force)
+                                  force=True)
 
     def _shardings(self, params, net_state, opt_state):
         mesh = self.mesh
@@ -236,7 +299,85 @@ class DistriOptimizer(LocalOptimizer):
         opt_state = jax.eval_shape(self.optim_method.init_state, params)
         return params, net_state, opt_state
 
+    def _build_step_pipeline(self):
+        """Pipeline-parallel train step through the same Optimizer front
+        door (ref Optimizer.scala:151-186): partition the Sequential model
+        into P stages, stream the batch as M microbatches under the chosen
+        schedule, update each stage's params with the stage-local grads.
+        Params/opt-state/net-state live stage-sharded on the ``pipe`` axis
+        — per-device model memory is O(|model|/P), the point of PP."""
+        from bigdl_tpu.parallel.pipeline import (pipeline_apply,
+                                                 pipeline_train_1f1b)
+        from bigdl_tpu.parallel.pipeline_model import partition_sequential
+
+        # Shape peek from the TRAIN stream (the eval pass may end with a
+        # partial batch and its first batch can differ from the looped
+        # train batch size), with the host RNG snapshotted/restored: the
+        # peek's shuffle permutation and augmentation draws must not
+        # advance the stream, or every later batch would shift and the
+        # trajectory would silently diverge from an identical
+        # non-pipeline run.  (A PreFetch stage in the pipeline draws from
+        # per-thread derived streams this snapshot cannot cover.)
+        rng_state = RNG.np_rng().get_state()
+        peek = next(iter(self.dataset.data(train=True)))
+        RNG.np_rng().set_state(rng_state)
+        xb = np.asarray(peek.data)
+        B = xb.shape[0]
+        M = self.pipeline_microbatches or 2 * self.pipeline_stages
+        if B % M:
+            raise ValueError(
+                f"batch size {B} is not divisible by "
+                f"pipeline_microbatches={M}")
+        plan = partition_sequential(self.model, self.pipeline_stages,
+                                    (B // M,) + xb.shape[1:], axis="pipe")
+        self._pipe_plan = plan
+        logger.info("pipeline partition (schedule=%s, %d microbatches):\n%s",
+                    self.pipeline_schedule, M, plan.describe())
+
+        criterion, method = self.criterion, self.optim_method
+        static_hyper = self._hyper(None)
+        del static_hyper["lr"]
+        if self._setup_lr_scales(static_hyper):
+            raise ValueError("state['learningRates'] (per-param lr scales) "
+                             "is not supported with pipeline_stages")
+        mesh, schedule, remat = self.mesh, self.pipeline_schedule, self.remat
+        loss_fn = plan.make_loss_fn(criterion)
+
+        def step(stacked_p, stacked_s, opt_state, x, y, lr, key, lr_scales):
+            hyper = dict(static_hyper, lr=lr)
+            xf = plan.pack_input(x.reshape((M, plan.mb) + x.shape[1:]))
+            tm = y.reshape((M, plan.mb) + y.shape[1:])
+            stage_fn = plan.make_stage_fn(key)
+            if schedule == "1f1b":
+                loss, grads, new_s = pipeline_train_1f1b(
+                    stage_fn, loss_fn, stacked_p, xf, tm, mesh, "pipe",
+                    stage_state=stacked_s)
+            else:
+                def gpipe_loss(p, s):
+                    outs, ns = pipeline_apply(stage_fn, p, xf, mesh, "pipe",
+                                              remat=remat, stage_state=s)
+                    return jax.vmap(loss_fn)(outs, tm).mean(), ns
+
+                (loss, new_s), grads = jax.value_and_grad(
+                    gpipe_loss, has_aux=True)(stacked_p, stacked_s)
+            new_p, new_opt = method.update(grads, opt_state, stacked_p,
+                                           hyper)
+            return new_p, new_s, new_opt, loss
+
+        pipe = NamedSharding(mesh, P("pipe"))
+        rep = NamedSharding(mesh, P())
+        n = self.iters_per_dispatch
+        fn = step if n <= 1 else self._scan_chunk(step, n)
+        return jax.jit(
+            fn,
+            in_shardings=(pipe, pipe, pipe, rep, rep, rep, rep, rep),
+            out_shardings=(pipe, pipe, pipe, rep),
+            donate_argnums=(0, 1, 2),
+        )
+
     def _build_step(self):
+        if self.pipeline_stages is not None:
+            return self._build_step_pipeline()
         if self.gradient_compression:
             return self._build_step_compressed()
         step = self._core_step()
@@ -249,7 +390,12 @@ class DistriOptimizer(LocalOptimizer):
         shard.  ``stacked=True``: (n, local_B, ...) chunk for the
         device-side loop — sharded over "data" on dim 1."""
         mesh = self.mesh
-        spec = P(None, "data") if stacked else P("data")
+        if self.pipeline_stages is not None:
+            # pipeline ranks consume the whole microbatch stream: operands
+            # ride replicated (pipeline_train_1f1b in_specs P())
+            spec = P()
+        else:
+            spec = P(None, "data") if stacked else P("data")
         sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
             return (jax.device_put(jnp.asarray(x), sharding),
@@ -262,10 +408,16 @@ class DistriOptimizer(LocalOptimizer):
         state.get_or_update("epoch", 1)
         state.get_or_update("neval", 1)
 
+        step_fn = self._build_step()  # pipeline mode builds its plan here
         params = jax.tree_util.tree_map(jnp.copy, self.model.params())
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
+        if self._pipe_plan is not None:
+            pipe_s = NamedSharding(self.mesh, P("pipe"))
+            params = jax.device_put(self._pipe_plan.pack_params(params),
+                                    pipe_s)
+            net_state = jax.device_put(self._pipe_plan.pack_state(net_state),
+                                       pipe_s)
         opt_state = self._initial_opt_state(params)
-        step_fn = self._build_step()
 
         count = 0
         epoch_size = self.dataset.size()
@@ -312,6 +464,9 @@ class DistriOptimizer(LocalOptimizer):
             self._fire_triggers(params, net_state, opt_state, state, n_disp)
 
         # gather (replicated -> host) and write back, ref getModel :475-499
+        if self._pipe_plan is not None:
+            params = self._pipe_plan.unpack_params(params)
+            net_state = self._pipe_plan.unpack_state(net_state)
         self.model.load_params(jax.device_get(params))
         self.model.load_state(jax.device_get(net_state))
         logger.info("Training finished in %.1fs", time.perf_counter() - wall_start)

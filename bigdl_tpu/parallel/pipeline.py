@@ -32,7 +32,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
-                   axis: str = "pipe", remat: bool = False):
+                   axis: str = "pipe", remat: bool = False,
+                   stage_state=None):
     """Run a P-stage pipeline over microbatches.
 
     stage_fn(params_slice, x) -> y          (one stage's computation;
@@ -41,6 +42,17 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
                   sharded over ``axis``.
     x_micro: (M, micro_batch, ...) microbatched input (replicated).
     Returns (M, micro_batch, ...) outputs of the last stage.
+
+    ``stage_state`` (optional): a stage-stacked pytree of per-stage carried
+    state (e.g. BatchNorm running stats), sharded over ``axis`` like the
+    params.  When given, the stage function takes the extended signature
+    ``stage_fn(params_slice, state_slice, x, micro_idx) -> (y, new_state)``
+    — ``micro_idx`` is the (traced) global microbatch index, for deriving
+    per-microbatch RNG keys — state updates apply only on valid (non-fill/
+    drain) ticks, sequentially per microbatch (the reference's per-clone
+    running-stat updates on sub-batches, BatchNormalization.scala under
+    _subModelNumber), and the return value becomes
+    ``(outputs, new_stage_state)``.
 
     ``remat=True`` wraps the stage in ``jax.checkpoint``: only the
     pipeline-boundary activations (the scan carry, one microbatch
@@ -55,13 +67,21 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
     docs/distributed.md records both cost models.
     """
     n_stage = mesh.shape[axis]
+    stateful = stage_state is not None
+    if stateful:
+        fn = stage_fn
+    else:
+        # legacy stateless signature; dummy state rides along untouched
+        fn = lambda p, s, x, m: (stage_fn(p, x), s)
+        stage_state = jnp.zeros((n_stage, 1), jnp.float32)
     if remat:
-        stage_fn = jax.checkpoint(stage_fn)
+        fn = jax.checkpoint(fn)
 
-    def ranked(params, x_all):
+    def ranked(params, st, x_all):
         # inside shard_map: params has leading dim 1 (my stage), x_all is
         # the full microbatch stack (replicated)
         my_params = jax.tree_util.tree_map(lambda v: v[0], params)
+        my_state = jax.tree_util.tree_map(lambda v: v[0], st)
         rank = lax.axis_index(axis)
         n_micro = x_all.shape[0]
         n_ticks = n_micro + n_stage - 1
@@ -74,13 +94,19 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
                      (axis,))
 
         def tick(carry, t):
-            buf, outs = carry
+            buf, outs, my_state = carry
+            # rank r processes the microbatch rank 0 injected at t - r
+            m = t - rank
+            valid = (m >= 0) & (m < n_micro)
             # rank 0 injects microbatch t (when available)
             inject = x_all[jnp.clip(t, 0, n_micro - 1)]
             cur = jnp.where(rank == 0,
                             jnp.where(t < n_micro, inject, jnp.zeros_like(inject)),
                             buf)
-            y = stage_fn(my_params, cur)
+            y, ns = fn(my_params, my_state, cur, m)
+            # state advances only on valid ticks (fill/drain run on zeros)
+            my_state = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(valid, new, old), my_state, ns)
             # last rank emits microbatch (t - (P-1)) at tick t
             out_idx = t - (n_stage - 1)
             emit = (rank == n_stage - 1) & (out_idx >= 0)
@@ -88,20 +114,24 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
                 outs, y, jnp.maximum(out_idx, 0), 0)
             outs = jnp.where(emit, upd, outs)
             buf = lax.ppermute(y, axis, fwd)
-            return (buf, outs), None
+            return (buf, outs, my_state), None
 
-        (buf, outs), _ = lax.scan(tick, (buf, outs), jnp.arange(n_ticks))
+        (buf, outs, my_state), _ = lax.scan(
+            tick, (buf, outs, my_state), jnp.arange(n_ticks))
         # every rank holds `outs`, but only the last rank's is real;
         # broadcast it (max works since others are zero-initialized only if
         # last rank wrote) — use psum of masked value for correctness
         mask = (rank == n_stage - 1).astype(outs.dtype)
         outs = lax.psum(outs * mask, axis)
-        return outs
+        return outs, jax.tree_util.tree_map(lambda v: v[None], my_state)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    sspec = jax.tree_util.tree_map(lambda _: P(axis), stage_state)
     f = jax.shard_map(ranked, mesh=mesh,
-                      in_specs=(pspec, P()), out_specs=P())
-    return f(stage_params, x_micro)
+                      in_specs=(pspec, sspec, P()),
+                      out_specs=(P(), sspec))
+    outs, new_state = f(stage_params, stage_state, x_micro)
+    return (outs, new_state) if stateful else outs
 
 
 def stack_stage_params(per_stage_params):
@@ -111,7 +141,7 @@ def stack_stage_params(per_stage_params):
 
 def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
                         mesh: Mesh, axis: str = "pipe",
-                        shard_inputs: bool = False):
+                        shard_inputs: bool = False, stage_state=None):
     """1F1B pipeline schedule: forward and backward interleaved so each
     stage keeps at most ~2*(P-1)+1 in-flight microbatch activations —
     independent of the microbatch count — where GPipe's autodiff keeps
@@ -145,8 +175,27 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
     and the owner delivers the tick's microbatch with ONE masked psum
     (same for the target on the backward side) — O(n_micro/P) operand
     memory for two extra microbatch-sized collectives per tick.
+
+    ``stage_state`` (optional): stage-stacked carried state (BN running
+    stats), sharded over ``axis``; switches the stage function to the
+    extended signature ``stage_fn(params_slice, state_slice, x, micro_idx)
+    -> (y, new_state)`` and the return value to ``(loss, grads,
+    new_stage_state)``.  Contract: a stage's TRAINING-mode output must not
+    depend on the carried state (true of BatchNorm, which normalizes by
+    batch statistics in training — running stats are eval-only), because
+    the backward-time recompute runs against a later state than the
+    forward half; stochastic layers must key off ``micro_idx`` so the
+    recompute draws the same mask.  State advances once per valid forward
+    tick — per-microbatch sequential EMA, the reference's per-clone
+    sub-batch updates (BatchNormalization.scala under _subModelNumber).
     """
     n_stage = mesh.shape[axis]
+    stateful = stage_state is not None
+    if stateful:
+        fn = stage_fn
+    else:
+        fn = lambda p, s, x, m: (stage_fn(p, x), s)
+        stage_state = jnp.zeros((n_stage, 1), jnp.float32)
     n_micro = x_micro.shape[0]
     depth = 2 * n_stage  # circular residual buffer, >= max in-flight + 1
     if shard_inputs and n_micro % n_stage:
@@ -154,8 +203,9 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
                          f"divisible by the pipe axis ({n_stage})")
     per = n_micro // n_stage if shard_inputs else n_micro
 
-    def ranked(params, x_all, t_all):
+    def ranked(params, st, x_all, t_all):
         my_params = jax.tree_util.tree_map(lambda v: v[0], params)
+        my_state0 = jax.tree_util.tree_map(lambda v: v[0], st)
         rank = lax.axis_index(axis)
 
         def fetch(arr, m):
@@ -186,7 +236,7 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
         loss_acc = pvary(jnp.zeros((), jnp.float32), (axis,))
 
         def tick(carry, k):
-            buf_fwd, buf_bwd, resid, grad_acc, loss_acc = carry
+            buf_fwd, buf_bwd, resid, grad_acc, loss_acc, my_state = carry
 
             # ---------------- forward half ----------------
             mf = k - rank
@@ -194,7 +244,9 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
             # global index: rank 0 is the only consumer and its mf == k
             inject = fetch(x_all, k)
             cur = jnp.where(rank == 0, inject, buf_fwd)
-            y = stage_fn(my_params, cur)
+            y, ns = fn(my_params, my_state, cur, mf)
+            my_state = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(f_valid, new, old), my_state, ns)
             resid = lax.dynamic_update_index_in_dim(
                 resid, jnp.where(f_valid, cur, zeros_micro),
                 jnp.maximum(mf, 0) % depth, 0)
@@ -215,8 +267,12 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
             # cheap vjp of loss_fn alone on the recomputed y) or the
             # incoming activation gradient off the reverse ring.  Static
             # structure on every rank/tick, 3 stage evals per microbatch
-            # total (fwd half + recompute + bwd) as documented.
-            y_re, stage_vjp = jax.vjp(stage_fn, my_params, x_saved)
+            # total (fwd half + recompute + bwd) as documented.  The
+            # carried state is a non-diff constant here (see the stateful
+            # contract in the docstring).
+            y_re, stage_vjp = jax.vjp(
+                lambda p, xx: fn(p, my_state, xx, mb)[0],
+                my_params, x_saved)
             loss_val, loss_vjp = jax.vjp(
                 lambda yy: loss_fn(yy, tgt) / n_micro, y_re)
             one = pvary(jnp.ones((), loss_val.dtype), (axis,))
@@ -237,18 +293,21 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
                 jnp.where(b_valid, gx, jnp.zeros_like(gx)), axis, bwd_ring)
 
             return (buf_fwd_next, buf_bwd_next, resid, grad_acc,
-                    loss_acc), None
+                    loss_acc, my_state), None
 
-        carry = (buf_fwd, buf_bwd, resid, grad_acc, loss_acc)
+        carry = (buf_fwd, buf_bwd, resid, grad_acc, loss_acc, my_state0)
         carry, _ = lax.scan(tick, carry, jnp.arange(n_ticks))
-        _, _, _, grad_acc, loss_acc = carry
+        _, _, _, grad_acc, loss_acc, my_state = carry
         loss = lax.psum(loss_acc, axis)  # only last rank contributed
         grads = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
-        return loss, grads
+        return loss, grads, jax.tree_util.tree_map(lambda v: v[None],
+                                                   my_state)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+    sspec = jax.tree_util.tree_map(lambda _: P(axis), stage_state)
     xspec = P(axis) if shard_inputs else P()
     f = jax.shard_map(ranked, mesh=mesh,
-                      in_specs=(pspec, xspec, xspec),
-                      out_specs=(P(), pspec))
-    return f(stage_params, x_micro, t_micro)
+                      in_specs=(pspec, sspec, xspec, xspec),
+                      out_specs=(P(), pspec, sspec))
+    loss, grads, new_state = f(stage_params, stage_state, x_micro, t_micro)
+    return (loss, grads, new_state) if stateful else (loss, grads)

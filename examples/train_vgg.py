@@ -23,6 +23,12 @@ def main(argv=None):
                    help="stop after N iterations (smoke/perf runs)")
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--distributed", action="store_true")
+    p.add_argument("--pipeline", type=int, default=0,
+                   help="train with P pipeline-parallel stages over a "
+                        "'pipe' mesh axis (DistriOptimizer(pipeline_stages"
+                        "=P)); 0 = off")
+    p.add_argument("--pipelineSchedule", default="1f1b",
+                   choices=["1f1b", "gpipe"])
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -50,7 +56,13 @@ def main(argv=None):
     test_ds = DataSet.array(test_data) >> norm >> ImgToBatch(args.batchSize)
 
     model = VggForCifar10(class_num=10)
-    optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
+    if args.pipeline:
+        from bigdl_tpu.optim import DistriOptimizer
+        optimizer = DistriOptimizer(model, train_ds, nn.ClassNLLCriterion(),
+                                    pipeline_stages=args.pipeline,
+                                    pipeline_schedule=args.pipelineSchedule)
+    else:
+        optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
     optimizer.set_state(T(learningRate=args.learningRate,
                           momentum=args.momentum,
                           weightDecay=args.weightDecay))
