@@ -104,11 +104,14 @@ class DistriOptimizer(LocalOptimizer):
                 raise ValueError(
                     f"mesh needs a 'pipe' axis of size {pipeline_stages}, "
                     f"got {dict(mesh.shape)}")
-        elif gradient_compression and (tensor_parallel or zero1):
-            raise NotImplementedError(
-                "gradient_compression composes with pure data parallelism, "
-                "not tensor_parallel/zero1")
+        elif gradient_compression and tensor_parallel:
+            raise ValueError(
+                "gradient_compression composes with DP and zero1, not "
+                "tensor_parallel: TP grads are per-leaf sharded over the "
+                "model axis, so there is no single flat gradient wire to "
+                "compress (the reference has no TP at all)")
         self.gradient_compression = gradient_compression
+        self._z1c_flat = None  # padded flat-param length (compressed ZeRO-1)
         self.pipeline_stages = pipeline_stages
         self.pipeline_schedule = pipeline_schedule
         self.pipeline_microbatches = pipeline_microbatches
@@ -179,11 +182,12 @@ class DistriOptimizer(LocalOptimizer):
         return reps(params), reps(net_state), reps(opt_state), data
 
     def _core_step(self, fold_axis=None, grad_transform=None,
-                   state_merge=None):
+                   state_merge=None, update_transform=None):
         """The train step both builders share: loss_fn, value_and_grad,
         optimizer update.  ``fold_axis`` decorrelates the dropout key per
         replica; ``grad_transform``/``state_merge`` hook the compressed
-        path's collectives in."""
+        path's collectives in; ``update_transform`` replaces the plain
+        ``method.update`` (the compressed-ZeRO-1 owner-partition path)."""
         model, criterion, method = self.model, self.criterion, self.optim_method
         static_hyper = self._hyper(None)
         del static_hyper["lr"]
@@ -213,8 +217,12 @@ class DistriOptimizer(LocalOptimizer):
                 grads, loss = grad_transform(grads, loss)
             if state_merge is not None:
                 new_net_state = state_merge(new_net_state)
-            new_params, new_opt_state = method.update(
-                grads, opt_state, params, hyper)
+            if update_transform is not None:
+                new_params, new_opt_state = update_transform(
+                    grads, opt_state, params, hyper)
+            else:
+                new_params, new_opt_state = method.update(
+                    grads, opt_state, params, hyper)
             return new_params, new_net_state, new_opt_state, loss
 
         return step
@@ -258,8 +266,31 @@ class DistriOptimizer(LocalOptimizer):
         the reference's replicas likewise each update their own running
         stats on their sub-batch (BatchNormalization.scala under
         _subModelNumber clones); the global-batch stats of the plain jit
-        path are a (slightly tighter) superset of that behavior."""
+        path are a (slightly tighter) superset of that behavior.
+
+        ``zero1=True`` composes, reproducing the reference's single
+        mechanism where the fp16 codec and the owner-partition update are
+        one code path (AllReduceParameter.scala:162-235: compressed
+        gradient slices land on their owner, which runs optimMethod on
+        its slice and serves the updated weights back):
+
+        - local grads ravel to ONE flat vector (the reference's flattened
+          getParameters storage), padded to a multiple of the data-axis
+          size;
+        - ``psum_scatter`` in bf16 — each device receives only its owned
+          slice of the summed gradient, and only bf16 bytes cross the
+          mesh (vs pmean moving the full vector to every device);
+        - the optimizer updates the owned slice with opt state that
+          lives data-sharded (ZeRO-1: HBM per chip for optimizer state
+          drops by 1/N);
+        - ``all_gather`` redistributes the updated f32 slices (the
+          reference's getWeights).
+        """
         mesh = self.mesh
+        method = self.optim_method
+
+        def loss_mean(grads, loss):
+            return grads, jax.lax.pmean(loss, "data")
 
         def grad_transform(grads, loss):
             # compress -> all-reduce(mean) in bf16 over the wire -> f32
@@ -274,21 +305,99 @@ class DistriOptimizer(LocalOptimizer):
                 if jnp.issubdtype(jnp.asarray(s).dtype, jnp.floating) else s,
                 net_state)
 
-        step = self._core_step(fold_axis="data", grad_transform=grad_transform,
-                               state_merge=state_merge)
+        update_transform = None
+        if self.zero1:
+            from jax.flatten_util import ravel_pytree
+            if self.state.get("learningRates", None) is not None:
+                raise ValueError(
+                    "state['learningRates'] (per-param lr scales) is not "
+                    "supported with zero1 + gradient_compression: the "
+                    "owner-partition update runs on a flat slice, not the "
+                    "param tree")
+            ndata = mesh.shape["data"]
+            # concrete ravel builds the unravel closure; the flat copy is
+            # transient (freed after this scope)
+            flat0, unravel = ravel_pytree(self.model.params())
+            total = int(flat0.size)
+            pad = (-total) % ndata
+            self._z1c_flat = total + pad
+            slice_len = self._z1c_flat // ndata
+            del flat0
+
+            def update_transform(grads, opt_state, params, hyper):
+                gflat, _ = ravel_pytree(grads)
+                gflat = jnp.pad(gflat, (0, pad)).astype(jnp.bfloat16)
+                gslice = jax.lax.psum_scatter(gflat, "data", tiled=True)
+                gslice = gslice.astype(jnp.float32) / ndata
+                pflat, _ = ravel_pytree(params)
+                pflat = jnp.pad(pflat, (0, pad))
+                rank = jax.lax.axis_index("data")
+                pslice = jax.lax.dynamic_slice_in_dim(
+                    pflat, rank * slice_len, slice_len)
+                new_pslice, new_opt = method.update(
+                    gslice, opt_state, pslice, hyper)
+                new_flat = jax.lax.all_gather(new_pslice, "data", tiled=True)
+                return unravel(new_flat[:total]), new_opt
+
+        step = self._core_step(
+            fold_axis="data",
+            grad_transform=loss_mean if self.zero1 else grad_transform,
+            state_merge=state_merge, update_transform=update_transform)
         rep, data = P(), P("data")
+        if self.zero1:
+            # flat mirrors of the parameter vector shard over data; scalar
+            # leaves (e.g. Adagrad's 0-d step counter, identical on every
+            # rank) stay replicated — same guard as zero1_rule
+            ospec = jax.tree_util.tree_map(
+                self._z1c_leaf_spec, self._z1c_opt_shape())
+        else:
+            ospec = rep
         sharded = jax.shard_map(
             step, mesh=mesh,
-            in_specs=(rep, rep, rep, data, data, rep, rep, rep),
-            out_specs=(rep, rep, rep, rep),
+            in_specs=(rep, rep, ospec, data, data, rep, rep, rep),
+            out_specs=(rep, rep, ospec, rep),
             check_vma=False,
         )
         params, net_state, opt_state = self._state_trees()
         rep_s = NamedSharding(mesh, rep)
         data_s = NamedSharding(mesh, data)
         reps = lambda tree: jax.tree_util.tree_map(lambda _: rep_s, tree)
+        if self.zero1:
+            opt_s = jax.tree_util.tree_map(
+                lambda l: NamedSharding(mesh, self._z1c_leaf_spec(l)),
+                self._z1c_opt_shape())
+        else:
+            opt_s = reps(opt_state)
         return self._jit_step(sharded, reps(params), reps(net_state),
-                              reps(opt_state), data_s)
+                              opt_s, data_s)
+
+    def _z1c_opt_shape(self):
+        """Abstract optimizer-state tree for the flat compressed-ZeRO-1
+        parameter vector."""
+        return jax.eval_shape(
+            self.optim_method.init_state,
+            jax.ShapeDtypeStruct((self._z1c_flat,), jnp.float32))
+
+    def _z1c_leaf_spec(self, leaf):
+        ndata = self.mesh.shape["data"]
+        if leaf.ndim >= 1 and leaf.shape[0] % ndata == 0:
+            return P("data")
+        return P()
+
+    def _initial_opt_state(self, params):
+        """Compressed ZeRO-1 keeps optimizer state as data-sharded slices
+        of the flat parameter vector (the reference's per-partition
+        optimMethod state, AllReduceParameter.scala:162-235) — init it
+        flat; everything else defers to the base builder."""
+        if (self.gradient_compression and self.zero1
+                and self._resume_opt_state is None):
+            state = self.optim_method.init_state(
+                jnp.zeros((self._z1c_flat,), jnp.float32))
+            return jax.tree_util.tree_map(
+                lambda v: jax.device_put(
+                    v, NamedSharding(self.mesh, self._z1c_leaf_spec(v))),
+                state)
+        return super()._initial_opt_state(params)
 
     def _state_trees(self):
         # used only to derive sharding specs: opt_state as abstract
