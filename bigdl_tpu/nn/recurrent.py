@@ -225,6 +225,13 @@ class BiRecurrent(Container):
 
     def apply(self, params, x, state, ctx):
         if self._fused_lstm_eligible():
+            if ctx.training:
+                # consume exactly the two keys the two-scan path draws
+                # (one per Recurrent.apply): a model with stochastic
+                # layers AFTER this module must see the same downstream
+                # key stream whichever path runs
+                ctx.next_key()
+                ctx.next_key()
             y = self._apply_fused_lstm(params, x, ctx)
             return y, state
         yf, sf = self.modules[0].apply(params["0"], x, state["0"], ctx)

@@ -143,7 +143,7 @@ class DistriOptimizer(LocalOptimizer):
         super()._maybe_validate(params, net_state, state, force=True)
 
     def _maybe_checkpoint(self, params, net_state, opt_state, state,
-                          force=False):
+                          force=False, neval_label=None):
         if not force and (self.checkpoint_trigger is None
                           or not self.checkpoint_trigger(state)):
             return
@@ -160,7 +160,7 @@ class DistriOptimizer(LocalOptimizer):
             params = self._pipe_plan.unpack_params(params)
             net_state = self._pipe_plan.unpack_state(net_state)
         super()._maybe_checkpoint(params, net_state, opt_state, state,
-                                  force=True)
+                                  force=True, neval_label=neval_label)
 
     def _shardings(self, params, net_state, opt_state):
         mesh = self.mesh
@@ -578,5 +578,8 @@ class DistriOptimizer(LocalOptimizer):
             net_state = self._pipe_plan.unpack_state(net_state)
         self.model.load_params(jax.device_get(params))
         self.model.load_state(jax.device_get(net_state))
+        # snapshot per-node metrics while every process is still here, so
+        # post-training summary(per_node=True) from one process is safe
+        self.metrics.collect_per_node()
         logger.info("Training finished in %.1fs", time.perf_counter() - wall_start)
         return self.model

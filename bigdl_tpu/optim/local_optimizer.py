@@ -319,30 +319,37 @@ class LocalOptimizer:
         trigger would have fired at ANY intermediate iteration of the
         chunk (at most once per dispatch)."""
         if n_disp > 1:
-            if self._fired_within(self.validation_trigger, state, n_disp):
+            if self._fired_within(self.validation_trigger, state,
+                                  n_disp) is not None:
                 self._maybe_validate(params, net_state, state, force=True)
-            if self._fired_within(self.checkpoint_trigger, state, n_disp):
+            ne = self._fired_within(self.checkpoint_trigger, state, n_disp)
+            if ne is not None:
+                # label the snapshot with the nominal firing iteration
+                # (the first matched neval inside the chunk), so a
+                # several_iteration(k) run numbers its files at the
+                # k-multiples resume tooling expects even when k < n
                 self._maybe_checkpoint(params, net_state, opt_state, state,
-                                       force=True)
+                                       force=True, neval_label=ne)
         else:
             self._maybe_validate(params, net_state, state)
             self._maybe_checkpoint(params, net_state, opt_state, state)
 
     @staticmethod
     def _fired_within(trig, state, n):
-        """Would ``trig`` have fired at any neval in this chunk's
-        (neval-n, neval] interval?  Probes a shallow state copy per
-        intermediate iteration (triggers are cheap predicates)."""
+        """The first neval in this chunk's (neval-n, neval] interval at
+        which ``trig`` would have fired, or None.  Probes a shallow state
+        copy per intermediate iteration (triggers are cheap
+        predicates)."""
         if trig is None:
-            return False
+            return None
         neval = state["neval"]
         for ne in range(neval - n + 1, neval + 1):
             probe = T()
             probe.update(state)
             probe["neval"] = ne
             if trig(probe):
-                return True
-        return False
+                return ne
+        return None
 
     # -- validation (ref LocalOptimizer.scala:196-242) --------------------
     def _maybe_validate(self, params, net_state, state, force=False):
@@ -356,17 +363,20 @@ class LocalOptimizer:
             state[str(method)] = result.result()[0]
 
     def _maybe_checkpoint(self, params, net_state, opt_state, state,
-                          force=False):
+                          force=False, neval_label=None):
         if not force and (self.checkpoint_trigger is None
                           or not self.checkpoint_trigger(state)):
             return
-        neval = state["neval"]
+        neval = state["neval"] if neval_label is None else neval_label
         # load host copies: loading the live pytree would leave the module
         # referencing buffers the next (donating) step deletes
         self.model.load_params(jax.device_get(params))
         self.model.load_state(jax.device_get(net_state))
         File.save_module(self.model, f"{self.checkpoint_path}/model.{neval}")
-        File.save({"state": state, "opt_state": opt_state},
+        # "neval": the file label (= the nominal firing iteration under
+        # the device-side loop, which may be < state['neval']); kept in
+        # the payload so resume tooling can detect the chunked case
+        File.save({"state": state, "opt_state": opt_state, "neval": neval},
                   f"{self.checkpoint_path}/state.{neval}")
 
 

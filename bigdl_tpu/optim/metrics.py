@@ -21,6 +21,7 @@ class Metrics:
         self._sums = defaultdict(float)
         self._counts = defaultdict(int)
         self._distributed = set()
+        self._per_node_cache = {}
 
     def set(self, name: str, value: float, distributed: bool = False):
         self._sums[name] = value
@@ -43,16 +44,37 @@ class Metrics:
     def per_node(self, name: str):
         """One mean per jax PROCESS (the reference's per-node accumulator
         readout, Metrics.scala "computing time for each node" consumed by
-        DistriOptimizer.scala:114-118).  Single-process: a 1-list."""
+        DistriOptimizer.scala:114-118).  Single-process: a 1-list.
+
+        Multi-process this is a COLLECTIVE unless a cached snapshot
+        exists: DistriOptimizer calls :meth:`collect_per_node` at the end
+        of ``optimize()`` — a point every process reaches — so post-
+        training ``per_node``/``summary(per_node=True)`` from process 0
+        alone reads the cache instead of deadlocking the other hosts
+        waiting in ``process_allgather``."""
         import jax
         local = self.mean(name)
         if jax.process_count() == 1:
             return [local]
+        if name in self._per_node_cache:
+            return list(self._per_node_cache[name])
         import numpy as np
         from jax.experimental import multihost_utils
         vals = multihost_utils.process_allgather(
             np.asarray(local, np.float64))
         return [float(v) for v in np.asarray(vals).reshape(-1)]
+
+    def collect_per_node(self):
+        """Eagerly gather the per-process snapshot of every distributed
+        entry (collective — every process must call this together); later
+        ``per_node``/``summary(per_node=True)`` calls are then local."""
+        import jax
+        if jax.process_count() == 1:
+            return self
+        for name in sorted(self._distributed):
+            self._per_node_cache.pop(name, None)
+            self._per_node_cache[name] = self.per_node(name)
+        return self
 
     @contextmanager
     def timer(self, name: str, distributed: bool = False):
@@ -85,3 +107,4 @@ class Metrics:
         self._sums.clear()
         self._counts.clear()
         self._distributed.clear()
+        self._per_node_cache.clear()

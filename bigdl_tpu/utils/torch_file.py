@@ -271,7 +271,7 @@ def load_module_weights(model, path, strict: bool = True):
                 src = src.reshape(dst.shape)
             store[name] = jnp.asarray(src, dst.dtype)
 
-    skipped = []
+    missing_params, missing_buffers = [], []
     for tm, tgt in zip(torch_mods, targets):
         names = ("weight", "bias") + tuple(
             k for k in tgt._params if k not in ("weight", "bias"))
@@ -279,12 +279,24 @@ def load_module_weights(model, path, strict: bool = True):
             copy_into(tgt._params, name, tm)
         for name in tuple(tgt._buffers):
             copy_into(tgt._buffers, name, tm)
-        for name in tuple(tgt._params) + tuple(tgt._buffers):
+        for name in tuple(tgt._params):
             if tm.get(name) is None:
-                skipped.append(f"{type(tgt).__name__}.{name}")
+                missing_params.append(f"{type(tgt).__name__}.{name}")
+        for name in tuple(tgt._buffers):
+            if tm.get(name) is None:
+                missing_buffers.append(f"{type(tgt).__name__}.{name}")
+    if missing_params and strict:
+        # a missing PARAMETER means the model would train/predict with
+        # random values where the checkpoint was expected to provide them
+        raise ValueError(
+            f".t7 file lacks {len(missing_params)} parameter field(s): "
+            f"{', '.join(missing_params[:8])}"
+            + ("..." if len(missing_params) > 8 else "")
+            + " (strict=False loads what exists and warns)")
+    skipped = missing_params + missing_buffers
     if skipped:
-        # not fatal even under strict: e.g. legacy torch files store
-        # running_std instead of running_var — but never silent
+        # buffers stay warn-only even under strict: e.g. legacy torch
+        # files store running_std instead of running_var — never silent
         import warnings
         warnings.warn(
             f".t7 file lacks {len(skipped)} field(s) kept at their "

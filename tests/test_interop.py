@@ -167,6 +167,31 @@ class TestT7ZooRoundTrip:
                                    np.asarray(m2.forward(x)),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_strict_raises_on_missing_param(self, tmp_path):
+        """strict=True must refuse a checkpoint that leaves a PARAMETER
+        at its random init (a truncated/mismatched .t7); buffers (BN
+        running stats) stay warn-only (legacy files store running_std)."""
+        from bigdl_tpu.utils import torch_file
+        from bigdl_tpu.utils.random import set_seed
+
+        set_seed(11)
+        src = nn.Linear(4, 3)
+        src._params.pop("bias")       # simulate a bias-less source layer
+        src._grads.pop("bias")
+        p = tmp_path / "nobias.t7"
+        torch_file.save_module(src, str(p))
+
+        set_seed(12)
+        dst = nn.Linear(4, 3)
+        with pytest.raises(ValueError, match="parameter field"):
+            torch_file.load_module_weights(dst, str(p))
+        # non-strict: loads what exists, warns
+        with pytest.warns(UserWarning, match="lacks"):
+            torch_file.load_module_weights(dst, str(p), strict=False)
+        np.testing.assert_allclose(np.asarray(dst._params["weight"]),
+                                   np.asarray(src._params["weight"]),
+                                   rtol=1e-6)
+
     def test_rnn_roundtrip(self, tmp_path):
         from bigdl_tpu.models.textclassifier import TextClassifierBiLSTM
         from bigdl_tpu.utils import torch_file

@@ -153,3 +153,31 @@ def test_bilstm_fused_matches_two_scan():
                       jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_bilstm_fused_preserves_downstream_key_stream():
+    """The fused Bi-LSTM path must consume the same number of ctx keys as
+    the two-scan path (one per Recurrent.apply), so stochastic layers
+    AFTER a BiRecurrent see an identical RNG stream whichever path runs
+    — a model's reproducibility must not depend on fusion eligibility."""
+    from bigdl_tpu.nn.module import Context
+    import jax
+
+    from bigdl_tpu.utils.random import set_seed
+    set_seed(5)
+    fused = nn.BiRecurrent(nn.LSTMCell(6, 5), nn.LSTMCell(6, 5))
+    assert fused._fused_lstm_eligible()
+    set_seed(5)
+    unfused = nn.BiRecurrent(nn.LSTMCell(6, 5), nn.LSTMCell(6, 5),
+                             bptt_truncate=2)
+    assert not unfused._fused_lstm_eligible()
+
+    x = jnp.asarray(np.random.RandomState(1).randn(3, 7, 6), np.float32)
+    key = jax.random.PRNGKey(9)
+
+    ctx_f = Context(training=True, key=key)
+    fused.apply(fused.params(), x, fused.state(), ctx_f)
+    ctx_u = Context(training=True, key=key)
+    unfused.apply(unfused.params(), x, unfused.state(), ctx_u)
+    np.testing.assert_array_equal(np.asarray(ctx_f.key),
+                                  np.asarray(ctx_u.key))
