@@ -42,6 +42,48 @@ def guess_peak(device) -> float:
     return 197e12  # default to v5e
 
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOFLINE_SIDECAR = os.path.join(_HERE, ".bench_roofline.json")
+
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache for bench subprocesses (same
+    mechanism as tests/conftest.py).  Measured on the axon-relay v5e: the
+    cache DOES serve TPU executables across processes (1.15s cold ->
+    0.01s warm for a probe jit), so retries after a relay wedge and
+    repeat runs skip their compile, reclaiming 20-60s of each 300s
+    config budget."""
+    import jax
+    try:
+        os.makedirs(os.path.join(_HERE, ".xla_cache"), exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_HERE, ".xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # cache is an optimization, never a blocker
+        print("compile cache unavailable: %r" % e, file=sys.stderr,
+              flush=True)
+
+
+def _save_roofline_sidecar(roof, device):
+    try:
+        with open(_ROOFLINE_SIDECAR, "w") as f:
+            json.dump({"roofline_tflops": roof, "device": device,
+                       "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S")},
+                      f)
+    except Exception as e:
+        print("roofline sidecar write failed: %r" % e, file=sys.stderr,
+              flush=True)
+
+
+def _load_roofline_sidecar():
+    try:
+        with open(_ROOFLINE_SIDECAR) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
 def _raw_step(model, criterion):
     """The un-jitted per-step train function shared by make_step (one
     dispatch per step) and make_chunk_step (scanned device-side loop)."""
@@ -165,9 +207,26 @@ def bench_config(build, records_per_batch, warmup=3, iters=10, windows=3,
 
 
 def measured_roofline():
-    """Achievable bf16 matmul TF/s on THIS chip/runtime right now (8192^3
+    """Achievable bf16 matmul TF/s on THIS chip right now (8192^3
     chained) — contextualizes MFU when the runtime can't reach the
-    datasheet peak (e.g. relay-attached chips)."""
+    datasheet peak.  Prefers the DEVICE-CLOCK measurement
+    (tools/profile_step.measure_matmul_roofline, a jax.profiler kernel
+    duration): host wall time through the relay tunnel deflated round
+    2/3's numbers to 65-117 TF/s on a chip whose device clock shows
+    186.9 (95%% of datasheet) — see PERF_NOTES.  Falls back to the
+    wall-clock probe if the profiler is unavailable."""
+    import importlib.util
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "bigdl_profile_step", os.path.join(_HERE, "tools",
+                                               "profile_step.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.measure_matmul_roofline()
+    except Exception as e:
+        print("device-clock roofline unavailable (%r); using wall-clock "
+              "probe (relay-deflated — see PERF_NOTES)" % e,
+              file=sys.stderr, flush=True)
     import jax
     import jax.numpy as jnp
     # probe matrix generated ON DEVICE: a cold-connection 256 MB
@@ -252,6 +311,43 @@ def configs():
     ]
 
 
+def bench_eval(build, records_per_batch, warmup=2, iters=10, windows=3):
+    """Forward-only evaluation throughput + top1/top5 on the synthetic
+    batch — the reference logs validation records/s
+    (LocalOptimizer.scala:231-233); this closes the measurement-apparatus
+    contract for the eval path."""
+    import jax
+    from bigdl_tpu.nn.module import Context
+    from bigdl_tpu.optim.validation import Top1Accuracy, Top5Accuracy
+
+    model, criterion, x, y = build()
+    params, net_state = model.params(), model.state()
+
+    @jax.jit
+    def fwd(p, s, xb):
+        out, _ = model.apply(p, xb, s,
+                             Context(training=False,
+                                     key=jax.random.PRNGKey(0)))
+        return out
+    for _ in range(warmup):
+        out = fwd(params, net_state, x)
+    np.asarray(out[0, 0])  # device->host copy = hard sync
+    dts = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fwd(params, net_state, x)
+        np.asarray(out[0, 0])
+        dts.append((time.perf_counter() - t0) / iters)
+    dt = min(dts)
+    top1 = Top1Accuracy()(out, y)
+    top5 = Top5Accuracy()(out, y)
+    return {"records_per_sec": round(records_per_batch / dt, 2),
+            "step_time_ms": round(dt * 1e3, 3),
+            "top1": round(top1.result()[0], 4),
+            "top5": round(top5.result()[0], 4)}
+
+
 def run_one(only: str):
     """Measure the configs matching ``only`` in THIS process and print one
     JSON line per config (subprocess mode)."""
@@ -260,12 +356,15 @@ def run_one(only: str):
     from bigdl_tpu import tensor as bt
     from bigdl_tpu.utils.random import set_seed
 
+    _enable_compile_cache()
     set_seed(1)
     bt.set_policy(bt.BF16_COMPUTE)  # matmuls/convs in bf16 on the MXU
+    device_kind = jax.devices()[0].device_kind
 
     if only == "--roofline":
-        print(json.dumps({"roofline_tflops": round(measured_roofline(), 1),
-                          "device": jax.devices()[0].device_kind}))
+        roof = round(measured_roofline(), 1)
+        _save_roofline_sidecar(roof, device_kind)
+        print(json.dumps({"roofline_tflops": roof, "device": device_kind}))
         return
     for name, build, recs, unit, aflops, n_disp in configs():
         if only.lower() not in name.lower():
@@ -280,6 +379,7 @@ def run_one(only: str):
             "step_tflops": round(flops / (ms / 1e3) / 1e12, 1)
             if np.isfinite(flops) else None,
             "flops_per_step": flops, "loss": loss,
+            "device": device_kind,
         }
         # entry goes out BEFORE any roofline attempt: a roofline wedge
         # must never cost an already-measured config
@@ -288,11 +388,24 @@ def run_one(only: str):
             # roofline in THIS warm process (a separate cold subprocess
             # wedged the relay twice in rehearsals), as its own line
             try:
-                print(json.dumps({
-                    "roofline_tflops": round(measured_roofline(), 1),
-                    "device": jax.devices()[0].device_kind}), flush=True)
-            except Exception:
-                pass
+                roof = round(measured_roofline(), 1)
+                _save_roofline_sidecar(roof, device_kind)
+                print(json.dumps({"roofline_tflops": roof,
+                                  "device": device_kind}), flush=True)
+            except Exception as e:
+                # never silent (VERDICT r3: BENCH_r03 shipped roofline
+                # null because this except swallowed the reason)
+                print("in-band roofline probe failed: %r" % e,
+                      file=sys.stderr, flush=True)
+            # eval apparatus: forward throughput + top1/top5
+            try:
+                ev = bench_eval(build, recs)
+                ev["config"] = name.replace("sync-SGD", "eval forward")
+                ev["unit"] = "images/sec"
+                print(json.dumps({"eval": ev}), flush=True)
+            except Exception as e:
+                print("eval bench failed: %r" % e, file=sys.stderr,
+                      flush=True)
 
 
 _BENCH_DEADLINE = time.monotonic() + float(
@@ -342,7 +455,8 @@ def _subprocess_json(arg, timeout_s, retries=1, retry_sleep=10):
     return []
 
 
-def _summary_line(entries, primary, roof, device):
+def _summary_line(entries, primary, roof, device, roof_src="measured",
+                  eval_entry=None):
     """The driver-contract JSON line for whatever has been measured so
     far.  Printed after EVERY config (the driver takes the LAST line), so
     a mid-run kill still reports the completed configs."""
@@ -352,19 +466,27 @@ def _summary_line(entries, primary, roof, device):
         return json.dumps({"metric": "bench failed: relay unavailable",
                            "value": 0, "unit": "images/sec",
                            "vs_baseline": 0})
+    if device == "unknown":
+        # every config entry records the chip it ran on
+        device = next((e.get("device") for e in entries if e.get("device")),
+                      "unknown")
     vs_baseline = (primary["mfu"] / 0.4) if primary.get("mfu") else 1.0
+    detail = {
+        "step_time_ms": primary["step_time_ms"],
+        "mfu": primary.get("mfu"),
+        "measured_matmul_roofline_tflops": roof,
+        "roofline_source": roof_src if roof is not None else "unavailable",
+        "device": device,
+        "configs": entries,
+    }
+    if eval_entry is not None:
+        detail["eval"] = eval_entry
     return json.dumps({
         "metric": "images/sec/chip (Inception-v1 bs128 sync-SGD train)",
         "value": primary["value"],
         "unit": "images/sec",
         "vs_baseline": round(vs_baseline, 4),
-        "detail": {
-            "step_time_ms": primary["step_time_ms"],
-            "mfu": primary.get("mfu"),
-            "measured_matmul_roofline_tflops": roof,
-            "device": device,
-            "configs": entries,
-        },
+        "detail": detail,
     })
 
 
@@ -375,7 +497,8 @@ def main():
 
     entries = []
     primary = None
-    roof, device = None, "unknown"
+    eval_entry = None
+    roof, device, roof_src = None, "unknown", "measured"
     # headline (Inception) FIRST so a driver kill at any point still
     # leaves the number that matters on stdout
     # headline first; bi-lstm before the fast tail configs (it is the
@@ -391,17 +514,42 @@ def main():
                 roof = entry["roofline_tflops"]
                 device = entry.get("device", device)
                 continue
+            if "eval" in entry:
+                eval_entry = entry["eval"]
+                continue
             entries.append(entry)
             if "Inception" in entry["config"]:
                 primary = entry
-        print(_summary_line(entries, primary, roof, device), flush=True)
+        print(_summary_line(entries, primary, roof, device, roof_src,
+                            eval_entry), flush=True)
     if roof is None:
-        # fallback: the standalone probe (short leash — informational only)
+        # fallback 1: the standalone probe (short leash)
         roof_info = _subprocess_json("--roofline", timeout_s=90, retries=0)
         if roof_info:
             roof = roof_info[0]["roofline_tflops"]
             device = roof_info[0]["device"]
-    print(_summary_line(entries, primary, roof, device), flush=True)
+    if roof is None:
+        # fallback 2: last-good sidecar — the artifact must always be
+        # self-interpreting even when this run's probes all failed
+        # (VERDICT r3 item 4: BENCH_r03 shipped a null roofline).  Only
+        # honored when the cached chip matches the one that ran the
+        # configs — a v5e roofline must not contextualize a v6e run.
+        cached = _load_roofline_sidecar()
+        run_device = next((e.get("device") for e in entries
+                           if e.get("device")), device)
+        if cached and cached.get("device") in (run_device, "unknown") \
+                or cached and run_device == "unknown":
+            roof = cached.get("roofline_tflops")
+            if device == "unknown":
+                device = cached.get("device", device)
+            roof_src = "cached %s on %s" % (cached.get("measured_at", "?"),
+                                            cached.get("device", "?"))
+        elif cached:
+            print("roofline sidecar is for %r, this run is on %r — "
+                  "not using it" % (cached.get("device"), run_device),
+                  file=sys.stderr, flush=True)
+    print(_summary_line(entries, primary, roof, device, roof_src,
+                        eval_entry), flush=True)
 
 
 if __name__ == "__main__":

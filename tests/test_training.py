@@ -68,7 +68,8 @@ class TestLocalOptimizer:
         opt.optimize()
         assert opt.state["loss"] < 1.0
 
-    def test_validation_runs(self):
+    def test_validation_runs(self, caplog):
+        import logging
         set_seed(2)
         samples = make_classification()
         ds = DataSet.array(samples) >> SampleToBatch(32)
@@ -78,8 +79,13 @@ class TestLocalOptimizer:
         opt.set_end_when(max_epoch(2))
         opt.set_validation(every_epoch(), ds, [Top1Accuracy(),
                                                Loss(nn.ClassNLLCriterion())])
-        opt.optimize()
+        with caplog.at_level(logging.INFO, logger="bigdl_tpu.optim"):
+            opt.optimize()
         assert "Top1Accuracy" in opt.state
+        # the reference's validation-throughput line
+        # (LocalOptimizer.scala:231-233)
+        assert any("validate model throughput" in m
+                   for m in caplog.messages)
 
     def test_checkpoint_and_resume(self, tmp_path):
         set_seed(2)
