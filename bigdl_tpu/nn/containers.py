@@ -8,6 +8,7 @@ MapTable, Bottle (Bottle.scala).  Recurrent/TimeDistributed live in
 """
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.module import Container, Module
@@ -32,19 +33,90 @@ class Sequential(Container):
         return x, new_state
 
 
+_MERGE_1X1 = True  # kill switch for the merged-pointwise-head execution
+
+
 class Concat(Container):
     """Apply every branch to the same input, concatenate outputs along
-    ``dimension`` (1-based, ref Concat.scala)."""
+    ``dimension`` (1-based, ref Concat.scala).
+
+    TPU execution detail: when several branches START with a pointwise
+    (1x1/s1/p0, grouped=1, biased) convolution of the shared input —
+    the Inception block shape — those heads execute as ONE conv whose
+    weight is the trace-time concat of the branch weights, and the
+    result is sliced back per branch.  Exact same arithmetic and the
+    identical parameter tree (the concat/slice pair is differentiable,
+    so each branch's grads land on its own weight); what changes is the
+    kernel economy: one (B*HW, C) x (C, sum(c_i)) MXU matmul instead of
+    three skinny ones, in a step whose measured limiter is inter-kernel
+    scheduling of many small kernels (PERF_NOTES round 3: ~6 ms/step of
+    gaps; round 4 A/B table for this rewrite)."""
 
     def __init__(self, dimension: int, *modules):
         super().__init__(*modules)
         self.dimension = dimension
 
+    def _merge_plan(self):
+        """Branch indices whose leading module is a mergeable pointwise
+        conv (>= 2 needed to merge).  Static per architecture — cached
+        under a ``_cached_`` name so clones/pickles drop it."""
+        cached = getattr(self, "_cached_merge_plan", None)
+        if cached is not None:
+            return cached
+        from bigdl_tpu.nn.conv import SpatialConvolution
+        plan = []
+        if self.dimension == 2:
+            for i, br in enumerate(self.modules):
+                if not (isinstance(br, Sequential) and br.modules):
+                    continue
+                c = br.modules[0]
+                if (isinstance(c, SpatialConvolution)
+                        and c.kernel_w == 1 and c.kernel_h == 1
+                        and c.stride_w == 1 and c.stride_h == 1
+                        and c.pad_w == 0 and c.pad_h == 0
+                        and c.n_group == 1 and c.with_bias):
+                    plan.append(i)
+        plan = plan if len(plan) >= 2 else []
+        self._cached_merge_plan = plan
+        return plan
+
     def apply(self, params, x, state, ctx):
+        plan = self._merge_plan() if _MERGE_1X1 else []
+        if plan and hasattr(x, "ndim") and x.ndim == 4:
+            return self._apply_merged(params, x, state, ctx, plan)
         outs = []
         new_state = dict(state)
         for i in range(len(self.modules)):
             y, ns = _child_apply(self, i, params, x, state, ctx)
+            outs.append(y)
+            new_state[str(i)] = ns
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+    def _apply_merged(self, params, x, state, ctx, plan):
+        from bigdl_tpu.nn.conv import _conv, bias_add
+        heads = [params[str(i)]["0"]["~"] for i in plan]
+        w = jnp.concatenate([h["weight"] for h in heads], axis=0)
+        b = jnp.concatenate([h["bias"] for h in heads], axis=0)
+        merged = bias_add(_conv(x, w, (1, 1), [(0, 0), (0, 0)]), b)
+        sizes = [h["weight"].shape[0] for h in heads]
+        offs = np.cumsum([0] + sizes)
+        slices = {i: merged[:, offs[k]:offs[k + 1]]
+                  for k, i in enumerate(plan)}
+
+        outs = []
+        new_state = dict(state)
+        for i in range(len(self.modules)):
+            if i in slices:
+                br = self.modules[i]
+                bparams, bstate = params[str(i)], state[str(i)]
+                y = slices[i]
+                ns = dict(bstate)
+                for j in range(1, len(br.modules)):
+                    y, s_j = br.modules[j].apply(bparams[str(j)], y,
+                                                 bstate[str(j)], ctx)
+                    ns[str(j)] = s_j
+            else:
+                y, ns = _child_apply(self, i, params, x, state, ctx)
             outs.append(y)
             new_state[str(i)] = ns
         return jnp.concatenate(outs, axis=self.dimension - 1), new_state

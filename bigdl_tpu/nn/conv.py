@@ -12,6 +12,8 @@ semantics.  XLA re-layouts internally for the MXU.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -51,21 +53,49 @@ def _maybe_batch(x):
 
 _S2D_STEM = True  # isolated win, end-to-end neutral on Inception (PERF_NOTES); helps ResNet/AlexNet stems
 
+_SPLIT_DB = False  # REJECTED default: measured 33.84 -> 37.64 ms/step
 
-def _space_to_depth_conv(x, w, s, pad):
-    """Strided low-channel conv rewritten as space-to-depth + stride-1 conv.
 
-    A k x k stride-s conv over C channels equals a ceil(k/s)^2 stride-1
-    conv over C*s*s space-to-depth channels.  For stem convs (C=3, s=2 or
-    4) this multiplies the MXU contraction depth by s^2: the 7x7/s2
-    Inception-v1 stem measured 33 TF/s as-is (3 input channels fill 3/128
-    MXU rows) and proportionally better after this rewrite.  Exact same
-    arithmetic, reassociated.
+@jax.custom_vjp
+def _bias_add(y, b):
+    """Bias add whose backward computes db in a standalone kernel —
+    kept as measured evidence, default OFF.
 
-    out(i,j) = sum_t w[t] xpad[s*i + t]  becomes, with t = s*u + r,
-    sum_r sum_u w[s*u + r] X_r[i + u]  where X_r is the r-th phase of the
-    space-to-depth transform.
-    """
+    Hypothesis (VERDICT r3 lever a): the autodiff db is sum(g, (0,2,3))
+    — isolated it streams at 754 GB/s, but XLA folds it into the
+    multi-operand backward fusion around the conv which runs at ~270
+    GB/s effective, so splitting it out with ``optimization_barrier``
+    should win.  Device-clock A/B (round 4): Inception device-busy
+    33.84 -> **37.64 ms/step WITH the split** — the barrier forces a
+    second full read of every conv cotangent (~2 ms of standalone
+    reduces) while the fusions shrink by less; the "270 GB/s fusion"
+    was SHARING one read between dx and db all along.  The
+    isolated-vs-fused bandwidth comparison was the misleading number.
+    See PERF_NOTES round 4."""
+    return y + b[None, :, None, None]
+
+
+def _bias_add_fwd(y, b):
+    return _bias_add(y, b), None
+
+
+def _bias_add_bwd(_, g):
+    return g, jnp.sum(lax.optimization_barrier(g), axis=(0, 2, 3))
+
+
+_bias_add.defvjp(_bias_add_fwd, _bias_add_bwd)
+
+
+def bias_add(y, b):
+    """Conv bias add (NCHW); routed through the split-db custom VJP."""
+    if _SPLIT_DB:
+        return _bias_add(y, b)
+    return y + b[None, :, None, None]
+
+
+def _s2d_parts(x, w, s, pad):
+    """The space-to-depth operands: (xs, ws, out crop) — see
+    _space_to_depth_conv."""
     o, c, kh, kw = w.shape
     b, _, h, wd = x.shape
     (plh, phh), (plw, phw) = pad
@@ -84,10 +114,66 @@ def _space_to_depth_conv(x, w, s, pad):
     wpad = jnp.pad(w, ((0, 0), (0, 0), (0, s * khp - kh), (0, s * kwp - kw)))
     ws = wpad.reshape(o, c, khp, s, kwp, s).transpose(0, 1, 3, 5, 2, 4)
     ws = ws.reshape(o, c * s * s, khp, kwp)
-    y = _conv(xs, ws, (1, 1), [(0, 0), (0, 0)])
     oh = (hp - kh) // s + 1
     ow = (wp - kw) // s + 1
+    return xs, ws, oh, ow
+
+
+_S2D_BWD = True  # keep the stem wgrad in s2d geometry (A/B: PERF_NOTES r4)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _space_to_depth_conv(x, w, s, pad):
+    """Strided low-channel conv rewritten as space-to-depth + stride-1 conv.
+
+    A k x k stride-s conv over C channels equals a ceil(k/s)^2 stride-1
+    conv over C*s*s space-to-depth channels.  For stem convs (C=3, s=2 or
+    4) this multiplies the MXU contraction depth by s^2: the 7x7/s2
+    Inception-v1 stem measured 33 TF/s as-is (3 input channels fill 3/128
+    MXU rows) and proportionally better after this rewrite.  Exact same
+    arithmetic, reassociated.
+
+    out(i,j) = sum_t w[t] xpad[s*i + t]  becomes, with t = s*u + r,
+    sum_r sum_u w[s*u + r] X_r[i + u]  where X_r is the r-th phase of the
+    space-to-depth transform.
+
+    The custom VJP keeps the BACKWARD convs in the s2d geometry too:
+    plain autodiff emits the right s2d-shaped grad convs, but XLA's
+    layout/canonicalization pass folds the phase transforms back in and
+    rewrites the weight grad to the original low-channel form
+    (out[64,3,7,7] over raw 224x224 input: 0.907 ms at 18%% of roofline,
+    PROFILE round 4) — ``optimization_barrier`` on the cotangent side
+    pins the s2d form the same way the round-2 maxpool lesson pinned
+    residuals."""
+    xs, ws, oh, ow = _s2d_parts(x, w, s, pad)
+    y = _conv(xs, ws, (1, 1), [(0, 0), (0, 0)])
     return y[:, :, :oh, :ow]
+
+
+def _s2d_conv_fwd(x, w, s, pad):
+    return _space_to_depth_conv(x, w, s, pad), (x, w)
+
+
+def _s2d_conv_bwd(s, pad, res, g):
+    x, w = res
+
+    def inner(x_, w_):
+        xs, ws, oh, ow = _s2d_parts(x_, w_, s, pad)
+        # barrier the s2d operands: without it XLA folds the phase
+        # transforms into the grad convs and canonicalizes them back to
+        # the slow low-channel geometry
+        if _S2D_BWD:
+            xs = lax.optimization_barrier(xs)
+            ws = lax.optimization_barrier(ws)
+        y = _conv(xs, ws, (1, 1), [(0, 0), (0, 0)])
+        return y[:, :, :oh, :ow]
+
+    _, vjp = jax.vjp(inner, x, w)
+    dx, dw = vjp(g)
+    return dx, dw
+
+
+_space_to_depth_conv.defvjp(_s2d_conv_fwd, _s2d_conv_bwd)
 
 
 class SpatialConvolution(TensorModule):
@@ -147,13 +233,13 @@ class SpatialConvolution(TensorModule):
             # rewrite fills the MXU contraction dim s^2 times better
             y = _space_to_depth_conv(
                 x, P["weight"], s,
-                [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)])
+                ((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)))
         else:
             y = _conv(x, P["weight"], (self.stride_h, self.stride_w),
                       [(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
                       groups=self.n_group)
         if self.with_bias:
-            y = y + P["bias"][None, :, None, None]
+            y = bias_add(y, P["bias"])
         return (y[0] if was3d else y), None
 
     def __repr__(self):

@@ -10,12 +10,18 @@ in-place ``runningMean/runningVar`` mutation made functional.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from bigdl_tpu.nn.module import TensorModule
 from bigdl_tpu.nn import init as init_
+from bigdl_tpu.tensor import policy
+
+_COMPUTE_DTYPE_BN = True  # BN apply chain in the policy compute dtype
 
 
 class BatchNormalization(TensorModule):
@@ -74,10 +80,18 @@ class BatchNormalization(TensorModule):
         if self.affine:
             scale = scale * P["weight"]
             shift = shift * P["weight"] + P["bias"]
-        # scale/shift are f32; keep the big (N,C,H,W) buffer in x's dtype
-        y = (x * scale.astype(x.dtype).reshape(bshape)
-             + shift.astype(x.dtype).reshape(bshape))
-        return (y[0] if was_unbatched else y), new_S
+        # statistics stay f32 (above); the big (N,C,H,W) apply runs in
+        # the policy COMPUTE dtype — the normalize chain and its backward
+        # are pure bandwidth, and bf16 halves their bytes (ResNet-50 A/B:
+        # PERF_NOTES round 4).  Output returns in x's dtype.
+        p = policy()
+        xa = x
+        if (_COMPUTE_DTYPE_BN and p.compute_dtype != x.dtype
+                and jnp.issubdtype(x.dtype, jnp.floating)):
+            xa = x.astype(p.compute_dtype)
+        y = (xa * scale.astype(xa.dtype).reshape(bshape)
+             + shift.astype(xa.dtype).reshape(bshape))
+        return ((y[0] if was_unbatched else y).astype(x.dtype)), new_S
 
     def __repr__(self):
         return f"{type(self).__name__}({self.n_output})"
@@ -114,6 +128,8 @@ class SpatialCrossMapLRN(TensorModule):
     # reduce_window + fusions already run well here, unlike its maxpool
     # emitter.  Kernel kept as tested evidence; off by default.
     _PALLAS = False
+    _ANALYTIC_VJP = True   # see _lrn below
+    _COMPUTE_DTYPE = True  # run the LRN chain in the policy compute dtype
 
     def _forward(self, P, x, S, ctx):
         if self._PALLAS and x.ndim == 4:
@@ -122,6 +138,23 @@ class SpatialCrossMapLRN(TensorModule):
                                not _on_tpu()), None
         lo = (self.size - 1) // 2
         hi = self.size - 1 - lo
+        if self._ANALYTIC_VJP and not self._STENCIL:
+            p = policy()
+            cast = (self._COMPUTE_DTYPE and p.compute_dtype != x.dtype
+                    and jnp.issubdtype(x.dtype, jnp.floating))
+            if cast:
+                # LRN is pure bandwidth (window sums + eltwise): the
+                # compute-dtype cast halves its bytes like every matmul/
+                # conv operand under the policy.  Denominator error is
+                # bounded: z = k + (alpha/n) sum x^2 with k=1 dominates,
+                # and bf16 keeps ~3 significant digits of the small
+                # correction term.  Measured loss drift and device win:
+                # PERF_NOTES round 4.
+                y = _lrn(x.astype(p.compute_dtype), self.size, self.alpha,
+                         self.beta, self.k, self._SQRT_POW)
+                return y.astype(x.dtype), None
+            return _lrn(x, self.size, self.alpha, self.beta, self.k,
+                        self._SQRT_POW), None
         if self._STENCIL:
             # Cross-channel window sum as ``size`` shifted slice-adds — a
             # pure elementwise stencil XLA fuses into one pass regardless
@@ -134,20 +167,69 @@ class SpatialCrossMapLRN(TensorModule):
             sqp = jnp.pad(x * x, ((0, 0), (lo, hi), (0, 0), (0, 0)))
             sq_sum = sum(lax.slice_in_dim(sqp, t, t + c, axis=1)
                          for t in range(self.size))
+            z = self.k + (self.alpha / self.size) * sq_sum
         else:
-            sq_sum = lax.reduce_window(
-                x * x, 0.0, lax.add,
-                window_dimensions=(1, self.size, 1, 1),
-                window_strides=(1, 1, 1, 1),
-                padding=((0, 0), (lo, hi), (0, 0), (0, 0)))
-        z = self.k + (self.alpha / self.size) * sq_sum
-        if self.beta == 0.75 and self._SQRT_POW:
-            # z^(3/4) = (z^(1/4))^3 via two sqrts: no exp/log transcendentals
-            # in either the forward or the autodiff backward
-            denom = jnp.sqrt(jnp.sqrt(z)) ** 3
-        else:
-            denom = z ** self.beta
+            z = self.k + (self.alpha / self.size) * _lrn_window_sum(
+                x * x, self.size, lo, hi)
+        denom = _lrn_denom(z, self.beta, self.size, self._SQRT_POW)
         return x / denom, None
+
+
+def _lrn_window_sum(v, size, lo, hi):
+    return lax.reduce_window(
+        v, 0.0, lax.add,
+        window_dimensions=(1, size, 1, 1),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (lo, hi), (0, 0), (0, 0)))
+
+
+def _lrn_denom(z, beta, size, sqrt_pow):
+    if beta == 0.75 and sqrt_pow:
+        # z^(3/4) = (z^(1/4))^3 via two sqrts: no exp/log transcendentals
+        return jnp.sqrt(jnp.sqrt(z)) ** 3
+    return z ** beta
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def _lrn(x, size, alpha, beta, k, sqrt_pow):
+    """LRN with the ANALYTIC backward instead of the jvp-transpose one.
+
+    y_c = x_c z_c^{-beta} with z = k + (alpha/n) sum_win x^2 gives
+
+        dx_d = g_d / denom_d - (2 alpha beta / n) x_d
+               * sum_{c : d in win(c)} g_c y_c / z_c
+
+    — ONE reversed-window reduce_window over g*y/z, where the
+    jvp-transpose backward emits TWO window reductions plus a wider
+    mul/add fusion chain (measured 1.44 ms of reduce_window + 2.1 ms of
+    fusions per Inception step, PROFILE round 3/4).  Device-clock A/B in
+    PERF_NOTES round 4.  Residuals: x and z only; denom/y are two-sqrt
+    recomputes."""
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+    z = k + (alpha / size) * _lrn_window_sum(x * x, size, lo, hi)
+    return x / _lrn_denom(z, beta, size, sqrt_pow)
+
+
+def _lrn_fwd(x, size, alpha, beta, k, sqrt_pow):
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+    z = k + (alpha / size) * _lrn_window_sum(x * x, size, lo, hi)
+    return x / _lrn_denom(z, beta, size, sqrt_pow), (x, z)
+
+
+def _lrn_bwd(size, alpha, beta, k, sqrt_pow, res, g):
+    x, z = res
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+    denom = _lrn_denom(z, beta, size, sqrt_pow)
+    # g*y/z^  — y recomputed as x/denom; z^{-beta-1} = 1/(z*denom)
+    t = _lrn_window_sum(g * x / (z * denom), size, hi, lo)  # flipped window
+    dx = g / denom - (2.0 * alpha * beta / size) * x * t
+    return (dx,)
+
+
+_lrn.defvjp(_lrn_fwd, _lrn_bwd)
 
 
 def _gaussian_kernel(kernel_size: int) -> np.ndarray:

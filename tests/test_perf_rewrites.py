@@ -1,0 +1,164 @@
+"""Equivalence guards for the round-4 performance rewrites (all exact or
+policy-scoped):
+
+- Concat merged-pointwise heads: same-input 1x1 branch heads execute as
+  one conv (containers.Concat._apply_merged) — must match the unmerged
+  path bit-for-float-summation-order on forward and gradients;
+- analytic LRN VJP (normalization._lrn) vs the jvp-transpose backward;
+- space-to-depth stem conv custom VJP vs the plain conv;
+- compute-dtype max pooling: active only under a reduced-precision
+  policy, output dtype preserved.
+
+Each rewrite's device-clock measurement lives in PERF_NOTES round 4; these
+tests pin the semantics.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.nn.containers as containers
+import bigdl_tpu.nn.conv as convmod
+from bigdl_tpu.nn.module import Context
+from bigdl_tpu.nn.normalization import SpatialCrossMapLRN
+from bigdl_tpu.utils.random import set_seed
+
+
+def _ctx():
+    return Context(training=False, key=jax.random.PRNGKey(0))
+
+
+def test_concat_merged_pointwise_matches_unmerged():
+    from bigdl_tpu.models.inception import inception_module
+    set_seed(3)
+    blk = inception_module(192, 64, 96, 128, 16, 32, 32)
+    assert blk._merge_plan() == [0, 1, 2]
+    params, state = blk.params(), blk.state()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 192, 14, 14),
+                    jnp.float32)
+
+    def loss(p, merged):
+        containers._MERGE_1X1 = merged
+        try:
+            return (blk.apply(p, x, state, _ctx())[0] ** 2).sum()
+        finally:
+            containers._MERGE_1X1 = True
+
+    l1, g1 = jax.value_and_grad(loss)(params, True)
+    l0, g0 = jax.value_and_grad(loss)(params, False)
+    assert l1 == pytest.approx(l0, rel=1e-6)
+    np.testing.assert_allclose(np.asarray(ravel_pytree(g1)[0]),
+                               np.asarray(ravel_pytree(g0)[0]),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_concat_without_pointwise_heads_unchanged():
+    m = nn.Concat(2, nn.Sequential(nn.SpatialConvolution(4, 3, 3, 3, 1, 1,
+                                                         1, 1)),
+                  nn.Sequential(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1)))
+    assert m._merge_plan() == []
+
+
+@pytest.mark.parametrize("size", [5, 4])
+def test_lrn_analytic_vjp_matches_autodiff(size):
+    m = SpatialCrossMapLRN(size, 0.0001, 0.75)
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 16, 7, 7), jnp.float32)
+    g = jnp.asarray(np.random.RandomState(1).randn(3, 16, 7, 7), jnp.float32)
+
+    def run(analytic):
+        SpatialCrossMapLRN._ANALYTIC_VJP = analytic
+        try:
+            y, vjp = jax.vjp(lambda v: m._forward({}, v, {}, _ctx())[0], x)
+            return y, vjp(g)[0]
+        finally:
+            SpatialCrossMapLRN._ANALYTIC_VJP = True
+
+    y1, dx1 = run(True)
+    y0, dx0 = run(False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(dx1), np.asarray(dx0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_s2d_stem_custom_vjp_matches_plain_conv():
+    set_seed(4)
+    m = convmod.SpatialConvolution(3, 8, 7, 7, 2, 2, 3, 3)
+    params = m.params()["~"]
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 30, 30), jnp.float32)
+
+    def run(s2d):
+        convmod._S2D_STEM = s2d
+        try:
+            y, vjp = jax.vjp(lambda p, v: m._forward(p, v, {}, _ctx())[0],
+                             params, x)
+            gp, gx = vjp(jnp.ones_like(y))
+            return y, gp, gx
+        finally:
+            convmod._S2D_STEM = True
+
+    y1, gp1, gx1 = run(True)
+    y0, gp0, gx0 = run(False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gp1["weight"]),
+                               np.asarray(gp0["weight"]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx0),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_batchnorm_compute_dtype_keeps_f32_stats():
+    """BN under a reduced-precision policy: the APPLY chain runs in the
+    compute dtype, but batch statistics and running-stat EMAs stay f32
+    and the output dtype is preserved."""
+    from bigdl_tpu import tensor as bt
+    set_seed(6)
+    m = nn.SpatialBatchNormalization(4)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4, 5, 5), jnp.float32)
+    ctx = Context(training=True, key=jax.random.PRNGKey(0))
+
+    y32, s32 = m._forward(m.params()["~"], x, m.state()["~"], ctx)
+    bt.set_policy(bt.BF16_COMPUTE)
+    try:
+        ybf, sbf = m._forward(m.params()["~"], x, m.state()["~"], ctx)
+    finally:
+        bt.set_policy(bt.FP32)
+    assert ybf.dtype == jnp.float32
+    for k in s32:
+        assert sbf[k].dtype == jnp.float32
+        # stats identical: they are computed from the f32 input either way
+        np.testing.assert_allclose(np.asarray(sbf[k]), np.asarray(s32[k]),
+                                   rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ybf), np.asarray(y32),
+                               rtol=2e-2, atol=3e-2)
+
+
+def test_maxpool_compute_dtype_scoped_to_policy():
+    from bigdl_tpu import tensor as bt
+    m = nn.SpatialMaxPooling(2, 2, 2, 2)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 8, 8), jnp.float32)
+
+    y_f32, _ = m._forward({}, x, {}, _ctx())
+    assert y_f32.dtype == jnp.float32
+
+    bt.set_policy(bt.BF16_COMPUTE)
+    try:
+        y_bf, _ = m._forward({}, x, {}, _ctx())
+    finally:
+        bt.set_policy(bt.FP32)
+    # output dtype preserved; values equal up to bf16 rounding of the max
+    assert y_bf.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(y_bf), np.asarray(y_f32),
+                               rtol=8e-3, atol=1e-6)
+    # FP32 policy: bitwise identical to the unflagged path
+    import bigdl_tpu.nn.pooling as poolmod
+    poolmod._COMPUTE_DTYPE_POOL = False
+    try:
+        y_off, _ = m._forward({}, x, {}, _ctx())
+    finally:
+        poolmod._COMPUTE_DTYPE_POOL = True
+    np.testing.assert_array_equal(np.asarray(y_f32), np.asarray(y_off))
