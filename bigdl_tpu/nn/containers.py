@@ -58,11 +58,10 @@ class Concat(Container):
 
     def _merge_plan(self):
         """Branch indices whose leading module is a mergeable pointwise
-        conv (>= 2 needed to merge).  Static per architecture — cached
-        under a ``_cached_`` name so clones/pickles drop it."""
-        cached = getattr(self, "_cached_merge_plan", None)
-        if cached is not None:
-            return cached
+        conv (>= 2 needed to merge).  Recomputed per apply — it is a
+        microsecond loop that only runs at trace time under jit, and a
+        cache would go stale if a branch head were surgically swapped
+        between calls."""
         from bigdl_tpu.nn.conv import SpatialConvolution
         plan = []
         if self.dimension == 2:
@@ -76,9 +75,7 @@ class Concat(Container):
                         and c.pad_w == 0 and c.pad_h == 0
                         and c.n_group == 1 and c.with_bias):
                     plan.append(i)
-        plan = plan if len(plan) >= 2 else []
-        self._cached_merge_plan = plan
-        return plan
+        return plan if len(plan) >= 2 else []
 
     def apply(self, params, x, state, ctx):
         plan = self._merge_plan() if _MERGE_1X1 else []

@@ -86,8 +86,9 @@ class BatchNormalization(TensorModule):
         # PERF_NOTES round 4).  Output returns in x's dtype.
         p = policy()
         xa = x
-        if (_COMPUTE_DTYPE_BN and p.compute_dtype != x.dtype
-                and jnp.issubdtype(x.dtype, jnp.floating)):
+        if (_COMPUTE_DTYPE_BN and p.compute_dtype != jnp.float32
+                and p.compute_dtype != x.dtype
+                and x.dtype == jnp.float32):
             xa = x.astype(p.compute_dtype)
         y = (xa * scale.astype(xa.dtype).reshape(bshape)
              + shift.astype(xa.dtype).reshape(bshape))
@@ -140,8 +141,10 @@ class SpatialCrossMapLRN(TensorModule):
         hi = self.size - 1 - lo
         if self._ANALYTIC_VJP and not self._STENCIL:
             p = policy()
-            cast = (self._COMPUTE_DTYPE and p.compute_dtype != x.dtype
-                    and jnp.issubdtype(x.dtype, jnp.floating))
+            cast = (self._COMPUTE_DTYPE
+                    and p.compute_dtype != jnp.float32
+                    and p.compute_dtype != x.dtype
+                    and x.dtype == jnp.float32)
             if cast:
                 # LRN is pure bandwidth (window sums + eltwise): the
                 # compute-dtype cast halves its bytes like every matmul/

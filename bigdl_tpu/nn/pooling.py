@@ -41,9 +41,13 @@ def _max_pool2d(x, window, strides, padding):
     kh, kw = window
     dh, dw = strides
     p = policy()
+    # gate on a reduced-precision policy being ACTIVE, not on any dtype
+    # mismatch: f64 inputs under the default FP32 policy must not be
+    # silently downcast, and bf16 inputs must not be upcast
     cast = (_COMPUTE_DTYPE_POOL
+            and p.compute_dtype != jnp.float32
             and p.compute_dtype != x.dtype
-            and jnp.issubdtype(x.dtype, jnp.floating))
+            and x.dtype == jnp.float32)
     xin = x.astype(p.compute_dtype) if cast else x
     y = lax.reduce_window(
         xin, np.array(-np.inf, xin.dtype), lax.max,

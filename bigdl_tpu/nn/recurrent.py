@@ -275,23 +275,36 @@ class BiRecurrent(Container):
                              (((3,), (1,)), ((1,), (0,))),
                              preferred_element_type=jnp.float32)
         zx = jnp.swapaxes(zx, 0, 1) + b2[:, None]         # (T, 2, N, 4H)
+        # under a reduced-precision policy the two big scan-adjacent
+        # buffers ride in the COMPUTE dtype: zx (T,2,N,4H — written once,
+        # re-read per step and again in the backward replay) and the
+        # stacked per-step outputs (T,2,N,H).  The serial recurrence
+        # itself stays f32 (carry h/c and gate math) — only the streamed
+        # tensors halve their bytes.  Device-clock A/B: PERF_NOTES r4.
+        reduced = p.compute_dtype != jnp.float32
+        if reduced:
+            zx = zx.astype(p.compute_dtype)
         z0 = jnp.zeros((2, n, hdim))
 
         def step(carry, zx_t):
             h, c = carry
-            z = zx_t + lax.dot_general(p.cast_compute(h), wh,
-                                       (((2,), (1,)), ((0,), (0,))),
-                                       preferred_element_type=jnp.float32)
+            z = zx_t.astype(jnp.float32) + lax.dot_general(
+                p.cast_compute(h), wh,
+                (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
             z = z.astype(p.output_dtype)
             h_new, hc = LSTMCell._gates(z, c)
-            return hc, h_new
+            out = h_new.astype(p.compute_dtype) if reduced else h_new
+            return hc, out
 
         _, outs = lax.scan(step, (z0, z0), zx)            # (T, 2, N, H)
         yf = jnp.swapaxes(outs[:, 0], 0, 1)               # (N, T, H)
         yb = jnp.swapaxes(jnp.flip(outs[:, 1], axis=0), 0, 1)
-        if self.merge == "concat":
-            return jnp.concatenate([yf, yb], axis=-1)
-        return yf + yb
+        y = (jnp.concatenate([yf, yb], axis=-1)
+             if self.merge == "concat" else yf + yb)
+        # back to the output dtype so the head's reductions (Mean over T)
+        # accumulate in f32 over the rounded values
+        return y.astype(p.output_dtype) if reduced else y
 
 
 class TimeDistributed(Container):

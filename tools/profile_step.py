@@ -261,6 +261,10 @@ def build_step(model_name: str, batch: int):
         from bigdl_tpu.models.lenet import LeNet5
         model = LeNet5(class_num=10)
         xshape, nclass = (batch, 1, 28, 28), 10
+    elif model_name == "bilstm":
+        from bigdl_tpu.models.textclassifier import TextClassifierBiLSTM
+        model = TextClassifierBiLSTM(20, 200, hidden_size=128)
+        xshape, nclass = (batch, 500, 200), 20
     else:
         raise SystemExit("unknown model %s" % model_name)
 
