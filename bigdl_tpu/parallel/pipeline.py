@@ -31,9 +31,19 @@ from bigdl_tpu.parallel.collectives import pvary
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _merge_state_over(state, data_axis):
+    """Replica-merge per-stage carried state: float leaves (BN running
+    stats) average, non-float leaves take the max (rank-identical by
+    construction).  Shared by both schedules."""
+    return jax.tree_util.tree_map(
+        lambda s: lax.pmean(s, data_axis)
+        if jnp.issubdtype(s.dtype, jnp.floating)
+        else lax.pmax(s, data_axis), state)
+
+
 def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
                    axis: str = "pipe", remat: bool = False,
-                   stage_state=None):
+                   stage_state=None, data_axis: str = None):
     """Run a P-stage pipeline over microbatches.
 
     stage_fn(params_slice, x) -> y          (one stage's computation;
@@ -53,6 +63,10 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
     running-stat updates on sub-batches, BatchNormalization.scala under
     _subModelNumber), and the return value becomes
     ``(outputs, new_stage_state)``.
+
+    ``data_axis`` composes with data parallelism: x_micro is sharded
+    over it on the per-microbatch batch dim and the outputs come back
+    likewise sharded; float state pmeans across replicas.
 
     ``remat=True`` wraps the stage in ``jax.checkpoint``: only the
     pipeline-boundary activations (the scan carry, one microbatch
@@ -76,12 +90,16 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
         stage_state = jnp.zeros((n_stage, 1), jnp.float32)
     if remat:
         fn = jax.checkpoint(fn)
+    vary_axes = (axis,) if data_axis is None else (axis, data_axis)
 
     def ranked(params, st, x_all):
         # inside shard_map: params has leading dim 1 (my stage), x_all is
-        # the full microbatch stack (replicated)
+        # the full microbatch stack (replicated over the pipe axis)
         my_params = jax.tree_util.tree_map(lambda v: v[0], params)
         my_state = jax.tree_util.tree_map(lambda v: v[0], st)
+        if data_axis is not None:
+            my_state = jax.tree_util.tree_map(
+                lambda v: pvary(v, (data_axis,)), my_state)
         rank = lax.axis_index(axis)
         n_micro = x_all.shape[0]
         n_ticks = n_micro + n_stage - 1
@@ -89,9 +107,9 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
 
         micro_shape = x_all.shape[1:]
         # pvary: scan carries must be device-varying over the pipe axis
-        buf = pvary(jnp.zeros(micro_shape, x_all.dtype), (axis,))
+        buf = pvary(jnp.zeros(micro_shape, x_all.dtype), vary_axes)
         outs = pvary(jnp.zeros((n_micro,) + micro_shape, x_all.dtype),
-                     (axis,))
+                     vary_axes)
 
         def tick(carry, t):
             buf, outs, my_state = carry
@@ -123,13 +141,16 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
         # last rank wrote) — use psum of masked value for correctness
         mask = (rank == n_stage - 1).astype(outs.dtype)
         outs = lax.psum(outs * mask, axis)
+        if data_axis is not None:
+            my_state = _merge_state_over(my_state, data_axis)
         return outs, jax.tree_util.tree_map(lambda v: v[None], my_state)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     sspec = jax.tree_util.tree_map(lambda _: P(axis), stage_state)
+    xspec = P(None, data_axis) if data_axis is not None else P()
     f = jax.shard_map(ranked, mesh=mesh,
-                      in_specs=(pspec, sspec, P()),
-                      out_specs=(P(), sspec))
+                      in_specs=(pspec, sspec, xspec),
+                      out_specs=(xspec, sspec))
     outs, new_state = f(stage_params, stage_state, x_micro)
     return (outs, new_state) if stateful else outs
 
@@ -141,7 +162,8 @@ def stack_stage_params(per_stage_params):
 
 def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
                         mesh: Mesh, axis: str = "pipe",
-                        shard_inputs: bool = False, stage_state=None):
+                        shard_inputs: bool = False, stage_state=None,
+                        data_axis: str = None):
     """1F1B pipeline schedule: forward and backward interleaved so each
     stage keeps at most ~2*(P-1)+1 in-flight microbatch activations —
     independent of the microbatch count — where GPipe's autodiff keeps
@@ -176,6 +198,14 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
     (same for the target on the backward side) — O(n_micro/P) operand
     memory for two extra microbatch-sized collectives per tick.
 
+    ``data_axis`` (optional): composes the pipeline with data
+    parallelism over a second mesh axis — each data-parallel replica
+    group runs the SAME 1F1B schedule on its microbatch shard (x/t
+    sharded over ``data_axis`` on the per-microbatch batch dim), and
+    gradients / loss / float state pmean across replicas before
+    returning, exactly the plain-DP contract.  Incompatible with
+    ``shard_inputs`` (one sharding per operand dim).
+
     ``stage_state`` (optional): stage-stacked carried state (BN running
     stats), sharded over ``axis``; switches the stage function to the
     extended signature ``stage_fn(params_slice, state_slice, x, micro_idx)
@@ -198,14 +228,24 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
         stage_state = jnp.zeros((n_stage, 1), jnp.float32)
     n_micro = x_micro.shape[0]
     depth = 2 * n_stage  # circular residual buffer, >= max in-flight + 1
+    if shard_inputs and data_axis is not None:
+        raise ValueError("shard_inputs and data_axis are mutually "
+                         "exclusive (one sharding per operand dim)")
     if shard_inputs and n_micro % n_stage:
         raise ValueError(f"shard_inputs requires n_micro ({n_micro}) "
                          f"divisible by the pipe axis ({n_stage})")
     per = n_micro // n_stage if shard_inputs else n_micro
+    vary_axes = (axis,) if data_axis is None else (axis, data_axis)
+    dscale = mesh.shape[data_axis] if data_axis is not None else 1
 
     def ranked(params, st, x_all, t_all):
         my_params = jax.tree_util.tree_map(lambda v: v[0], params)
         my_state0 = jax.tree_util.tree_map(lambda v: v[0], st)
+        if data_axis is not None:
+            # state updates derive from the data-sharded x, so the carry
+            # must start data-varying
+            my_state0 = jax.tree_util.tree_map(
+                lambda v: pvary(v, (data_axis,)), my_state0)
         rank = lax.axis_index(axis)
 
         def fetch(arr, m):
@@ -226,14 +266,19 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
 
         micro_shape = x_all.shape[1:]
         zeros_micro = jnp.zeros(micro_shape, x_all.dtype)
-        buf_fwd = pvary(zeros_micro, (axis,))          # fwd ring carry
-        buf_bwd = pvary(zeros_micro, (axis,))          # bwd ring carry
+        buf_fwd = pvary(zeros_micro, vary_axes)        # fwd ring carry
+        buf_bwd = pvary(zeros_micro, vary_axes)        # bwd ring carry
         resid = pvary(jnp.zeros((depth,) + micro_shape, x_all.dtype),
-                      (axis,))                         # saved stage inputs
+                      vary_axes)                       # saved stage inputs
         # my_params are already device-varying (stage-sharded), so zeros
         # derived from them are too — no pvary needed (pcast would reject)
+        # grad_acc stays data-INVARIANT: inside shard_map, jax.vjp w.r.t.
+        # the data-replicated my_params already psums each cotangent over
+        # the data axis (vma-aware AD), so the per-tick gp arrives as the
+        # cross-replica SUM — the 1/dscale in the loss closure turns that
+        # into the mean, and no explicit grad collective is needed
         grad_acc = jax.tree_util.tree_map(jnp.zeros_like, my_params)
-        loss_acc = pvary(jnp.zeros((), jnp.float32), (axis,))
+        loss_acc = pvary(jnp.zeros((), jnp.float32), vary_axes)
 
         def tick(carry, k):
             buf_fwd, buf_bwd, resid, grad_acc, loss_acc, my_state = carry
@@ -274,8 +319,8 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
                 lambda p, xx: fn(p, my_state, xx, mb)[0],
                 my_params, x_saved)
             loss_val, loss_vjp = jax.vjp(
-                lambda yy: loss_fn(yy, tgt) / n_micro, y_re)
-            one = pvary(jnp.ones((), loss_val.dtype), (axis,))
+                lambda yy: loss_fn(yy, tgt) / (n_micro * dscale), y_re)
+            one = pvary(jnp.ones((), loss_val.dtype), vary_axes)
             (dy,) = loss_vjp(one)
             cot = jnp.where(is_last, dy, buf_bwd)
             gp, gx = stage_vjp(cot)
@@ -299,13 +344,23 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
         carry, _ = lax.scan(tick, carry, jnp.arange(n_ticks))
         _, _, _, grad_acc, loss_acc, my_state = carry
         loss = lax.psum(loss_acc, axis)  # only last rank contributed
+        if data_axis is not None:
+            # loss_acc already carries the 1/dscale factor: psum over the
+            # replicas completes the global mean
+            loss = lax.psum(loss, data_axis)
+            my_state = _merge_state_over(my_state, data_axis)
         grads = jax.tree_util.tree_map(lambda g: g[None], grad_acc)
         return loss, grads, jax.tree_util.tree_map(lambda v: v[None],
                                                    my_state)
 
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     sspec = jax.tree_util.tree_map(lambda _: P(axis), stage_state)
-    xspec = P(axis) if shard_inputs else P()
+    if shard_inputs:
+        xspec = P(axis)
+    elif data_axis is not None:
+        xspec = P(None, data_axis)   # (M, mb, ...): shard the batch dim
+    else:
+        xspec = P()
     f = jax.shard_map(ranked, mesh=mesh,
                       in_specs=(pspec, sspec, xspec, xspec),
                       out_specs=(P(), pspec, sspec))

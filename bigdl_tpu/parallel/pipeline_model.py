@@ -167,7 +167,9 @@ class StagePlan:
             def run(flat_p, flat_s, flat_x, m):
                 p_list = self.unravel_p[i](flat_p[:p_size])
                 s_list = self.unravel_s[i](flat_s[:s_size])
-                x = flat_x[:, :in_size].reshape(in_shape)
+                # batch dim stays -1: under a hybrid dp x pp mesh the
+                # stage sees the LOCAL microbatch shard, not plan.mb
+                x = flat_x[:, :in_size].reshape((-1,) + in_shape[1:])
                 key = jax.random.fold_in(
                     jax.random.fold_in(base_key, jnp.maximum(m, 0)), i)
                 ctx = Context(training=training, key=key)
@@ -175,7 +177,7 @@ class StagePlan:
                 for k, j in enumerate(range(a, b)):
                     x, ns = self.modules[j].apply(p_list[k], x, s_list[k], ctx)
                     new_s.append(ns)
-                y = x.reshape(mb, -1).astype(jnp.float32)
+                y = x.reshape(x.shape[0], -1).astype(jnp.float32)
                 y = jnp.pad(y, ((0, 0), (0, self.max_act - y.shape[1])))
                 fs, _ = ravel_pytree(new_s)
                 fs = (fs.astype(jnp.float32) if fs.size else
@@ -187,29 +189,40 @@ class StagePlan:
 
         return [branch(i) for i in range(self.n_stages)]
 
-    def make_stage_fn(self, base_key, training=True):
+    def make_stage_fn(self, base_key, training=True, fold_axis=None):
         """Build the engine-facing ``stage_fn(flat_p, flat_s, flat_x, m)
-        -> (flat_y, flat_s')`` dispatching on the pipe rank."""
-        branches = self.make_branches(base_key, training)
+        -> (flat_y, flat_s')`` dispatching on the pipe rank.
+        ``fold_axis`` decorrelates stochastic layers per data-parallel
+        replica (the DP step's per-replica key fold)."""
         axis = self.axis
 
-        def varying(v):
+        def varying(v, target_vma):
             # a stateless stage emits its (empty-padded) state as a
-            # CONSTANT, so its vma lacks the pipe axis while stateful
-            # branches' outputs carry it — switch requires equal types
+            # CONSTANT, so its vma lacks axes that stateful branches'
+            # outputs carry (pipe, and data under hybrid dp x pp) —
+            # switch requires equal output types, so promote every
+            # branch output to the operands' varying axes
             from bigdl_tpu.parallel.collectives import pvary
             vma = getattr(jax.typeof(v), "vma", None)
-            if vma is None or axis in vma:
+            if vma is None:
                 return v
-            return pvary(v, (axis,))
-
-        wrapped = [
-            (lambda p, s, x, mm, b=b:
-             jax.tree_util.tree_map(varying, b(p, s, x, mm)))
-            for b in branches
-        ]
+            missing = tuple(a for a in target_vma if a not in vma)
+            return pvary(v, missing) if missing else v
 
         def stage_fn(flat_p, flat_s, flat_x, m):
+            key = base_key
+            if fold_axis is not None:
+                key = jax.random.fold_in(key, lax.axis_index(fold_axis))
+            branches = self.make_branches(key, training)
+            target = set(getattr(jax.typeof(flat_x), "vma", ()) or ())
+            target |= set(getattr(jax.typeof(flat_p), "vma", ()) or ())
+            target |= {axis}
+            wrapped = [
+                (lambda p, s, x, mm, b=b:
+                 jax.tree_util.tree_map(
+                     lambda v: varying(v, sorted(target)), b(p, s, x, mm)))
+                for b in branches
+            ]
             rank = lax.axis_index(axis)
             return lax.switch(rank, wrapped, flat_p, flat_s, flat_x, m)
 
@@ -217,15 +230,18 @@ class StagePlan:
 
     def make_loss_fn(self, criterion):
         def loss_fn(y_flat, tgt):
-            out = y_flat[:, :self.out_size].reshape(self.out_shape)
+            # -1 batch dim: the local microbatch under dp x pp, the
+            # global one in the GPipe outside-shard_map loss
+            out = y_flat[:, :self.out_size].reshape(
+                (-1,) + self.out_shape[1:])
             return criterion.apply_loss(out, tgt)
         return loss_fn
 
     def pack_input(self, x_micro):
         """(M, mb, ...) microbatched input -> (M, mb, max_act) flat-padded
         ring buffers."""
-        m = x_micro.shape[0]
-        xf = x_micro.reshape(m, self.mb, -1).astype(jnp.float32)
+        m, mb = x_micro.shape[0], x_micro.shape[1]
+        xf = x_micro.reshape(m, mb, -1).astype(jnp.float32)
         return jnp.pad(xf, ((0, 0), (0, 0), (0, self.max_act - xf.shape[2])))
 
     def describe(self):
