@@ -47,6 +47,7 @@ from bigdl_tpu.nn.nms import Nms, nms_mask, nms_indices
 from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTMCell, GRUCell, Recurrent, BiRecurrent, TimeDistributed,
 )
+from bigdl_tpu.nn.moe import MoE
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, DistKLDivCriterion, ClassSimplexCriterion,

@@ -1,0 +1,83 @@
+"""Mixture-of-experts FFN as a model-zoo module.
+
+The reference's ``MixtureTable`` (nn/MixtureTable.scala:221) is a
+single-device soft mixture over branch outputs; a sparse expert layer
+trainable through the Optimizer is absent (SURVEY.md §2.9: EP = NO).
+This module is the missing front door: drop ``nn.MoE`` into a
+``Sequential`` and train it like any layer — and with
+``DistriOptimizer(expert_parallel=True)`` over a mesh with an ``expert``
+axis, the expert-stacked parameters shard across chips and XLA GSPMD
+partitions the dispatch/expert/combine einsums (all-to-all over ICI),
+the same computation the hand-scheduled ``parallel/moe.moe_apply``
+expresses with shard_map.
+
+Formulation: GShard/Switch static-capacity top-1 routing
+(``parallel.moe.top1_gating``): one-hot dispatch (T, E, C) einsums keep
+every shape static for XLA; tokens over an expert's capacity are dropped
+(standard switch semantics — pair with a residual connection).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+from bigdl_tpu.tensor import policy
+from bigdl_tpu.utils.random import RNG
+
+
+class MoE(TensorModule):
+    """Top-1 switch-routed expert FFN: (…, D) -> (…, D).
+
+    Params: ``router`` (D, E); expert-stacked ``w1`` (E, D, H), ``b1``
+    (E, H), ``w2`` (E, H, D), ``b2`` (E, D) — the leading expert dim is
+    what ``expert_parallel`` shards.
+    """
+
+    def __init__(self, d_model: int, hidden: int, n_experts: int,
+                 capacity_factor: float = 1.25):
+        super().__init__()
+        self.d_model = d_model
+        self.hidden = hidden
+        self.n_experts = n_experts
+        self.capacity_factor = capacity_factor
+        self.reset()
+
+    def reset(self):
+        rng = RNG.np_rng()
+        d, h, e = self.d_model, self.hidden, self.n_experts
+        s1 = 1.0 / np.sqrt(d)
+        s2 = 1.0 / np.sqrt(h)
+        self._add_param("router", rng.uniform(-s1, s1, (d, e)).astype(np.float32))
+        self._add_param("w1", rng.uniform(-s1, s1, (e, d, h)).astype(np.float32))
+        self._add_param("b1", np.zeros((e, h), np.float32))
+        self._add_param("w2", rng.uniform(-s2, s2, (e, h, d)).astype(np.float32))
+        self._add_param("b2", np.zeros((e, d), np.float32))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        from bigdl_tpu.parallel.moe import expert_capacity, top1_gating
+        p = policy()
+        d = x.shape[-1]
+        xt = x.reshape(-1, d)                        # (T, D) tokens
+        n_tok = xt.shape[0]
+        e = self.n_experts
+        cap = expert_capacity(n_tok, e, self.capacity_factor)
+
+        logits = jnp.matmul(p.cast_compute(xt),
+                            p.cast_compute(P["router"])).astype(jnp.float32)
+        dispatch, combine = top1_gating(logits, e, cap)  # (T, E, C) each
+
+        cc = p.cast_compute
+        xe = jnp.einsum("tec,td->ecd", cc(dispatch), cc(xt))
+        hdn = jnp.einsum("ecd,edh->ech", xe, cc(P["w1"]))
+        hdn = jax.nn.relu(hdn.astype(jnp.float32) + P["b1"][:, None])
+        ye = jnp.einsum("ech,ehd->ecd", cc(hdn), cc(P["w2"]))
+        ye = ye.astype(jnp.float32) + P["b2"][:, None]
+        y = jnp.einsum("tec,ecd->td", cc(combine), cc(ye))
+        return y.astype(p.output_dtype).reshape(x.shape), None
+
+    def __repr__(self):
+        return (f"MoE({self.d_model}, hidden={self.hidden}, "
+                f"experts={self.n_experts})")

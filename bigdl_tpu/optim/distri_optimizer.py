@@ -48,7 +48,8 @@ class DistriOptimizer(LocalOptimizer):
                  drop_percentage: float = 0.0, tensor_parallel: bool = False,
                  zero1: bool = False, gradient_compression: str = None,
                  pipeline_stages: int = None, pipeline_schedule: str = "1f1b",
-                 pipeline_microbatches: int = None):
+                 pipeline_microbatches: int = None,
+                 expert_parallel: bool = False):
         """``tensor_parallel=True`` with a mesh containing a ``model`` axis
         shards eligible weights (and their optimizer state) over that axis
         via ``parallel.sharding.shard_params_rule`` — hybrid DP x TP with
@@ -83,10 +84,12 @@ class DistriOptimizer(LocalOptimizer):
         if gradient_compression not in (None, "bf16"):
             raise ValueError("gradient_compression must be None or 'bf16'")
         if pipeline_stages is not None:
-            if tensor_parallel or zero1 or gradient_compression:
+            if tensor_parallel or zero1 or gradient_compression \
+                    or expert_parallel:
                 raise ValueError(
                     "pipeline_stages owns the mesh; it does not combine "
-                    "with tensor_parallel/zero1/gradient_compression")
+                    "with tensor_parallel/zero1/gradient_compression/"
+                    "expert_parallel")
             if pipeline_schedule not in ("1f1b", "gpipe"):
                 raise ValueError("pipeline_schedule must be '1f1b' or "
                                  "'gpipe'")
@@ -108,6 +111,16 @@ class DistriOptimizer(LocalOptimizer):
                 raise ValueError(
                     "pipeline meshes support 'pipe' plus an optional "
                     f"'data' axis (hybrid dp x pp), got {mesh.axis_names}")
+        elif expert_parallel:
+            if tensor_parallel or zero1 or gradient_compression:
+                raise ValueError(
+                    "expert_parallel composes with data parallelism only "
+                    "(mesh {'data': d, 'expert': e}); tensor_parallel/"
+                    "zero1/gradient_compression assume replicated or "
+                    "data-sharded params, not expert-sharded ones")
+            if mesh is None or "expert" not in mesh.axis_names:
+                raise ValueError(
+                    "expert_parallel needs a mesh with an 'expert' axis")
         elif gradient_compression and tensor_parallel:
             raise ValueError(
                 "gradient_compression composes with DP and zero1, not "
@@ -123,6 +136,7 @@ class DistriOptimizer(LocalOptimizer):
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.tensor_parallel = tensor_parallel
         self.zero1 = zero1
+        self.expert_parallel = expert_parallel
         if drop_percentage:
             logger.warning(
                 "straggler drop (dropPercentage=%s) is a no-op on TPU: XLA "
@@ -166,11 +180,57 @@ class DistriOptimizer(LocalOptimizer):
         super()._maybe_checkpoint(params, net_state, opt_state, state,
                                   force=True, neval_label=neval_label)
 
+    def _expert_param_specs(self, params):
+        """Path-aware sharding tree: the expert-stacked leaves of ``MoE``
+        modules (w1/b1/w2/b2, leading dim = n_experts) shard dim 0 over
+        the ``expert`` axis — the reference has no EP at all (SURVEY.md
+        §2.9); the GSPMD partitioning of the MoE dispatch einsums is the
+        all-to-all the hand-scheduled parallel/moe.moe_apply spells out.
+        Router and every non-MoE param replicate."""
+        from bigdl_tpu.nn.moe import MoE
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+        exp = NamedSharding(mesh, P("expert"))
+        esize = mesh.shape["expert"]
+
+        def walk(mod, ptree):
+            out = {"~": {}}
+            is_moe = isinstance(mod, MoE)
+            for k, v in ptree.get("~", {}).items():
+                shard = (is_moe and k != "router"
+                         and np.ndim(v) >= 1 and v.shape[0] % esize == 0)
+                out["~"][k] = exp if shard else rep
+            for name, child in mod._modules.items():
+                out[name] = walk(child, ptree[name])
+            return out
+
+        return walk(self.model, params)
+
+    def _mirror_opt_specs(self, opt_state, params, pspec, rep):
+        """Optimizer-state subtrees that mirror the param tree (SGD
+        velocity, Adagrad variance) inherit the param shardings; anything
+        else (scalar counters) replicates."""
+        ptd = jax.tree_util.tree_structure(params)
+        if not isinstance(opt_state, dict):
+            return jax.tree_util.tree_map(lambda _: rep, opt_state)
+        out = {}
+        for k, sub in opt_state.items():
+            if jax.tree_util.tree_structure(sub) == ptd:
+                out[k] = pspec
+            else:
+                out[k] = jax.tree_util.tree_map(lambda _: rep, sub)
+        return out
+
     def _shardings(self, params, net_state, opt_state):
         mesh = self.mesh
         rep = NamedSharding(mesh, P())
-        data = NamedSharding(mesh, P("data"))
+        data = NamedSharding(mesh, P("data")
+                             if "data" in mesh.axis_names else P())
         reps = lambda tree: jax.tree_util.tree_map(lambda _: rep, tree)
+        if self.expert_parallel:
+            pspec = self._expert_param_specs(params)
+            ospec = self._mirror_opt_specs(opt_state, params, pspec, rep)
+            return pspec, reps(net_state), ospec, data
         if self.tensor_parallel and "model" in mesh.axis_names:
             from bigdl_tpu.parallel.sharding import (shard_params_rule,
                                                      zero1_tp_rule)
@@ -518,8 +578,10 @@ class DistriOptimizer(LocalOptimizer):
             # transfer than strictly needed; acceptable at current batch
             # sizes, revisit with a reshaped device_put if it shows up)
             spec = P()
-        else:
+        elif "data" in mesh.axis_names:
             spec = P(None, "data") if stacked else P("data")
+        else:
+            spec = P()   # e.g. a pure-EP mesh: batch replicates
         sharding = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
             return (jax.device_put(jnp.asarray(x), sharding),

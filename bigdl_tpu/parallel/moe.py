@@ -24,6 +24,14 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def expert_capacity(n_tokens: int, n_experts: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token capacity — ONE formula shared by every MoE front
+    door (moe_apply, moe_apply_sharded_tokens, nn.MoE), so the same
+    capacity_factor drops the same tokens everywhere."""
+    return max(int(capacity_factor * n_tokens / n_experts), 1)
+
+
 def top1_gating(logits, n_experts: int, capacity: int):
     """logits: (T, E). Returns (dispatch (T, E, C) one-hot, combine
     (T, E, C) weights): token t goes to expert e at slot c."""
@@ -56,7 +64,7 @@ def moe_apply(router_w, expert_w1, expert_b1, expert_w2, expert_b2, x,
     assert n_expert % n_rank == 0
     e_local = n_expert // n_rank
     t = x.shape[0]
-    capacity = max(int(capacity_factor * t / n_expert), 1)
+    capacity = expert_capacity(t, n_expert, capacity_factor)
 
     def ranked(router_w, w1, b1, w2, b2, x):
         logits = x @ router_w                           # (T, E)
@@ -100,7 +108,7 @@ def moe_apply_sharded_tokens(router_w, expert_w1, expert_b1, expert_w2,
 
     def ranked(router_w, w1, b1, w2, b2, x_local):
         t_local = x_local.shape[0]
-        capacity = max(int(capacity_factor * t_local / n_expert), 1)
+        capacity = expert_capacity(t_local, n_expert, capacity_factor)
         logits = x_local @ router_w
         dispatch, combine = top1_gating(logits, n_expert, capacity)
         expert_in = jnp.einsum("td,tec->ecd", x_local, dispatch)  # (E, C, D)

@@ -209,11 +209,19 @@ class StagePlan:
             missing = tuple(a for a in target_vma if a not in vma)
             return pvary(v, missing) if missing else v
 
+        # without a per-replica key fold the branch closures are key-
+        # independent: build them ONCE (stage_fn is retraced many times —
+        # fwd + vjp per 1F1B tick)
+        static_branches = (self.make_branches(base_key, training)
+                           if fold_axis is None else None)
+
         def stage_fn(flat_p, flat_s, flat_x, m):
-            key = base_key
-            if fold_axis is not None:
-                key = jax.random.fold_in(key, lax.axis_index(fold_axis))
-            branches = self.make_branches(key, training)
+            if static_branches is not None:
+                branches = static_branches
+            else:
+                key = jax.random.fold_in(base_key,
+                                         lax.axis_index(fold_axis))
+                branches = self.make_branches(key, training)
             target = set(getattr(jax.typeof(flat_x), "vma", ()) or ())
             target |= set(getattr(jax.typeof(flat_p), "vma", ()) or ())
             target |= {axis}
