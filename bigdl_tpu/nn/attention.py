@@ -1,0 +1,82 @@
+"""Multi-head self-attention as a model-zoo module.
+
+Absent in the reference (its only sequence machinery is the serial
+truncated-BPTT Recurrent loop, SURVEY.md §5.7); first-class here because
+long-context attention is the workload sequence/context parallelism
+exists for.  The layer has TWO execution paths with identical math:
+
+- single-device: full softmax attention (``parallel.ring_attention.
+  full_attention``);
+- sequence-parallel: when the trainer sets ``ctx.seq_mesh``
+  (``DistriOptimizer(sequence_parallel=True)``), attention runs as the
+  EXACT blockwise ring collective (``ring_self_attention``) — Q/K/V
+  sequence blocks stay on their devices, K/V rotate around the ``seq``
+  ring over ICI with an online softmax, and the batch dim rides a
+  ``data`` axis when the mesh has one.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+from bigdl_tpu.nn import init as init_
+from bigdl_tpu.tensor import policy
+
+
+class MultiHeadSelfAttention(TensorModule):
+    """(B, T, D) -> (B, T, D) multi-head self-attention.
+
+    Params: in-projections ``wq/wk/wv`` and out-projection ``wo`` (all
+    (D, D)) with biases.  ``causal=True`` applies the autoregressive
+    mask (identically in both execution paths).
+    """
+
+    def __init__(self, d_model: int, n_heads: int, causal: bool = False):
+        super().__init__()
+        if d_model % n_heads:
+            raise ValueError(f"d_model ({d_model}) must divide by "
+                             f"n_heads ({n_heads})")
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.causal = causal
+        self.reset()
+
+    def reset(self):
+        d = self.d_model
+        for name in ("wq", "wk", "wv", "wo"):
+            self._add_param(name, init_.default_linear((d, d), d))
+            self._add_param(name.replace("w", "b"),
+                            np.zeros((d,), np.float32))
+        return self
+
+    def _forward(self, P, x, S, ctx):
+        from bigdl_tpu.parallel.ring_attention import (full_attention,
+                                                       ring_self_attention)
+        p = policy()
+        b, t, d = x.shape
+        h = self.n_heads
+        hd = d // h
+
+        def proj(w, bias):
+            y = jnp.matmul(p.cast_compute(x), p.cast_compute(w))
+            return (y.astype(jnp.float32) + bias).reshape(b, t, h, hd)
+
+        q = proj(P["wq"], P["bq"])
+        k = proj(P["wk"], P["bk"])
+        v = proj(P["wv"], P["bv"])
+        if ctx.seq_mesh is not None:
+            batch_axis = ("data" if "data" in ctx.seq_mesh.axis_names
+                          else None)
+            o = ring_self_attention(q, k, v, ctx.seq_mesh, ctx.seq_axis,
+                                    causal=self.causal,
+                                    batch_axis=batch_axis)
+        else:
+            o = full_attention(q, k, v, causal=self.causal)
+        o = o.astype(jnp.float32).reshape(b, t, d)
+        y = jnp.matmul(p.cast_compute(o), p.cast_compute(P["wo"]))
+        return y.astype(p.output_dtype) + P["bo"], None
+
+    def __repr__(self):
+        return (f"MultiHeadSelfAttention({self.d_model}, heads="
+                f"{self.n_heads}{', causal' if self.causal else ''})")

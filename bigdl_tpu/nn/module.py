@@ -44,13 +44,21 @@ class Context:
 
     The key stream is split deterministically at trace time, so the same
     ``apply`` traced under jit produces the same key-derivation graph.
+
+    ``seq_mesh``/``seq_axis``: set by a sequence-parallel trainer
+    (``DistriOptimizer(sequence_parallel=True)``); attention layers read
+    them to route through the exact ring-attention collective instead of
+    the single-device softmax (``nn/attention.py``).
     """
 
-    __slots__ = ("training", "key")
+    __slots__ = ("training", "key", "seq_mesh", "seq_axis")
 
-    def __init__(self, training: bool = False, key=None):
+    def __init__(self, training: bool = False, key=None, seq_mesh=None,
+                 seq_axis: str = "seq"):
         self.training = training
         self.key = key
+        self.seq_mesh = seq_mesh
+        self.seq_axis = seq_axis
 
     def next_key(self):
         if self.key is None:

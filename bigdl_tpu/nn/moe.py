@@ -23,8 +23,8 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu.nn.module import TensorModule
+from bigdl_tpu.nn import init as init_
 from bigdl_tpu.tensor import policy
-from bigdl_tpu.utils.random import RNG
 
 
 class MoE(TensorModule):
@@ -45,14 +45,11 @@ class MoE(TensorModule):
         self.reset()
 
     def reset(self):
-        rng = RNG.np_rng()
         d, h, e = self.d_model, self.hidden, self.n_experts
-        s1 = 1.0 / np.sqrt(d)
-        s2 = 1.0 / np.sqrt(h)
-        self._add_param("router", rng.uniform(-s1, s1, (d, e)).astype(np.float32))
-        self._add_param("w1", rng.uniform(-s1, s1, (e, d, h)).astype(np.float32))
+        self._add_param("router", init_.default_linear((d, e), d))
+        self._add_param("w1", init_.default_linear((e, d, h), d))
         self._add_param("b1", np.zeros((e, h), np.float32))
-        self._add_param("w2", rng.uniform(-s2, s2, (e, h, d)).astype(np.float32))
+        self._add_param("w2", init_.default_linear((e, h, d), h))
         self._add_param("b2", np.zeros((e, d), np.float32))
         return self
 

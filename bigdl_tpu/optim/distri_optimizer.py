@@ -49,7 +49,8 @@ class DistriOptimizer(LocalOptimizer):
                  zero1: bool = False, gradient_compression: str = None,
                  pipeline_stages: int = None, pipeline_schedule: str = "1f1b",
                  pipeline_microbatches: int = None,
-                 expert_parallel: bool = False):
+                 expert_parallel: bool = False,
+                 sequence_parallel: bool = False):
         """``tensor_parallel=True`` with a mesh containing a ``model`` axis
         shards eligible weights (and their optimizer state) over that axis
         via ``parallel.sharding.shard_params_rule`` — hybrid DP x TP with
@@ -85,11 +86,11 @@ class DistriOptimizer(LocalOptimizer):
             raise ValueError("gradient_compression must be None or 'bf16'")
         if pipeline_stages is not None:
             if tensor_parallel or zero1 or gradient_compression \
-                    or expert_parallel:
+                    or expert_parallel or sequence_parallel:
                 raise ValueError(
                     "pipeline_stages owns the mesh; it does not combine "
                     "with tensor_parallel/zero1/gradient_compression/"
-                    "expert_parallel")
+                    "expert_parallel/sequence_parallel")
             if pipeline_schedule not in ("1f1b", "gpipe"):
                 raise ValueError("pipeline_schedule must be '1f1b' or "
                                  "'gpipe'")
@@ -112,15 +113,27 @@ class DistriOptimizer(LocalOptimizer):
                     "pipeline meshes support 'pipe' plus an optional "
                     f"'data' axis (hybrid dp x pp), got {mesh.axis_names}")
         elif expert_parallel:
-            if tensor_parallel or zero1 or gradient_compression:
+            if tensor_parallel or zero1 or gradient_compression \
+                    or sequence_parallel:
                 raise ValueError(
                     "expert_parallel composes with data parallelism only "
                     "(mesh {'data': d, 'expert': e}); tensor_parallel/"
-                    "zero1/gradient_compression assume replicated or "
-                    "data-sharded params, not expert-sharded ones")
+                    "zero1/gradient_compression/sequence_parallel assume "
+                    "replicated or data-sharded params, not expert-"
+                    "sharded ones")
             if mesh is None or "expert" not in mesh.axis_names:
                 raise ValueError(
                     "expert_parallel needs a mesh with an 'expert' axis")
+        elif sequence_parallel:
+            if tensor_parallel or zero1 or gradient_compression:
+                raise ValueError(
+                    "sequence_parallel composes with data parallelism "
+                    "only (mesh {'data': d, 'seq': s})")
+            if mesh is None or "seq" not in mesh.axis_names \
+                    or "data" not in mesh.axis_names:
+                raise ValueError(
+                    "sequence_parallel needs a mesh with 'data' and "
+                    "'seq' axes (pure SP: use {'data': 1, 'seq': s})")
         elif gradient_compression and tensor_parallel:
             raise ValueError(
                 "gradient_compression composes with DP and zero1, not "
@@ -137,6 +150,7 @@ class DistriOptimizer(LocalOptimizer):
         self.tensor_parallel = tensor_parallel
         self.zero1 = zero1
         self.expert_parallel = expert_parallel
+        self.sequence_parallel = sequence_parallel
         if drop_percentage:
             logger.warning(
                 "straggler drop (dropPercentage=%s) is a no-op on TPU: XLA "
@@ -256,6 +270,9 @@ class DistriOptimizer(LocalOptimizer):
         static_hyper = self._hyper(None)
         del static_hyper["lr"]
         has_scales = self._setup_lr_scales(static_hyper)
+        # sequence-parallel trainers hand attention layers the mesh so
+        # they route through the exact ring collective (nn/attention.py)
+        seq_mesh = self.mesh if self.sequence_parallel else None
 
         def step(params, net_state, opt_state, x, y, lr, key, lr_scales):
             hyper = dict(static_hyper, lr=lr)
@@ -268,7 +285,8 @@ class DistriOptimizer(LocalOptimizer):
 
             def loss_fn(p):
                 out, ns = model.apply(p, x, net_state,
-                                      Context(training=True, key=key))
+                                      Context(training=True, key=key,
+                                              seq_mesh=seq_mesh))
                 # in the plain jit path: mean over the GLOBAL batch — with x
                 # sharded over "data" and params replicated, jax.grad makes
                 # XLA emit the cross-ICI all-reduce; this line IS
@@ -291,11 +309,15 @@ class DistriOptimizer(LocalOptimizer):
 
         return step
 
-    def _jit_step(self, step, ps, ns, os_, data_s):
+    def _jit_step(self, step, ps, ns, os_, data_s, x_s=None,
+                  x_chunk_s=None):
         """Shared jit wiring: carried state is donated (buffers recycled in
         place); optimize() passes copies so the module's arrays survive.
         The trailing lr_scales argument rides replicated (prefix sharding
         broadcasts over its pytree) and is never donated.
+
+        ``x_s``/``x_chunk_s`` override the INPUT sharding when it differs
+        from the label sharding (sequence parallelism also shards dim T).
 
         With ``iters_per_dispatch > 1`` the step is wrapped in a
         lax.scan over stacked (n, B, ...) batches — same device-side
@@ -306,7 +328,8 @@ class DistriOptimizer(LocalOptimizer):
         if n <= 1:
             return jax.jit(
                 step,
-                in_shardings=(ps, ns, os_, data_s, data_s, rep, rep, rep),
+                in_shardings=(ps, ns, os_, x_s or data_s, data_s,
+                              rep, rep, rep),
                 out_shardings=(ps, ns, os_, rep),
                 donate_argnums=(0, 1, 2),
             )
@@ -314,8 +337,8 @@ class DistriOptimizer(LocalOptimizer):
         chunk_data_s = NamedSharding(self.mesh, P(None, "data"))
         return jax.jit(
             self._scan_chunk(step, n),
-            in_shardings=(ps, ns, os_, chunk_data_s, chunk_data_s,
-                          rep, rep, rep),
+            in_shardings=(ps, ns, os_, x_chunk_s or chunk_data_s,
+                          chunk_data_s, rep, rep, rep),
             out_shardings=(ps, ns, os_, rep),
             donate_argnums=(0, 1, 2),
         )
@@ -564,7 +587,11 @@ class DistriOptimizer(LocalOptimizer):
         step = self._core_step()
         params, net_state, opt_state = self._state_trees()
         ps, ns, os_, data_s = self._shardings(params, net_state, opt_state)
-        return self._jit_step(step, ps, ns, os_, data_s)
+        x_s = x_chunk_s = None
+        if self.sequence_parallel:
+            x_s = NamedSharding(self.mesh, P("data", "seq"))
+            x_chunk_s = NamedSharding(self.mesh, P(None, "data", "seq"))
+        return self._jit_step(step, ps, ns, os_, data_s, x_s, x_chunk_s)
 
     def _device_put_batch(self, x, y, stacked: bool = False):
         """Assemble the global sharded batch from this process's local
@@ -582,12 +609,25 @@ class DistriOptimizer(LocalOptimizer):
             spec = P(None, "data") if stacked else P("data")
         else:
             spec = P()   # e.g. a pure-EP mesh: batch replicates
-        sharding = NamedSharding(mesh, spec)
+        xspec = spec
+        if self.sequence_parallel and spec != P():
+            # inputs additionally shard their time dim over "seq"
+            t_dim = 2 if stacked else 1
+            xa = np.asarray(x)
+            if xa.ndim <= t_dim or xa.shape[t_dim] % mesh.shape["seq"]:
+                raise ValueError(
+                    f"sequence_parallel needs input dim {t_dim} (time) "
+                    f"divisible by the seq axis ({mesh.shape['seq']}); "
+                    f"got shape {xa.shape}")
+            xspec = (P(None, "data", "seq") if stacked
+                     else P("data", "seq"))
+        xsh = NamedSharding(mesh, xspec)
+        ysh = NamedSharding(mesh, spec)
         if jax.process_count() == 1:
-            return (jax.device_put(jnp.asarray(x), sharding),
-                    jax.device_put(jnp.asarray(y), sharding))
-        return (jax.make_array_from_process_local_data(sharding, np.asarray(x)),
-                jax.make_array_from_process_local_data(sharding, np.asarray(y)))
+            return (jax.device_put(jnp.asarray(x), xsh),
+                    jax.device_put(jnp.asarray(y), ysh))
+        return (jax.make_array_from_process_local_data(xsh, np.asarray(x)),
+                jax.make_array_from_process_local_data(ysh, np.asarray(y)))
 
     def optimize(self):
         state = self.state

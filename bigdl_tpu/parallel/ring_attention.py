@@ -83,11 +83,12 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False):
         return (k_blk, v_blk, m_new, l, o), None
 
     b, _, h, d = q.shape
-    # pvary: initial accumulators are device-varying over the ring axis
-    # (shard_map scan carries must keep a consistent varying type)
-    m0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), (axis_name,))
-    l0 = pvary(jnp.zeros((b, h, t_local), jnp.float32), (axis_name,))
-    o0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), (axis_name,))
+    # pvary: initial accumulators must carry the same varying type as the
+    # operands (the ring axis, plus a batch axis under hybrid dp x sp)
+    vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
+    m0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), vary_axes)
+    l0 = pvary(jnp.zeros((b, h, t_local), jnp.float32), vary_axes)
+    o0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), vary_axes)
     (k_f, v_f, m, l, o), _ = lax.scan(
         step, (k, v, m0, l0, o0), jnp.arange(n))
     l = jnp.maximum(l, 1e-20)
@@ -96,10 +97,15 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False):
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                        causal: bool = False):
+                        causal: bool = False, batch_axis: str = None):
     """Host-level wrapper: shard (B, T, H, D) over ``axis_name`` and run the
-    ring.  The jitted result composes with surrounding pjit computation."""
-    spec = P(None, axis_name)
+    ring.  The jitted result composes with surrounding pjit computation.
+
+    ``batch_axis``: also shard the batch dim (hybrid dp x sp) — each
+    data-parallel group runs its own seq ring; without it a mesh that
+    HAS a data axis would replicate (all-gather) the batch into every
+    data slice."""
+    spec = P(batch_axis, axis_name)
     f = jax.shard_map(
         partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
