@@ -1,0 +1,73 @@
+"""Transformer encoder classifier — the attention-family flagship.
+
+No counterpart in the reference (its sequence model zoo stops at
+RNN/LSTM text classifiers, models/textclassifier); this family exists to
+exercise the long-context machinery end to end: `nn.MultiHeadSelfAttention`
+(ring attention under ``DistriOptimizer(sequence_parallel=True)``),
+`nn.LayerNorm` (per-token — no cross-device stats under any sharding),
+and optionally `nn.MoE` FFN blocks (expert-parallel under
+``expert_parallel=True``).
+
+Structure per block (pre-LN): x + Attn(LN(x)); x + FFN(LN(x)) — the
+residuals use the reference's ConcatTable(Identity, branch) + CAddTable
+idiom (same as its ResNet shortcut spelling).
+"""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def _residual(branch: nn.Module) -> nn.Module:
+    return nn.Sequential(nn.ConcatTable(nn.Identity(), branch),
+                         nn.CAddTable())
+
+
+def _ffn(d_model: int, hidden: int, dropout: float,
+         moe_experts: int) -> nn.Module:
+    if moe_experts > 0:
+        return nn.Sequential(nn.MoE(d_model, hidden, moe_experts),
+                             nn.Dropout(dropout))
+    return nn.Sequential(
+        nn.TimeDistributed(nn.Linear(d_model, hidden)),
+        nn.ReLU(True),
+        nn.Dropout(dropout),
+        nn.TimeDistributed(nn.Linear(hidden, d_model)),
+    )
+
+
+def encoder_block(d_model: int, n_heads: int, hidden: int,
+                  dropout: float = 0.1, causal: bool = False,
+                  moe_experts: int = 0) -> nn.Module:
+    return nn.Sequential(
+        _residual(nn.Sequential(
+            nn.LayerNorm(d_model),
+            nn.MultiHeadSelfAttention(d_model, n_heads, causal=causal),
+            nn.Dropout(dropout),
+        )),
+        _residual(nn.Sequential(
+            nn.LayerNorm(d_model),
+            _ffn(d_model, hidden, dropout, moe_experts),
+        )),
+    )
+
+
+def TransformerClassifier(class_num: int, d_model: int = 128,
+                          n_heads: int = 4, n_layers: int = 2,
+                          hidden: int = 256, dropout: float = 0.1,
+                          causal: bool = False, moe_experts: int = 0):
+    """(B, T, d_model) embeddings -> class log-probs.
+
+    The head mirrors the Bi-LSTM text classifier's (mean over time ->
+    linear -> LogSoftMax), so the two families slot into the same
+    training CLIs and datasets.  ``causal=True`` masks attention
+    autoregressively in every block.
+    """
+    m = nn.Sequential()
+    for _ in range(n_layers):
+        m.add(encoder_block(d_model, n_heads, hidden, dropout,
+                            causal=causal, moe_experts=moe_experts))
+    m.add(nn.LayerNorm(d_model))
+    m.add(nn.Mean(1, n_input_dims=2))
+    m.add(nn.Linear(d_model, class_num))
+    m.add(nn.LogSoftMax())
+    return m

@@ -28,7 +28,7 @@ from bigdl_tpu.nn.pooling import (
 from bigdl_tpu.nn.normalization import (
     BatchNormalization, SpatialBatchNormalization, SpatialCrossMapLRN,
     SpatialSubtractiveNormalization, SpatialDivisiveNormalization,
-    SpatialContrastiveNormalization,
+    SpatialContrastiveNormalization, LayerNorm,
 )
 from bigdl_tpu.nn.shape_ops import (
     Reshape, InferReshape, View, Transpose, Replicate, Squeeze, Unsqueeze,

@@ -1,0 +1,121 @@
+"""Transformer text classifier on news20 embeddings — the attention-family
+training CLI (no reference counterpart; the reference's text example is
+the RNN text classifier, examples/textclassifier).
+
+  python examples/train_transformer.py -b 128 --maxEpoch 5
+  python examples/train_transformer.py --sequenceParallel 4   # dp x sp mesh
+  python examples/train_transformer.py --moeExperts 8 --expertParallel 4
+"""
+import argparse
+import logging
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("-f", "--folder", default="./news20")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--embedDim", type=int, default=128)
+    p.add_argument("--seqLen", type=int, default=128)
+    p.add_argument("--dModel", type=int, default=None,
+                   help="model width; defaults to --embedDim (a projection "
+                        "is prepended when they differ)")
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--moeExperts", type=int, default=0,
+                   help="replace FFN blocks with nn.MoE of this many experts")
+    p.add_argument("--learningRate", type=float, default=0.01)
+    p.add_argument("--maxEpoch", type=int, default=5)
+    p.add_argument("--maxIteration", type=int, default=None)
+    p.add_argument("--iterationsPerDispatch", type=int, default=1)
+    p.add_argument("--sequenceParallel", type=int, default=0,
+                   help="shard the sequence dim over a 'seq' mesh axis of "
+                        "this size (ring attention); 0 = off")
+    p.add_argument("--expertParallel", type=int, default=0,
+                   help="shard MoE experts over an 'expert' mesh axis of "
+                        "this size; 0 = off")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToBatch
+    from bigdl_tpu.models.transformer import TransformerClassifier
+    from bigdl_tpu.optim import (DistriOptimizer, Optimizer, Top1Accuracy,
+                                 every_epoch, max_epoch, max_iteration)
+    from bigdl_tpu.parallel.mesh import make_mesh
+    from bigdl_tpu.utils.table import T
+
+    if args.sequenceParallel and args.expertParallel:
+        raise SystemExit("pick one of --sequenceParallel/--expertParallel")
+    if args.expertParallel:
+        if args.moeExperts <= 0:
+            raise SystemExit("--expertParallel needs --moeExperts > 0 "
+                             "(there are no expert params to shard)")
+        if args.moeExperts % args.expertParallel:
+            raise SystemExit(
+                f"--moeExperts ({args.moeExperts}) must divide by "
+                f"--expertParallel ({args.expertParallel})")
+
+    import os
+    from bigdl_tpu.dataset import news20
+    n_class = 20
+    if os.path.isdir(args.folder):
+        # real 20-newsgroups + GloVe (pre-extracted; ref news20.py)
+        texts = news20.get_news20(args.folder)
+        w2v = news20.get_glove_w2v(args.folder, dim=args.embedDim)
+        samples = news20.embed_samples(texts, w2v, args.seqLen,
+                                       args.embedDim)
+        n_class = int(max(s.label[0] for s in samples))
+        np.random.RandomState(0).shuffle(samples)
+    else:
+        logging.warning("no news20 data in %s — synthetic embedded docs",
+                        args.folder)
+        rs = np.random.RandomState(0)
+        class_means = rs.randn(n_class, args.embedDim)
+        samples = [Sample((rs.randn(args.seqLen, args.embedDim) * 0.5
+                           + class_means[i % n_class]).astype(np.float32),
+                          np.asarray([float(i % n_class + 1)], np.float32))
+                   for i in range(2048)]
+
+    split = int(len(samples) * 0.8)
+    train_ds = (DataSet.array(samples[:split])
+                >> SampleToBatch(args.batchSize, drop_last=True))
+    test_ds = (DataSet.array(samples[split:])
+               >> SampleToBatch(args.batchSize, drop_last=True))
+
+    d_model = args.dModel or args.embedDim
+    model = TransformerClassifier(n_class, d_model=d_model,
+                                  n_heads=args.heads, n_layers=args.layers,
+                                  hidden=args.hidden,
+                                  moe_experts=args.moeExperts)
+    if d_model != args.embedDim:
+        model = nn.Sequential(
+            nn.TimeDistributed(nn.Linear(args.embedDim, d_model)), model)
+    if args.sequenceParallel:
+        optimizer = DistriOptimizer(
+            model, train_ds, nn.ClassNLLCriterion(),
+            mesh=make_mesh({"data": -1, "seq": args.sequenceParallel}),
+            sequence_parallel=True)
+    elif args.expertParallel:
+        optimizer = DistriOptimizer(
+            model, train_ds, nn.ClassNLLCriterion(),
+            mesh=make_mesh({"data": -1, "expert": args.expertParallel}),
+            expert_parallel=True)
+    else:
+        optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
+    optimizer.set_state(T(learningRate=args.learningRate))
+    if args.maxIteration:
+        optimizer.set_end_when(max_iteration(args.maxIteration))
+    else:
+        optimizer.set_end_when(max_epoch(args.maxEpoch))
+    optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])
+    optimizer.set_iterations_per_dispatch(args.iterationsPerDispatch)
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -42,8 +42,16 @@ def run_example(module_name, argv):
      # batch must divide the 8-device mesh (Utils.getBatchSize rule)
      ["--synthetic", "--batchSize", "8", "--maxIteration", "2",
       "--classNumber", "10"]),
+    ("examples.train_transformer",
+     ["--folder", "/nonexistent", "--batchSize", "16", "--maxIteration",
+      "3", "--seqLen", "16", "--embedDim", "16", "--heads", "2",
+      "--layers", "1", "--hidden", "32"]),
+    ("examples.train_transformer",
+     ["--folder", "/nonexistent", "--batchSize", "16", "--maxIteration",
+      "2", "--seqLen", "16", "--embedDim", "16", "--heads", "2",
+      "--layers", "1", "--hidden", "32", "--sequenceParallel", "4"]),
 ], ids=["lenet", "vgg", "autoencoder", "rnn", "textconv", "textlstm",
-        "inception"])
+        "inception", "transformer", "transformer-sp"])
 def test_example_trains(module, argv, monkeypatch, tmp_path):
     monkeypatch.chdir(tmp_path)  # checkpoints etc. land in tmp
     sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))
