@@ -94,12 +94,6 @@ class DistriOptimizer(LocalOptimizer):
             if pipeline_schedule not in ("1f1b", "gpipe"):
                 raise ValueError("pipeline_schedule must be '1f1b' or "
                                  "'gpipe'")
-            if jax.process_count() > 1:
-                raise ValueError(
-                    "pipeline_stages requires a single-process runtime: "
-                    "multi-host PP needs globally identical batches and a "
-                    "cross-host stage gather, neither of which the "
-                    "sharded-dataset feeding path provides")
             if mesh is None:
                 from bigdl_tpu.parallel.mesh import make_mesh
                 mesh = make_mesh({"pipe": pipeline_stages})
@@ -179,18 +173,25 @@ class DistriOptimizer(LocalOptimizer):
         if not force and (self.checkpoint_trigger is None
                           or not self.checkpoint_trigger(state)):
             return
+        if self._pipe_plan is not None:
+            # unpack only when actually firing (full-model host gather),
+            # and BEFORE the process gate: multi-host stage gathering is
+            # a collective every process must join.  opt_state stays
+            # stage-stacked — a resumed run re-packs the same partition,
+            # so set_optim_state round-trips.
+            params = self._pipe_plan.unpack_params(params)
+            net_state = self._pipe_plan.unpack_state(net_state)
+            # opt_state leaves are stage-stacked too: bring host copies
+            # so process 0 can pickle them (a multi-host sharded array
+            # is not picklable)
+            opt_state = jax.tree_util.tree_map(
+                self._pipe_plan._gather_stacked, opt_state)
         # params are replicated, so exactly one process writes — the
         # reference gathers slices to the driver and saves once
         # (getModel + File.save, DistriOptimizer.scala:320-342); writing
         # from every host would race on a shared checkpoint path.
         if jax.process_index() != 0:
             return
-        if self._pipe_plan is not None:
-            # unpack only when actually firing (full-model host gather);
-            # opt_state stays stage-stacked — a resumed run re-packs the
-            # same partition, so set_optim_state round-trips
-            params = self._pipe_plan.unpack_params(params)
-            net_state = self._pipe_plan.unpack_state(net_state)
         super()._maybe_checkpoint(params, net_state, opt_state, state,
                                   force=True, neval_label=neval_label)
 
@@ -505,6 +506,18 @@ class DistriOptimizer(LocalOptimizer):
         from bigdl_tpu.parallel.pipeline import (pipeline_apply,
                                                  pipeline_train_1f1b)
         from bigdl_tpu.parallel.pipeline_model import partition_sequential
+
+        if jax.process_count() > 1:
+            # multi-host pipeline: stages span hosts over DCN.  Every
+            # process must feed the IDENTICAL global batch (the operands
+            # ride replicated), so a per-process-sharded dataset cannot
+            # drive it.
+            from bigdl_tpu.optim.optimizer import is_distributed_dataset
+            if is_distributed_dataset(self.dataset):
+                raise ValueError(
+                    "multi-host pipeline_stages needs a replicated "
+                    "(non-distributed) dataset: every process feeds the "
+                    "identical global batch")
 
         # Shape peek from the TRAIN stream (the eval pass may end with a
         # partial batch and its first batch can differ from the looped

@@ -17,6 +17,15 @@ def _root_dataset(ds):
     return ds
 
 
+def is_distributed_dataset(ds):
+    """ONE predicate for "this dataset shards per process" — shared by the
+    Optimizer factory's Local/Distri routing and the multi-host pipeline
+    guard."""
+    root = _root_dataset(ds)
+    return isinstance(root, ShardedDataSet) or getattr(
+        root, "distributed", False)
+
+
 def Optimizer(model, dataset=None, criterion=None, *, training_rdd=None,
               optim_method=None, state=None, end_trigger=None,
               batch_size=None, **kwargs):
@@ -32,8 +41,7 @@ def Optimizer(model, dataset=None, criterion=None, *, training_rdd=None,
             raise ValueError("batch_size is required with training_rdd")
         dataset = (DataSet.array(list(training_rdd), distributed=True)
                    >> SampleToBatch(batch_size, drop_last=True))
-    root = _root_dataset(dataset)
-    if isinstance(root, ShardedDataSet) or getattr(root, "distributed", False):
+    if is_distributed_dataset(dataset):
         opt = DistriOptimizer(model, dataset, criterion, **kwargs)
     else:
         opt = LocalOptimizer(model, dataset, criterion)

@@ -133,8 +133,42 @@ class StagePlan:
     def pack_state(self, tree):
         return self._pack(tree, self.max_s)
 
+    @staticmethod
+    def _gather_stacked(stacked):
+        """Host copy of a (P, width) stage-stacked array.  Single-process:
+        a plain device_get.  Multi-host (stages span processes over DCN):
+        rows are placed by their GLOBAL dim-0 index and de-duplicated —
+        under a hybrid dp x pp mesh each stage row is replicated across
+        the data axis, so a naive concat of addressable shards would
+        duplicate or misplace rows.  The assembly is a COLLECTIVE
+        (process_allgather), so every process must reach the call site
+        together (checkpoint/validation unpacks run on all processes
+        before any process-0 gating)."""
+        if jax.process_count() == 1 or getattr(
+                stacked, "is_fully_addressable", True):
+            return np.asarray(jax.device_get(stacked))
+        from jax.experimental import multihost_utils
+        n_rows = stacked.shape[0]
+        local = np.zeros(stacked.shape, stacked.dtype)
+        have = np.zeros((n_rows,), np.float32)
+        for s in stacked.addressable_shards:
+            start = s.index[0].start or 0
+            data = np.asarray(s.data)
+            local[start:start + data.shape[0]] = data
+            have[start:start + data.shape[0]] = 1.0
+        g_rows = np.asarray(multihost_utils.process_allgather(
+            local, tiled=False))          # (nproc, P, width)
+        g_have = np.asarray(multihost_utils.process_allgather(
+            have, tiled=False))           # (nproc, P)
+        out = np.zeros(stacked.shape, stacked.dtype)
+        for r in range(n_rows):
+            owners = np.nonzero(g_have[:, r])[0]
+            assert owners.size, f"stage row {r} owned by no process"
+            out[r] = g_rows[owners[0], r]
+        return out
+
     def _unpack(self, stacked, sizes, unravels):
-        stacked = jax.device_get(stacked)
+        stacked = self._gather_stacked(stacked)
         tree = {"~": {}}
         for i, (a, b) in enumerate(self.ranges):
             stage = unravels[i](jnp.asarray(stacked[i, :sizes[i]]))

@@ -32,6 +32,9 @@ def main():
     resume = "--resume" in argv
     if resume:
         argv.remove("--resume")
+    pipeline = "--pipeline" in argv
+    if pipeline:
+        argv.remove("--pipeline")
     pid, nproc, port = int(argv[0]), int(argv[1]), argv[2]
     ckpt_dir = argv[3] if len(argv) > 3 else None
 
@@ -73,11 +76,43 @@ def main():
     ds = (DataSet.array(samples, distributed=(nproc > 1))
           >> SampleToBatch(local_batch))
 
-    model = nn.Sequential(nn.Linear(d, 8), nn.Tanh(),
-                          nn.Linear(8, classes), nn.LogSoftMax())
     from bigdl_tpu.optim import several_iteration
     from bigdl_tpu.optim.trigger import Trigger
     from bigdl_tpu.utils import file as File
+
+    if pipeline:
+        # multi-host PIPELINE: stages span processes (DCN in production,
+        # loopback here); every process feeds the identical global batch
+        # through a replicated dataset — the contract
+        # _build_step_pipeline enforces
+        from bigdl_tpu.parallel.mesh import make_mesh
+        n_stage = 2 * nproc
+        ds_p = DataSet.array(samples) >> SampleToBatch(n)
+        model_p = nn.Sequential(nn.Linear(d, 16), nn.ReLU(True),
+                                nn.Linear(16, 16), nn.Tanh(),
+                                nn.Linear(16, 8), nn.ReLU(True),
+                                nn.Linear(8, classes), nn.LogSoftMax())
+        opt = DistriOptimizer(model_p, ds_p, nn.ClassNLLCriterion(),
+                              mesh=make_mesh({"pipe": n_stage}),
+                              pipeline_stages=n_stage,
+                              pipeline_microbatches=4)
+        opt.set_state(T(learningRate=0.5, momentum=0.9))
+        opt.set_end_when(max_iteration(6))
+        if ckpt_dir:
+            opt.set_checkpoint(ckpt_dir, several_iteration(3))
+        opt.optimize()
+        psum = float(sum(np.abs(np.asarray(p)).sum()
+                         for p in jax.tree_util.tree_leaves(
+                             model_p.params())))
+        out = {"process_id": pid, "losses": [float(opt.state["loss"])],
+               "psum": psum}
+        if ckpt_dir:
+            out["ckpt_files"] = sorted(_os.listdir(ckpt_dir))
+        print(json.dumps(out))
+        return
+
+    model = nn.Sequential(nn.Linear(d, 8), nn.Tanh(),
+                          nn.Linear(8, classes), nn.LogSoftMax())
 
     # momentum makes the drill honest: resuming without the optimizer
     # velocity would visibly diverge from the uninterrupted oracle
