@@ -94,6 +94,18 @@ class DistriOptimizer(LocalOptimizer):
             if pipeline_schedule not in ("1f1b", "gpipe"):
                 raise ValueError("pipeline_schedule must be '1f1b' or "
                                  "'gpipe'")
+            if jax.process_count() > 1:
+                # multi-host pipeline: stages span hosts over DCN.  Every
+                # process must feed the IDENTICAL global batch (operands
+                # ride replicated), so a per-process-sharded dataset
+                # cannot drive it — fail at construction, not at
+                # optimize() after the user's setup work
+                from bigdl_tpu.optim.optimizer import is_distributed_dataset
+                if is_distributed_dataset(dataset):
+                    raise ValueError(
+                        "multi-host pipeline_stages needs a replicated "
+                        "(non-distributed) dataset: every process feeds "
+                        "the identical global batch")
             if mesh is None:
                 from bigdl_tpu.parallel.mesh import make_mesh
                 mesh = make_mesh({"pipe": pipeline_stages})
@@ -507,17 +519,6 @@ class DistriOptimizer(LocalOptimizer):
                                                  pipeline_train_1f1b)
         from bigdl_tpu.parallel.pipeline_model import partition_sequential
 
-        if jax.process_count() > 1:
-            # multi-host pipeline: stages span hosts over DCN.  Every
-            # process must feed the IDENTICAL global batch (the operands
-            # ride replicated), so a per-process-sharded dataset cannot
-            # drive it.
-            from bigdl_tpu.optim.optimizer import is_distributed_dataset
-            if is_distributed_dataset(self.dataset):
-                raise ValueError(
-                    "multi-host pipeline_stages needs a replicated "
-                    "(non-distributed) dataset: every process feeds the "
-                    "identical global batch")
 
         # Shape peek from the TRAIN stream (the eval pass may end with a
         # partial batch and its first batch can differ from the looped

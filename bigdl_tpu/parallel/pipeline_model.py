@@ -147,24 +147,41 @@ class StagePlan:
         if jax.process_count() == 1 or getattr(
                 stacked, "is_fully_addressable", True):
             return np.asarray(jax.device_get(stacked))
-        from jax.experimental import multihost_utils
+        # row ownership is GLOBAL sharding metadata — every process
+        # computes the identical map, so no ownership collective is
+        # needed and the short-circuit below is process-consistent
         n_rows = stacked.shape[0]
+        owner = {}                       # global row -> owning process
+        rows_of = {}                     # process -> set of rows
+        for dev, idx in stacked.sharding.devices_indices_map(
+                stacked.shape).items():
+            sl = idx[0]
+            for r in range(sl.start or 0, sl.stop if sl.stop is not None
+                           else n_rows):
+                owner.setdefault(r, dev.process_index)
+                rows_of.setdefault(dev.process_index, set()).add(r)
+        assert len(owner) == n_rows, "stage rows with no owner"
+        if all(len(rows_of.get(p, ())) == n_rows
+               for p in range(jax.process_count())):
+            # e.g. hybrid dp x pp data-major layouts: every process holds
+            # every stage row — purely local assembly, no collective
+            local = np.zeros(stacked.shape, stacked.dtype)
+            for s in stacked.addressable_shards:
+                start = s.index[0].start or 0
+                data = np.asarray(s.data)
+                local[start:start + data.shape[0]] = data
+            return local
+        from jax.experimental import multihost_utils
         local = np.zeros(stacked.shape, stacked.dtype)
-        have = np.zeros((n_rows,), np.float32)
         for s in stacked.addressable_shards:
             start = s.index[0].start or 0
             data = np.asarray(s.data)
             local[start:start + data.shape[0]] = data
-            have[start:start + data.shape[0]] = 1.0
         g_rows = np.asarray(multihost_utils.process_allgather(
             local, tiled=False))          # (nproc, P, width)
-        g_have = np.asarray(multihost_utils.process_allgather(
-            have, tiled=False))           # (nproc, P)
         out = np.zeros(stacked.shape, stacked.dtype)
         for r in range(n_rows):
-            owners = np.nonzero(g_have[:, r])[0]
-            assert owners.size, f"stage row {r} owned by no process"
-            out[r] = g_rows[owners[0], r]
+            out[r] = g_rows[owner[r], r]
         return out
 
     def _unpack(self, stacked, sizes, unravels):

@@ -35,6 +35,10 @@ def main():
     pipeline = "--pipeline" in argv
     if pipeline:
         argv.remove("--pipeline")
+    pipeline_hybrid = "--pipeline-hybrid" in argv
+    if pipeline_hybrid:
+        argv.remove("--pipeline-hybrid")
+        pipeline = True
     pid, nproc, port = int(argv[0]), int(argv[1]), argv[2]
     ckpt_dir = argv[3] if len(argv) > 3 else None
 
@@ -86,15 +90,22 @@ def main():
         # through a replicated dataset — the contract
         # _build_step_pipeline enforces
         from bigdl_tpu.parallel.mesh import make_mesh
-        n_stage = 2 * nproc
+        if pipeline_hybrid:
+            # hybrid dp x pp SPANNING processes: stage rows replicate
+            # over the data axis, exercising the replica-dedup stage
+            # gather in checkpoints
+            n_stage = nproc
+            mesh = make_mesh({"data": 2, "pipe": n_stage})
+        else:
+            n_stage = 2 * nproc
+            mesh = make_mesh({"pipe": n_stage})
         ds_p = DataSet.array(samples) >> SampleToBatch(n)
         model_p = nn.Sequential(nn.Linear(d, 16), nn.ReLU(True),
                                 nn.Linear(16, 16), nn.Tanh(),
                                 nn.Linear(16, 8), nn.ReLU(True),
                                 nn.Linear(8, classes), nn.LogSoftMax())
         opt = DistriOptimizer(model_p, ds_p, nn.ClassNLLCriterion(),
-                              mesh=make_mesh({"pipe": n_stage}),
-                              pipeline_stages=n_stage,
+                              mesh=mesh, pipeline_stages=n_stage,
                               pipeline_microbatches=4)
         opt.set_state(T(learningRate=0.5, momentum=0.9))
         opt.set_end_when(max_iteration(6))

@@ -98,6 +98,34 @@ def test_two_process_pipeline_matches_single_process(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_hybrid_dp_pp_checkpoint_dedups_replicas(tmp_path):
+    """Hybrid {'data': 2, 'pipe': 2} spanning 2 processes: stage rows
+    are REPLICATED across the data axis, so the cross-host stage gather
+    must place rows by global index and de-duplicate — the checkpoint
+    must hold each stage's params exactly once and match the
+    single-process run."""
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    two = run_workers(2, free_port(), ckpt_dir=ck,
+                      per_proc_args={0: ["--pipeline-hybrid"],
+                                     1: ["--pipeline-hybrid"]})
+    one = run_workers(1, free_port(), per_proc_args={0: ["--pipeline"]})
+    assert two[0]["losses"] == pytest.approx(two[1]["losses"], rel=1e-5)
+    assert two[0]["losses"] == pytest.approx(one[0]["losses"], rel=1e-4)
+    assert two[0]["psum"] == pytest.approx(one[0]["psum"], rel=1e-4)
+
+    from bigdl_tpu.utils import file as File
+    files = two[0]["ckpt_files"]
+    latest = max(int(f.split(".")[-1]) for f in files
+                 if f.startswith("model."))
+    m = File.load_module(str(ck / f"model.{latest}"))
+    # every layer's params present exactly once with the right shapes
+    shapes = sorted(tuple(p.shape) for p in m.parameters()[0])
+    assert shapes == sorted([(16, 6), (16,), (16, 16), (16,), (8, 16),
+                             (8,), (3, 8), (3,)]), shapes
+
+
+@pytest.mark.slow
 def test_two_process_checkpoint_written_once_and_resumable(tmp_path):
     """Only process 0 writes checkpoints (the reference's driver-side
     getModel+save, DistriOptimizer.scala:320-342); every process can
