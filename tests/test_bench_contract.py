@@ -123,5 +123,8 @@ def test_subprocess_timeout_salvages_printed_entries(tmp_path, monkeypatch):
         b.os.path, "abspath",
         lambda p: str(fake) if orig(p) == real else orig(p))
     monkeypatch.setattr(b, "_BENCH_DEADLINE", b.time.monotonic() + 600)
-    out = b._subprocess_json("x", timeout_s=3, retries=0)
+    # 20s: the child prints immediately then sleeps 600 — the timeout only
+    # needs to cover interpreter startup, which can stretch under a loaded
+    # host (this test once flaked at 3s while a bench ran concurrently)
+    out = b._subprocess_json("x", timeout_s=20, retries=0)
     assert out and out[0]["config"] == "Inception-v1 fake"
