@@ -584,12 +584,20 @@ class DistriOptimizer(LocalOptimizer):
 
         pipe = NamedSharding(mesh, P("pipe"))
         rep = NamedSharding(mesh, P())
+        # opt-state leaves mirror the (P, max) stacked params and shard
+        # over "pipe"; scalar leaves (Adagrad's step counter) replicate
+        opt_shape = jax.eval_shape(
+            method.init_state,
+            jax.ShapeDtypeStruct((plan.n_stages, plan.max_p), jnp.float32))
+        opt_s = jax.tree_util.tree_map(
+            lambda l: pipe if l.ndim >= 1
+            and l.shape[0] % plan.n_stages == 0 else rep, opt_shape)
         n = self.iters_per_dispatch
         fn = step if n <= 1 else self._scan_chunk(step, n)
         return jax.jit(
             fn,
-            in_shardings=(pipe, pipe, pipe, rep, rep, rep, rep, rep),
-            out_shardings=(pipe, pipe, pipe, rep),
+            in_shardings=(pipe, pipe, opt_s, rep, rep, rep, rep, rep),
+            out_shardings=(pipe, pipe, opt_s, rep),
             donate_argnums=(0, 1, 2),
         )
 

@@ -253,6 +253,22 @@ def test_hybrid_dp_pp_with_bn_and_dropout_trains():
         model.modules[1].state()["~"]["running_mean"])).sum()) > 0
 
 
+def test_pipeline_with_adagrad():
+    """Optimizers with scalar state leaves work under pipeline sharding
+    (the step counter replicates while stacked mirrors shard)."""
+    from bigdl_tpu.optim import Adagrad
+    model = _mlp()
+    mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+    opt = DistriOptimizer(model, _mlp_ds(), nn.ClassNLLCriterion(),
+                          mesh=mesh, pipeline_stages=4,
+                          pipeline_microbatches=4)
+    opt.set_optim_method(Adagrad())
+    opt.set_state(T(learningRate=0.1))
+    opt.set_end_when(max_iteration(3))
+    opt.optimize()
+    assert np.isfinite(opt.state["loss"])
+
+
 def test_pipeline_invalid_combos():
     model = _mlp()
     with pytest.raises(ValueError, match="owns the mesh"):
