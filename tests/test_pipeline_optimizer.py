@@ -253,6 +253,79 @@ def test_hybrid_dp_pp_with_bn_and_dropout_trains():
         model.modules[1].state()["~"]["running_mean"])).sum()) > 0
 
 
+def test_pipeline_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """Kill-and-resume through the pipeline path: a run restarted from
+    model.N + state.N (stage-stacked opt_state re-packed onto the same
+    partition) lands on the uninterrupted run's trajectory — momentum
+    makes a missing velocity restore visible."""
+    from bigdl_tpu.utils import file as File
+
+    def fresh(model):
+        mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+        opt = DistriOptimizer(model, _mlp_ds(), nn.ClassNLLCriterion(),
+                              mesh=mesh, pipeline_stages=4,
+                              pipeline_microbatches=4)
+        return opt
+
+    # uninterrupted 4-iteration oracle
+    m_full = _mlp()
+    opt = fresh(m_full)
+    opt.set_state(T(learningRate=0.1, momentum=0.9))
+    opt.set_end_when(max_iteration(4))
+    opt.optimize()
+
+    # run A: 2 iterations, checkpoint each
+    m_a = _mlp()
+    opt_a = fresh(m_a)
+    opt_a.set_state(T(learningRate=0.1, momentum=0.9))
+    opt_a.set_end_when(max_iteration(2))
+    opt_a.set_checkpoint(str(tmp_path), several_iteration(1))
+    opt_a.optimize()
+
+    # run B: resume from the newest snapshot, 2 more iterations.  The
+    # data stream must continue where run A stopped: replay A's RNG
+    # draws (a throwaway model init) and skip its consumed batches.
+    nevals = sorted(int(f.name.split(".")[-1])
+                    for f in tmp_path.iterdir()
+                    if f.name.startswith("model."))
+    latest = nevals[-1]
+    m_b = File.load_module(str(tmp_path / f"model.{latest}"))
+    snap = File.load(str(tmp_path / f"state.{latest}"))
+    _ = _mlp()              # replay run A's init draws (same seed inside)
+
+    class _SkipDS:
+        """Continue the epoch where the killed run stopped."""
+        def __init__(self, base, skip):
+            self.base, self.skip = base, skip
+        def data(self, train):
+            it = self.base.data(train)
+            if train:
+                for _ in range(self.skip):
+                    next(it)
+            return it
+        def size(self):
+            return self.base.size()
+        def shuffle(self):
+            return self.base.shuffle()
+
+    ds = _SkipDS(_mlp_ds(), 2)
+    mesh = make_mesh({"pipe": 4}, jax.devices()[:4])
+    opt_b = DistriOptimizer(m_b, ds, nn.ClassNLLCriterion(),
+                            mesh=mesh, pipeline_stages=4,
+                            pipeline_microbatches=4)
+    start = T(learningRate=0.1, momentum=0.9)
+    start.update(snap["state"])
+    opt_b.set_state(start)
+    opt_b.set_optim_state(snap["opt_state"])
+    opt_b.set_end_when(max_iteration(4))
+    opt_b.optimize()
+
+    assert abs(opt_b.state["loss"] - opt.state["loss"]) < 1e-5
+    np.testing.assert_allclose(np.asarray(_flat(m_b.params())),
+                               np.asarray(_flat(m_full.params())),
+                               rtol=2e-5, atol=2e-6)
+
+
 def test_pipeline_with_adagrad():
     """Optimizers with scalar state leaves work under pipeline sharding
     (the step counter replicates while stacked mirrors shard)."""
