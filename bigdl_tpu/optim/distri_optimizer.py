@@ -108,7 +108,27 @@ class DistriOptimizer(LocalOptimizer):
                         "the identical global batch")
             if mesh is None:
                 from bigdl_tpu.parallel.mesh import make_mesh
-                mesh = make_mesh({"pipe": pipeline_stages})
+                devs = jax.devices()
+                if len(devs) < pipeline_stages:
+                    raise ValueError(
+                        f"pipeline_stages={pipeline_stages} needs that "
+                        f"many devices, have {len(devs)}")
+                if jax.process_count() > 1 and len(devs) != pipeline_stages:
+                    # devs[:P] would be a host-0-only mesh while every
+                    # process must join the pipeline collectives — the
+                    # multi-host spanning layout needs an explicit choice
+                    raise ValueError(
+                        f"multi-host pipeline with {len(devs)} global "
+                        f"devices and pipeline_stages={pipeline_stages}: "
+                        "pass an explicit mesh (e.g. make_mesh({'data': "
+                        f"{len(devs) // pipeline_stages}, 'pipe': "
+                        f"{pipeline_stages}}})) so every process holds "
+                        "mesh devices")
+                # default mesh: the first P devices as a pure pipe axis
+                # (pass an explicit {'data': d, 'pipe': P} mesh to use
+                # the rest for hybrid dp x pp)
+                mesh = make_mesh({"pipe": pipeline_stages},
+                                 devs[:pipeline_stages])
             if "pipe" not in mesh.axis_names or \
                     mesh.shape["pipe"] != pipeline_stages:
                 raise ValueError(

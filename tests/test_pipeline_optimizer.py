@@ -326,6 +326,19 @@ def test_pipeline_checkpoint_resume_matches_uninterrupted(tmp_path):
                                rtol=2e-5, atol=2e-6)
 
 
+def test_pipeline_default_mesh_takes_first_p_devices():
+    """No explicit mesh: pipeline_stages=4 on an 8-device host must build
+    a 4-device pipe mesh (the train_vgg.py --pipeline path), not demand
+    P == device_count."""
+    opt = DistriOptimizer(_mlp(), _mlp_ds(), nn.ClassNLLCriterion(),
+                          pipeline_stages=4, pipeline_microbatches=4)
+    assert dict(opt.mesh.shape) == {"pipe": 4}
+    opt.set_state(T(learningRate=0.1))
+    opt.set_end_when(max_iteration(2))
+    opt.optimize()
+    assert np.isfinite(opt.state["loss"])
+
+
 def test_pipeline_with_adagrad():
     """Optimizers with scalar state leaves work under pipeline sharding
     (the step counter replicates while stacked mirrors shard)."""
