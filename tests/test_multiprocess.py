@@ -31,6 +31,10 @@ def free_port():
 def spawn_workers(nproc, port, ckpt_dir=None, per_proc_args=None):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
+    # the worker script lives in tests/helpers/, so its sys.path[0] is NOT
+    # the repo root — make bigdl_tpu importable without a pip install
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     extra = [str(ckpt_dir)] if ckpt_dir else []
     return [subprocess.Popen(
         [sys.executable, WORKER, str(i), str(nproc), str(port)] + extra
