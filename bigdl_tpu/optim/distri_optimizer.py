@@ -13,10 +13,17 @@ Mapping from the reference, piece by piece:
   (FP16CompressedTensor)                          (on-chip cast, no wire)
 - per-partition weight update                  -> optional ZeRO-1 optimizer
   (optimMethod.optimize on MY slice :232)         state sharding
-- straggler dropping (invokeAndWait2 timeout)  -> N/A: XLA collectives are
-                                                  bulk-synchronous on a TPU
-                                                  slice; knobs accepted as
-                                                  documented no-ops
+- straggler dropping (invokeAndWait2 timeout)  -> gradient masking: an XLA
+  (DistriOptimizer.scala:154-172, threshold        dispatch cannot be
+  :245-278)                                        cancelled, so replicas
+                                                  over the kth-largest
+                                                  time threshold are
+                                                  masked out of the NEXT
+                                                  aggregation instead —
+                                                  psum(w*g)/sum(w), the
+                                                  reference's div-by-
+                                                  finishedModelNum (see
+                                                  optim/straggler.py)
 - Metrics phase breakdown :114-118             -> step metrics below
 
 Multi-host: each process feeds its local batch shard;
@@ -177,15 +184,76 @@ class DistriOptimizer(LocalOptimizer):
         self.zero1 = zero1
         self.expert_parallel = expert_parallel
         self.sequence_parallel = sequence_parallel
+        self._straggler = None
         if drop_percentage:
-            logger.warning(
-                "straggler drop (dropPercentage=%s) is a no-op on TPU: XLA "
-                "collectives are bulk-synchronous (ref DistriOptimizer straggler "
-                "machinery, DistriOptimizer.scala:154-172)", drop_percentage)
+            # constructor shorthand: drop and cap at the same fraction
+            # (the reference arms both through setDropMoudleProperty,
+            # Optimizer.scala:116-124)
+            self.set_drop_module_property(drop_percentage, drop_percentage)
 
-    def set_drop_module_property(self, *args, **kwargs):
-        """Accepted for API parity; see class docstring (no-op)."""
+    def set_drop_module_property(self, drop_percentage: float,
+                                 max_drop_percentage: float,
+                                 batch_size: int = 100,
+                                 warmup_iteration: int = 200,
+                                 time_source=None):
+        """Arm straggler dropping (ref Optimizer.setDropMoudleProperty,
+        Optimizer.scala:116-124; drop/threshold machinery
+        DistriOptimizer.scala:154-172, :245-278).  Each data replica is
+        one reference "task": replicas whose measured step time exceeded
+        the kth-largest threshold are masked out of the gradient
+        aggregation — ``psum(w*g)/sum(w)``, the reference's
+        ``gradientPartition.div(finishedModelNum)`` — one dispatch after
+        the measurement (an XLA collective cannot be cancelled mid-
+        flight the way ``invokeAndWait2`` cancels a JVM task).
+        ``time_source(local_wall) -> (n_tasks,) seconds`` overrides the
+        per-process wall-clock default (tests inject synthetic
+        schedules); see optim/straggler.py."""
+        from bigdl_tpu.optim.straggler import StragglerPolicy
+        if not drop_percentage:
+            self._straggler = None
+            return self
+        if (self.pipeline_stages is not None or self.expert_parallel
+                or self.sequence_parallel or self.tensor_parallel):
+            raise ValueError(
+                "straggler drop masks per-DATA-replica gradients; it "
+                "composes with DP, zero1 and gradient_compression only "
+                "(the reference's tasks are data-parallel model clones)")
+        if "data" not in self.mesh.axis_names:
+            raise ValueError("straggler drop needs a 'data' mesh axis")
+        self._straggler = StragglerPolicy(
+            n_tasks=self.mesh.shape["data"],
+            drop_percentage=drop_percentage,
+            max_drop_percentage=max_drop_percentage,
+            compute_threshold_batch_size=batch_size,
+            warmup_iteration=warmup_iteration,
+            time_source=time_source)
         return self
+
+    def _straggler_task_times(self, fetch_wall: float,
+                              step_wall: float) -> np.ndarray:
+        """Per-task (= per data-replica) seconds for this iteration.
+
+        Multi-host: the signal is each process's HOST-SIDE wall (data
+        fetch + preprocessing), assigned to the replicas that process
+        owns.  The dispatch wall itself is useless here — the collective
+        is bulk-synchronous, so every process's step ENDS at the same
+        instant and a process that entered late (because its fetch was
+        slow) measures a SHORTER dispatch than the healthy hosts; the
+        fetch wall is the part of the iteration where a straggling host
+        actually spends its excess time.  Single host: no skew is
+        observable within one XLA dispatch, so every task reads the same
+        total wall and dropping never engages."""
+        pol = self._straggler
+        if pol.time_source is not None or jax.process_count() == 1:
+            return pol.task_times(fetch_wall + step_wall)
+        from jax.experimental import multihost_utils
+        walls = np.asarray(multihost_utils.process_allgather(
+            np.asarray(fetch_wall, np.float64))).reshape(-1)
+        ax = list(self.mesh.axis_names).index("data")
+        devs = np.moveaxis(self.mesh.devices, ax, 0).reshape(
+            pol.n_tasks, -1)
+        return np.array([walls[row[0].process_index] for row in devs],
+                        np.float64)
 
     def _maybe_validate(self, params, net_state, state, force=False):
         # triggers first (every_epoch is stateful — probe exactly once),
@@ -343,7 +411,7 @@ class DistriOptimizer(LocalOptimizer):
         return step
 
     def _jit_step(self, step, ps, ns, os_, data_s, x_s=None,
-                  x_chunk_s=None):
+                  x_chunk_s=None, extra_in=()):
         """Shared jit wiring: carried state is donated (buffers recycled in
         place); optimize() passes copies so the module's arrays survive.
         The trailing lr_scales argument rides replicated (prefix sharding
@@ -362,11 +430,14 @@ class DistriOptimizer(LocalOptimizer):
             return jax.jit(
                 step,
                 in_shardings=(ps, ns, os_, x_s or data_s, data_s,
-                              rep, rep, rep),
+                              rep, rep, rep) + tuple(extra_in),
                 out_shardings=(ps, ns, os_, rep),
                 donate_argnums=(0, 1, 2),
             )
 
+        if extra_in:
+            raise ValueError("extra step operands are single-dispatch "
+                             "only (no chunked-scan wiring for them)")
         chunk_data_s = NamedSharding(self.mesh, P(None, "data"))
         return jax.jit(
             self._scan_chunk(step, n),
@@ -408,22 +479,52 @@ class DistriOptimizer(LocalOptimizer):
         """
         mesh = self.mesh
         method = self.optim_method
+        # straggler drop rides this same shard_map path with a f32 wire
+        # when compression is off: tasks = data replicas, and the masked
+        # aggregation needs the per-replica gradients this builder has
+        wire = jnp.bfloat16 if self.gradient_compression else jnp.float32
+        masked = self._straggler is not None
+        # (w, msum) for the current trace, pushed by the masked step
+        # wrapper below so the hooks — whose (grads, loss) signature is
+        # fixed by _core_step — can see the mask operand
+        mask_cell = []
+
+        def wmean(x, dtype):
+            """Weighted replica mean computed in ``dtype`` — with w == 1
+            this is exactly pmean(x.astype(dtype)): psum then divide."""
+            w, msum = mask_cell[-1]
+            return (jax.lax.psum((x * w).astype(dtype), "data")
+                    / msum.astype(dtype))
 
         def loss_mean(grads, loss):
+            if mask_cell:
+                # the reference's lossSum / finishedModelNum (:226)
+                return grads, wmean(loss, loss.dtype)
             return grads, jax.lax.pmean(loss, "data")
 
         def grad_transform(grads, loss):
-            # compress -> all-reduce(mean) in bf16 over the wire -> f32
+            # compress -> all-reduce(mean) over the wire dtype -> f32;
+            # masked: psum(w*g)/sum(w) — the reference's div-by-
+            # finishedModelNum (DistriOptimizer.scala:231-234)
+            if mask_cell:
+                grads = jax.tree_util.tree_map(
+                    lambda g: wmean(g, wire).astype(g.dtype), grads)
+                return grads, wmean(loss, loss.dtype)
             grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g.astype(jnp.bfloat16),
+                lambda g: jax.lax.pmean(g.astype(wire),
                                         "data").astype(g.dtype), grads)
             return grads, jax.lax.pmean(loss, "data")
 
         def state_merge(net_state):
-            return jax.tree_util.tree_map(
-                lambda s: jax.lax.pmean(s, "data")
-                if jnp.issubdtype(jnp.asarray(s).dtype, jnp.floating) else s,
-                net_state)
+            def merge(s):
+                if not jnp.issubdtype(jnp.asarray(s).dtype, jnp.floating):
+                    return s
+                if mask_cell:
+                    # dropped replicas' BN stats are excluded, like the
+                    # reference's cancelled tasks never touching theirs
+                    return wmean(s, s.dtype)
+                return jax.lax.pmean(s, "data")
+            return jax.tree_util.tree_map(merge, net_state)
 
         update_transform = None
         if self.zero1:
@@ -446,9 +547,14 @@ class DistriOptimizer(LocalOptimizer):
 
             def update_transform(grads, opt_state, params, hyper):
                 gflat, _ = ravel_pytree(grads)
-                gflat = jnp.pad(gflat, (0, pad)).astype(jnp.bfloat16)
+                if mask_cell:
+                    # masked replica contributes zeros; divide by the
+                    # finished count instead of ndata
+                    gflat = gflat * mask_cell[-1][0]
+                gflat = jnp.pad(gflat, (0, pad)).astype(wire)
                 gslice = jax.lax.psum_scatter(gflat, "data", tiled=True)
-                gslice = gslice.astype(jnp.float32) / ndata
+                gslice = gslice.astype(jnp.float32) / (
+                    mask_cell[-1][1] if mask_cell else ndata)
                 pflat, _ = ravel_pytree(params)
                 pflat = jnp.pad(pflat, (0, pad))
                 rank = jax.lax.axis_index("data")
@@ -459,10 +565,22 @@ class DistriOptimizer(LocalOptimizer):
                 new_flat = jax.lax.all_gather(new_pslice, "data", tiled=True)
                 return unravel(new_flat[:total]), new_opt
 
-        step = self._core_step(
+        core = self._core_step(
             fold_axis="data",
             grad_transform=loss_mean if self.zero1 else grad_transform,
             state_merge=state_merge, update_transform=update_transform)
+        if masked:
+            # 9th operand: the (n_tasks,) 0/1 drop mask, replicated —
+            # push (w_this_replica, finished_count) for the hooks above
+            def step(params, ns, os_, x, y, lr, key, lr_scales, mask):
+                w = mask[jax.lax.axis_index("data")]
+                mask_cell.append((w, mask.sum()))
+                try:
+                    return core(params, ns, os_, x, y, lr, key, lr_scales)
+                finally:
+                    mask_cell.pop()
+        else:
+            step = core
         rep, data = P(), P("data")
         if self.zero1:
             # flat mirrors of the parameter vector shard over data; scalar
@@ -474,7 +592,8 @@ class DistriOptimizer(LocalOptimizer):
             ospec = rep
         sharded = jax.shard_map(
             step, mesh=mesh,
-            in_specs=(rep, rep, ospec, data, data, rep, rep, rep),
+            in_specs=(rep, rep, ospec, data, data, rep, rep, rep)
+            + ((rep,) if masked else ()),
             out_specs=(rep, rep, ospec, rep),
             check_vma=False,
         )
@@ -488,6 +607,15 @@ class DistriOptimizer(LocalOptimizer):
                 self._z1c_opt_shape())
         else:
             opt_s = reps(opt_state)
+        if masked:
+            if self.iters_per_dispatch > 1:
+                raise ValueError(
+                    "straggler drop recomputes the mask every iteration "
+                    "(ref DistriOptimizer.scala:154: the timeout applies "
+                    "per invokeAndWait2 round); it does not combine with "
+                    "set_iterations_per_dispatch > 1")
+            return self._jit_step(sharded, reps(params), reps(net_state),
+                                  opt_s, data_s, extra_in=(rep_s,))
         return self._jit_step(sharded, reps(params), reps(net_state),
                               opt_s, data_s)
 
@@ -509,8 +637,8 @@ class DistriOptimizer(LocalOptimizer):
         of the flat parameter vector (the reference's per-partition
         optimMethod state, AllReduceParameter.scala:162-235) — init it
         flat; everything else defers to the base builder."""
-        if (self.gradient_compression and self.zero1
-                and self._resume_opt_state is None):
+        if ((self.gradient_compression or self._straggler is not None)
+                and self.zero1 and self._resume_opt_state is None):
             state = self.optim_method.init_state(
                 jnp.zeros((self._z1c_flat,), jnp.float32))
             return jax.tree_util.tree_map(
@@ -624,7 +752,10 @@ class DistriOptimizer(LocalOptimizer):
     def _build_step(self):
         if self.pipeline_stages is not None:
             return self._build_step_pipeline()
-        if self.gradient_compression:
+        if self.gradient_compression or self._straggler is not None:
+            # straggler drop needs the per-replica gradients only the
+            # shard_map builder sees; it rides that path with a f32 wire
+            # when compression is off
             return self._build_step_compressed()
         step = self._core_step()
         params, net_state, opt_state = self._state_trees()
@@ -694,7 +825,9 @@ class DistriOptimizer(LocalOptimizer):
         wall_start = time.perf_counter()
 
         n_disp = self.iters_per_dispatch
+        straggler = self._straggler
         while not self.end_when(state):
+            fetch_start = time.perf_counter()
             with self.metrics.timer("data fetch time"):
                 if n_disp <= 1:
                     batch = next(data_iter)
@@ -704,19 +837,48 @@ class DistriOptimizer(LocalOptimizer):
                     xh, yh = self._next_chunk(data_iter, n_disp)
                     x, y = self._device_put_batch(xh, yh, stacked=True)
                     global_b = x.shape[0] * x.shape[1]
+            fetch_wall = time.perf_counter() - fetch_start
+
+            drop_mask = None
+            if straggler is not None:
+                drop_mask = straggler.mask()
+                if not straggler.accepts(drop_mask):
+                    # iteration rejected: batch consumed, no update, no
+                    # neval advance (ref DistriOptimizer.scala:224 guard)
+                    straggler.reject(drop_mask)
+                    continue
 
             # distributed: summary() adds the per-process breakdown, the
             # reference's "computing time for each node" accumulator
+            it_start = time.perf_counter()
             with self.metrics.timer("computing time average",
                                     distributed=True):
                 lr = self._current_lr()
                 key = RNG.next_key()
-                params, net_state, opt_state, loss = step_fn(
-                    params, net_state, opt_state, x, y, jnp.float32(lr), key,
-                    self._lr_scales_arg)
+                step_args = (params, net_state, opt_state, x, y,
+                             jnp.float32(lr), key, self._lr_scales_arg)
+                if straggler is not None:
+                    params, net_state, opt_state, loss = step_fn(
+                        *step_args, jnp.asarray(drop_mask))
+                else:
+                    params, net_state, opt_state, loss = step_fn(*step_args)
+                # float() blocks on the device result, so the timer (and
+                # the straggler's task clock) sees the real dispatch wall
                 loss = float(loss[-1]) if n_disp > 1 else float(loss)
 
             step_time = self.metrics.mean("computing time average")
+            if straggler is not None:
+                straggler.record(self._straggler_task_times(
+                    fetch_wall, time.perf_counter() - it_start), drop_mask)
+                n_dropped = int(len(drop_mask) - drop_mask.sum())
+                if n_dropped:
+                    # ref logger.debug("Dropped modules: " + ...) :248
+                    logger.debug("Dropped modules: %d", n_dropped)
+                    # only the finished tasks' records count toward the
+                    # epoch (ref recordsNum += finishedThreads.size *
+                    # stackSize, accumulateCount += recordsNum :236)
+                    global_b = int(global_b * float(drop_mask.sum())
+                                   / len(drop_mask))
             count += global_b
             state["neval"] = state["neval"] + n_disp
             state["loss"] = loss

@@ -160,7 +160,7 @@ Perf CLIs: `bigdl_tpu/models/utils/perf.py` +
 | Data parallelism (inter+intra node) | YES | `DistriOptimizer` (mesh `data` axis; intra-node splitting dissolves into XLA, SURVEY §2.9) |
 | Parameter sharding all-reduce | YES | jit-emitted reduce-scatter/all-gather; `parallel/collectives.py` |
 | Gradient compression | YES | `gradient_compression="bf16"` |
-| Straggler mitigation | documented no-op | `DistriOptimizer(drop_percentage=...)` warns (bulk-synchronous XLA) |
+| Straggler mitigation | YES (as gradient masking) | `set_drop_module_property` / `drop_percentage=` — kth-largest time threshold, masked `psum(w*g)/sum(w)`, max-drop rejection (`optim/straggler.py`; ref DistriOptimizer.scala:154-172,:245-278) |
 | Intra-op threading | YES (free) | XLA fusion |
 | Tensor parallelism | YES (beyond ref) | `parallel/sharding.py` + `tensor_parallel=True` |
 | Pipeline parallelism | YES (beyond ref) | `parallel/pipeline.py` |
@@ -181,8 +181,12 @@ audits should not flag these):
 - `BGRImgCropper` defaults to random crop (reference default CropRandom);
   the framework-native `ImgCropper` spelling defaults to center crop for
   validation pipelines.
-- Straggler dropping is a documented no-op under bulk-synchronous XLA
-  collectives (SURVEY §7 hard parts).
+- Straggler dropping masks gradients instead of cancelling tasks: an XLA
+  dispatch cannot be cancelled mid-flight, so a replica whose measured time
+  exceeded the threshold is masked out of the NEXT iteration's aggregation
+  (one-dispatch lag vs the reference's in-flight `invokeAndWait2` timeout);
+  threshold arithmetic, finished-count division, and the max-drop rejection
+  follow the reference exactly (`optim/straggler.py`).
 - RNG: seeded determinism is preserved, but streams are JAX counter-based
   PRNG, not Torch's Mersenne-Twister (SURVEY §7 hard parts).
 """
