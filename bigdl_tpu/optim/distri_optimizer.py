@@ -244,7 +244,19 @@ class DistriOptimizer(LocalOptimizer):
         observable within one XLA dispatch, so every task reads the same
         total wall and dropping never engages."""
         pol = self._straggler
-        if pol.time_source is not None or jax.process_count() == 1:
+        if pol.time_source is not None:
+            times = pol.task_times(fetch_wall + step_wall)
+            if jax.process_count() > 1:
+                # every process must hold IDENTICAL policy state or they
+                # disagree on accept/reject and deadlock the collective:
+                # merge the per-process views (any process seeing a task
+                # slow counts)
+                from jax.experimental import multihost_utils
+                allv = np.asarray(multihost_utils.process_allgather(
+                    np.asarray(times, np.float64)))
+                times = allv.reshape(jax.process_count(), -1).max(axis=0)
+            return times
+        if jax.process_count() == 1:
             return pol.task_times(fetch_wall + step_wall)
         from jax.experimental import multihost_utils
         walls = np.asarray(multihost_utils.process_allgather(

@@ -95,11 +95,20 @@ class StragglerPolicy:
                 and self.iteration > self.warmup + self.batch_size - 1)
 
     def mask(self) -> np.ndarray:
-        """(n_tasks,) float32 of 0/1 — 1 keeps the task's gradient."""
+        """(n_tasks,) float32 of 0/1 — 1 keeps the task's gradient.
+
+        A task is dropped only when it is over the threshold AND slower
+        than the fastest cohort: the threshold is a quantile over TIME,
+        so a uniformly slow iteration (GC pause, relay hiccup — every
+        task's wall identical) would otherwise mask ALL tasks and
+        spuriously reject the iteration.  A straggler is slow RELATIVE
+        to its peers (the reference's timeout fires while other tasks
+        finish); uniform slowness has no straggler to drop."""
         if (not self.armed or self._last_times is None
                 or not math.isfinite(self.threshold)):
             return np.ones(self.n_tasks, np.float32)
-        return (self._last_times <= self.threshold).astype(np.float32)
+        t = self._last_times
+        return ((t <= self.threshold) | (t <= t.min())).astype(np.float32)
 
     def accepts(self, mask: np.ndarray) -> bool:
         """Ref DistriOptimizer.scala:224: the update runs only when

@@ -81,6 +81,20 @@ class TestStragglerPolicy:
         assert not pol.accepts(
             np.asarray([1, 1, 1, 1, 1, 0, 0, 0], np.float32))
 
+    def test_uniform_slowness_masks_nobody(self):
+        # the threshold is a quantile over TIME: a uniformly slow
+        # iteration (GC pause — every task identical) has no straggler
+        # to drop; the fastest cohort always survives
+        pol = StragglerPolicy(n_tasks=4, drop_percentage=0.25,
+                              max_drop_percentage=0.5,
+                              compute_threshold_batch_size=2,
+                              warmup_iteration=0)
+        pol.record([1.0, 1.0, 1.0, 1.0], pol.mask())
+        pol.record([1.0, 1.0, 1.0, 1.0], pol.mask())
+        assert pol.threshold == pytest.approx(1.0)
+        pol.record([7.0, 7.0, 7.0, 7.0], pol.mask())
+        np.testing.assert_array_equal(pol.mask(), np.ones(4))
+
     def test_never_accepts_empty_mask(self):
         # max_drop_percentage=1.0 makes the reference guard vacuous
         # (0 >= 0); a zero finished-count would NaN the masked mean, so
@@ -241,6 +255,27 @@ class TestStragglerIntegration:
         # iteration 3's mask keeps 5 < 8*(1-0.3)=5.6 -> REJECTED
         assert any("REJECTED" in r.message for r in caplog.records)
         assert m is not None
+
+    def test_uniform_spike_never_rejects(self, caplog):
+        """A globally slow iteration (every replica's wall spikes
+        together) must not reject anything — the all-or-none failure a
+        time-quantile threshold would otherwise produce single-host."""
+        calls = {"n": 0}
+
+        def schedule(wall):
+            calls["n"] += 1
+            return np.full(self.N, 9.0 if calls["n"] == 3 else 1.0)
+
+        with caplog.at_level(logging.WARNING, logger="bigdl_tpu.optim"):
+            m_strag = _run_distri(
+                time_source=schedule,
+                drop_kw=dict(drop_percentage=0.25, max_drop_percentage=0.5,
+                             batch_size=2, warmup_iteration=0))
+        assert not any("REJECTED" in r.message for r in caplog.records)
+        m_plain = _run_distri()
+        for wp, ws in zip(m_plain.parameters()[0], m_strag.parameters()[0]):
+            np.testing.assert_allclose(np.asarray(wp), np.asarray(ws),
+                                       rtol=1e-4, atol=1e-5)
 
     def test_all_ones_compression_matches_compressed(self):
         """Straggler armed but never dropping must not perturb the bf16
