@@ -32,6 +32,9 @@ def main():
     resume = "--resume" in argv
     if resume:
         argv.remove("--resume")
+    straggler = "--straggler" in argv
+    if straggler:
+        argv.remove("--straggler")
     pipeline = "--pipeline" in argv
     if pipeline:
         argv.remove("--pipeline")
@@ -143,6 +146,21 @@ def main():
         resume_opt = st.get("opt_state")
 
     opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion())
+    if straggler:
+        # multi-host straggler drill: only process 0 OBSERVES the last
+        # replica as slow; the allgather+max merge must give every
+        # process the identical policy state (divergent masks would
+        # deadlock the collective).  k = int(0.375*2*4) = 3 -> threshold
+        # lands at the fast cohort -> replica 3 masked from iteration 3
+        n_tasks = 2 * nproc
+        def observed(wall):
+            t = np.ones(n_tasks)
+            if pid == 0:
+                t[-1] = 9.0
+            return t
+        opt.set_drop_module_property(0.375, 0.5, batch_size=2,
+                                     warmup_iteration=0,
+                                     time_source=observed)
     opt.set_state(start_state)
     if resume_opt is not None:
         opt.set_optim_state(resume_opt)
@@ -169,6 +187,8 @@ def main():
            # for each node"): one entry per process
            "compute_per_node": opt.metrics.per_node(
                "computing time average")}
+    if straggler:
+        out["drop_mask"] = [float(v) for v in opt._straggler.mask()]
 
     # cross-process validation merge (ref DistriValidator.scala:32): each
     # process sees its shard; merged counts must cover the GLOBAL set

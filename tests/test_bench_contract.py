@@ -79,7 +79,10 @@ def test_roofline_sidecar_roundtrip(bench, tmp_path, monkeypatch):
     a last-good sidecar backs the in-band and standalone probes."""
     monkeypatch.setattr(bench, "_ROOFLINE_SIDECAR",
                         str(tmp_path / "roof.json"))
-    assert bench._load_roofline_sidecar() is None
+    # no sidecar file yet (fresh workspace): the committed last-good
+    # default answers, so the artifact is self-interpreting from run one
+    c0 = bench._load_roofline_sidecar()
+    assert c0 == bench._ROOFLINE_LAST_GOOD
     bench._save_roofline_sidecar(186.9, "TPU v5 lite")
     c = bench._load_roofline_sidecar()
     assert c["roofline_tflops"] == 186.9

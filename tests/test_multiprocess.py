@@ -56,6 +56,21 @@ def run_workers(nproc, port, ckpt_dir=None, per_proc_args=None):
 
 
 @pytest.mark.slow
+def test_two_process_straggler_drop_consistent():
+    """Multi-host straggler drop: only process 0 OBSERVES the slow
+    replica through its time_source; the allgather+max merge must give
+    both processes identical policy state (divergent masks would
+    deadlock the psum), and the drop must actually engage."""
+    outs = run_workers(2, free_port(),
+                       per_proc_args={0: ["--straggler"],
+                                      1: ["--straggler"]})
+    assert outs[0]["losses"] == pytest.approx(outs[1]["losses"], rel=1e-6)
+    assert outs[0]["psum"] == pytest.approx(outs[1]["psum"], rel=1e-6)
+    assert outs[0]["drop_mask"] == outs[1]["drop_mask"]
+    assert outs[0]["drop_mask"] == [1.0, 1.0, 1.0, 0.0]
+
+
+@pytest.mark.slow
 def test_two_process_distri_optimizer_matches_single_process():
     two = run_workers(2, free_port())
     one = run_workers(1, free_port())

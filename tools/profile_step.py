@@ -13,7 +13,8 @@ The reference's profiling analogue is per-module wall timers
 (SpatialConvolution.scala:73-78); on TPU the per-op device trace is the
 honest equivalent because XLA fuses across module boundaries.
 
-Usage:  python tools/profile_step.py [inception|vgg16|lenet|resnet50] [batch]
+Usage:  python tools/profile_step.py \
+            [inception|vgg16|lenet|resnet50|bilstm|transformer] [batch]
 Writes ``PROFILE_<model>.md`` at the repo root and prints the table.
 """
 from __future__ import annotations
@@ -265,6 +266,14 @@ def build_step(model_name: str, batch: int):
         from bigdl_tpu.models.textclassifier import TextClassifierBiLSTM
         model = TextClassifierBiLSTM(20, 200, hidden_size=128)
         xshape, nclass = (batch, 500, 200), 20
+    elif model_name == "transformer":
+        # the bench flagship geometry (bench.py configs): d_model 1024,
+        # 4 heads (d_head 256 — K<=128 batched gemms are emitter-bound,
+        # PERF_NOTES), ffn 4096, L6
+        from bigdl_tpu.models.transformer import TransformerClassifier
+        model = TransformerClassifier(class_num=20, d_model=1024,
+                                      n_heads=4, n_layers=6, hidden=4096)
+        xshape, nclass = (batch, 512, 1024), 20
     else:
         raise SystemExit("unknown model %s" % model_name)
 
@@ -500,7 +509,11 @@ def report(rows, total_flops, roofline, model_name, batch, path=None):
 
 def main():
     model_name = sys.argv[1] if len(sys.argv) > 1 else "inception"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+    # per-model default batch = the bench.py config geometry (a bs128
+    # transformer would be 8x the benchmarked flagship and overrun HBM)
+    default_batch = {"transformer": 16, "resnet50": 64, "lenet": 256}
+    batch = (int(sys.argv[2]) if len(sys.argv) > 2
+             else default_batch.get(model_name, 128))
     rows, total_flops, roofline, tmpdir = profile(model_name, batch)
     path = "PROFILE_%s.md" % model_name
     print(report(rows, total_flops, roofline, model_name, batch, path))
