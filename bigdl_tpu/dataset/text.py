@@ -40,6 +40,13 @@ class Dictionary:
         """0-based index; unknown words map to vocab_size (OOV bucket)."""
         return self.word2index.get(word, len(self.index2word))
 
+    def word(self, index):
+        """Reverse lookup (ref Dictionary.getWord): the OOV bucket and
+        out-of-range indices render as ``<unk>``."""
+        if 0 <= int(index) < len(self.index2word):
+            return self.index2word[int(index)]
+        return "<unk>"
+
 
 class WordTokenizer(Transformer):
     """Lower-case word tokenizer (ref rnn/Utils.WordTokenizer :207)."""

@@ -3,6 +3,8 @@ Bi-LSTM classifier head (BASELINE config 4).
 """
 from __future__ import annotations
 
+import numpy as np
+
 import bigdl_tpu.nn as nn
 
 
@@ -17,6 +19,40 @@ def SimpleRNN(input_size: int = 4000, hidden_size: int = 40,
             nn.Linear(hidden_size, output_size),
             nn.LogSoftMax())),
     )
+
+
+def generate(model, dictionary, seed_ids, n_words, rng=None):
+    """Autoregressive word sampling — the reference's rnn/Test.scala
+    generation loop (:58-90): forward the sentence, inverse-CDF-sample
+    the next word from the last timestep's distribution, append, repeat.
+
+    ``seed_ids``: list of 0-based word ids; returns the extended list.
+    The reference samples with ``cumsum.filter(_ < rand).length - 1`` on
+    its cumulative array — an off-by-one that can yield -1 when the
+    first bucket already exceeds the draw; here the standard inverse-CDF
+    index ``(cumsum < rand).sum()`` is used (a documented divergence,
+    PARITY.md).  ``rng`` defaults to the framework host stream."""
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.module import Context
+    from bigdl_tpu.utils.random import RNG
+
+    if rng is None:
+        rng = RNG.np_rng()
+    vocab = dictionary.vocab_size() + 1   # + OOV bucket
+    ids = [int(i) for i in seed_ids]
+    params, state = model.params(), model.state()
+    for _ in range(int(n_words)):
+        x = np.zeros((1, len(ids), vocab), np.float32)
+        x[0, np.arange(len(ids)), ids] = 1.0
+        out, _ = model.apply(params, jnp.asarray(x), state,
+                             Context(training=False))
+        probs = np.exp(np.asarray(out[0, -1], np.float64))
+        probs /= probs.sum()
+        # clamp: fp rounding can leave cumsum[-1] a hair under 1.0, and
+        # a draw above it would index one past the last class
+        idx = int((np.cumsum(probs) < rng.uniform()).sum())
+        ids.append(min(idx, vocab - 1))
+    return ids
 
 
 def BiLSTMClassifier(input_size: int, hidden_size: int, class_num: int):

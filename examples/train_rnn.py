@@ -33,6 +33,10 @@ def main(argv=None):
     p.add_argument("--learningRate", type=float, default=0.1)
     p.add_argument("--maxEpoch", type=int, default=5)
     p.add_argument("--seqLength", type=int, default=8)
+    p.add_argument("--numOfWords", type=int, default=0,
+                   help="after training, autoregressively generate this "
+                        "many words from the first corpus sentence (ref "
+                        "rnn/Test.scala numOfWords)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -73,6 +77,15 @@ def main(argv=None):
     opt.set_end_when(max_epoch(args.maxEpoch))
     opt.set_iterations_per_dispatch(args.iterationsPerDispatch)
     opt.optimize()
+
+    if args.numOfWords > 0:
+        # the reference's generation pass (rnn/Test.scala:58-90): seed
+        # with a corpus sentence, sample word by word
+        from bigdl_tpu.models.rnn import generate
+        seed = [dictionary.index(w) for w in tokenized[0]]
+        ids = generate(model, dictionary, seed, args.numOfWords)
+        logging.info("generated: %s",
+                     ",".join(dictionary.word(i) for i in ids))
 
 
 if __name__ == "__main__":

@@ -86,6 +86,24 @@ class TestRingAttention:
         full = full_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(ring, full, atol=1e-5)
 
+    def test_matches_full_attention_bf16_inputs(self):
+        """The exact-math pair holds for bf16 q/k/v too — what the
+        attention core feeds both paths under a reduced-precision
+        compute policy (nn/attention.py): scores and online-softmax
+        stats stay f32 via preferred_element_type, so ring and full
+        agree to bf16-output rounding."""
+        mesh = make_mesh({"seq": 8})
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.randn(2, 16, 2, 8), jnp.bfloat16)
+        k = jnp.asarray(rs.randn(2, 16, 2, 8), jnp.bfloat16)
+        v = jnp.asarray(rs.randn(2, 16, 2, 8), jnp.bfloat16)
+        ring = ring_self_attention(q, k, v, mesh, "seq", causal=True)
+        full = full_attention(q, k, v, causal=True)
+        assert ring.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(ring, np.float32),
+                                   np.asarray(full, np.float32),
+                                   atol=2e-2)
+
     def test_gradients_match(self):
         mesh = make_mesh({"seq": 4}, jax.devices()[:4])
         rs = np.random.RandomState(1)

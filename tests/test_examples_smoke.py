@@ -30,7 +30,8 @@ def run_example(module_name, argv):
      ["--folder", "/nonexistent", "--batchSize", "32", "--maxEpoch", "1"]),
     ("examples.train_rnn",
      ["--dataFolder", "/nonexistent", "--batchSize", "8", "--maxEpoch", "1",
-      "--seqLength", "12", "--hiddenSize", "16", "--vocabSize", "32"]),
+      "--seqLength", "12", "--hiddenSize", "16", "--vocabSize", "32",
+      "--numOfWords", "3"]),   # exercises the rnn/Test.scala generation pass
     ("examples.text_classifier",
      ["--baseDir", "/nonexistent", "--batchSize", "16", "--maxEpoch", "1",
       "--seqLength", "150", "--embedDim", "8", "--classNum", "3"]),

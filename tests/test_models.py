@@ -109,3 +109,30 @@ def test_vgg16():
     m = Vgg_16(1000).evaluate()
     y = m.forward(randn(1, 3, 224, 224))
     assert y.shape == (1, 1000)
+
+
+def test_rnn_generate():
+    """models/rnn.generate — the rnn/Test.scala sampling loop: seeds
+    extend by n_words, every sampled id is a valid class index, and the
+    draw stream is deterministic under set_seed."""
+    import numpy as np
+    from bigdl_tpu.dataset.text import Dictionary
+    from bigdl_tpu.models.rnn import SimpleRNN, generate
+    from bigdl_tpu.utils.random import set_seed
+
+    sentences = [["the", "cat", "sat"], ["the", "dog", "ran"]]
+    d = Dictionary(sentences)
+    vocab = d.vocab_size() + 1
+    set_seed(11)
+    model = SimpleRNN(input_size=vocab, hidden_size=8, output_size=vocab,
+                      bptt_truncate=2)
+    seed_ids = [d.index(w) for w in sentences[0]]
+
+    set_seed(3)
+    out1 = generate(model, d, seed_ids, 4)
+    assert out1[:3] == seed_ids and len(out1) == 7
+    assert all(0 <= i < vocab for i in out1[3:])
+    assert all(isinstance(d.word(i), str) for i in out1)
+    set_seed(3)
+    out2 = generate(model, d, seed_ids, 4)
+    assert out2 == out1

@@ -189,6 +189,10 @@ audits should not flag these):
   follow the reference exactly (`optim/straggler.py`).
 - RNG: seeded determinism is preserved, but streams are JAX counter-based
   PRNG, not Torch's Mersenne-Twister (SURVEY §7 hard parts).
+- RNN generation (`models/rnn.generate`) samples the standard inverse-CDF
+  index `(cumsum < rand).sum()`; the reference's
+  `cumsum.filter(_ < rand).length - 1` (rnn/Test.scala:70-77) is off by
+  one against its own cumulative array and can yield -1.
 """
     out = os.path.join(ROOT, "PARITY.md")
     with open(out, "w") as f:
