@@ -51,6 +51,29 @@ def encoder_block(d_model: int, n_heads: int, hidden: int,
     )
 
 
+def TransformerLM(vocab_size: int, d_model: int = 128, n_heads: int = 4,
+                  n_layers: int = 2, hidden: int = 256,
+                  dropout: float = 0.1):
+    """Causal word LM over (B, T, vocab) one-hot input -> per-token class
+    log-probs — the attention-family counterpart of models/rnn.SimpleRNN
+    (ref SimpleRNN.scala:23-38): same input/output contract, so it trains
+    with ``TimeDistributedCriterion(ClassNLLCriterion)`` and generates
+    with ``models.rnn.generate`` unchanged.  Sequence order comes from
+    ``nn.SinusoidalPositionalEncoding`` (attention is permutation-
+    equivariant; the RNN's recurrence is replaced, not imitated)."""
+    m = nn.Sequential(
+        nn.TimeDistributed(nn.Linear(vocab_size, d_model)),
+        nn.SinusoidalPositionalEncoding(d_model),
+    )
+    for _ in range(n_layers):
+        m.add(encoder_block(d_model, n_heads, hidden, dropout,
+                            causal=True))
+    m.add(nn.LayerNorm(d_model))
+    m.add(nn.TimeDistributed(nn.Sequential(
+        nn.Linear(d_model, vocab_size), nn.LogSoftMax())))
+    return m
+
+
 def TransformerClassifier(class_num: int, d_model: int = 128,
                           n_heads: int = 4, n_layers: int = 2,
                           hidden: int = 256, dropout: float = 0.1,

@@ -48,7 +48,8 @@ from bigdl_tpu.nn.recurrent import (
     Cell, RnnCell, LSTMCell, GRUCell, Recurrent, BiRecurrent, TimeDistributed,
 )
 from bigdl_tpu.nn.moe import MoE
-from bigdl_tpu.nn.attention import MultiHeadSelfAttention
+from bigdl_tpu.nn.attention import (MultiHeadSelfAttention,
+                                    SinusoidalPositionalEncoding)
 from bigdl_tpu.nn.criterion import (
     ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
     BCECriterion, DistKLDivCriterion, ClassSimplexCriterion,

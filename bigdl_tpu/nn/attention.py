@@ -86,3 +86,31 @@ class MultiHeadSelfAttention(TensorModule):
     def __repr__(self):
         return (f"MultiHeadSelfAttention({self.d_model}, heads="
                 f"{self.n_heads}{', causal' if self.causal else ''})")
+
+
+class SinusoidalPositionalEncoding(TensorModule):
+    """x + PE[:T] with the standard sin/cos table (parameter-free).
+
+    No reference counterpart (its sequence order comes from recurrence);
+    needed by the attention-family LM, whose attention is permutation-
+    equivariant without it.  The table is built from the STATIC (T, D)
+    of the traced input, so jit sees a constant."""
+
+    def __init__(self, d_model: int, base: float = 10000.0):
+        super().__init__()
+        self.d_model = d_model
+        self.base = base
+
+    def _forward(self, P, x, S, ctx):
+        t, d = x.shape[1], x.shape[2]
+        if d != self.d_model:
+            raise ValueError(f"input dim {d} != d_model {self.d_model}")
+        ang = np.arange(t)[:, None] * np.exp(
+            np.arange(0, d, 2) * (-np.log(self.base) / d))
+        pe = np.zeros((t, d), np.float32)
+        pe[:, 0::2] = np.sin(ang)
+        pe[:, 1::2] = np.cos(ang[:, :d // 2])
+        return x + jnp.asarray(pe, x.dtype), None
+
+    def __repr__(self):
+        return f"SinusoidalPositionalEncoding({self.d_model})"

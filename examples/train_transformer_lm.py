@@ -1,7 +1,9 @@
-"""SimpleRNN character/word language model (ref models/rnn/Train.scala +
-Utils: Dictionary, WordTokenizer, readSentence).
+"""Causal transformer word language model + generation — the attention-
+family counterpart of examples/train_rnn.py (ref models/rnn Train.scala +
+Test.scala pairing; the reference has no transformer, SURVEY.md §2.9).
 
-  python examples/train_rnn.py -f input.txt --hiddenSize 40 --bptt 4
+  python examples/train_transformer_lm.py -f input.txt --layers 2
+  python examples/train_transformer_lm.py --numOfWords 10   # sample after
 Falls back to a small built-in corpus when the file is missing.
 """
 import argparse
@@ -10,27 +12,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-FALLBACK_CORPUS = """the quick brown fox jumps over the lazy dog
-a stitch in time saves nine
-all that glitters is not gold
-actions speak louder than words
-practice makes perfect every single day
-the early bird catches the worm
-better late than never they say
-birds of a feather flock together
-"""
-
-
-def load_corpus(path):
-    """Corpus lines from ``path``, or the built-in fallback (shared with
-    examples/train_transformer_lm.py)."""
-    import logging
-    import os
-    if os.path.exists(path):
-        with open(path) as f:
-            return f.readlines()
-    logging.warning("no corpus at %s — using built-in sample", path)
-    return FALLBACK_CORPUS.strip().split("\n")
+from examples.train_rnn import load_corpus
 
 
 def main(argv=None):
@@ -38,17 +20,19 @@ def main(argv=None):
     p.add_argument("-f", "--dataFolder", default="./rnn_corpus.txt")
     p.add_argument("-b", "--batchSize", type=int, default=4)
     p.add_argument("--iterationsPerDispatch", type=int, default=1,
-                   help="device-side loop: n scanned steps per dispatch")
+                   help="device-side scanned steps per dispatch")
     p.add_argument("--vocabSize", type=int, default=4000)
-    p.add_argument("--hiddenSize", type=int, default=40)
-    p.add_argument("--bptt", type=int, default=4)
+    p.add_argument("--dModel", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=128)
     p.add_argument("--learningRate", type=float, default=0.1)
     p.add_argument("--maxEpoch", type=int, default=5)
     p.add_argument("--seqLength", type=int, default=8)
     p.add_argument("--numOfWords", type=int, default=0,
                    help="after training, autoregressively generate this "
-                        "many words from the first corpus sentence (ref "
-                        "rnn/Test.scala numOfWords)")
+                        "many words from the first corpus sentence (the "
+                        "rnn/Test.scala numOfWords role)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -59,7 +43,8 @@ def main(argv=None):
         Dictionary, WordTokenizer, SentenceToLabeledSentence,
         LabeledSentenceToSample)
     from bigdl_tpu.dataset.transformer import SampleToBatch
-    from bigdl_tpu.models.rnn import SimpleRNN
+    from bigdl_tpu.models.rnn import generate
+    from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.optim import LocalOptimizer, max_epoch
     from bigdl_tpu.utils.table import T
 
@@ -74,9 +59,11 @@ def main(argv=None):
                                      fixed_length=args.seqLength)
           >> SampleToBatch(args.batchSize))
 
-    model = SimpleRNN(input_size=vocab, hidden_size=args.hiddenSize,
-                      output_size=vocab, bptt_truncate=args.bptt)
-    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), size_average=True)
+    model = TransformerLM(vocab_size=vocab, d_model=args.dModel,
+                          n_heads=args.heads, n_layers=args.layers,
+                          hidden=args.hidden)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
     opt = LocalOptimizer(model, ds, crit)
     opt.set_state(T(learningRate=args.learningRate))
     opt.set_end_when(max_epoch(args.maxEpoch))
@@ -84,9 +71,8 @@ def main(argv=None):
     opt.optimize()
 
     if args.numOfWords > 0:
-        # the reference's generation pass (rnn/Test.scala:58-90): seed
-        # with a corpus sentence, sample word by word
-        from bigdl_tpu.models.rnn import generate
+        # same sampling loop as the RNN family — the LM shares the
+        # one-hot (B, T, vocab) -> per-token log-probs contract
         seed = [dictionary.index(w) for w in tokenized[0]]
         ids = generate(model, dictionary, seed, args.numOfWords)
         logging.info("generated: %s",

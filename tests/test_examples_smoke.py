@@ -32,6 +32,10 @@ def run_example(module_name, argv):
      ["--dataFolder", "/nonexistent", "--batchSize", "8", "--maxEpoch", "1",
       "--seqLength", "12", "--hiddenSize", "16", "--vocabSize", "32",
       "--numOfWords", "3"]),   # exercises the rnn/Test.scala generation pass
+    ("examples.train_transformer_lm",
+     ["--dataFolder", "/nonexistent", "--batchSize", "8", "--maxEpoch", "1",
+      "--seqLength", "12", "--dModel", "16", "--heads", "2", "--hidden",
+      "32", "--vocabSize", "32", "--numOfWords", "3"]),
     ("examples.text_classifier",
      ["--baseDir", "/nonexistent", "--batchSize", "16", "--maxEpoch", "1",
       "--seqLength", "150", "--embedDim", "8", "--classNum", "3"]),
@@ -51,8 +55,8 @@ def run_example(module_name, argv):
      ["--folder", "/nonexistent", "--batchSize", "16", "--maxIteration",
       "2", "--seqLen", "16", "--embedDim", "16", "--heads", "2",
       "--layers", "1", "--hidden", "32", "--sequenceParallel", "4"]),
-], ids=["lenet", "vgg", "autoencoder", "rnn", "textconv", "textlstm",
-        "inception", "transformer", "transformer-sp"])
+], ids=["lenet", "vgg", "autoencoder", "rnn", "transformer-lm", "textconv",
+        "textlstm", "inception", "transformer", "transformer-sp"])
 def test_example_trains(module, argv, monkeypatch, tmp_path):
     monkeypatch.chdir(tmp_path)  # checkpoints etc. land in tmp
     sys.path.insert(0, str(__import__("pathlib").Path(__file__).parents[1]))

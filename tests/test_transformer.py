@@ -118,3 +118,68 @@ def test_transformer_causal_variant_runs():
                    Context(training=True, key=jax.random.PRNGKey(0)))
     assert y.shape == (2, 4)
     assert np.isfinite(np.asarray(y)).all()
+
+
+def test_sinusoidal_positional_encoding():
+    """Parameter-free sin/cos table: matches the closed form, and the
+    even/odd channel split covers odd d_model."""
+    for d in (8, 7):
+        pe_mod = nn.SinusoidalPositionalEncoding(d)
+        t = 5
+        x = jnp.zeros((1, t, d), jnp.float32)
+        out, _ = pe_mod.apply(pe_mod.params(), x, pe_mod.state(),
+                              Context(training=False))
+        got = np.asarray(out[0])
+        pos = np.arange(t)[:, None]
+        div = np.exp(np.arange(0, d, 2) * (-np.log(10000.0) / d))
+        ang = pos * div
+        np.testing.assert_allclose(got[:, 0::2], np.sin(ang), atol=1e-6)
+        np.testing.assert_allclose(got[:, 1::2], np.cos(ang[:, :d // 2]),
+                                   atol=1e-6)
+    # additive: non-zero input shifts by the same table
+    pe8 = nn.SinusoidalPositionalEncoding(8)
+    x2 = jnp.ones((1, 3, 8), jnp.float32)
+    out2, _ = pe8.apply(pe8.params(), x2, pe8.state(),
+                        Context(training=False))
+    assert np.asarray(out2).shape == (1, 3, 8)
+
+
+def test_transformer_lm_next_word_overfits():
+    """The causal LM memorizes a tiny corpus: after training, the
+    argmax next-word prediction for a training prefix is the corpus
+    continuation (the rnn-family LM contract, ref SimpleRNN Train+Test)."""
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.dataset.text import (Dictionary,
+                                        SentenceToLabeledSentence,
+                                        LabeledSentenceToSample)
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.optim import LocalOptimizer, max_epoch
+    from bigdl_tpu.utils.table import T
+
+    sentences = [["the", "cat", "sat", "on", "the", "mat"],
+                 ["a", "dog", "ran", "in", "the", "park"]] * 4
+    d = Dictionary(sentences)
+    vocab = d.vocab_size() + 1
+    ds = (DataSet.array(sentences)
+          >> SentenceToLabeledSentence(d)
+          >> LabeledSentenceToSample(n_input_dims=vocab, fixed_length=6)
+          >> SampleToBatch(8))
+    set_seed(9)
+    m = TransformerLM(vocab_size=vocab, d_model=32, n_heads=2,
+                      n_layers=1, hidden=64, dropout=0.0)
+    opt = LocalOptimizer(m, ds, nn.TimeDistributedCriterion(
+        nn.ClassNLLCriterion(), size_average=True))
+    opt.set_state(T(learningRate=0.5))
+    opt.set_end_when(max_epoch(30))
+    opt.optimize()
+
+    ids = [d.index(w) for w in ["the", "cat", "sat"]]
+    x = np.zeros((1, 3, vocab), np.float32)
+    x[0, np.arange(3), ids] = 1.0
+    out, _ = m.apply(m.params(), jnp.asarray(x), m.state(),
+                     Context(training=False))
+    # output INDEX j is word id j: targets are word_id+1 (1-based
+    # classes) and ClassNLL indexes log-probs at target-1
+    nxt = int(np.asarray(out[0, -1]).argmax())
+    assert d.word(nxt) == "on"
