@@ -254,6 +254,11 @@ def build_step(model_name: str, batch: int):
         from bigdl_tpu.models.vgg import Vgg_16
         model = Vgg_16(class_num=1000)
         xshape, nclass = (batch, 3, 224, 224), 1000
+    elif model_name == "vgg_cifar":
+        # the bench config (VGG-16 bs128 CIFAR-10)
+        from bigdl_tpu.models.vgg import VggForCifar10
+        model = VggForCifar10(class_num=10)
+        xshape, nclass = (batch, 3, 32, 32), 10
     elif model_name == "resnet50":
         from bigdl_tpu.models.resnet import ResNet
         model = ResNet(depth=50, class_num=1000)
