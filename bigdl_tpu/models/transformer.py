@@ -74,6 +74,113 @@ def TransformerLM(vocab_size: int, d_model: int = 128, n_heads: int = 4,
     return m
 
 
+def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None):
+    """KV-cached incremental decoding for a ``TransformerLM`` model.
+
+    Same math as re-forwarding the whole prefix per token
+    (``models.rnn.generate``): causal attention at position i reads only
+    positions <= i, so the per-layer K/V projections are computed ONCE
+    and cached.  The entire decode — seed consumption and generation —
+    is a single ``lax.scan`` with static shapes (fixed-size caches
+    written via ``.at[i].set``), so it compiles to one TPU program with
+    no host round-trip per token; the reference's generation loop
+    (rnn/Test.scala:58-90) re-forwards the growing sentence from
+    scratch each word.
+
+    ``greedy=True`` takes the argmax; otherwise ``key`` (a JAX PRNG key)
+    drives ``jax.random.categorical`` — a different draw stream from
+    ``generate``'s host inverse-CDF, same distribution.  Returns
+    ``seed_ids`` extended by ``n_words`` ids.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bigdl_tpu.nn.attention import SinusoidalPositionalEncoding
+
+    mods = model.modules
+    n_layers = len(mods) - 4
+    if (n_layers < 1
+            or not isinstance(mods[1], SinusoidalPositionalEncoding)):
+        raise ValueError("lm_decode expects a TransformerLM-built model "
+                         "(embedding, positional encoding, blocks, final "
+                         "LayerNorm, head)")
+    if not greedy and key is None:
+        raise ValueError("sampling (greedy=False) needs a PRNG key")
+    params = model.params()
+    emb = params["0"]["0"]["~"]            # Linear: weight (d, vocab)
+    d_model = int(emb["weight"].shape[0])
+    blocks, block_eps = [], []
+    for li in range(n_layers):
+        pb = params[str(2 + li)]
+        blocks.append((pb["0"]["0"]["1"],   # {"0": LN, "1": MHSA}
+                       pb["1"]["0"]["1"]))  # {"0": LN, "1": FFN seq}
+        branches = mods[2 + li].modules
+        block_eps.append(
+            (branches[0].modules[0].modules[1].modules[0].eps,
+             branches[1].modules[0].modules[1].modules[0].eps))
+    n_heads = mods[2].modules[0].modules[0].modules[1].modules[1].n_heads
+    hd = d_model // n_heads
+    ln_f = params[str(2 + n_layers)]["~"]
+    eps_f = mods[2 + n_layers].eps
+    head = params[str(3 + n_layers)]["0"]["0"]["~"]  # weight (vocab, d)
+    vocab = int(head["weight"].shape[0])
+
+    if len(seed_ids) == 0:
+        raise ValueError("lm_decode needs at least one seed token")
+    seed = jnp.asarray([int(i) for i in seed_ids], jnp.int32)
+    n_seed = int(seed.shape[0])
+    n_pos = n_seed + int(n_words) - 1      # positions fed through
+    pe = jnp.asarray(mods[1].table(n_pos))
+    scale = 1.0 / np.sqrt(hd)
+
+    def layernorm(x, p, eps):
+        mean = x.mean()
+        inv = jax.lax.rsqrt(x.var() + eps)
+        return (x - mean) * inv * p["~"]["weight"] + p["~"]["bias"]
+
+    def step(carry, i):
+        kcache, vcache, tok, k_rng = carry
+        tok = jnp.where(i < n_seed, seed[jnp.minimum(i, n_seed - 1)], tok)
+        x = emb["weight"][:, tok] + emb["bias"] + pe[i]
+        for li, (pa, pf) in enumerate(blocks):
+            a = layernorm(x, pa["0"], block_eps[li][0])
+            m = pa["1"]["~"]
+            q = (a @ m["wq"] + m["bq"]).reshape(n_heads, hd)
+            k = (a @ m["wk"] + m["bk"]).reshape(n_heads, hd)
+            v = (a @ m["wv"] + m["bv"]).reshape(n_heads, hd)
+            kcache = kcache.at[li, i].set(k)
+            vcache = vcache.at[li, i].set(v)
+            s = jnp.einsum("hd,thd->ht", q, kcache[li]) * scale
+            s = jnp.where(jnp.arange(n_pos)[None, :] <= i, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("ht,thd->hd", p, vcache[li]).reshape(d_model)
+            x = x + o @ m["wo"] + m["bo"]
+            a2 = layernorm(x, pf["0"], block_eps[li][1])
+            f = pf["1"]
+            h = jax.nn.relu(a2 @ f["0"]["0"]["~"]["weight"].T
+                            + f["0"]["0"]["~"]["bias"])
+            x = x + (h @ f["3"]["0"]["~"]["weight"].T
+                     + f["3"]["0"]["~"]["bias"])
+        xf = ((x - x.mean()) * jax.lax.rsqrt(x.var() + eps_f)
+              * ln_f["weight"] + ln_f["bias"])
+        logp = jax.nn.log_softmax(xf @ head["weight"].T + head["bias"])
+        if greedy:
+            nxt = jnp.argmax(logp).astype(jnp.int32)
+        else:
+            k_rng, sub = jax.random.split(k_rng)
+            nxt = jax.random.categorical(sub, logp).astype(jnp.int32)
+        return (kcache, vcache, nxt, k_rng), nxt
+
+    k0 = jnp.zeros((n_layers, n_pos, n_heads, hd), jnp.float32)
+    rng0 = key if key is not None else jax.random.PRNGKey(0)
+    (_, _, _, _), preds = jax.lax.scan(
+        step, (k0, jnp.zeros_like(k0), jnp.int32(0), rng0),
+        jnp.arange(n_pos))
+    out = [int(t) for t in seed_ids]
+    out += [int(t) for t in np.asarray(preds[n_seed - 1:])]
+    return out
+
+
 def TransformerClassifier(class_num: int, d_model: int = 128,
                           n_heads: int = 4, n_layers: int = 2,
                           hidden: int = 256, dropout: float = 0.1,

@@ -101,16 +101,23 @@ class SinusoidalPositionalEncoding(TensorModule):
         self.d_model = d_model
         self.base = base
 
-    def _forward(self, P, x, S, ctx):
-        t, d = x.shape[1], x.shape[2]
-        if d != self.d_model:
-            raise ValueError(f"input dim {d} != d_model {self.d_model}")
+    def table(self, t: int) -> np.ndarray:
+        """The (t, d_model) sin/cos table — shared with the KV-cached
+        decoder (models/transformer.lm_decode), which must add the exact
+        same positions the training forward added."""
+        d = self.d_model
         ang = np.arange(t)[:, None] * np.exp(
             np.arange(0, d, 2) * (-np.log(self.base) / d))
         pe = np.zeros((t, d), np.float32)
         pe[:, 0::2] = np.sin(ang)
         pe[:, 1::2] = np.cos(ang[:, :d // 2])
-        return x + jnp.asarray(pe, x.dtype), None
+        return pe
+
+    def _forward(self, P, x, S, ctx):
+        t, d = x.shape[1], x.shape[2]
+        if d != self.d_model:
+            raise ValueError(f"input dim {d} != d_model {self.d_model}")
+        return x + jnp.asarray(self.table(t), x.dtype), None
 
     def __repr__(self):
         return f"SinusoidalPositionalEncoding({self.d_model})"

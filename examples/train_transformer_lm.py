@@ -33,6 +33,10 @@ def main(argv=None):
                    help="after training, autoregressively generate this "
                         "many words from the first corpus sentence (the "
                         "rnn/Test.scala numOfWords role)")
+    p.add_argument("--fastDecode", action="store_true",
+                   help="generate via the KV-cached single-scan decoder "
+                        "(models.transformer.lm_decode) instead of "
+                        "re-forwarding the prefix per word")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -71,10 +75,18 @@ def main(argv=None):
     opt.optimize()
 
     if args.numOfWords > 0:
-        # same sampling loop as the RNN family — the LM shares the
-        # one-hot (B, T, vocab) -> per-token log-probs contract
         seed = [dictionary.index(w) for w in tokenized[0]]
-        ids = generate(model, dictionary, seed, args.numOfWords)
+        if args.fastDecode:
+            # one lax.scan with per-layer KV caches: no O(T^2) prefix
+            # re-forward, no host round-trip per token
+            import jax
+            from bigdl_tpu.models.transformer import lm_decode
+            ids = lm_decode(model, seed, args.numOfWords, greedy=False,
+                            key=jax.random.PRNGKey(0))
+        else:
+            # same sampling loop as the RNN family — the LM shares the
+            # one-hot (B, T, vocab) -> per-token log-probs contract
+            ids = generate(model, dictionary, seed, args.numOfWords)
         logging.info("generated: %s",
                      ",".join(dictionary.word(i) for i in ids))
 

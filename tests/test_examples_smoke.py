@@ -36,6 +36,10 @@ def run_example(module_name, argv):
      ["--dataFolder", "/nonexistent", "--batchSize", "8", "--maxEpoch", "1",
       "--seqLength", "12", "--dModel", "16", "--heads", "2", "--hidden",
       "32", "--vocabSize", "32", "--numOfWords", "3"]),
+    # (--fastDecode's lm_decode path is covered token-exactly by
+    # tests/test_transformer.py::test_lm_decode_matches_full_reforward;
+    # this smoke keeps the default generate() path exercised on a
+    # transformer model)
     ("examples.text_classifier",
      ["--baseDir", "/nonexistent", "--batchSize", "16", "--maxEpoch", "1",
       "--seqLength", "150", "--embedDim", "8", "--classNum", "3"]),
