@@ -21,7 +21,25 @@ def SimpleRNN(input_size: int = 4000, hidden_size: int = 40,
     )
 
 
-def generate(model, dictionary, seed_ids, n_words, rng=None):
+def adjust_logprobs(logp, temperature: float = 1.0, top_k: int = 0):
+    """Renormalized log-probs after temperature scaling and top-k
+    truncation (no reference counterpart — rnn/Test.scala samples the
+    raw distribution; both knobs default to that behavior)."""
+    logp = np.asarray(logp, np.float64)
+    if temperature != 1.0:
+        if temperature <= 0:
+            raise ValueError("temperature must be > 0 (use a small value "
+                             "like 1e-3 to approach greedy)")
+        logp = logp / temperature
+    if top_k and top_k < logp.size:
+        kth = np.partition(logp, -top_k)[-top_k]
+        logp = np.where(logp >= kth, logp, -np.inf)
+    logp = logp - logp.max()
+    return logp - np.log(np.exp(logp).sum())
+
+
+def generate(model, dictionary, seed_ids, n_words, rng=None,
+             temperature: float = 1.0, top_k: int = 0):
     """Autoregressive word sampling — the reference's rnn/Test.scala
     generation loop (:58-90): forward the sentence, inverse-CDF-sample
     the next word from the last timestep's distribution, append, repeat.
@@ -31,7 +49,9 @@ def generate(model, dictionary, seed_ids, n_words, rng=None):
     its cumulative array — an off-by-one that can yield -1 when the
     first bucket already exceeds the draw; here the standard inverse-CDF
     index ``(cumsum < rand).sum()`` is used (a documented divergence,
-    PARITY.md).  ``rng`` defaults to the framework host stream."""
+    PARITY.md).  ``rng`` defaults to the framework host stream;
+    ``temperature``/``top_k`` reshape the distribution (defaults = the
+    reference's raw sampling)."""
     import jax.numpy as jnp
     from bigdl_tpu.nn.module import Context
     from bigdl_tpu.utils.random import RNG
@@ -46,12 +66,14 @@ def generate(model, dictionary, seed_ids, n_words, rng=None):
         x[0, np.arange(len(ids)), ids] = 1.0
         out, _ = model.apply(params, jnp.asarray(x), state,
                              Context(training=False))
-        probs = np.exp(np.asarray(out[0, -1], np.float64))
+        logp = adjust_logprobs(out[0, -1], temperature, top_k)
+        probs = np.exp(logp)
         probs /= probs.sum()
         # clamp: fp rounding can leave cumsum[-1] a hair under 1.0, and
-        # a draw above it would index one past the last class
+        # a draw above it would index past the last class — land on the
+        # last SUPPORTED class (top_k may have zeroed the tail)
         idx = int((np.cumsum(probs) < rng.uniform()).sum())
-        ids.append(min(idx, vocab - 1))
+        ids.append(min(idx, int(np.flatnonzero(probs)[-1])))
     return ids
 
 

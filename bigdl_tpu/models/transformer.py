@@ -74,7 +74,8 @@ def TransformerLM(vocab_size: int, d_model: int = 128, n_heads: int = 4,
     return m
 
 
-def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None):
+def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
+              temperature: float = 1.0, top_k: int = 0):
     """KV-cached incremental decoding for a ``TransformerLM`` model.
 
     Same math as re-forwarding the whole prefix per token
@@ -89,8 +90,10 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None):
 
     ``greedy=True`` takes the argmax; otherwise ``key`` (a JAX PRNG key)
     drives ``jax.random.categorical`` — a different draw stream from
-    ``generate``'s host inverse-CDF, same distribution.  Returns
-    ``seed_ids`` extended by ``n_words`` ids.
+    ``generate``'s host inverse-CDF, same distribution —
+    with optional ``temperature`` scaling and ``top_k`` truncation
+    (models.rnn.adjust_logprobs semantics, computed device-side).
+    Returns ``seed_ids`` extended by ``n_words`` ids.
     """
     import jax
     import jax.numpy as jnp
@@ -106,6 +109,8 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None):
                          "LayerNorm, head)")
     if not greedy and key is None:
         raise ValueError("sampling (greedy=False) needs a PRNG key")
+    if temperature <= 0:
+        raise ValueError("temperature must be > 0")
     params = model.params()
     emb = params["0"]["0"]["~"]            # Linear: weight (d, vocab)
     d_model = int(emb["weight"].shape[0])
@@ -167,8 +172,12 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None):
         if greedy:
             nxt = jnp.argmax(logp).astype(jnp.int32)
         else:
+            lp = logp if temperature == 1.0 else logp / temperature
+            if top_k and top_k < vocab:
+                kth = jax.lax.top_k(lp, top_k)[0][-1]
+                lp = jnp.where(lp >= kth, lp, -jnp.inf)
             k_rng, sub = jax.random.split(k_rng)
-            nxt = jax.random.categorical(sub, logp).astype(jnp.int32)
+            nxt = jax.random.categorical(sub, lp).astype(jnp.int32)
         return (kcache, vcache, nxt, k_rng), nxt
 
     k0 = jnp.zeros((n_layers, n_pos, n_heads, hd), jnp.float32)
