@@ -93,7 +93,10 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
     ``generate``'s host inverse-CDF, same distribution —
     with optional ``temperature`` scaling and ``top_k`` truncation
     (models.rnn.adjust_logprobs semantics, computed device-side).
-    Returns ``seed_ids`` extended by ``n_words`` ids.
+
+    ``seed_ids`` is a flat list of ids (returns the extended flat list)
+    or a rectangular batch of B seed rows (returns B extended rows) —
+    batched decoding shares ONE scan, with independent draws per row.
     """
     import jax
     import jax.numpy as jnp
@@ -132,33 +135,46 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
 
     if len(seed_ids) == 0:
         raise ValueError("lm_decode needs at least one seed token")
-    seed = jnp.asarray([int(i) for i in seed_ids], jnp.int32)
-    n_seed = int(seed.shape[0])
+    try:
+        seed_np = np.asarray(seed_ids, np.int32)
+    except (ValueError, TypeError) as e:   # ragged rows
+        raise ValueError("seed_ids must be a flat id list or a "
+                         "RECTANGULAR batch of seed rows") from e
+    flat = seed_np.ndim == 1
+    seed_np = np.atleast_2d(seed_np)
+    if seed_np.ndim != 2 or seed_np.shape[1] == 0:
+        raise ValueError("seed_ids must be a flat id list or a "
+                         "rectangular batch of non-empty seed rows")
+    seed = jnp.asarray(seed_np)
+    bsz, n_seed = int(seed.shape[0]), int(seed.shape[1])
     n_pos = n_seed + int(n_words) - 1      # positions fed through
     pe = jnp.asarray(mods[1].table(n_pos))
     scale = 1.0 / np.sqrt(hd)
 
     def layernorm(x, p, eps):
-        mean = x.mean()
-        inv = jax.lax.rsqrt(x.var() + eps)
+        mean = x.mean(axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps)
         return (x - mean) * inv * p["~"]["weight"] + p["~"]["bias"]
 
     def step(carry, i):
         kcache, vcache, tok, k_rng = carry
-        tok = jnp.where(i < n_seed, seed[jnp.minimum(i, n_seed - 1)], tok)
-        x = emb["weight"][:, tok] + emb["bias"] + pe[i]
+        tok = jnp.where(i < n_seed, seed[:, jnp.minimum(i, n_seed - 1)],
+                        tok)
+        x = emb["weight"][:, tok].T + emb["bias"] + pe[i]
         for li, (pa, pf) in enumerate(blocks):
             a = layernorm(x, pa["0"], block_eps[li][0])
             m = pa["1"]["~"]
-            q = (a @ m["wq"] + m["bq"]).reshape(n_heads, hd)
-            k = (a @ m["wk"] + m["bk"]).reshape(n_heads, hd)
-            v = (a @ m["wv"] + m["bv"]).reshape(n_heads, hd)
-            kcache = kcache.at[li, i].set(k)
-            vcache = vcache.at[li, i].set(v)
-            s = jnp.einsum("hd,thd->ht", q, kcache[li]) * scale
-            s = jnp.where(jnp.arange(n_pos)[None, :] <= i, s, -jnp.inf)
+            q = (a @ m["wq"] + m["bq"]).reshape(bsz, n_heads, hd)
+            k = (a @ m["wk"] + m["bk"]).reshape(bsz, n_heads, hd)
+            v = (a @ m["wv"] + m["bv"]).reshape(bsz, n_heads, hd)
+            kcache = kcache.at[li, :, i].set(k)
+            vcache = vcache.at[li, :, i].set(v)
+            s = jnp.einsum("bhd,bthd->bht", q, kcache[li]) * scale
+            s = jnp.where(jnp.arange(n_pos)[None, None, :] <= i, s,
+                          -jnp.inf)
             p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("ht,thd->hd", p, vcache[li]).reshape(d_model)
+            o = jnp.einsum("bht,bthd->bhd", p,
+                           vcache[li]).reshape(bsz, d_model)
             x = x + o @ m["wo"] + m["bo"]
             a2 = layernorm(x, pf["0"], block_eps[li][1])
             f = pf["1"]
@@ -166,28 +182,31 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
                             + f["0"]["0"]["~"]["bias"])
             x = x + (h @ f["3"]["0"]["~"]["weight"].T
                      + f["3"]["0"]["~"]["bias"])
-        xf = ((x - x.mean()) * jax.lax.rsqrt(x.var() + eps_f)
+        xf = ((x - x.mean(axis=-1, keepdims=True))
+              * jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps_f)
               * ln_f["weight"] + ln_f["bias"])
         logp = jax.nn.log_softmax(xf @ head["weight"].T + head["bias"])
         if greedy:
-            nxt = jnp.argmax(logp).astype(jnp.int32)
+            nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
         else:
             lp = logp if temperature == 1.0 else logp / temperature
             if top_k and top_k < vocab:
-                kth = jax.lax.top_k(lp, top_k)[0][-1]
+                kth = jax.lax.top_k(lp, top_k)[0][:, -1:]
                 lp = jnp.where(lp >= kth, lp, -jnp.inf)
             k_rng, sub = jax.random.split(k_rng)
             nxt = jax.random.categorical(sub, lp).astype(jnp.int32)
         return (kcache, vcache, nxt, k_rng), nxt
 
-    k0 = jnp.zeros((n_layers, n_pos, n_heads, hd), jnp.float32)
+    k0 = jnp.zeros((n_layers, bsz, n_pos, n_heads, hd), jnp.float32)
     rng0 = key if key is not None else jax.random.PRNGKey(0)
     (_, _, _, _), preds = jax.lax.scan(
-        step, (k0, jnp.zeros_like(k0), jnp.int32(0), rng0),
+        step, (k0, jnp.zeros_like(k0),
+               jnp.zeros((bsz,), jnp.int32), rng0),
         jnp.arange(n_pos))
-    out = [int(t) for t in seed_ids]
-    out += [int(t) for t in np.asarray(preds[n_seed - 1:])]
-    return out
+    gen = np.asarray(preds[n_seed - 1:])        # (n_words, B)
+    rows = [[int(t) for t in seed_np[b]] + [int(t) for t in gen[:, b]]
+            for b in range(bsz)]
+    return rows[0] if flat else rows
 
 
 def TransformerClassifier(class_num: int, d_model: int = 128,
