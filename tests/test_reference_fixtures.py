@@ -1,0 +1,135 @@
+"""Interop against artifacts SHIPPED BY THE REFERENCE (not generated here).
+
+The reference bundles real test fixtures the builder of this repo did not
+create:
+
+- four Torch7-written golden tensors
+  ``dl/src/test/resources/torch/n0*.t7`` plus the Lua recipe that made
+  them (``genPreprocessRefTensors.lua``): load JPEG as float RGB in
+  [0,1], random-crop 224x224 under ``torch.manualSeed(100)``, hflip,
+  normalize mean {0.4,0.5,0.6} std {0.1,0.2,0.3}, ``torch.save``;
+- the matching ImageNet JPEGs ``dl/src/test/resources/imagenet/n0*/``;
+- CIFAR PNG class folders ``dl/src/test/resources/cifar/{airplane,deer}``.
+
+These tests prove (a) ``utils.torch_file.load`` reads Torch-era .t7
+files byte-for-byte correctly, and (b) the image pipeline's
+decode/crop/flip/normalize reproduces Torch's ``image`` package output
+bit-exactly on the shipped JPEGs.
+
+Torch7 RNG note: ``torch.uniform(a, b)`` draws ONE raw 32-bit MT19937
+word per call and scales by 2**-32 (THRandom.c); numpy's legacy
+``RandomState`` uses the identical MT19937 init and word stream, so the
+crop offsets under ``manualSeed(100)`` are predictable exactly — no
+offset search, the recipe is replayed deterministically.
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+REF_RES = "/root/reference/dl/src/test/resources"
+
+# (t7 fixture stem, shipped JPEG path relative to resources/imagenet)
+PAIRS = [
+    ("n02110063_11239", "n02110063/n02110063_11239.JPEG"),
+    ("n04370456_5753", "n04370456/n04370456_5753.JPEG"),
+    ("n15075141_38508", "n15075141/n15075141_38508.JPEG"),
+    ("n03000134_4970", "n99999999/n03000134_4970.JPEG"),
+]
+
+MEAN = (0.4, 0.5, 0.6)
+STD = (0.1, 0.2, 0.3)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(os.path.join(REF_RES, "torch")),
+    reason="reference resources not mounted")
+
+
+def _torch_uniform_pair(seed, a1, b1, a2, b2):
+    """Two ``torch.uniform`` draws as Torch7 makes them: one raw MT19937
+    32-bit word each, scaled by 2**-32 (THRandom.c __uniform__)."""
+    rs = np.random.RandomState(seed)
+    d = rs.randint(0, 2 ** 32, size=2, dtype=np.uint32).astype(np.float64)
+    u = d / 2.0 ** 32
+    return a1 + u[0] * (b1 - a1), a2 + u[1] * (b2 - a2)
+
+
+def _replay_recipe(jpeg_path):
+    """genPreprocessRefTensors.lua's preprocess(), through this repo's
+    own pipeline pieces (decoder + ImgNormalizer)."""
+    from bigdl_tpu.dataset.dataset import DataSet
+    from bigdl_tpu.dataset.image import BytesToImg, ImgNormalizer
+    from bigdl_tpu.dataset.sample import ByteRecord
+
+    raw = open(jpeg_path, "rb").read()
+    (img,) = BytesToImg()(iter([ByteRecord(raw, 1.0)]))  # HWC RGB float
+    img.data /= 255.0  # image.load(path, 3, 'float') range
+    h, w = img.data.shape[:2]
+    # crop(img, 224, 224): h1 = ceil(uniform(1e-2, iH-224)), same for w1;
+    # image.crop(x1=w1, y1=h1, ...) starts at 0-based offset (w1, h1).
+    u1, u2 = _torch_uniform_pair(100, 1e-2, h - 224, 1e-2, w - 224)
+    h1, w1 = math.ceil(u1), math.ceil(u2)
+    img.data = img.data[h1:h1 + 224, w1:w1 + 224]
+    img.data = img.data[:, ::-1].copy()  # image.hflip
+    (img,) = ImgNormalizer(MEAN, STD)(iter([img]))
+    return np.transpose(img.data, (2, 0, 1))  # Torch layout (3, H, W)
+
+
+class TestShippedT7Goldens:
+    @pytest.mark.parametrize("stem", [p[0] for p in PAIRS])
+    def test_t7_loads_with_expected_shape_and_range(self, stem):
+        from bigdl_tpu.utils import torch_file
+        g = torch_file.load(os.path.join(REF_RES, "torch", stem + ".t7"))
+        assert isinstance(g, np.ndarray)
+        assert g.shape == (3, 224, 224)
+        assert g.dtype == np.float32
+        # normalized range per channel: ((0..1) - mean) / std
+        for c in range(3):
+            lo = (0.0 - MEAN[c]) / STD[c]
+            hi = (1.0 - MEAN[c]) / STD[c]
+            assert g[c].min() >= lo - 1e-5
+            assert g[c].max() <= hi + 1e-5
+
+    @pytest.mark.parametrize("stem,jpeg", PAIRS)
+    def test_pipeline_reproduces_torch_golden(self, stem, jpeg):
+        from bigdl_tpu.utils import torch_file
+        golden = torch_file.load(os.path.join(REF_RES, "torch", stem + ".t7"))
+        ours = _replay_recipe(os.path.join(REF_RES, "imagenet", jpeg))
+        assert ours.shape == golden.shape
+        # Bit-exact on this container's libjpeg; the loose backstop bound
+        # covers a different-decoder environment (±2/255 pre-normalize).
+        err = np.abs(ours - golden)
+        assert err.max() <= 2.0 / 255.0 / min(STD)
+        assert err.mean() < 1e-3
+        # In the measured environment the decode matches Torch exactly.
+        assert err.max() < 1e-5
+
+
+class TestShippedImageFolders:
+    def test_image_folder_over_shipped_cifar_pngs(self):
+        """DataSet.image_folder (ref DataSet.scala:322-379) over the
+        reference's CIFAR PNG class folders decodes to labeled 32x32 RGB."""
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.image import BytesToImg
+        from bigdl_tpu.dataset.sample import ByteRecord
+
+        ds = DataSet.image_folder(os.path.join(REF_RES, "cifar"))
+        records = list(ds.data(train=False))
+        assert len(records) == 7  # 3 airplane + 4 deer
+        labels = sorted({lab for _, lab in records})
+        assert labels == [1.0, 2.0]  # 1-based labels as the reference's
+        byte_recs = [ByteRecord(open(p, "rb").read(), lab)
+                     for p, lab in records]
+        imgs = list(BytesToImg()(iter(byte_recs)))
+        for im in imgs:
+            assert im.data.shape == (32, 32, 3)
+            assert 0.0 <= im.data.min() and im.data.max() <= 255.0
+
+    def test_image_folder_over_shipped_imagenet_jpegs(self):
+        from bigdl_tpu.dataset.dataset import DataSet
+        ds = DataSet.image_folder(os.path.join(REF_RES, "imagenet"))
+        records = list(ds.data(train=False))
+        # 4 class dirs; n99999999 holds 2 JPEGs + a bmp + stray files
+        assert len([r for r in records if r[0].endswith(".JPEG")]) == 10
+        assert {lab for _, lab in records} == {1.0, 2.0, 3.0, 4.0}
