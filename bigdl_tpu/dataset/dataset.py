@@ -165,10 +165,22 @@ class DataSet:
         return DataSet.array(records, distributed)
 
     @staticmethod
-    def seq_file_folder(path, distributed: bool = False):
-        """Packed-shard streaming dataset — the Hadoop SequenceFile
-        ingestion role (ref DataSet.SeqFileFolder DataSet.scala:384-455);
-        shards are written by ``bigdl_tpu.dataset.shardfile.write_shards``
-        / ``imagenet_tools``."""
+    def seq_file_folder(path, distributed: bool = False, class_num=None):
+        """Streaming packed-record dataset (ref DataSet.SeqFileFolder
+        DataSet.scala:384-455).  A folder of ``*.seq`` files is read as
+        actual Hadoop SequenceFiles — the reference toolchain's ImageNet
+        wire format (``bigdl_tpu.dataset.seqfile``); otherwise the folder
+        is this framework's own packed-shard format written by
+        ``bigdl_tpu.dataset.shardfile.write_shards`` / ``imagenet_tools``."""
+        from bigdl_tpu.dataset import seqfile
+        seq_files = seqfile.find_seq_files(path)
+        if seq_files:
+            return seqfile.SeqFileDataSet(path, class_num=class_num,
+                                          distributed=distributed,
+                                          files=seq_files)
+        if class_num is not None:
+            raise ValueError(
+                f"class_num is only supported for Hadoop SequenceFile "
+                f"folders; {path} holds no .seq files")
         from bigdl_tpu.dataset.shardfile import ShardFolder
         return ShardFolder(path, distributed=distributed)

@@ -1,0 +1,199 @@
+"""Hadoop SequenceFile reader/writer (ref DataSet.SeqFileFolder
+DataSet.scala:384-455, BGRImgToLocalSeqFile.scala, LocalSeqFileToBytes.scala).
+"""
+import io
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import seqfile
+from bigdl_tpu.dataset.dataset import DataSet
+from bigdl_tpu.dataset.image import LabeledImage
+from bigdl_tpu.dataset.seqfile import (
+    BGRImgToLocalSeqFile, LocalSeqFileToBytes, SeqBytesToBGRImg,
+    SeqFileDataSet, SequenceFileWriter, read_sequence_file, read_vint,
+    write_vint)
+
+
+class TestVInt:
+    @pytest.mark.parametrize("v", [
+        0, 1, -1, 127, -112, 128, -113, 255, 256, 65535, 2 ** 20,
+        2 ** 31 - 1, -(2 ** 31), 2 ** 40, -(2 ** 40), 2 ** 62])
+    def test_round_trip(self, v):
+        assert read_vint(io.BytesIO(write_vint(v))) == v
+
+    def test_single_byte_range_is_one_byte(self):
+        for v in (-112, 0, 127):
+            assert len(write_vint(v)) == 1
+
+
+class TestFileRoundTrip:
+    def test_many_records_with_sync_escapes(self, tmp_path):
+        path = str(tmp_path / "t_0.seq")
+        records = [(f"k{i}".encode(), os.urandom(137) * (i % 3 + 1))
+                   for i in range(200)]  # >> SYNC_INTERVAL bytes total
+        with SequenceFileWriter(path) as w:
+            for k, v in records:
+                w.append(k, v)
+        # the writer must actually have inserted sync escapes
+        assert os.path.getsize(path) > seqfile.SYNC_INTERVAL * 2
+        got = list(read_sequence_file(path))
+        assert got == records
+
+    def test_reads_hand_built_file(self, tmp_path):
+        """A byte-literal SequenceFile assembled straight from the Hadoop
+        spec (not via SequenceFileWriter) must parse — guards against the
+        reader and writer agreeing on a wrong format."""
+        text_cls = b"\x19org.apache.hadoop.io.Text"  # vint(25) + name
+        sync = bytes(range(16))
+        key, value = b"\x013", b"\x05hello"  # Text("3"), Text("hello")
+        blob = (b"SEQ\x06" + text_cls + text_cls + b"\x00\x00"
+                + struct.pack(">i", 0) + sync
+                + struct.pack(">ii", len(key) + len(value), len(key))
+                + key + value
+                + struct.pack(">i", -1) + sync  # sync escape mid-stream
+                + struct.pack(">ii", len(key) + len(value), len(key))
+                + key + value)
+        path = str(tmp_path / "hand_0.seq")
+        with open(path, "wb") as f:
+            f.write(blob)
+        assert list(read_sequence_file(path)) == [(b"3", b"hello")] * 2
+
+    def test_rejects_compressed_and_non_seq(self, tmp_path):
+        bad = str(tmp_path / "x_0.seq")
+        with open(bad, "wb") as f:
+            f.write(b"NOPE")
+        with pytest.raises(ValueError):
+            list(read_sequence_file(bad))
+        comp = str(tmp_path / "c_0.seq")
+        with open(comp, "wb") as f:
+            f.write(b"SEQ\x06" + b"\x19org.apache.hadoop.io.Text" * 2
+                    + b"\x01\x00" + struct.pack(">i", 0) + bytes(16))
+        with pytest.raises(NotImplementedError):
+            list(read_sequence_file(comp))
+
+
+def _images(n, h=8, w=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return [LabeledImage(rng.rand(h, w, 3).astype(np.float32),
+                         float(i % 4 + 1), order="bgr") for i in range(n)]
+
+
+class TestImageLayer:
+    def test_block_splitting_and_read_back(self, tmp_path):
+        imgs = _images(7)
+        base = str(tmp_path / "imagenet-seq-0")
+        files = list(BGRImgToLocalSeqFile(3, base)(iter(imgs)))
+        assert files == [f"{base}_{i}.seq" for i in range(3)]  # 3+3+1
+        recs = list(LocalSeqFileToBytes()(iter(files)))
+        assert [r.label for r in recs] == [img.label for img in imgs]
+        out = list(SeqBytesToBGRImg()(iter(recs)))
+        for got, want in zip(out, imgs):
+            assert got.data.shape == want.data.shape
+            # on-disk bytes quantize pixels to 1/255 steps
+            assert np.abs(got.data - want.data).max() <= 1.0 / 255.0 + 1e-6
+            assert got.order == "bgr"
+
+    def test_rgb_images_are_flipped_to_disk_bgr(self, tmp_path):
+        img = _images(1)[0]
+        rgb = LabeledImage(img.data[..., ::-1], img.label, order="rgb")
+        base = str(tmp_path / "s")
+        (f1,) = BGRImgToLocalSeqFile(8, base)(iter([img]))
+        (f2,) = BGRImgToLocalSeqFile(8, str(tmp_path / "r"))(iter([rgb]))
+        (_, v1), (_, v2) = next(read_sequence_file(f1)), next(
+            read_sequence_file(f2))
+        assert v1 == v2
+
+    def test_has_name_keys(self, tmp_path):
+        imgs = _images(2)
+        named = [(img, f"n0/{i}.JPEG") for i, img in enumerate(imgs)]
+        base = str(tmp_path / "named")
+        (f,) = BGRImgToLocalSeqFile(8, base, has_name=True)(iter(named))
+        keys = [k for k, _ in read_sequence_file(f)]
+        assert keys[0].decode() == "n0/0.JPEG\n1"
+        assert seqfile.read_label(keys[0]) == "1"
+        assert seqfile.read_name(keys[0]) == "n0/0.JPEG"
+        with pytest.raises(ValueError):
+            seqfile.read_name(b"1")  # label-only key has no name
+
+
+class TestSeqFileDataSet:
+    def test_folder_dataset_and_class_filter(self, tmp_path):
+        imgs = _images(10)  # labels cycle 1..4
+        list(BGRImgToLocalSeqFile(4, str(tmp_path / "a"))(iter(imgs)))
+        ds = SeqFileDataSet(str(tmp_path))
+        assert ds.size() == 10
+        ds2 = SeqFileDataSet(str(tmp_path), class_num=2)
+        labels = [r.label for r in ds2.data(train=False)]
+        assert labels and all(l <= 2.0 for l in labels)
+        with pytest.raises(ValueError):
+            SeqFileDataSet(str(tmp_path / "missing-dir-ok"))
+
+    def test_dispatch_and_pipeline_chaining(self, tmp_path):
+        imgs = _images(5)
+        list(BGRImgToLocalSeqFile(5, str(tmp_path / "b"))(iter(imgs)))
+        ds = DataSet.seq_file_folder(str(tmp_path))
+        assert isinstance(ds, SeqFileDataSet)
+        decoded = list((ds >> SeqBytesToBGRImg()).data(train=False))
+        assert len(decoded) == 5
+        assert decoded[0].data.shape == imgs[0].data.shape
+
+    def test_size_uses_keys_only_scan_and_caches(self, tmp_path):
+        imgs = _images(9)
+        list(BGRImgToLocalSeqFile(4, str(tmp_path / "c"))(iter(imgs)))
+        keys = [k for f in seqfile.find_seq_files(str(tmp_path))
+                for k in seqfile.iter_record_keys(f)]
+        assert [seqfile.read_label(k) for k in keys] \
+            == [str(int(i.label)) for i in imgs]
+        ds = SeqFileDataSet(str(tmp_path), class_num=3)
+        want = sum(1 for i in imgs if i.label <= 3)
+        assert ds.size() == want
+        assert ds._size == want  # cached after first call
+
+    def test_distributed_shards_whole_files_per_process(self, tmp_path,
+                                                        monkeypatch):
+        imgs = _images(8)
+        list(BGRImgToLocalSeqFile(2, str(tmp_path / "d"))(iter(imgs)))  # 4 files
+        import jax
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        ds = SeqFileDataSet(str(tmp_path), distributed=True)
+        assert ds.local_files == ds.files[1::2]
+        assert len(list(ds.data(train=False))) == 4  # this process's half
+        assert ds.size() == 8  # size() stays global
+        monkeypatch.setattr(jax, "process_index", lambda: 5)
+        monkeypatch.setattr(jax, "process_count", lambda: 9)
+        with pytest.raises(ValueError):  # empty local slice must be loud
+            SeqFileDataSet(str(tmp_path), distributed=True)
+
+    def test_class_num_rejected_on_shardfile_fallback(self, tmp_path):
+        from bigdl_tpu.dataset.shardfile import write_shards
+        write_shards(iter([("1", b"x")]), str(tmp_path), n_shards=1)
+        with pytest.raises(ValueError):
+            DataSet.seq_file_folder(str(tmp_path), class_num=5)
+
+    def test_matches_shardfile_path_on_same_records(self, tmp_path):
+        """The same images through the reference wire format and through
+        this framework's own shardfile format decode identically."""
+        from bigdl_tpu.dataset.shardfile import write_shards
+        imgs = _images(6, seed=3)
+        # seq path
+        list(BGRImgToLocalSeqFile(6, str(tmp_path / "seq" / "p"))(iter(imgs)))
+        seq_imgs = list(
+            (DataSet.seq_file_folder(str(tmp_path / "seq"))
+             >> SeqBytesToBGRImg()).data(train=False))
+        # shardfile path carries the already-quantized payload bytes
+        recs = [(str(int(img.label)),
+                 seqfile.encode_image_value(img.data, img.width, img.height))
+                for img in imgs]
+        write_shards(iter(recs), str(tmp_path / "shards"), n_shards=2,
+                     prefix="p")
+        shard_ds = DataSet.seq_file_folder(str(tmp_path / "shards"))
+        assert not isinstance(shard_ds, SeqFileDataSet)
+        shard_imgs = list((shard_ds >> SeqBytesToBGRImg()).data(train=False))
+        by_label = sorted(
+            ((i.label, i.data.tobytes()) for i in shard_imgs))
+        assert sorted((i.label, i.data.tobytes()) for i in seq_imgs) \
+            == by_label
