@@ -85,12 +85,21 @@ _ROOFLINE_LAST_GOOD = {"roofline_tflops": 186.9, "device": "TPU v5 lite",
                        "measured_at": "2026-07-31 (committed default)"}
 
 
-def _load_roofline_sidecar():
+def _load_roofline_sidecar(run_device):
+    """Last-good roofline, ONLY if it was measured on ``run_device``
+    (or either side is unknown).  The chip-match guard lives here so no
+    call site can contextualize a run with another chip's roofline."""
     try:
         with open(_ROOFLINE_SIDECAR) as f:
-            return json.load(f)
+            cached = json.load(f)
     except Exception:
-        return dict(_ROOFLINE_LAST_GOOD)
+        cached = dict(_ROOFLINE_LAST_GOOD)
+    if (cached.get("device") in (run_device, "unknown")
+            or run_device == "unknown"):
+        return cached
+    print("roofline sidecar is for %r, this run is on %r — not using it"
+          % (cached.get("device"), run_device), file=sys.stderr, flush=True)
+    return None
 
 
 def _raw_step(model, criterion):
@@ -566,20 +575,15 @@ def main():
         # (VERDICT r3 item 4: BENCH_r03 shipped a null roofline).  Only
         # honored when the cached chip matches the one that ran the
         # configs — a v5e roofline must not contextualize a v6e run.
-        cached = _load_roofline_sidecar()
         run_device = next((e.get("device") for e in entries
                            if e.get("device")), device)
-        if cached and cached.get("device") in (run_device, "unknown") \
-                or cached and run_device == "unknown":
+        cached = _load_roofline_sidecar(run_device)
+        if cached:
             roof = cached.get("roofline_tflops")
             if device == "unknown":
                 device = cached.get("device", device)
             roof_src = "cached %s on %s" % (cached.get("measured_at", "?"),
                                             cached.get("device", "?"))
-        elif cached:
-            print("roofline sidecar is for %r, this run is on %r — "
-                  "not using it" % (cached.get("device"), run_device),
-                  file=sys.stderr, flush=True)
     print(_summary_line(entries, primary, roof, device, roof_src,
                         eval_entry), flush=True)
 

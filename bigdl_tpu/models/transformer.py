@@ -101,7 +101,28 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from bigdl_tpu.nn.attention import SinusoidalPositionalEncoding
+    from bigdl_tpu.nn.attention import (MultiHeadSelfAttention,
+                                        SinusoidalPositionalEncoding)
+    from bigdl_tpu.nn.linear import Linear
+    from bigdl_tpu.nn.moe import MoE
+    from bigdl_tpu.nn.normalization import LayerNorm
+
+    # Sub-module handles are derived STRUCTURALLY (walk each block for
+    # its LayerNorm/attention/Linear instances) so refactors of
+    # encoder_block's container nesting fail loudly here instead of
+    # silently diverging through stale hard-coded param paths.
+    def _walk(mod, path=()):
+        yield path, mod
+        for i, ch in enumerate(getattr(mod, "modules", None) or []):
+            yield from _walk(ch, path + (str(i),))
+
+    def _find(mod, cls):
+        return [(p, m) for p, m in _walk(mod) if isinstance(m, cls)]
+
+    def _param_at(tree, path):
+        for k in path:
+            tree = tree[k]
+        return tree
 
     mods = model.modules
     n_layers = len(mods) - 4
@@ -115,22 +136,46 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
     if temperature <= 0:
         raise ValueError("temperature must be > 0")
     params = model.params()
-    emb = params["0"]["0"]["~"]            # Linear: weight (d, vocab)
+    emb_mods = _find(mods[0], Linear)
+    if len(emb_mods) != 1:
+        raise ValueError("lm_decode: embedding stage must hold exactly "
+                         "one Linear")
+    emb = _param_at(params["0"], emb_mods[0][0])["~"]  # weight (d, vocab)
     d_model = int(emb["weight"].shape[0])
     blocks, block_eps = [], []
+    n_heads = None
     for li in range(n_layers):
-        pb = params[str(2 + li)]
-        blocks.append((pb["0"]["0"]["1"],   # {"0": LN, "1": MHSA}
-                       pb["1"]["0"]["1"]))  # {"0": LN, "1": FFN seq}
-        branches = mods[2 + li].modules
-        block_eps.append(
-            (branches[0].modules[0].modules[1].modules[0].eps,
-             branches[1].modules[0].modules[1].modules[0].eps))
-    n_heads = mods[2].modules[0].modules[0].modules[1].modules[1].n_heads
+        blk, pb = mods[2 + li], params[str(2 + li)]
+        if _find(blk, MoE):
+            raise NotImplementedError(
+                "lm_decode does not support MoE FFN blocks")
+        attn = _find(blk, MultiHeadSelfAttention)
+        lns = _find(blk, LayerNorm)
+        ffn_lins = _find(blk, Linear)
+        if len(attn) != 1 or len(lns) != 2 or len(ffn_lins) != 2:
+            raise ValueError(
+                f"lm_decode: block {li} must hold exactly one attention, "
+                f"two LayerNorms and two FFN Linears; found {len(attn)}/"
+                f"{len(lns)}/{len(ffn_lins)} — was encoder_block "
+                f"restructured?")
+        n_heads = attn[0][1].n_heads
+        blocks.append((
+            _param_at(pb, lns[0][0]),        # attention-branch LN
+            _param_at(pb, attn[0][0])["~"],  # MHSA weights
+            _param_at(pb, lns[1][0]),        # FFN-branch LN
+            _param_at(pb, ffn_lins[0][0])["~"],  # d_model -> hidden
+            _param_at(pb, ffn_lins[1][0])["~"],  # hidden -> d_model
+        ))
+        block_eps.append((lns[0][1].eps, lns[1][1].eps))
     hd = d_model // n_heads
     ln_f = params[str(2 + n_layers)]["~"]
     eps_f = mods[2 + n_layers].eps
-    head = params[str(3 + n_layers)]["0"]["0"]["~"]  # weight (vocab, d)
+    head_mods = _find(mods[3 + n_layers], Linear)
+    if len(head_mods) != 1:
+        raise ValueError("lm_decode: head stage must hold exactly one "
+                         "Linear")
+    head = _param_at(params[str(3 + n_layers)],
+                     head_mods[0][0])["~"]   # weight (vocab, d)
     vocab = int(head["weight"].shape[0])
 
     if len(seed_ids) == 0:
@@ -161,9 +206,8 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
         tok = jnp.where(i < n_seed, seed[:, jnp.minimum(i, n_seed - 1)],
                         tok)
         x = emb["weight"][:, tok].T + emb["bias"] + pe[i]
-        for li, (pa, pf) in enumerate(blocks):
-            a = layernorm(x, pa["0"], block_eps[li][0])
-            m = pa["1"]["~"]
+        for li, (ln1, m, ln2, lin1, lin2) in enumerate(blocks):
+            a = layernorm(x, ln1, block_eps[li][0])
             q = (a @ m["wq"] + m["bq"]).reshape(bsz, n_heads, hd)
             k = (a @ m["wk"] + m["bk"]).reshape(bsz, n_heads, hd)
             v = (a @ m["wv"] + m["bv"]).reshape(bsz, n_heads, hd)
@@ -176,12 +220,9 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
             o = jnp.einsum("bht,bthd->bhd", p,
                            vcache[li]).reshape(bsz, d_model)
             x = x + o @ m["wo"] + m["bo"]
-            a2 = layernorm(x, pf["0"], block_eps[li][1])
-            f = pf["1"]
-            h = jax.nn.relu(a2 @ f["0"]["0"]["~"]["weight"].T
-                            + f["0"]["0"]["~"]["bias"])
-            x = x + (h @ f["3"]["0"]["~"]["weight"].T
-                     + f["3"]["0"]["~"]["bias"])
+            a2 = layernorm(x, ln2, block_eps[li][1])
+            h = jax.nn.relu(a2 @ lin1["weight"].T + lin1["bias"])
+            x = x + h @ lin2["weight"].T + lin2["bias"]
         xf = ((x - x.mean(axis=-1, keepdims=True))
               * jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps_f)
               * ln_f["weight"] + ln_f["bias"])

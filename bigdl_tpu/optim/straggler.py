@@ -78,6 +78,18 @@ class StragglerPolicy:
         self.batch_size = int(compute_threshold_batch_size)
         self.warmup = int(warmup_iteration)
         self.time_source = time_source
+        if (self.drop_percentage > 0 and
+                int(self.drop_percentage * self.batch_size * self.n_tasks)
+                == 0):
+            # k rounds to 0 every window -> the threshold stays inf and
+            # dropping can never engage; tell the user at configuration
+            # time instead of silently doing nothing
+            logger.warning(
+                "straggler dropping cannot arm: drop_percentage (%g) * "
+                "compute_threshold_batch_size (%d) * n_tasks (%d) rounds "
+                "to 0 slow slots per window; raise drop_percentage or "
+                "the window size", self.drop_percentage, self.batch_size,
+                self.n_tasks)
         # ref: threshold starts at Long.MaxValue (Util.kthLargest k=0)
         self.threshold = math.inf
         self.iteration = 0          # accepted iterations, ref `iteration`

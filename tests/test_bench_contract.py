@@ -81,13 +81,18 @@ def test_roofline_sidecar_roundtrip(bench, tmp_path, monkeypatch):
                         str(tmp_path / "roof.json"))
     # no sidecar file yet (fresh workspace): the committed last-good
     # default answers, so the artifact is self-interpreting from run one
-    c0 = bench._load_roofline_sidecar()
+    c0 = bench._load_roofline_sidecar("TPU v5 lite")
     assert c0 == bench._ROOFLINE_LAST_GOOD
     bench._save_roofline_sidecar(186.9, "TPU v5 lite")
-    c = bench._load_roofline_sidecar()
+    c = bench._load_roofline_sidecar("TPU v5 lite")
     assert c["roofline_tflops"] == 186.9
     assert c["device"] == "TPU v5 lite"
     assert "measured_at" in c
+    # the chip-match guard now lives INSIDE the loader (ADVICE r4): a
+    # different chip cannot be contextualized by this sidecar ...
+    assert bench._load_roofline_sidecar("TPU v6e") is None
+    # ... but an unknown run device still accepts the last-good entry
+    assert bench._load_roofline_sidecar("unknown") == c
 
 
 def test_summary_line_self_interpreting_without_probe(bench):
