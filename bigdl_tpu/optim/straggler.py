@@ -78,9 +78,7 @@ class StragglerPolicy:
         self.batch_size = int(compute_threshold_batch_size)
         self.warmup = int(warmup_iteration)
         self.time_source = time_source
-        if (self.drop_percentage > 0 and
-                int(self.drop_percentage * self.batch_size * self.n_tasks)
-                == 0):
+        if self.drop_percentage > 0 and self._k_per_window() == 0:
             # k rounds to 0 every window -> the threshold stays inf and
             # dropping can never engage; tell the user at configuration
             # time instead of silently doing nothing
@@ -96,6 +94,13 @@ class StragglerPolicy:
         self._window: list[float] = []   # ref moduleTimeList (flattened)
         self._dropped_in_window = 0      # ref dropModelNumBatch
         self._last_times: np.ndarray | None = None
+
+    def _k_per_window(self) -> int:
+        """Slow slots per threshold window (ref DistriOptimizer.scala:
+        250: ``dropPercentage * computeThresholdbatchSize * n``) — the
+        ONE k formula shared by the threshold update and the cannot-arm
+        configuration check."""
+        return int(self.drop_percentage * self.batch_size * self.n_tasks)
 
     # ------------------------------------------------------------- mask
     @property
@@ -171,7 +176,7 @@ class StragglerPolicy:
         self.iteration += 1
         if (self.drop_percentage > 0 and self.iteration > self.warmup
                 and self.iteration % self.batch_size == 0):
-            k = int(self.drop_percentage * self.batch_size * self.n_tasks)
+            k = self._k_per_window()
             if k > self._dropped_in_window:
                 self.threshold = kth_largest(
                     np.asarray(self._window),

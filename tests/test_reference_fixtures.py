@@ -132,3 +132,30 @@ class TestShippedImageFolders:
         # 4 class dirs; n99999999 holds 2 JPEGs + a bmp + stray files
         assert len([r for r in records if r[0].endswith(".JPEG")]) == 10
         assert {lab for _, lab in records} == {1.0, 2.0, 3.0, 4.0}
+
+
+class TestRealDataAccuracy:
+    """End-to-end accuracy on reference-shipped image files (the role of
+    ref models/lenet/Test.scala / ModelValidator.scala:114-146): decode
+    -> train -> Validator top1 must be WELL above chance, proving the
+    decode/label/accuracy plumbing with a discriminating number."""
+
+    def test_cifar_png_folder_trains_to_perfect_top1(self):
+        from bigdl_tpu.models.utils.real_data import (
+            train_and_eval_image_folder)
+        r = train_and_eval_image_folder(os.path.join(REF_RES, "cifar"))
+        assert r["n_records"] == 7 and r["n_classes"] == 2
+        # majority-class chance is 4/7 ~= 0.57; an overfit 7-image drill
+        # through a healthy pipeline lands at 1.0
+        assert r["top1"] == 1.0
+        assert r["loss"] < 0.1
+
+    @pytest.mark.slow
+    def test_imagenet_jpeg_folder_trains_above_chance(self):
+        from bigdl_tpu.models.utils.real_data import (
+            train_and_eval_image_folder)
+        r = train_and_eval_image_folder(os.path.join(REF_RES, "imagenet"),
+                                        image_size=64, iterations=150)
+        # 10 shipped JPEGs + the one decodable BMP in n99999999
+        assert r["n_records"] == 11 and r["n_classes"] == 4
+        assert r["top1"] >= 0.9  # chance is ~0.27 (3/11 majority class)
