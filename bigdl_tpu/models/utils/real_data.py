@@ -81,18 +81,26 @@ def train_and_eval_image_folder(folder: str, image_size: int = 32,
     from bigdl_tpu.utils.random import set_seed
     from bigdl_tpu.utils.table import T
 
+    from bigdl_tpu.utils.random import RNG
+    saved = (RNG._seed, RNG._key_counter, RNG._np)
     set_seed(seed)
-    ds, recs, n_classes = _byte_record_dataset(folder, image_size)
-    if model is None:
-        model = small_convnet(n_classes, image_size)
-    batched = ds >> ImgToBatch(len(recs))
-    opt = LocalOptimizer(model, batched, nn.ClassNLLCriterion())
-    opt.set_state(T(learningRate=learning_rate, momentum=0.9))
-    opt.set_end_when(max_iteration(iterations))
-    opt.optimize()
-    results = validate(model, model.params(), model.state(), batched,
-                       [Top1Accuracy(), Top5Accuracy()])
-    (_, top1), (_, top5) = results
+    try:
+        ds, recs, n_classes = _byte_record_dataset(folder, image_size)
+        if model is None:
+            model = small_convnet(n_classes, image_size)
+        batched = ds >> ImgToBatch(len(recs))
+        opt = LocalOptimizer(model, batched, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=learning_rate, momentum=0.9))
+        opt.set_end_when(max_iteration(iterations))
+        opt.optimize()
+        results = validate(model, model.params(), model.state(), batched,
+                           [Top1Accuracy(), Top5Accuracy()])
+        (_, top1), (_, top5) = results
+    finally:
+        # this helper runs mid-bench / mid-suite: restore the process
+        # RNG stream it borrowed so callers after it are unaffected
+        set_seed(saved[0])
+        RNG._key_counter, RNG._np = saved[1], saved[2]
     return {"top1": round(top1.result()[0], 4),
             "top5": round(top5.result()[0], 4),
             "n_records": len(recs), "n_classes": n_classes,

@@ -27,6 +27,11 @@ from bigdl_tpu.utils.random import RNG
 _DN = ("NCHW", "OIHW", "NCHW")
 
 
+_DOT_1X1 = False  # REJECTED default: isolated 1.7-2.1x wins, end-to-end
+# loss (Inception 26.30 -> 27.92, ResNet-50 32.31 -> 32.59 ms/step) —
+# see the dot-1x1 comment in _conv and PERF_NOTES round 5
+
+
 def _conv(x, w, stride, padding, *, lhs_dilation=None, rhs_dilation=None, groups=1):
     # Both operands cast to the compute dtype (bf16 feeds the MXU at full
     # rate; accumulation is f32 inside the MXU regardless), output cast back.
@@ -36,6 +41,26 @@ def _conv(x, w, stride, padding, *, lhs_dilation=None, rhs_dilation=None, groups
     # isolated chained-conv microbench, measured 4-12% SLOWER end-to-end on
     # Inception-v1/VGG-16 training steps (PERF_NOTES.md), so it was removed.
     p = policy()
+    if (_DOT_1X1 and x.ndim == 4 and w.shape[2:] == (1, 1)
+            and tuple(stride) == (1, 1) and groups == 1
+            and lhs_dilation in (None, (1, 1))
+            and rhs_dilation in (None, (1, 1))
+            and (isinstance(padding, str)  # k=1: SAME == VALID == zero pad
+                 or all(lo == 0 and hi == 0 for lo, hi in padding))):
+        # A stride-1 1x1 conv IS a channel GEMM.  Isolated, this form
+        # measured 1.7-2.1x faster than the conv emitter on the worst
+        # ResNet 1x1-bwd shapes and never worse on any tested 1x1, bit-
+        # exact (tools/ab_conv_form.py).  END-TO-END it LOSES: Inception
+        # 26.30 -> 27.92, ResNet-50 32.31 -> 32.59 ms/step device-busy —
+        # the emitter's 1x1s fuse with the surrounding BN/ReLU/concat
+        # eltwise and the dot+transpose breaks those fusions (the same
+        # isolated-win/in-context-loss pattern as round 4's pet=f32
+        # experiment).  Kept OFF as measured evidence, PERF_NOTES r5.
+        co, ci = w.shape[0], w.shape[1]
+        y = lax.dot_general(p.cast_compute(w).reshape(co, ci),
+                            p.cast_compute(x),
+                            (((1,), (1,)), ((), ())))
+        return y.transpose(1, 0, 2, 3).astype(p.output_dtype)
     y = lax.conv_general_dilated(
         p.cast_compute(x), p.cast_compute(w),
         window_strides=stride, padding=padding,

@@ -18,6 +18,7 @@ from bigdl_tpu.tensor import policy
 
 
 _COMPUTE_DTYPE_POOL = True  # run max pools in the policy compute dtype
+_RESHAPE_POOL = True  # exact non-overlapping max pools via reshape+max
 
 
 def _max_pool2d(x, window, strides, padding):
@@ -49,11 +50,25 @@ def _max_pool2d(x, window, strides, padding):
             and p.compute_dtype != x.dtype
             and x.dtype == jnp.float32)
     xin = x.astype(p.compute_dtype) if cast else x
-    y = lax.reduce_window(
-        xin, np.array(-np.inf, xin.dtype), lax.max,
-        window_dimensions=(1, 1, kh, kw),
-        window_strides=(1, 1, dh, dw),
-        padding=((0, 0), (0, 0)) + padding)
+    n, c, h, w = xin.shape
+    if (_RESHAPE_POOL and (kh, kw) == (dh, dw)
+            and padding == ((0, 0), (0, 0))
+            and h % kh == 0 and w % kw == 0):
+        # Exact non-overlapping pool: windows tile the input, so the
+        # reduce is a plain reshape+max — no window machinery forward,
+        # and the backward is an eq-select instead of select_and_scatter.
+        # Tie semantics: jnp.max's VJP SPLITS the cotangent EVENLY among
+        # tied maxima (measured: an all-equal 2x2 window grads 0.25
+        # each), where select_and_scatter routes the full value to one
+        # element — an equally valid subgradient with the same
+        # per-window mass; documented in porting guide #6.
+        y = xin.reshape(n, c, h // kh, kh, w // kw, kw).max(axis=(3, 5))
+    else:
+        y = lax.reduce_window(
+            xin, np.array(-np.inf, xin.dtype), lax.max,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, dh, dw),
+            padding=((0, 0), (0, 0)) + padding)
     return y.astype(x.dtype) if cast else y
 
 

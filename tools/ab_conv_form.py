@@ -1,0 +1,157 @@
+"""Conv formulation A/B on device clock (round 5, VERDICT item 1b):
+XLA's native conv_general_dilated autodiff vs MXU-dot reformulations —
+1x1 convs as channel GEMMs, kxk backward via conv_general_dilated_patches
++ dot_general (the im2col/implicit-GEMM route the reference itself uses,
+SpatialConvolution.scala:409, NNPrimitive.scala:106).
+
+Each case times one jitted value_and_grad(sum(conv(x,w))) wrt (x, w):
+fwd + dx + dw on device clock, interleave-free (device clock is stable).
+
+Usage: python tools/ab_conv_form.py [case ...]
+"""
+import os as _os, sys as _sys
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+_sys.path.insert(0, _REPO); _sys.path.insert(0, _os.path.join(_REPO, "tools"))
+import shutil
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from profile_step import _trace_device_ops
+
+DN = ("NCHW", "OIHW", "NCHW")
+
+
+def native(stride, pad):
+    def f(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pad,
+            dimension_numbers=DN)
+    return f
+
+
+def dot_1x1(stride, pad):
+    """1x1 conv as a channel GEMM (pad must be 0)."""
+    def f(x, w):
+        if stride != (1, 1):
+            x = x[:, :, ::stride[0], ::stride[1]]
+        n, ci, h, wd = x.shape
+        co = w.shape[0]
+        # (N,Ci,H,W) x (Co,Ci) -> (N,Co,H,W), contract over Ci
+        y = lax.dot_general(w.reshape(co, ci), x,
+                            (((1,), (1,)), ((), ())))
+        return y.transpose(1, 0, 2, 3)
+    return f
+
+
+def patches_bwd(stride, pad, k):
+    """Native fwd; custom VJP computes dw and dx via patches+dot."""
+    @jax.custom_vjp
+    def f(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=pad,
+            dimension_numbers=DN)
+
+    def fwd(x, w):
+        return f(x, w), (x, w)
+
+    def bwd(res, g):
+        x, w = res
+        n, ci, h, wd = x.shape
+        co, _, kh, kw = w.shape
+        _, _, oh, ow = g.shape
+        # dw[o, i*kh*kw] = sum_{n,oh,ow} g[n,o,oh,ow] * patches(x)[n, i*kh*kw, oh, ow]
+        px = lax.conv_general_dilated_patches(
+            x, (kh, kw), stride, pad, dimension_numbers=DN)
+        dw = lax.dot_general(
+            g.reshape(n, co, oh * ow), px.reshape(n, ci * kh * kw, oh * ow),
+            (((2,), (2,)), ((0,), (0,))))  # (n, co, ci*kh*kw) batched? no:
+        dw = dw.sum(0) if dw.ndim == 3 else dw
+        dw = dw.reshape(co, ci, kh, kw)
+        # dx = conv(g_dilated, w_flipped^T) via patches on g
+        pg = lax.conv_general_dilated_patches(
+            g, (kh, kw),  (1, 1),
+            [(kh - 1 - pad[0][0], kh - 1 - pad[0][1]),
+             (kw - 1 - pad[1][0], kw - 1 - pad[1][1])],
+            lhs_dilation=stride, dimension_numbers=DN)
+        wf = w[:, :, ::-1, ::-1].transpose(1, 0, 2, 3)  # (ci, co, kh, kw)
+        dx = lax.dot_general(wf.reshape(ci, co * kh * kw),
+                             pg.reshape(n, co * kh * kw, h * wd),
+                             (((1,), (1,)), ((), ())))
+        dx = dx.transpose(1, 0, 2).reshape(n, ci, h, wd)
+        return dx, dw
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+CASES = {
+    # name: (N, Ci, H, W, Co, k, stride, pad)
+    "resnet_1x1_a": (64, 64, 56, 56, 256, 1, 1, 0),
+    "resnet_1x1_b": (64, 128, 28, 28, 512, 1, 1, 0),
+    "resnet_1x1_s2": (64, 256, 56, 56, 512, 1, 2, 0),
+    "vgg_3x3_a": (128, 64, 32, 32, 64, 3, 1, 1),
+    "vgg_3x3_b": (128, 512, 4, 4, 512, 3, 1, 1),
+    "incep_3x3": (128, 64, 56, 56, 192, 3, 1, 1),
+    "incep_1x1_a": (128, 288, 28, 28, 256, 1, 1, 0),
+    "incep_1x1_b": (128, 64, 56, 56, 64, 1, 1, 0),
+    "incep_1x1_c": (128, 192, 56, 56, 64, 1, 1, 0),
+    "resnet_1x1_c": (64, 256, 56, 56, 64, 1, 1, 0),
+    "resnet_1x1_d": (64, 512, 28, 28, 128, 1, 1, 0),
+}
+
+
+def run_case(name):
+    n, ci, h, wd, co, k, s, p = CASES[name]
+    stride, pad = (s, s), [(p, p), (p, p)]
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(n, ci, h, wd), jnp.bfloat16)
+    w = jnp.asarray(rs.randn(co, ci, k, k) * 0.05, jnp.bfloat16)
+    forms = {"native": native(stride, pad)}
+    if k == 1 and p == 0:
+        forms["dot1x1"] = dot_1x1(stride, pad)
+    if k > 1:
+        forms["patches"] = patches_bwd(stride, pad, k)
+    flops = 2 * n * ci * co * k * k * (h // s) * (wd // s) * 3  # fwd+dx+dw
+    for fname, f in forms.items():
+        def loss(x, w, f=f):
+            return jnp.sum(f(x, w).astype(jnp.float32))
+        g = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        # correctness vs native (loose: bf16)
+        if fname != "native":
+            gn = jax.jit(jax.grad(
+                lambda x, w: jnp.sum(
+                    native(stride, pad)(x, w).astype(jnp.float32)),
+                argnums=(0, 1)))
+            dx1, dw1 = g(x, w)
+            dx0, dw0 = gn(x, w)
+            ex = float(jnp.max(jnp.abs(dx1.astype(jnp.float32)
+                                       - dx0.astype(jnp.float32))))
+            ew = float(jnp.max(jnp.abs(dw1.astype(jnp.float32)
+                                       - dw0.astype(jnp.float32))))
+        else:
+            ex = ew = 0.0
+        out = g(x, w)
+        jax.block_until_ready(out)
+
+        def thunk():
+            o = None
+            for _ in range(10):
+                o = g(x, w)
+            return o
+
+        per_op, tmpdir = _trace_device_ops(
+            thunk, lambda o: float(jnp.sum(o[1].astype(jnp.float32))))
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        us = sum(t for nm, t in per_op.items()
+                 if not nm.startswith("while")) / 10
+        tf = flops / (us / 1e6) / 1e12
+        print(f"{name:14s} {fname:8s} {us/1e3:8.3f} ms  {tf:6.1f} TF/s"
+              f"  maxerr dx {ex:.3g} dw {ew:.3g}", flush=True)
+
+
+if __name__ == "__main__":
+    for case in (_sys.argv[1:] or CASES):
+        run_case(case)
