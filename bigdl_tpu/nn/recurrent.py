@@ -26,6 +26,15 @@ from bigdl_tpu.nn.activations import Tanh
 from bigdl_tpu.tensor import policy
 from bigdl_tpu.utils.table import Table
 
+# Bi-LSTM recurrence through the Pallas kernel pair on TPU (2.3x the
+# scan's autodiff, ops/pallas_kernels.bilstm_recurrence — PERF_NOTES
+# round 5).  False = lax.scan everywhere; "interpret" forces the kernel
+# through the Pallas interpreter on any backend (tests).  The kernel
+# computes gates/carries in f32, so it only replaces the scan when the
+# policy's output dtype is f32 (FP32/BF16_COMPUTE); BF16_ACT keeps the
+# scan, whose gates round through bf16.
+_PALLAS_BILSTM = True
+
 
 class Cell(Module):
     """Recurrent cell protocol: ``_step(P, x_t, h, ctx) -> (out_t, h_new)``
@@ -297,7 +306,25 @@ class BiRecurrent(Container):
             out = h_new.astype(p.compute_dtype) if reduced else h_new
             return hc, out
 
-        _, outs = lax.scan(step, (z0, z0), zx)            # (T, 2, N, H)
+        use_pallas = (_PALLAS_BILSTM
+                      and p.output_dtype == jnp.float32
+                      and (_PALLAS_BILSTM == "interpret"
+                           or jax.default_backend() == "tpu"))
+        if use_pallas:
+            # whole-recurrence Pallas kernel pair (fwd + hand-derived
+            # bwd), carries resident in VMEM across steps: 2.3x faster
+            # than the scan's autodiff on the flagship shapes — the one
+            # measured Mosaic win on this chip (ops/pallas_kernels.py
+            # bilstm_recurrence, PERF_NOTES round 5).  f32-policy only:
+            # forward bit-exact vs the scan body; grads differ by f32
+            # accumulation order.
+            from bigdl_tpu.ops.pallas_kernels import bilstm_recurrence
+            interp = _PALLAS_BILSTM == "interpret"
+            outs = bilstm_recurrence(zx, wh, interp)       # (T, 2, N, H)
+            if reduced:
+                outs = outs.astype(p.compute_dtype)
+        else:
+            _, outs = lax.scan(step, (z0, z0), zx)        # (T, 2, N, H)
         yf = jnp.swapaxes(outs[:, 0], 0, 1)               # (N, T, H)
         yb = jnp.swapaxes(jnp.flip(outs[:, 1], axis=0), 0, 1)
         y = (jnp.concatenate([yf, yb], axis=-1)

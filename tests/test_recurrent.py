@@ -155,6 +155,44 @@ def test_bilstm_fused_matches_two_scan():
                                    rtol=1e-4, atol=1e-5)
 
 
+def test_bilstm_pallas_recurrence_matches_scan():
+    """The Pallas kernel-pair recurrence (forced through the interpreter
+    on this CPU backend) must match the lax.scan fused path — outputs
+    and gradients (custom-VJP backward kernel vs scan autodiff)."""
+    from bigdl_tpu.nn import recurrent as rec
+    from bigdl_tpu.nn.module import Context
+    import jax
+
+    from bigdl_tpu.utils.random import set_seed
+    set_seed(5)
+    m = nn.BiRecurrent(nn.LSTMCell(6, 5), nn.LSTMCell(6, 5))
+    assert m._fused_lstm_eligible()
+    x = jnp.asarray(np.random.RandomState(2).randn(3, 7, 6), np.float32)
+    ctx = Context(training=False, key=jax.random.PRNGKey(0))
+    params = m.params()
+
+    def run(flag):
+        old = rec._PALLAS_BILSTM
+        rec._PALLAS_BILSTM = flag
+        try:
+            y = m._apply_fused_lstm(params, x, ctx)
+            g = jax.grad(
+                lambda p: (m._apply_fused_lstm(p, x, ctx) ** 2).sum()
+            )(params)
+        finally:
+            rec._PALLAS_BILSTM = old
+        return y, g
+
+    y_scan, g_scan = run(False)
+    y_pal, g_pal = run("interpret")
+    np.testing.assert_allclose(np.asarray(y_pal), np.asarray(y_scan),
+                               rtol=1e-5, atol=1e-6)
+    for l1, l2 in zip(jax.tree_util.tree_leaves(g_pal),
+                      jax.tree_util.tree_leaves(g_scan)):
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-4, atol=1e-5)
+
+
 def test_bilstm_fused_preserves_downstream_key_stream():
     """The fused Bi-LSTM path must consume the same number of ctx keys as
     the two-scan path (one per Recurrent.apply), so stochastic layers

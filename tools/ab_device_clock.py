@@ -36,6 +36,14 @@ def build_chunk(model_name, batch, impl, n=8):
         from bigdl_tpu.models.resnet import ResNet
         model = ResNet(depth=50, class_num=1000)
         xshape, nclass = (batch, 3, 224, 224), 1000
+    elif model_name == "lenet":
+        from bigdl_tpu.models.lenet import LeNet5
+        model = LeNet5(class_num=10)
+        xshape, nclass = (batch, 1, 28, 28), 10
+    elif model_name == "bilstm":
+        from bigdl_tpu.models.textclassifier import TextClassifierBiLSTM
+        model = TextClassifierBiLSTM(20, 200, hidden_size=128)
+        xshape, nclass = (batch, 500, 200), 20
     elif model_name == "transformer":
         from bigdl_tpu.models.transformer import TransformerClassifier
         model = TransformerClassifier(class_num=20, d_model=1024,
