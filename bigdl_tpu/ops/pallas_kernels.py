@@ -448,9 +448,11 @@ lrn_channel.defvjp(_lrn_vjp_fwd, _lrn_vjp_bwd)
 # Mosaic beats XLA on the math.
 
 
-def _bilstm_fwd_kernel(zx_ref, wht_ref, h_ref, c_ref, h_scr, c_scr):
+def _bilstm_fwd_body(zx_ref, wht_ref, h_ref, c_ref, h_scr, c_scr):
     """One grid step = one timestep, BOTH directions; zx already holds
-    the hoisted input projection + bias."""
+    the hoisted input projection + bias.  ``c_ref is None`` = primal-only
+    call: the cell-state stack is a VJP residual, so a no-grad forward
+    skips its HBM writes entirely."""
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -472,7 +474,16 @@ def _bilstm_fwd_kernel(zx_ref, wht_ref, h_ref, c_ref, h_scr, c_scr):
         h_scr[d] = h_new
         c_scr[d] = c_new
         h_ref[0, d] = h_new
-        c_ref[0, d] = c_new
+        if c_ref is not None:
+            c_ref[0, d] = c_new
+
+
+def _bilstm_fwd_kernel(zx_ref, wht_ref, h_ref, c_ref, h_scr, c_scr):
+    _bilstm_fwd_body(zx_ref, wht_ref, h_ref, c_ref, h_scr, c_scr)
+
+
+def _bilstm_fwd_kernel_primal(zx_ref, wht_ref, h_ref, h_scr, c_scr):
+    _bilstm_fwd_body(zx_ref, wht_ref, h_ref, None, h_scr, c_scr)
 
 
 def _bilstm_bwd_kernel(zx_ref, hprev_ref, c_ref, cprev_ref, g_ref,
@@ -522,12 +533,15 @@ def _shift_prev(xs):
     return jnp.concatenate([jnp.zeros_like(xs[:1]), xs[:-1]], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _bilstm_fwd_call(zx, wht, interpret=False):
+@functools.partial(jax.jit, static_argnames=("interpret", "with_c"))
+def _bilstm_fwd_call(zx, wht, interpret=False, with_c=True):
     t, _, b, h4 = zx.shape
     h = h4 // 4
+    out_spec = pl.BlockSpec((1, 2, b, h), lambda i: (i, 0, 0, 0),
+                            memory_space=pltpu.VMEM)
+    out_shape = jax.ShapeDtypeStruct((t, 2, b, h), jnp.float32)
     return pl.pallas_call(
-        _bilstm_fwd_kernel,
+        _bilstm_fwd_kernel if with_c else _bilstm_fwd_kernel_primal,
         grid=(t,),
         in_specs=[
             pl.BlockSpec((1, 2, b, h4), lambda i: (i, 0, 0, 0),
@@ -535,14 +549,8 @@ def _bilstm_fwd_call(zx, wht, interpret=False):
             pl.BlockSpec((2, h, h4), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((1, 2, b, h), lambda i: (i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2, b, h), lambda i: (i, 0, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[jax.ShapeDtypeStruct((t, 2, b, h), jnp.float32),
-                   jax.ShapeDtypeStruct((t, 2, b, h), jnp.float32)],
+        out_specs=[out_spec, out_spec] if with_c else out_spec,
+        out_shape=[out_shape, out_shape] if with_c else out_shape,
         scratch_shapes=[pltpu.VMEM((2, b, h), jnp.float32),
                         pltpu.VMEM((2, b, h), jnp.float32)],
         interpret=interpret,
@@ -587,8 +595,10 @@ def bilstm_recurrence(zx, wht, interpret=False):
     h stack (T, 2, B, H) f32.  Same math as the lax.scan body in
     Recurrent._apply_fused_lstm (forward bit-exact; gradients equal up
     to f32 accumulation order)."""
-    hs, _ = _bilstm_fwd_call(zx, wht, interpret=interpret)
-    return hs
+    # primal-only: skip the c-stack output — it is a VJP residual, and
+    # a no-grad forward (validation/inference) should not pay its HBM
+    # writes (~65 MB at the flagship shapes)
+    return _bilstm_fwd_call(zx, wht, interpret=interpret, with_c=False)
 
 
 def _bilstm_vjp_fwd(zx, wht, interpret=False):

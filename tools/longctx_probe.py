@@ -1,0 +1,101 @@
+"""Round-5 long-context measurements on the real chip (VERDICT item 6):
+1) single-chip causal-LM train step at T=8192 (full softmax) —
+   tokens/sec + HBM in use;
+2) KV-cached lm_decode at long T — tokens/sec for a full one-dispatch
+   decode at the longest tested length.
+
+Usage: python tools/longctx_probe.py [train|decode] ...
+"""
+import os as _os, sys as _sys, time
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+_sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def train_probe(t_len=8192, vocab=256, d_model=256, heads=4, layers=4):
+    import jax
+    import jax.numpy as jnp
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import tensor as bt
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.nn.module import Context
+    from bigdl_tpu.optim.optim_method import SGD
+    from bigdl_tpu.utils.random import set_seed
+
+    bt.set_policy(bt.BF16_COMPUTE)
+    set_seed(1)
+    m = TransformerLM(vocab_size=vocab, d_model=d_model, n_heads=heads,
+                      n_layers=layers, hidden=4 * d_model, dropout=0.1)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                       size_average=True)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (1, t_len))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    y = jnp.asarray(rs.randint(1, vocab + 1, (1, t_len)), jnp.float32)
+    method = SGD()
+    params, net_state = m.params(), m.state()
+    opt_state = method.init_state(params)
+    hyper = {"lr": 0.01, "momentum": 0.9, "dampening": 0.0,
+             "weight_decay": 0.0, "nesterov": False}
+
+    def step(params, net_state, opt_state, x, y, key):
+        def loss_fn(p):
+            out, ns = m.apply(p, x, net_state, Context(True, key))
+            return crit.apply_loss(out, y), ns
+        (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        p2, o2 = method.update(grads, opt_state, params, hyper)
+        return p2, ns, o2, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+    key = jax.random.PRNGKey(0)
+    t0 = time.time()
+    for _ in range(2):
+        params, net_state, opt_state, loss = jstep(params, net_state,
+                                                   opt_state, x, y, key)
+    print(f"T={t_len} compile+2: {time.time()-t0:.1f}s loss "
+          f"{float(loss):.3f}", flush=True)
+    best = 9e9
+    for _ in range(3):
+        t0 = time.time()
+        for _ in range(5):
+            params, net_state, opt_state, loss = jstep(
+                params, net_state, opt_state, x, y, key)
+        float(loss)
+        best = min(best, (time.time() - t0) / 5)
+    stats = jax.devices()[0].memory_stats() or {}
+    print(f"train T={t_len} d{d_model} L{layers}: {best*1e3:.1f} ms/step "
+          f"{t_len/best:,.0f} tokens/sec  hbm_in_use "
+          f"{stats.get('bytes_in_use', 0)/2**30:.2f} GiB", flush=True)
+
+
+def decode_probe(t_len=16384, vocab=2048, d_model=256, heads=4, layers=4):
+    import jax
+    from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+    from bigdl_tpu.utils.random import set_seed
+
+    set_seed(1)
+    m = TransformerLM(vocab_size=vocab, d_model=d_model, n_heads=heads,
+                      n_layers=layers, hidden=4 * d_model, dropout=0.0)
+    seed_ids = list(range(1, 17))
+    n_words = t_len - len(seed_ids) + 1
+    t0 = time.time()
+    out = lm_decode(m, seed_ids, n_words)
+    cold = time.time() - t0
+    t0 = time.time()
+    out = lm_decode(m, seed_ids, n_words)
+    warm = time.time() - t0
+    stats = jax.devices()[0].memory_stats() or {}
+    print(f"decode T={t_len} d{d_model} L{layers}: one-dispatch full "
+          f"decode cold {cold:.1f}s warm {warm:.1f}s = "
+          f"{n_words/warm:,.0f} tokens/sec  hbm_in_use "
+          f"{stats.get('bytes_in_use', 0)/2**30:.2f} GiB "
+          f"(len(out)={len(out)})", flush=True)
+
+
+if __name__ == "__main__":
+    mode = _sys.argv[1] if len(_sys.argv) > 1 else "train"
+    if mode == "train":
+        train_probe(*(int(a) for a in _sys.argv[2:]))
+    else:
+        decode_probe(*(int(a) for a in _sys.argv[2:]))
