@@ -14,6 +14,8 @@ idiom (same as its ResNet shortcut spelling).
 """
 from __future__ import annotations
 
+import collections
+
 import bigdl_tpu.nn as nn
 
 
@@ -74,43 +76,24 @@ def TransformerLM(vocab_size: int, d_model: int = 128, n_heads: int = 4,
     return m
 
 
-def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
-              temperature: float = 1.0, top_k: int = 0):
-    """KV-cached incremental decoding for a ``TransformerLM`` model.
+_LMHandles = collections.namedtuple(
+    "_LMHandles", ["mods", "n_layers", "emb", "d_model", "blocks",
+                   "block_eps", "n_heads", "hd", "ln_f", "eps_f", "head",
+                   "vocab"])
 
-    Same math as re-forwarding the whole prefix per token
-    (``models.rnn.generate``): causal attention at position i reads only
-    positions <= i, so the per-layer K/V projections are computed ONCE
-    and cached.  The entire decode — seed consumption and generation —
-    is a single ``lax.scan`` with static shapes (fixed-size caches
-    written via ``.at[i].set``), so it compiles to one TPU program with
-    no host round-trip per token; the reference's generation loop
-    (rnn/Test.scala:58-90) re-forwards the growing sentence from
-    scratch each word.
 
-    ``greedy=True`` takes the argmax; otherwise ``key`` (a JAX PRNG key)
-    drives ``jax.random.categorical`` — a different draw stream from
-    ``generate``'s host inverse-CDF, same distribution —
-    with optional ``temperature`` scaling and ``top_k`` truncation
-    (models.rnn.adjust_logprobs semantics, computed device-side).
-
-    ``seed_ids`` is a flat list of ids (returns the extended flat list)
-    or a rectangular batch of B seed rows (returns B extended rows) —
-    batched decoding shares ONE scan, with independent draws per row.
-    """
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+def _lm_handles(model):
+    """Structural handle extraction shared by ``lm_decode`` and
+    ``lm_beam_search``: walk each block for its LayerNorm/attention/
+    Linear instances (count-checked) so refactors of ``encoder_block``'s
+    container nesting fail loudly instead of silently diverging through
+    stale hard-coded param paths."""
     from bigdl_tpu.nn.attention import (MultiHeadSelfAttention,
                                         SinusoidalPositionalEncoding)
     from bigdl_tpu.nn.linear import Linear
     from bigdl_tpu.nn.moe import MoE
     from bigdl_tpu.nn.normalization import LayerNorm
 
-    # Sub-module handles are derived STRUCTURALLY (walk each block for
-    # its LayerNorm/attention/Linear instances) so refactors of
-    # encoder_block's container nesting fail loudly here instead of
-    # silently diverging through stale hard-coded param paths.
     def _walk(mod, path=()):
         yield path, mod
         for i, ch in enumerate(getattr(mod, "modules", None) or []):
@@ -131,10 +114,6 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
         raise ValueError("lm_decode expects a TransformerLM-built model "
                          "(embedding, positional encoding, blocks, final "
                          "LayerNorm, head)")
-    if not greedy and key is None:
-        raise ValueError("sampling (greedy=False) needs a PRNG key")
-    if temperature <= 0:
-        raise ValueError("temperature must be > 0")
     params = model.params()
     emb_mods = _find(mods[0], Linear)
     if len(emb_mods) != 1:
@@ -177,6 +156,91 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
     head = _param_at(params[str(3 + n_layers)],
                      head_mods[0][0])["~"]   # weight (vocab, d)
     vocab = int(head["weight"].shape[0])
+    return _LMHandles(mods, n_layers, emb, d_model, blocks, block_eps,
+                      n_heads, hd, ln_f, eps_f, head, vocab)
+
+
+def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
+    """One decode position for all rows: token ids (B,) at position i
+    with per-layer KV caches (layers, B, n_pos, H, hd) -> (log-probs
+    (B, vocab), updated caches).  The shared inner body of lm_decode and
+    lm_beam_search."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    h_ = handles
+    emb, blocks, block_eps = h_.emb, h_.blocks, h_.block_eps
+    n_heads, hd, d_model = h_.n_heads, h_.hd, h_.d_model
+    ln_f, eps_f, head = h_.ln_f, h_.eps_f, h_.head
+    kcache, vcache = caches
+    bsz = tok.shape[0]
+    scale = 1.0 / np.sqrt(hd)
+
+    def layernorm(x, p, eps):
+        mean = x.mean(axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps)
+        return (x - mean) * inv * p["~"]["weight"] + p["~"]["bias"]
+
+    x = emb["weight"][:, tok].T + emb["bias"] + pe[i]
+    for li, (ln1, m, ln2, lin1, lin2) in enumerate(blocks):
+        a = layernorm(x, ln1, block_eps[li][0])
+        q = (a @ m["wq"] + m["bq"]).reshape(bsz, n_heads, hd)
+        k = (a @ m["wk"] + m["bk"]).reshape(bsz, n_heads, hd)
+        v = (a @ m["wv"] + m["bv"]).reshape(bsz, n_heads, hd)
+        kcache = kcache.at[li, :, i].set(k)
+        vcache = vcache.at[li, :, i].set(v)
+        s = jnp.einsum("bhd,bthd->bht", q, kcache[li]) * scale
+        s = jnp.where(jnp.arange(n_pos)[None, None, :] <= i, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bht,bthd->bhd", p,
+                       vcache[li]).reshape(bsz, d_model)
+        x = x + o @ m["wo"] + m["bo"]
+        a2 = layernorm(x, ln2, block_eps[li][1])
+        h = jax.nn.relu(a2 @ lin1["weight"].T + lin1["bias"])
+        x = x + h @ lin2["weight"].T + lin2["bias"]
+    xf = ((x - x.mean(axis=-1, keepdims=True))
+          * jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps_f)
+          * ln_f["weight"] + ln_f["bias"])
+    logp = jax.nn.log_softmax(xf @ head["weight"].T + head["bias"])
+    return logp, (kcache, vcache)
+
+
+def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
+              temperature: float = 1.0, top_k: int = 0):
+    """KV-cached incremental decoding for a ``TransformerLM`` model.
+
+    Same math as re-forwarding the whole prefix per token
+    (``models.rnn.generate``): causal attention at position i reads only
+    positions <= i, so the per-layer K/V projections are computed ONCE
+    and cached.  The entire decode — seed consumption and generation —
+    is a single ``lax.scan`` with static shapes (fixed-size caches
+    written via ``.at[i].set``), so it compiles to one TPU program with
+    no host round-trip per token; the reference's generation loop
+    (rnn/Test.scala:58-90) re-forwards the growing sentence from
+    scratch each word.
+
+    ``greedy=True`` takes the argmax; otherwise ``key`` (a JAX PRNG key)
+    drives ``jax.random.categorical`` — a different draw stream from
+    ``generate``'s host inverse-CDF, same distribution —
+    with optional ``temperature`` scaling and ``top_k`` truncation
+    (models.rnn.adjust_logprobs semantics, computed device-side).
+
+    ``seed_ids`` is a flat list of ids (returns the extended flat list)
+    or a rectangular batch of B seed rows (returns B extended rows) —
+    batched decoding shares ONE scan, with independent draws per row.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if not greedy and key is None:
+        raise ValueError("sampling (greedy=False) needs a PRNG key")
+    if temperature <= 0:
+        raise ValueError("temperature must be > 0")
+    handles = _lm_handles(model)
+    mods, n_layers = handles.mods, handles.n_layers
+    n_heads, hd, vocab = handles.n_heads, handles.hd, handles.vocab
 
     if len(seed_ids) == 0:
         raise ValueError("lm_decode needs at least one seed token")
@@ -194,39 +258,13 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
     bsz, n_seed = int(seed.shape[0]), int(seed.shape[1])
     n_pos = n_seed + int(n_words) - 1      # positions fed through
     pe = jnp.asarray(mods[1].table(n_pos))
-    scale = 1.0 / np.sqrt(hd)
-
-    def layernorm(x, p, eps):
-        mean = x.mean(axis=-1, keepdims=True)
-        inv = jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps)
-        return (x - mean) * inv * p["~"]["weight"] + p["~"]["bias"]
 
     def step(carry, i):
         kcache, vcache, tok, k_rng = carry
         tok = jnp.where(i < n_seed, seed[:, jnp.minimum(i, n_seed - 1)],
                         tok)
-        x = emb["weight"][:, tok].T + emb["bias"] + pe[i]
-        for li, (ln1, m, ln2, lin1, lin2) in enumerate(blocks):
-            a = layernorm(x, ln1, block_eps[li][0])
-            q = (a @ m["wq"] + m["bq"]).reshape(bsz, n_heads, hd)
-            k = (a @ m["wk"] + m["bk"]).reshape(bsz, n_heads, hd)
-            v = (a @ m["wv"] + m["bv"]).reshape(bsz, n_heads, hd)
-            kcache = kcache.at[li, :, i].set(k)
-            vcache = vcache.at[li, :, i].set(v)
-            s = jnp.einsum("bhd,bthd->bht", q, kcache[li]) * scale
-            s = jnp.where(jnp.arange(n_pos)[None, None, :] <= i, s,
-                          -jnp.inf)
-            p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bht,bthd->bhd", p,
-                           vcache[li]).reshape(bsz, d_model)
-            x = x + o @ m["wo"] + m["bo"]
-            a2 = layernorm(x, ln2, block_eps[li][1])
-            h = jax.nn.relu(a2 @ lin1["weight"].T + lin1["bias"])
-            x = x + h @ lin2["weight"].T + lin2["bias"]
-        xf = ((x - x.mean(axis=-1, keepdims=True))
-              * jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps_f)
-              * ln_f["weight"] + ln_f["bias"])
-        logp = jax.nn.log_softmax(xf @ head["weight"].T + head["bias"])
+        logp, (kcache, vcache) = _lm_forward_one(
+            tok, i, (kcache, vcache), handles, n_pos, pe)
         if greedy:
             nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
         else:
@@ -248,6 +286,89 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
     rows = [[int(t) for t in seed_np[b]] + [int(t) for t in gen[:, b]]
             for b in range(bsz)]
     return rows[0] if flat else rows
+
+
+def lm_beam_search(model, seed_ids, n_words, beam_size: int = 4,
+                   return_all: bool = False):
+    """Beam-search decoding over the same KV-cache scan as ``lm_decode``.
+
+    Two compiled scans, no host round-trip per token: the seed is
+    consumed at batch 1 (beams share the prefix, so a K-wide seed pass
+    would be K-times redundant), the caches tile to ``beam_size`` rows,
+    and the beam scan does a joint top-k over ``beam_size * vocab``
+    continuations plus a beam-reordering gather of every layer's KV
+    cache per step.  Beams have equal length (``n_words``
+    continuations), so the winner is the highest total log-probability;
+    ``return_all=True`` additionally returns every beam's token row and
+    score, best first.
+
+    The reference has no beam search (its generation loop samples one
+    path, rnn/Test.scala:58-90); this extends the attention family's
+    decoder the TPU-native way: the beam dimension is just the batch
+    dimension of the cached decode, and reordering is a device-side
+    gather.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if beam_size < 1:
+        raise ValueError("beam_size must be >= 1")
+    seed_np = np.asarray(seed_ids, np.int32)
+    if seed_np.ndim != 1 or seed_np.size == 0:
+        raise ValueError("lm_beam_search takes one flat non-empty seed "
+                         "id list")
+    handles = _lm_handles(model)
+    mods, n_layers = handles.mods, handles.n_layers
+    n_heads, hd, vocab = handles.n_heads, handles.hd, handles.vocab
+    K = int(beam_size)
+    n_seed = int(seed_np.size)
+    n_pos = n_seed + int(n_words) - 1
+    pe = jnp.asarray(mods[1].table(n_pos))
+    seed = jnp.asarray(seed_np)
+
+    # ---- seed pass at batch 1: all beams share the prefix
+    k0 = jnp.zeros((n_layers, 1, n_pos, n_heads, hd), jnp.float32)
+
+    def seed_step(caches, i):
+        _, caches = _lm_forward_one(seed[i][None], i, caches, handles,
+                                    n_pos, pe)
+        return caches, None
+
+    (kc, vc), _ = jax.lax.scan(seed_step, (k0, jnp.zeros_like(k0)),
+                               jnp.arange(n_seed - 1))
+    kc = jnp.repeat(kc, K, axis=1)
+    vc = jnp.repeat(vc, K, axis=1)
+
+    # ---- beam scan over the generated positions
+    def step(carry, i):
+        kcache, vcache, tok, scores, gen = carry
+        logp, (kcache, vcache) = _lm_forward_one(
+            tok, i, (kcache, vcache), handles, n_pos, pe)
+        total = (scores[:, None] + logp).reshape(-1)
+        scores, flat_idx = jax.lax.top_k(total, K)
+        beam_idx = flat_idx // vocab
+        nxt = (flat_idx % vocab).astype(jnp.int32)
+        # reorder every beam-indexed carry to the surviving beams
+        kcache = kcache[:, beam_idx]
+        vcache = vcache[:, beam_idx]
+        gen = gen[beam_idx].at[:, i - (n_seed - 1)].set(nxt)
+        return (kcache, vcache, nxt, scores, gen), None
+
+    # only beam 0 is live at the first expansion, else the top-k would
+    # pick the same token K times from identical beams
+    scores0 = jnp.full((K,), -jnp.inf).at[0].set(0.0)
+    gen0 = jnp.zeros((K, int(n_words)), jnp.int32)
+    tok0 = jnp.full((K,), seed[-1], jnp.int32)
+    (_, _, _, scores, gen), _ = jax.lax.scan(
+        step, (kc, vc, tok0, scores0, gen0),
+        jnp.arange(n_seed - 1, n_pos))
+    order = np.argsort(-np.asarray(scores))
+    rows = [[int(t) for t in seed_np] + [int(t) for t in np.asarray(gen)[b]]
+            for b in order]
+    if return_all:
+        return rows, [float(scores[b]) for b in order]
+    return rows[0]
 
 
 def TransformerClassifier(class_num: int, d_model: int = 128,

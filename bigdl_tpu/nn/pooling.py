@@ -19,6 +19,7 @@ from bigdl_tpu.tensor import policy
 
 _COMPUTE_DTYPE_POOL = True  # run max pools in the policy compute dtype
 _RESHAPE_POOL = True  # exact non-overlapping max pools via reshape+max
+_SEPARABLE_POOL = False  # kxk max pool as (1,k)+(k,1) passes (A/B, r5)
 
 
 def _max_pool2d(x, window, strides, padding):
@@ -63,6 +64,20 @@ def _max_pool2d(x, window, strides, padding):
         # element — an equally valid subgradient with the same
         # per-window mass; documented in porting guide #6.
         y = xin.reshape(n, c, h // kh, kh, w // kw, kw).max(axis=(3, 5))
+    elif _SEPARABLE_POOL and kh > 1 and kw > 1:
+        # separable rectangle: max over (kh,kw) == max over rows of the
+        # max over columns; two 1-D windows whose select-and-scatter
+        # backwards each route over k elements instead of k*k
+        y = lax.reduce_window(
+            xin, np.array(-np.inf, xin.dtype), lax.max,
+            window_dimensions=(1, 1, 1, kw),
+            window_strides=(1, 1, 1, dw),
+            padding=((0, 0), (0, 0), (0, 0), padding[1]))
+        y = lax.reduce_window(
+            y, np.array(-np.inf, xin.dtype), lax.max,
+            window_dimensions=(1, 1, kh, 1),
+            window_strides=(1, 1, dh, 1),
+            padding=((0, 0), (0, 0), padding[0], (0, 0)))
     else:
         y = lax.reduce_window(
             xin, np.array(-np.inf, xin.dtype), lax.max,

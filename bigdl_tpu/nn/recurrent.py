@@ -185,6 +185,35 @@ class Recurrent(Container):
             xs = jnp.flip(xs, axis=0)
         key = ctx.next_key() if ctx.training else jax.random.PRNGKey(0)
 
+        p = policy()
+        use_pallas = (_PALLAS_BILSTM
+                      and type(cell) is LSTMCell  # not subclasses: their
+                      # overridden _step would silently be bypassed
+                      and (self.bptt_truncate <= 0
+                           or self.bptt_truncate >= t)
+                      and p.output_dtype == jnp.float32
+                      and (_PALLAS_BILSTM == "interpret"
+                           or jax.default_backend() == "tpu"))
+        if use_pallas:
+            # single-direction case of the same VMEM-carry kernel pair
+            # that earned the Bi-LSTM 2.3x (PERF_NOTES round 5): hoist
+            # the input projection to one MXU matmul, run the
+            # recurrence with a direction dim of 1.  The key drawn
+            # above keeps the ctx stream identical to the scan path
+            # (LSTMCell._step ignores its per-step keys).
+            from bigdl_tpu.ops.pallas_kernels import bilstm_recurrence
+            d = cell.input_size
+            wx = p.cast_compute(cp["w"][:, :d].T)     # (D, 4H)
+            wh = p.cast_compute(cp["w"][:, d:].T)     # (H, 4H)
+            zx = (jnp.matmul(p.cast_compute(xs), wx,
+                             preferred_element_type=jnp.float32)
+                  + cp["bias"])                       # (T, N, 4H)
+            outs = bilstm_recurrence(zx[:, None], wh[None],
+                                     _PALLAS_BILSTM == "interpret")[:, 0]
+            if self.reverse:
+                outs = jnp.flip(outs, axis=0)
+            return jnp.swapaxes(outs, 0, 1), state
+
         def step(carry, x_t):
             h, k = carry
             k, sub = jax.random.split(k)

@@ -461,7 +461,7 @@ def _bilstm_fwd_body(zx_ref, wht_ref, h_ref, c_ref, h_scr, c_scr):
         c_scr[...] = jnp.zeros_like(c_scr)
 
     hdim = h_scr.shape[-1]
-    for d in range(2):
+    for d in range(h_scr.shape[0]):  # static direction count (1 or 2)
         z = zx_ref[0, d].astype(jnp.float32) + jnp.dot(
             h_scr[d].astype(wht_ref.dtype), wht_ref[d],
             preferred_element_type=jnp.float32)
@@ -501,7 +501,7 @@ def _bilstm_bwd_kernel(zx_ref, hprev_ref, c_ref, cprev_ref, g_ref,
         dwh_scr[...] = jnp.zeros_like(dwh_scr)
 
     hdim = dh_scr.shape[-1]
-    for d in range(2):
+    for d in range(dh_scr.shape[0]):
         hprev = hprev_ref[0, d]
         z = zx_ref[0, d].astype(jnp.float32) + jnp.dot(
             hprev.astype(wht_ref.dtype), wht_ref[d],
@@ -535,64 +535,65 @@ def _shift_prev(xs):
 
 @functools.partial(jax.jit, static_argnames=("interpret", "with_c"))
 def _bilstm_fwd_call(zx, wht, interpret=False, with_c=True):
-    t, _, b, h4 = zx.shape
+    t, nd, b, h4 = zx.shape
     h = h4 // 4
-    out_spec = pl.BlockSpec((1, 2, b, h), lambda i: (i, 0, 0, 0),
+    out_spec = pl.BlockSpec((1, nd, b, h), lambda i: (i, 0, 0, 0),
                             memory_space=pltpu.VMEM)
-    out_shape = jax.ShapeDtypeStruct((t, 2, b, h), jnp.float32)
+    out_shape = jax.ShapeDtypeStruct((t, nd, b, h), jnp.float32)
     return pl.pallas_call(
         _bilstm_fwd_kernel if with_c else _bilstm_fwd_kernel_primal,
         grid=(t,),
         in_specs=[
-            pl.BlockSpec((1, 2, b, h4), lambda i: (i, 0, 0, 0),
+            pl.BlockSpec((1, nd, b, h4), lambda i: (i, 0, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((2, h, h4), lambda i: (0, 0, 0),
+            pl.BlockSpec((nd, h, h4), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[out_spec, out_spec] if with_c else out_spec,
         out_shape=[out_shape, out_shape] if with_c else out_shape,
-        scratch_shapes=[pltpu.VMEM((2, b, h), jnp.float32),
-                        pltpu.VMEM((2, b, h), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((nd, b, h), jnp.float32),
+                        pltpu.VMEM((nd, b, h), jnp.float32)],
         interpret=interpret,
     )(zx, wht)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _bilstm_bwd_call(zx, wht, hs, cs, gout, interpret=False):
-    t, _, b, h4 = zx.shape
+    t, nd, b, h4 = zx.shape
     h = h4 // 4
     rev = lambda i: (t - 1 - i, 0, 0, 0)
     return pl.pallas_call(
         _bilstm_bwd_kernel,
         grid=(t,),
         in_specs=[
-            pl.BlockSpec((1, 2, b, h4), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2, b, h), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2, b, h), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2, b, h), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2, b, h), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((2, h, h4), lambda i: (0, 0, 0),
+            pl.BlockSpec((1, nd, b, h4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, h, h4), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, 2, b, h4), rev, memory_space=pltpu.VMEM),
-            pl.BlockSpec((2, h, h4), lambda i: (0, 0, 0),
+            pl.BlockSpec((1, nd, b, h4), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, h, h4), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_shape=[jax.ShapeDtypeStruct((t, 2, b, h4), jnp.float32),
-                   jax.ShapeDtypeStruct((2, h, h4), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM((2, b, h), jnp.float32),
-                        pltpu.VMEM((2, b, h), jnp.float32),
-                        pltpu.VMEM((2, h, h4), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((t, nd, b, h4), jnp.float32),
+                   jax.ShapeDtypeStruct((nd, h, h4), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((nd, b, h), jnp.float32),
+                        pltpu.VMEM((nd, b, h), jnp.float32),
+                        pltpu.VMEM((nd, h, h4), jnp.float32)],
         interpret=interpret,
     )(zx, _shift_prev(hs), cs, _shift_prev(cs), gout, wht)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def bilstm_recurrence(zx, wht, interpret=False):
-    """Direction-batched LSTM recurrence: zx (T, 2, B, 4H) hoisted input
-    projection (+bias), wht (2, H, 4H) recurrent weights; returns the
-    h stack (T, 2, B, H) f32.  Same math as the lax.scan body in
+    """Direction-batched LSTM recurrence: zx (T, D, B, 4H) hoisted input
+    projection (+bias) with D directions (1 = plain Recurrent, 2 =
+    BiRecurrent), wht (D, H, 4H) recurrent weights; returns the h stack
+    (T, D, B, H) f32.  Same math as the lax.scan body in
     Recurrent._apply_fused_lstm (forward bit-exact; gradients equal up
     to f32 accumulation order)."""
     # primal-only: skip the c-stack output — it is a VJP residual, and

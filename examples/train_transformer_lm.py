@@ -37,6 +37,10 @@ def main(argv=None):
                    help="generate via the KV-cached single-scan decoder "
                         "(models.transformer.lm_decode) instead of "
                         "re-forwarding the prefix per word")
+    p.add_argument("--beamSize", type=int, default=0,
+                   help="> 0: beam-search the continuation instead of "
+                        "sampling (models.transformer.lm_beam_search; "
+                        "implies the KV-cached scan)")
     args = p.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO)
@@ -76,7 +80,11 @@ def main(argv=None):
 
     if args.numOfWords > 0:
         seed = [dictionary.index(w) for w in tokenized[0]]
-        if args.fastDecode:
+        if args.beamSize > 0:
+            from bigdl_tpu.models.transformer import lm_beam_search
+            ids = lm_beam_search(model, seed, args.numOfWords,
+                                 beam_size=args.beamSize)
+        elif args.fastDecode:
             # one lax.scan with per-layer KV caches: no O(T^2) prefix
             # re-forward, no host round-trip per token
             import jax

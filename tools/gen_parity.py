@@ -112,7 +112,13 @@ numpy fallback, the MKL.java discovery/fallback role).
 
 Shard streaming (SeqFileFolder/ImageNetSeqFileGenerator roles):
 `bigdl_tpu/dataset/shardfile.py`, `bigdl_tpu/dataset/imagenet_tools.py`,
-`DataSet.seq_file_folder`.  20-newsgroups + GloVe ingestion (the Python
+`DataSet.seq_file_folder` — which, as of round 5, also ingests ACTUAL
+Hadoop SequenceFiles in the reference's wire format
+(`bigdl_tpu/dataset/seqfile.py`: version-6 reader/writer,
+BGRImgToLocalSeqFile/LocalSeqFileToBytes/SeqBytesToBGRImg transformers,
+readLabel/readName key semantics, class_num filter — ref
+DataSet.scala:384-455, BGRImgToLocalSeqFile.scala,
+LocalSeqFileToBytes.scala).  20-newsgroups + GloVe ingestion (the Python
 news20.py role): `bigdl_tpu/dataset/news20.py` (offline, pre-extracted
 trees).  Built-in readers: `bigdl_tpu/dataset/mnist.py`,
 `bigdl_tpu/dataset/cifar.py`.
