@@ -20,6 +20,7 @@ from bigdl_tpu.tensor import policy
 _COMPUTE_DTYPE_POOL = True  # run max pools in the policy compute dtype
 _RESHAPE_POOL = True  # exact non-overlapping max pools via reshape+max
 _SEPARABLE_POOL = False  # kxk max pool as (1,k)+(k,1) passes (A/B, r5)
+_NHWC_POOL = False  # windowed pools transposed to NHWC (A/B, r5)
 
 
 def _max_pool2d(x, window, strides, padding):
@@ -78,6 +79,17 @@ def _max_pool2d(x, window, strides, padding):
             window_dimensions=(1, 1, kh, 1),
             window_strides=(1, 1, dh, 1),
             padding=((0, 0), (0, 0), padding[0], (0, 0)))
+    elif _NHWC_POOL:
+        # channels on the 128-wide lane dim instead of the (often
+        # half-empty) W dim: the select-and-scatter backward is the
+        # zero-FLOP bandwidth sink these layouts decide
+        y = lax.reduce_window(
+            xin.transpose(0, 2, 3, 1), np.array(-np.inf, xin.dtype),
+            lax.max,
+            window_dimensions=(1, kh, kw, 1),
+            window_strides=(1, dh, dw, 1),
+            padding=((0, 0),) + padding + ((0, 0),))
+        y = y.transpose(0, 3, 1, 2)
     else:
         y = lax.reduce_window(
             xin, np.array(-np.inf, xin.dtype), lax.max,

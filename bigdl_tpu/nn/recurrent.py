@@ -186,14 +186,31 @@ class Recurrent(Container):
         key = ctx.next_key() if ctx.training else jax.random.PRNGKey(0)
 
         p = policy()
+        interp = _PALLAS_BILSTM == "interpret"
         use_pallas = (_PALLAS_BILSTM
-                      and type(cell) is LSTMCell  # not subclasses: their
-                      # overridden _step would silently be bypassed
+                      # exact types only: a subclass's overridden _step
+                      # would silently be bypassed
+                      and type(cell) in (LSTMCell, GRUCell)
                       and (self.bptt_truncate <= 0
                            or self.bptt_truncate >= t)
                       and p.output_dtype == jnp.float32
-                      and (_PALLAS_BILSTM == "interpret"
-                           or jax.default_backend() == "tpu"))
+                      and (interp or jax.default_backend() == "tpu"))
+        if use_pallas and type(cell) is GRUCell:
+            # GRU case of the VMEM-carry kernel pattern
+            # (ops/pallas_kernels.gru_recurrence): hoist the two input
+            # projections, run the recurrence with a direction dim of 1.
+            # GRUCell._step computes in f32 (no policy cast) — so does
+            # the kernel.
+            from bigdl_tpu.ops.pallas_kernels import gru_recurrence
+            d = cell.input_size
+            zrz = jnp.matmul(xs, cp["w_rz"][:, :d].T) + cp["b_rz"]
+            zn = jnp.matmul(xs, cp["w_h"][:, :d].T) + cp["b_h"]
+            outs = gru_recurrence(zrz[:, None], zn[:, None],
+                                  cp["w_rz"][:, d:].T[None],
+                                  cp["w_h"][:, d:].T[None], interp)[:, 0]
+            if self.reverse:
+                outs = jnp.flip(outs, axis=0)
+            return jnp.swapaxes(outs, 0, 1), state
         if use_pallas:
             # single-direction case of the same VMEM-carry kernel pair
             # that earned the Bi-LSTM 2.3x (PERF_NOTES round 5): hoist
@@ -208,8 +225,7 @@ class Recurrent(Container):
             zx = (jnp.matmul(p.cast_compute(xs), wx,
                              preferred_element_type=jnp.float32)
                   + cp["bias"])                       # (T, N, 4H)
-            outs = bilstm_recurrence(zx[:, None], wh[None],
-                                     _PALLAS_BILSTM == "interpret")[:, 0]
+            outs = bilstm_recurrence(zx[:, None], wh[None], interp)[:, 0]
             if self.reverse:
                 outs = jnp.flip(outs, axis=0)
             return jnp.swapaxes(outs, 0, 1), state

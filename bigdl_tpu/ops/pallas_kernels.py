@@ -616,3 +616,167 @@ def _bilstm_vjp_bwd(interpret, res, gout):
 
 
 bilstm_recurrence.defvjp(_bilstm_vjp_fwd, _bilstm_vjp_bwd)
+
+
+# ------------------------------------------------------------------- GRU
+#
+# Same sequential-grid/VMEM-carry structure as the LSTM pair, for the
+# GRU cell (two recurrent gemms per step: the r/z gates and the
+# r-gated candidate — GRUCell._step's math exactly, f32 like the cell).
+
+
+def _gru_gates(zrz_t, zn_t, h, wrz_ref, wh_ref):
+    hdim = h.shape[-1]
+    rz = jax.nn.sigmoid(zrz_t + jnp.dot(
+        h, wrz_ref, preferred_element_type=jnp.float32))
+    r, z = rz[:, :hdim], rz[:, hdim:]
+    n = jnp.tanh(zn_t + jnp.dot(
+        r * h, wh_ref, preferred_element_type=jnp.float32))
+    return r, z, n
+
+
+def _gru_fwd_kernel(zrz_ref, zn_ref, wrz_ref, wh_ref, h_ref, h_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = jnp.zeros_like(h_scr)
+
+    for d in range(h_scr.shape[0]):
+        h = h_scr[d]
+        r, z, n = _gru_gates(zrz_ref[0, d].astype(jnp.float32),
+                             zn_ref[0, d].astype(jnp.float32),
+                             h, wrz_ref[d], wh_ref[d])
+        h_new = (1.0 - z) * n + z * h
+        h_scr[d] = h_new
+        h_ref[0, d] = h_new
+
+
+def _gru_bwd_kernel(zrz_ref, zn_ref, hprev_ref, g_ref, wrz_ref, wh_ref,
+                    dzrz_ref, dzn_ref, dwrz_ref, dwh_ref,
+                    dh_scr, dwrz_scr, dwh_scr):
+    """Reverse-time step: recompute r/z/n from the hoisted projections
+    and h_{t-1} (pre-shifted), fold the carried dh and this step's
+    output cotangent into dzrz_t/dzn_t, accumulate both weight grads."""
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+        dwrz_scr[...] = jnp.zeros_like(dwrz_scr)
+        dwh_scr[...] = jnp.zeros_like(dwh_scr)
+
+    for d in range(dh_scr.shape[0]):
+        hprev = hprev_ref[0, d]
+        r, z, n = _gru_gates(zrz_ref[0, d].astype(jnp.float32),
+                             zn_ref[0, d].astype(jnp.float32),
+                             hprev, wrz_ref[d], wh_ref[d])
+        dh_total = g_ref[0, d] + dh_scr[d]
+        dz = dh_total * (hprev - n)
+        dn_pre = dh_total * (1.0 - z) * (1.0 - n * n)
+        drh = jnp.dot(dn_pre, wh_ref[d].T,
+                      preferred_element_type=jnp.float32)
+        dr_pre = drh * hprev * r * (1.0 - r)
+        dz_pre = dz * z * (1.0 - z)
+        dzrz = jnp.concatenate([dr_pre, dz_pre], axis=-1)
+        dzrz_ref[0, d] = dzrz
+        dzn_ref[0, d] = dn_pre
+        dh_scr[d] = (dh_total * z + drh * r
+                     + jnp.dot(dzrz, wrz_ref[d].T,
+                               preferred_element_type=jnp.float32))
+        dwrz_scr[d] += jnp.dot(hprev.T, dzrz,
+                               preferred_element_type=jnp.float32)
+        dwh_scr[d] += jnp.dot((r * hprev).T, dn_pre,
+                              preferred_element_type=jnp.float32)
+    dwrz_ref[...] = dwrz_scr[...]
+    dwh_ref[...] = dwh_scr[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gru_fwd_call(zrz, zn, wrz, wh, interpret=False):
+    t, nd, b, h2 = zrz.shape
+    h = h2 // 2
+    return pl.pallas_call(
+        _gru_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, nd, b, h2), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, h, h2), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, h, h), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, nd, b, h), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t, nd, b, h), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((nd, b, h), jnp.float32)],
+        interpret=interpret,
+    )(zrz, zn, wrz, wh)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gru_bwd_call(zrz, zn, wrz, wh, hs, gout, interpret=False):
+    t, nd, b, h2 = zrz.shape
+    h = h2 // 2
+    rev = lambda i: (t - 1 - i, 0, 0, 0)
+    wspec2 = pl.BlockSpec((nd, h, h2), lambda i: (0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    wspec1 = pl.BlockSpec((nd, h, h), lambda i: (0, 0, 0),
+                          memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, nd, b, h2), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            wspec2,
+            wspec1,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, nd, b, h2), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            wspec2,
+            wspec1,
+        ],
+        out_shape=[jax.ShapeDtypeStruct((t, nd, b, h2), jnp.float32),
+                   jax.ShapeDtypeStruct((t, nd, b, h), jnp.float32),
+                   jax.ShapeDtypeStruct((nd, h, h2), jnp.float32),
+                   jax.ShapeDtypeStruct((nd, h, h), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((nd, b, h), jnp.float32),
+                        pltpu.VMEM((nd, h, h2), jnp.float32),
+                        pltpu.VMEM((nd, h, h), jnp.float32)],
+        interpret=interpret,
+    )(zrz, zn, _shift_prev(hs), gout, wrz, wh)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def gru_recurrence(zrz, zn, wrz, wh, interpret=False):
+    """GRU recurrence with VMEM-resident carry: zrz (T, D, B, 2H) and zn
+    (T, D, B, H) hoisted input projections (+bias), wrz (D, H, 2H) and
+    wh (D, H, H) recurrent weights, D directions in {1, 2}; returns the
+    h stack (T, D, B, H) f32.  Same math as GRUCell._step under
+    Recurrent's scan; backward recomputes the gates (residual = the h
+    stack the forward writes anyway)."""
+    return _gru_fwd_call(zrz, zn, wrz, wh, interpret=interpret)
+
+
+def _gru_vjp_fwd(zrz, zn, wrz, wh, interpret=False):
+    hs = _gru_fwd_call(zrz, zn, wrz, wh, interpret=interpret)
+    return hs, (zrz, zn, wrz, wh, hs)
+
+
+def _gru_vjp_bwd(interpret, res, gout):
+    zrz, zn, wrz, wh, hs = res
+    dzrz, dzn, dwrz, dwh = _gru_bwd_call(
+        zrz, zn, wrz, wh, hs, gout.astype(jnp.float32),
+        interpret=interpret)
+    return (dzrz.astype(zrz.dtype), dzn.astype(zn.dtype),
+            dwrz.astype(wrz.dtype), dwh.astype(wh.dtype))
+
+
+gru_recurrence.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)

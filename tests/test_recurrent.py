@@ -193,18 +193,21 @@ def test_bilstm_pallas_recurrence_matches_scan():
                                    rtol=1e-4, atol=1e-5)
 
 
-def test_single_direction_lstm_pallas_matches_scan():
-    """Recurrent(LSTMCell) — the single-direction case of the kernel
-    pair — must match the lax.scan path (outputs, grads, key stream),
-    forward and reverse."""
+@pytest.mark.parametrize("cell_cls", ["lstm", "gru"])
+def test_single_direction_pallas_matches_scan(cell_cls):
+    """Recurrent(LSTMCell/GRUCell) — the single-direction case of the
+    kernel pairs — must match the lax.scan path (outputs, grads, key
+    stream), forward and reverse."""
     from bigdl_tpu.nn import recurrent as rec
     from bigdl_tpu.nn.module import Context
     import jax
 
     from bigdl_tpu.utils.random import set_seed
+    make_cell = (lambda: nn.LSTMCell(6, 5)) if cell_cls == "lstm" \
+        else (lambda: nn.GRUCell(6, 5))
     for reverse in (False, True):
         set_seed(7)
-        m = nn.Recurrent(reverse=reverse).add(nn.LSTMCell(6, 5))
+        m = nn.Recurrent(reverse=reverse).add(make_cell())
         x = jnp.asarray(np.random.RandomState(3).randn(4, 9, 6),
                         np.float32)
         params, state = m.params(), m.state()
