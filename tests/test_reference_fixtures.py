@@ -134,6 +134,20 @@ class TestShippedImageFolders:
         assert {lab for _, lab in records} == {1.0, 2.0, 3.0, 4.0}
 
 
+class TestShippedMnistIdx:
+    def test_idx_label_reader_on_shipped_file(self):
+        """The reference ships the REAL MNIST t10k label file
+        (resources/mnist/t10k-labels.idx1-ubyte); the idx reader must
+        parse it and reproduce the canonical label sequence."""
+        from bigdl_tpu.dataset.mnist import load_labels
+        labels = load_labels(os.path.join(REF_RES, "mnist",
+                                          "t10k-labels.idx1-ubyte"))
+        assert labels.shape == (10000,)
+        # the first ten t10k labels, as published with MNIST itself
+        assert list(labels[:10]) == [7, 2, 1, 0, 4, 1, 4, 9, 5, 9]
+        assert set(np.unique(labels)) == set(range(10))
+
+
 class TestRealDataAccuracy:
     """End-to-end accuracy on reference-shipped image files (the role of
     ref models/lenet/Test.scala / ModelValidator.scala:114-146): decode
