@@ -26,14 +26,24 @@ from bigdl_tpu.nn.activations import Tanh
 from bigdl_tpu.tensor import policy
 from bigdl_tpu.utils.table import Table
 
-# Bi-LSTM recurrence through the Pallas kernel pair on TPU (2.3x the
-# scan's autodiff, ops/pallas_kernels.bilstm_recurrence — PERF_NOTES
-# round 5).  False = lax.scan everywhere; "interpret" forces the kernel
-# through the Pallas interpreter on any backend (tests).  The kernel
-# computes gates/carries in f32, so it only replaces the scan when the
-# policy's output dtype is f32 (FP32/BF16_COMPUTE); BF16_ACT keeps the
-# scan, whose gates round through bf16.
+# LSTM/GRU recurrence through the Pallas kernel pairs on TPU (2.3-4x
+# the scan's autodiff, ops/pallas_kernels — PERF_NOTES round 5).
+# False = lax.scan everywhere; "interpret" forces the kernels through
+# the Pallas interpreter on any backend (tests).  The kernels compute
+# gates/carries in f32, so they only replace the scan when the policy's
+# output dtype is f32 (FP32/BF16_COMPUTE); BF16_ACT keeps the scan,
+# whose gates round through bf16.
 _PALLAS_BILSTM = True
+
+
+def _pallas_gate():
+    """(use, interpret) — the ONE activation gate for the fused
+    recurrence kernels, shared by every dispatch site."""
+    interp = _PALLAS_BILSTM == "interpret"
+    use = (bool(_PALLAS_BILSTM)
+           and policy().output_dtype == jnp.float32
+           and (interp or jax.default_backend() == "tpu"))
+    return use, interp
 
 
 class Cell(Module):
@@ -186,15 +196,13 @@ class Recurrent(Container):
         key = ctx.next_key() if ctx.training else jax.random.PRNGKey(0)
 
         p = policy()
-        interp = _PALLAS_BILSTM == "interpret"
-        use_pallas = (_PALLAS_BILSTM
+        gate, interp = _pallas_gate()
+        use_pallas = (gate
                       # exact types only: a subclass's overridden _step
                       # would silently be bypassed
                       and type(cell) in (LSTMCell, GRUCell)
                       and (self.bptt_truncate <= 0
-                           or self.bptt_truncate >= t)
-                      and p.output_dtype == jnp.float32
-                      and (interp or jax.default_backend() == "tpu"))
+                           or self.bptt_truncate >= t))
         if use_pallas and type(cell) is GRUCell:
             # GRU case of the VMEM-carry kernel pattern
             # (ops/pallas_kernels.gru_recurrence): hoist the two input
@@ -268,17 +276,30 @@ class BiRecurrent(Container):
         self.add(Recurrent(bptt_truncate).add(cell_fwd))
         self.add(Recurrent(bptt_truncate, reverse=True).add(cell_bwd))
 
-    def _fused_lstm_eligible(self):
+    def _cells_eligible(self, cell_type):
+        """Both children hold exactly ``cell_type`` with matching sizes
+        and no truncation — the structural half of fused eligibility."""
         cf = self.modules[0].cell
         cb = self.modules[1].cell
-        return (type(cf) is LSTMCell and type(cb) is LSTMCell
+        return (type(cf) is cell_type and type(cb) is cell_type
                 and cf.input_size == cb.input_size
                 and cf.hidden_size == cb.hidden_size
                 and self.modules[0].bptt_truncate <= 0
                 and self.modules[1].bptt_truncate <= 0)
 
+    def _fused_lstm_eligible(self):
+        return self._cells_eligible(LSTMCell)
+
+    def _fused_gru_eligible(self):
+        # no scan form of the fused GRU exists: the kernels must be
+        # usable, so the gate joins the structural check
+        return self._cells_eligible(GRUCell) and _pallas_gate()[0]
+
     def apply(self, params, x, state, ctx):
-        if self._fused_lstm_eligible():
+        fused = (self._apply_fused_lstm if self._fused_lstm_eligible()
+                 else self._apply_fused_gru if self._fused_gru_eligible()
+                 else None)
+        if fused is not None:
             if ctx.training:
                 # consume exactly the two keys the two-scan path draws
                 # (one per Recurrent.apply): a model with stochastic
@@ -286,12 +307,46 @@ class BiRecurrent(Container):
                 # key stream whichever path runs
                 ctx.next_key()
                 ctx.next_key()
-            y = self._apply_fused_lstm(params, x, ctx)
-            return y, state
+            return fused(params, x, ctx), state
         yf, sf = self.modules[0].apply(params["0"], x, state["0"], ctx)
         yb, sb = self.modules[1].apply(params["1"], x, state["1"], ctx)
         y = jnp.concatenate([yf, yb], axis=-1) if self.merge == "concat" else yf + yb
         return y, {"~": state.get("~", {}), "0": sf, "1": sb}
+
+    def _apply_fused_gru(self, params, x, ctx):
+        """Both GRU directions through ONE direction-batched kernel pair
+        (ops/pallas_kernels.gru_recurrence, nd=2) with the two input
+        projections hoisted to batched MXU matmuls — the GRU analogue of
+        _apply_fused_lstm, half the kernel dispatches of two nd=1
+        Recurrent applies.  GRUCell math is f32 (no policy cast)."""
+        cf = self.modules[0].cell
+        from bigdl_tpu.ops.pallas_kernels import gru_recurrence
+        d = cf.input_size
+        xs = jnp.swapaxes(x, 0, 1)                        # (T, N, D)
+        xs2 = jnp.stack([xs, jnp.flip(xs, axis=0)], axis=1)  # (T, 2, N, D)
+        wrz2 = jnp.stack([params["0"]["0"]["~"]["w_rz"],
+                          params["1"]["0"]["~"]["w_rz"]])  # (2, 2H, D+H)
+        wh2 = jnp.stack([params["0"]["0"]["~"]["w_h"],
+                         params["1"]["0"]["~"]["w_h"]])    # (2, H, D+H)
+        brz2 = jnp.stack([params["0"]["0"]["~"]["b_rz"],
+                          params["1"]["0"]["~"]["b_rz"]])
+        bh2 = jnp.stack([params["0"]["0"]["~"]["b_h"],
+                         params["1"]["0"]["~"]["b_h"]])
+        # batched input projections over (dir, time*batch)
+        zrz = lax.dot_general(xs2, jnp.swapaxes(wrz2[:, :, :d], 1, 2),
+                              (((3,), (1,)), ((1,), (0,))))
+        zrz = jnp.swapaxes(zrz, 0, 1) + brz2[:, None]     # (T, 2, N, 2H)
+        zn = lax.dot_general(xs2, jnp.swapaxes(wh2[:, :, :d], 1, 2),
+                             (((3,), (1,)), ((1,), (0,))))
+        zn = jnp.swapaxes(zn, 0, 1) + bh2[:, None]        # (T, 2, N, H)
+        outs = gru_recurrence(zrz, zn,
+                              jnp.swapaxes(wrz2[:, :, d:], 1, 2),
+                              jnp.swapaxes(wh2[:, :, d:], 1, 2),
+                              _pallas_gate()[1])
+        yf = jnp.swapaxes(outs[:, 0], 0, 1)               # (N, T, H)
+        yb = jnp.swapaxes(jnp.flip(outs[:, 1], axis=0), 0, 1)
+        return (jnp.concatenate([yf, yb], axis=-1)
+                if self.merge == "concat" else yf + yb)
 
     def _apply_fused_lstm(self, params, x, ctx):
         """Both directions in ONE scan with the input projection hoisted
@@ -351,10 +406,7 @@ class BiRecurrent(Container):
             out = h_new.astype(p.compute_dtype) if reduced else h_new
             return hc, out
 
-        use_pallas = (_PALLAS_BILSTM
-                      and p.output_dtype == jnp.float32
-                      and (_PALLAS_BILSTM == "interpret"
-                           or jax.default_backend() == "tpu"))
+        use_pallas, interp = _pallas_gate()
         if use_pallas:
             # whole-recurrence Pallas kernel pair (fwd + hand-derived
             # bwd), carries resident in VMEM across steps: 2.3x faster
@@ -364,7 +416,6 @@ class BiRecurrent(Container):
             # forward bit-exact vs the scan body; grads differ by f32
             # accumulation order.
             from bigdl_tpu.ops.pallas_kernels import bilstm_recurrence
-            interp = _PALLAS_BILSTM == "interpret"
             outs = bilstm_recurrence(zx, wh, interp)       # (T, 2, N, H)
             if reduced:
                 outs = outs.astype(p.compute_dtype)

@@ -246,6 +246,57 @@ def test_single_direction_pallas_matches_scan(cell_cls):
                                        rtol=1e-4, atol=1e-5)
 
 
+def test_bigru_fused_matches_two_apply():
+    """BiRecurrent(GRUCell) through the direction-batched kernel pair
+    (forced interpreter) must match the two-child path — outputs,
+    gradients, and ctx key consumption."""
+    from bigdl_tpu.nn import recurrent as rec
+    from bigdl_tpu.nn.module import Context
+    import jax
+
+    from bigdl_tpu.utils.random import set_seed
+    for merge in ("concat", "add"):
+        set_seed(9)
+        m = nn.BiRecurrent(nn.GRUCell(6, 5), nn.GRUCell(6, 5), merge=merge)
+        x = jnp.asarray(np.random.RandomState(4).randn(3, 7, 6),
+                        np.float32)
+        params, state = m.params(), m.state()
+
+        def run(flag):
+            old = rec._PALLAS_BILSTM
+            rec._PALLAS_BILSTM = flag
+            try:
+                keys = []
+
+                class Ctx(Context):
+                    def next_key(self):
+                        k = super().next_key()
+                        keys.append(k)
+                        return k
+
+                y, _ = m.apply(params, x, state,
+                               Ctx(training=True,
+                                   key=jax.random.PRNGKey(0)))
+                g = jax.grad(lambda p: (m.apply(
+                    p, x, state,
+                    Context(training=False,
+                            key=jax.random.PRNGKey(0)))[0] ** 2).sum()
+                )(params)
+            finally:
+                rec._PALLAS_BILSTM = old
+            return y, g, len(keys)
+
+        y_s, g_s, nk_s = run(False)
+        y_p, g_p, nk_p = run("interpret")
+        assert nk_p == nk_s
+        np.testing.assert_allclose(np.asarray(y_p), np.asarray(y_s),
+                                   rtol=1e-5, atol=1e-6)
+        for l1, l2 in zip(jax.tree_util.tree_leaves(g_p),
+                          jax.tree_util.tree_leaves(g_s)):
+            np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                       rtol=1e-4, atol=1e-5)
+
+
 def test_bilstm_fused_preserves_downstream_key_stream():
     """The fused Bi-LSTM path must consume the same number of ctx keys as
     the two-scan path (one per Recurrent.apply), so stochastic layers
