@@ -200,9 +200,24 @@ class Recurrent(Container):
         use_pallas = (gate
                       # exact types only: a subclass's overridden _step
                       # would silently be bypassed
-                      and type(cell) in (LSTMCell, GRUCell)
+                      and (type(cell) in (LSTMCell, GRUCell)
+                           or (type(cell) is RnnCell
+                               and type(cell.activation) is Tanh))
                       and (self.bptt_truncate <= 0
                            or self.bptt_truncate >= t))
+        if use_pallas and type(cell) is RnnCell:
+            # vanilla tanh RNN (the reference's own RnnCell) through the
+            # same pattern; backward reuses the stored h stack directly
+            from bigdl_tpu.ops.pallas_kernels import rnn_recurrence
+            zx = (jnp.matmul(p.cast_compute(xs),
+                             p.cast_compute(cp["i2h"].T),
+                             preferred_element_type=jnp.float32)
+                  + cp["bias_i"] + cp["bias_h"])      # (T, N, H)
+            wh = p.cast_compute(cp["h2h"].T)          # (H, H)
+            outs = rnn_recurrence(zx[:, None], wh[None], interp)[:, 0]
+            if self.reverse:
+                outs = jnp.flip(outs, axis=0)
+            return jnp.swapaxes(outs, 0, 1), state
         if use_pallas and type(cell) is GRUCell:
             # GRU case of the VMEM-carry kernel pattern
             # (ops/pallas_kernels.gru_recurrence): hoist the two input

@@ -780,3 +780,119 @@ def _gru_vjp_bwd(interpret, res, gout):
 
 
 gru_recurrence.defvjp(_gru_vjp_fwd, _gru_vjp_bwd)
+
+
+# ------------------------------------------------------------ vanilla RNN
+#
+# h' = tanh(zx_t + h @ Wh) — the reference's own RnnCell (RNN.scala:28)
+# through the same sequential-grid/VMEM-carry structure.  The backward
+# needs no gate recompute at all: dz = dh_total * (1 - h_t^2) comes
+# straight from the stored h stack.
+
+
+def _rnn_fwd_kernel(zx_ref, wht_ref, h_ref, h_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[...] = jnp.zeros_like(h_scr)
+
+    for d in range(h_scr.shape[0]):
+        z = zx_ref[0, d].astype(jnp.float32) + jnp.dot(
+            h_scr[d].astype(wht_ref.dtype), wht_ref[d],
+            preferred_element_type=jnp.float32)
+        h_new = jnp.tanh(z)
+        h_scr[d] = h_new
+        h_ref[0, d] = h_new
+
+
+def _rnn_bwd_kernel(h_ref, hprev_ref, g_ref, wht_ref, dzx_ref, dwh_ref,
+                    dh_scr, dwh_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        dh_scr[...] = jnp.zeros_like(dh_scr)
+        dwh_scr[...] = jnp.zeros_like(dwh_scr)
+
+    for d in range(dh_scr.shape[0]):
+        h_t = h_ref[0, d]
+        dz = (g_ref[0, d] + dh_scr[d]) * (1.0 - h_t * h_t)
+        dzx_ref[0, d] = dz
+        dh_scr[d] = jnp.dot(dz.astype(wht_ref.dtype), wht_ref[d].T,
+                            preferred_element_type=jnp.float32)
+        dwh_scr[d] += jnp.dot(hprev_ref[0, d].T, dz,
+                              preferred_element_type=jnp.float32)
+    dwh_ref[...] = dwh_scr[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _rnn_fwd_call(zx, wht, interpret=False):
+    t, nd, b, h = zx.shape
+    return pl.pallas_call(
+        _rnn_fwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, nd, b, h), lambda i: (i, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, h, h), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, nd, b, h), lambda i: (i, 0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t, nd, b, h), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((nd, b, h), jnp.float32)],
+        interpret=interpret,
+    )(zx, wht)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _rnn_bwd_call(wht, hs, gout, interpret=False):
+    t, nd, b, h = hs.shape
+    rev = lambda i: (t - 1 - i, 0, 0, 0)
+    return pl.pallas_call(
+        _rnn_bwd_kernel,
+        grid=(t,),
+        in_specs=[
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, h, h), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, nd, b, h), rev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((nd, h, h), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((t, nd, b, h), jnp.float32),
+                   jax.ShapeDtypeStruct((nd, h, h), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((nd, b, h), jnp.float32),
+                        pltpu.VMEM((nd, h, h), jnp.float32)],
+        interpret=interpret,
+    )(hs, _shift_prev(hs), gout, wht)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rnn_recurrence(zx, wht, interpret=False):
+    """Vanilla tanh-RNN recurrence with VMEM-resident carry: zx
+    (T, D, B, H) hoisted input projection (+both biases), wht (D, H, H)
+    recurrent weights, D directions in {1, 2}; returns the h stack
+    (T, D, B, H) f32.  Same math as RnnCell._step with the default Tanh
+    under Recurrent's scan."""
+    return _rnn_fwd_call(zx, wht, interpret=interpret)
+
+
+def _rnn_vjp_fwd(zx, wht, interpret=False):
+    hs = _rnn_fwd_call(zx, wht, interpret=interpret)
+    return hs, (wht, hs)
+
+
+def _rnn_vjp_bwd(interpret, res, gout):
+    wht, hs = res
+    dzx, dwht = _rnn_bwd_call(wht, hs, gout.astype(jnp.float32),
+                              interpret=interpret)
+    return dzx.astype(jnp.float32), dwht.astype(wht.dtype)
+
+
+rnn_recurrence.defvjp(_rnn_vjp_fwd, _rnn_vjp_bwd)

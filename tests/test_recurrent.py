@@ -193,18 +193,19 @@ def test_bilstm_pallas_recurrence_matches_scan():
                                    rtol=1e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("cell_cls", ["lstm", "gru"])
+@pytest.mark.parametrize("cell_cls", ["lstm", "gru", "rnn"])
 def test_single_direction_pallas_matches_scan(cell_cls):
-    """Recurrent(LSTMCell/GRUCell) — the single-direction case of the
-    kernel pairs — must match the lax.scan path (outputs, grads, key
-    stream), forward and reverse."""
+    """Recurrent(LSTMCell/GRUCell/RnnCell) — the single-direction case
+    of the kernel pairs — must match the lax.scan path (outputs, grads,
+    key stream), forward and reverse."""
     from bigdl_tpu.nn import recurrent as rec
     from bigdl_tpu.nn.module import Context
     import jax
 
     from bigdl_tpu.utils.random import set_seed
-    make_cell = (lambda: nn.LSTMCell(6, 5)) if cell_cls == "lstm" \
-        else (lambda: nn.GRUCell(6, 5))
+    make_cell = {"lstm": lambda: nn.LSTMCell(6, 5),
+                 "gru": lambda: nn.GRUCell(6, 5),
+                 "rnn": lambda: nn.RnnCell(6, 5)}[cell_cls]
     for reverse in (False, True):
         set_seed(7)
         m = nn.Recurrent(reverse=reverse).add(make_cell())
