@@ -184,6 +184,13 @@ class Recurrent(Container):
     def cell(self) -> Cell:
         return self.modules[0]
 
+    def _finish_pallas(self, outs):
+        """Shared epilogue of the kernel branches: undo the reverse-time
+        flip and return to batch-major (N, T, H)."""
+        if self.reverse:
+            outs = jnp.flip(outs, axis=0)
+        return jnp.swapaxes(outs, 0, 1)
+
     def apply(self, params, x, state, ctx):
         cell = self.cell
         cp = params["0"]["~"]  # cells keep all params in their own dict
@@ -215,9 +222,7 @@ class Recurrent(Container):
                   + cp["bias_i"] + cp["bias_h"])      # (T, N, H)
             wh = p.cast_compute(cp["h2h"].T)          # (H, H)
             outs = rnn_recurrence(zx[:, None], wh[None], interp)[:, 0]
-            if self.reverse:
-                outs = jnp.flip(outs, axis=0)
-            return jnp.swapaxes(outs, 0, 1), state
+            return self._finish_pallas(outs), state
         if use_pallas and type(cell) is GRUCell:
             # GRU case of the VMEM-carry kernel pattern
             # (ops/pallas_kernels.gru_recurrence): hoist the two input
@@ -231,9 +236,7 @@ class Recurrent(Container):
             outs = gru_recurrence(zrz[:, None], zn[:, None],
                                   cp["w_rz"][:, d:].T[None],
                                   cp["w_h"][:, d:].T[None], interp)[:, 0]
-            if self.reverse:
-                outs = jnp.flip(outs, axis=0)
-            return jnp.swapaxes(outs, 0, 1), state
+            return self._finish_pallas(outs), state
         if use_pallas:
             # single-direction case of the same VMEM-carry kernel pair
             # that earned the Bi-LSTM 2.3x (PERF_NOTES round 5): hoist
@@ -249,9 +252,7 @@ class Recurrent(Container):
                              preferred_element_type=jnp.float32)
                   + cp["bias"])                       # (T, N, 4H)
             outs = bilstm_recurrence(zx[:, None], wh[None], interp)[:, 0]
-            if self.reverse:
-                outs = jnp.flip(outs, axis=0)
-            return jnp.swapaxes(outs, 0, 1), state
+            return self._finish_pallas(outs), state
 
         def step(carry, x_t):
             h, k = carry
