@@ -16,8 +16,8 @@ def main():
     bt.set_policy(getattr(bt, _os.environ.get("BIGDL_POLICY", "BF16_COMPUTE")))
     model_name, batch = _sys.argv[1], int(_sys.argv[2])
     mod, attr = importlib.import_module(_sys.argv[3]), _sys.argv[4]
-    impl = _os.environ.get("BIGDL_PRNG", "rbg")
     import jax
+    impl = _os.environ.get("BIGDL_PRNG", "rbg") or "threefry2x32"
     jax.config.update("jax_default_prng_impl", impl)
     for value in (False, True, False, True):
         setattr(mod, attr, value)

@@ -237,9 +237,12 @@ def build_step(model_name: str, batch: int):
     from bigdl_tpu import tensor as bt
     from bigdl_tpu.nn.module import Context
     from bigdl_tpu.optim.optim_method import SGD
-    from bigdl_tpu.utils.random import set_seed
+    from bigdl_tpu.utils.random import RNG, set_device_prng, set_seed
 
     set_seed(1)
+    # match the bench's device-PRNG selection (rbg) unless overridden:
+    # dropout-mask generation is part of the step being profiled
+    set_device_prng(_os.environ.get("BIGDL_PRNG", "rbg") or None)
     pol = _os.environ.get("BIGDL_POLICY", "BF16_COMPUTE")
     if pol not in ("FP32", "BF16_COMPUTE", "BF16_ACT"):
         raise SystemExit("BIGDL_POLICY must be one of FP32/BF16_COMPUTE/"
@@ -300,7 +303,7 @@ def build_step(model_name: str, batch: int):
     rs = np.random.RandomState(0)
     x = jnp.asarray(rs.randn(*xshape), jnp.float32)
     y = jnp.asarray(rs.randint(1, nclass + 1, (batch,)))
-    key = jax.random.PRNGKey(0)
+    key = RNG.next_key()  # honors the device-PRNG selection above
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
     return step, (params, net_state, opt_state, x, y, key)
 
