@@ -35,8 +35,9 @@ if _os.environ.get("BIGDL_CPU_MESH"):
     try:
         import jax as _jax
         _jax.config.update("jax_platforms", "cpu")
-        _jax.config.update("jax_num_cpu_devices",
-                           int(_os.environ["BIGDL_CPU_MESH"]))
+        from bigdl_tpu.utils.engine import set_cpu_device_count \
+            as _set_cpu_device_count
+        _set_cpu_device_count(int(_os.environ["BIGDL_CPU_MESH"]))
     except (RuntimeError, ValueError) as _e:
         # backend already initialized, or a non-integer value
         import warnings as _warnings

@@ -173,8 +173,22 @@ class DataSet:
         is this framework's own packed-shard format written by
         ``bigdl_tpu.dataset.shardfile.write_shards`` / ``imagenet_tools``."""
         from bigdl_tpu.dataset import seqfile
-        seq_files = seqfile.find_seq_files(path)
+        # one listing decides the wire format (a remote listdir is an RPC;
+        # two listings could also disagree under concurrent writes)
+        names = seqfile.folder_listing(path)
+        seq_files = seqfile.find_seq_files(path, names=names)
         if seq_files:
+            bdts = [n for n in names if n.endswith(".bdts")]
+            if bdts:
+                # dispatching on "any .seq present" would silently pick a
+                # wire format; a folder holding both is ambiguous
+                raise ValueError(
+                    f"{path} holds BOTH Hadoop SequenceFiles "
+                    f"({len(seq_files)} *.seq) and packed shards "
+                    f"({len(bdts)} *.bdts) — format selection would be "
+                    "silent and order-dependent; split the folder (or "
+                    "remove the stray files) so it holds exactly one "
+                    "wire format")
             return seqfile.SeqFileDataSet(path, class_num=class_num,
                                           distributed=distributed,
                                           files=seq_files)

@@ -89,6 +89,18 @@ def _read_text(f) -> bytes:
     return f.read(read_vint(f))
 
 
+def _read_exact(f, n: int, path: str, offset: int, what: str) -> bytes:
+    """``f.read(n)`` that REFUSES short reads: a truncated or corrupt
+    .seq file must raise, naming file and offset, instead of yielding
+    silently wrong records (ADVICE r5 #1)."""
+    data = f.read(n)
+    if len(data) != n:
+        raise ValueError(
+            f"{path}: truncated {what} at offset {offset}: wanted {n} "
+            f"bytes, got {len(data)} — file is corrupt or was cut short")
+    return data
+
+
 # ---------------------------------------------------------------------------
 # File-level reader / writer
 # ---------------------------------------------------------------------------
@@ -165,19 +177,49 @@ def read_sequence_file(path: str):
             _read_text(f), _read_text(f)
         sync = f.read(SYNC_SIZE)
         is_text = (key_cls == TEXT_CLASS, val_cls == TEXT_CLASS)
+        from bigdl_tpu.resilience import faults
+        inj = faults.get()
+        rec_index = 0
         while True:
+            off = f.tell()
             raw = f.read(4)
-            if len(raw) < 4:
+            if not raw:
                 return
+            if len(raw) < 4:
+                raise ValueError(
+                    f"{path}: truncated record length at offset {off}: "
+                    f"got {len(raw)}/4 bytes — file was cut short")
             (rec_len,) = struct.unpack(">i", raw)
             if rec_len == -1:  # sync escape
-                marker = f.read(SYNC_SIZE)
+                marker = _read_exact(f, SYNC_SIZE, path, off + 4,
+                                     "sync marker")
                 if marker != sync:
                     raise ValueError(f"{path}: corrupt sync marker")
                 continue
-            (key_len,) = struct.unpack(">i", f.read(4))
-            key = f.read(key_len)
-            value = f.read(rec_len - key_len)
+            (key_len,) = struct.unpack(
+                ">i", _read_exact(f, 4, path, off + 4, "key length"))
+            if key_len < 0 or rec_len < key_len:
+                raise ValueError(
+                    f"{path}: corrupt record header at offset {off}: "
+                    f"rec_len {rec_len}, key_len {key_len} (need "
+                    "rec_len >= key_len >= 0)")
+            key = _read_exact(f, key_len, path, off + 8, "record key")
+            vlen = rec_len - key_len
+            value = f.read(vlen)
+            if inj is not None:
+                spec = inj.fires("record_truncate", step=rec_index)
+                if spec is not None:  # simulated short read, caught below
+                    value = faults.truncate(value)
+            if len(value) != vlen:
+                raise ValueError(
+                    f"{path}: truncated record value at offset "
+                    f"{off + 8 + key_len}: wanted {vlen} bytes, got "
+                    f"{len(value)} — file is corrupt or was cut short")
+            if inj is not None:
+                spec = inj.fires("record_corrupt", step=rec_index)
+                if spec is not None:  # silent payload damage (bit rot)
+                    value = faults.flip_bit(value, spec, rec_index)
+            rec_index += 1
             if is_text[0]:
                 key = _read_text(io.BytesIO(key))
             if is_text[1]:
@@ -289,16 +331,27 @@ class SeqBytesToBGRImg(Transformer):
             yield LabeledImage(arr, rec.label, order="bgr")
 
 
-def find_seq_files(path: str):
-    """Sorted ``*.seq`` under a local folder or fsspec URL
-    (ref DataSet.scala:449-455)."""
+def folder_listing(path: str):
+    """Entry names of a local folder or fsspec URL; [] when the path is
+    not a listable directory.  Shared by the wire-format dispatch
+    (``DataSet.seq_file_folder``) and ``find_seq_files`` so one listing
+    (one RPC on remote stores) answers both questions."""
     from bigdl_tpu.utils import fs
     if not fs.is_url(path) and not os.path.isdir(path):
         return []
     try:
-        names = fs.listdir(path)
+        return fs.listdir(path)
     except (FileNotFoundError, OSError):
         return []
+
+
+def find_seq_files(path: str, names=None):
+    """Sorted ``*.seq`` under a local folder or fsspec URL
+    (ref DataSet.scala:449-455).  ``names`` short-circuits the listing
+    when the caller already holds one (``folder_listing``)."""
+    from bigdl_tpu.utils import fs
+    if names is None:
+        names = folder_listing(path)
     return sorted(fs.join(path, f) for f in names if f.endswith(".seq"))
 
 
@@ -318,16 +371,39 @@ def iter_record_keys(path: str):
         for _ in range(n_meta):
             _read_text(f), _read_text(f)
         f.read(SYNC_SIZE)
+        # seeking skips the value payloads, so a file cut short mid-value
+        # is only detectable against the real size — grab it up front
+        here = f.tell()
+        f.seek(0, 2)
+        file_size = f.tell()
+        f.seek(here)
         while True:
+            off = f.tell()
             raw = f.read(4)
-            if len(raw) < 4:
+            if not raw:
                 return
+            if len(raw) < 4:
+                raise ValueError(
+                    f"{path}: truncated record length at offset {off}: "
+                    f"got {len(raw)}/4 bytes — file was cut short")
             (rec_len,) = struct.unpack(">i", raw)
             if rec_len == -1:
-                f.seek(SYNC_SIZE, 1)
+                _read_exact(f, SYNC_SIZE, path, off + 4, "sync marker")
                 continue
-            (key_len,) = struct.unpack(">i", f.read(4))
-            key = f.read(key_len)
+            (key_len,) = struct.unpack(
+                ">i", _read_exact(f, 4, path, off + 4, "key length"))
+            if key_len < 0 or rec_len < key_len:
+                raise ValueError(
+                    f"{path}: corrupt record header at offset {off}: "
+                    f"rec_len {rec_len}, key_len {key_len} (need "
+                    "rec_len >= key_len >= 0)")
+            if off + 8 + rec_len > file_size:
+                raise ValueError(
+                    f"{path}: truncated record value at offset "
+                    f"{off + 8 + key_len}: record ends at "
+                    f"{off + 8 + rec_len} but the file holds only "
+                    f"{file_size} bytes")
+            key = _read_exact(f, key_len, path, off + 8, "record key")
             f.seek(rec_len - key_len, 1)
             yield (_read_text(io.BytesIO(key))
                    if key_cls == TEXT_CLASS else key)

@@ -82,9 +82,11 @@ def train_and_eval_image_folder(folder: str, image_size: int = 32,
     from bigdl_tpu.utils.table import T
 
     from bigdl_tpu.utils.random import RNG
-    saved = (RNG._seed, RNG._key_counter, RNG._np)
-    set_seed(seed)
-    try:
+    # this helper runs mid-bench / mid-suite: borrow the process RNG via
+    # the snapshot/restore API (epoch included, so worker-thread derived
+    # streams re-derive correctly) and hand it back on exit
+    with RNG.scoped():
+        set_seed(seed)
         ds, recs, n_classes = _byte_record_dataset(folder, image_size)
         if model is None:
             model = small_convnet(n_classes, image_size)
@@ -96,11 +98,6 @@ def train_and_eval_image_folder(folder: str, image_size: int = 32,
         results = validate(model, model.params(), model.state(), batched,
                            [Top1Accuracy(), Top5Accuracy()])
         (_, top1), (_, top5) = results
-    finally:
-        # this helper runs mid-bench / mid-suite: restore the process
-        # RNG stream it borrowed so callers after it are unaffected
-        set_seed(saved[0])
-        RNG._key_counter, RNG._np = saved[1], saved[2]
     return {"top1": round(top1.result()[0], 4),
             "top5": round(top5.result()[0], 4),
             "n_records": len(recs), "n_classes": n_classes,

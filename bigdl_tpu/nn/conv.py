@@ -144,7 +144,22 @@ def _s2d_parts(x, w, s, pad):
     return xs, ws, oh, ow
 
 
-_S2D_BWD = True  # keep the stem wgrad in s2d geometry (A/B: PERF_NOTES r4)
+def _barrier_grad_supported() -> bool:
+    """Older jaxlibs have no differentiation rule for
+    ``optimization_barrier``; trace (no dispatch) a grad through one to
+    decide whether the s2d backward may pin its operands."""
+    try:
+        jax.make_jaxpr(jax.grad(
+            lambda v: lax.optimization_barrier(v * v)))(1.0)
+        return True
+    except NotImplementedError:
+        return False
+
+
+# keep the stem wgrad in s2d geometry (A/B: PERF_NOTES r4) where the
+# barrier is differentiable; otherwise plain autodiff geometry (slower
+# stem wgrad, same numbers)
+_S2D_BWD = _barrier_grad_supported()
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))

@@ -14,9 +14,14 @@ from bigdl_tpu.optim.validation import (
 )
 from bigdl_tpu.optim.validator import Validator, LocalValidator, DistriValidator
 from bigdl_tpu.optim.metrics import Metrics
-from bigdl_tpu.optim.local_optimizer import LocalOptimizer, validate, distri_validate
+from bigdl_tpu.optim.local_optimizer import (
+    LocalOptimizer, NonFiniteGradError, validate, distri_validate,
+)
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
-from bigdl_tpu.optim.optimizer import Optimizer, save_model, save_state
+from bigdl_tpu.optim.optimizer import (
+    Optimizer, list_checkpoints, load_latest_checkpoint, save_model,
+    save_state,
+)
 from bigdl_tpu.optim.predictor import Predictor, DLClassifier
 
 __all__ = [
@@ -30,5 +35,6 @@ __all__ = [
     "Validator", "LocalValidator", "DistriValidator",
     "LocalOptimizer", "DistriOptimizer", "Optimizer", "validate",
     "distri_validate", "Predictor", "DLClassifier",
-    "save_model", "save_state",
+    "save_model", "save_state", "list_checkpoints",
+    "load_latest_checkpoint", "NonFiniteGradError",
 ]

@@ -36,14 +36,18 @@ import logging
 import time
 
 import jax
+
+from bigdl_tpu.parallel.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import Context
-from bigdl_tpu.optim.local_optimizer import LocalOptimizer, validate
+from bigdl_tpu.optim.local_optimizer import (LocalOptimizer, _finite_all,
+                                             _where_finite, validate)
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.parallel.mesh import data_parallel_mesh
+from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.random import RNG
 from bigdl_tpu.utils.table import T
 
@@ -307,6 +311,31 @@ class DistriOptimizer(LocalOptimizer):
         super()._maybe_checkpoint(params, net_state, opt_state, state,
                                   force=True, neval_label=neval_label)
 
+    def _preemption_pending(self) -> bool:
+        """Multi-host preemption barrier: ANY process's SIGTERM stops all
+        of them at the same iteration (one host exiting alone would
+        strand the rest in a dead collective).  The merge is a tiny
+        allgather per iteration, paid only while the handler is armed —
+        install it on EVERY process (``Engine.install_preemption_handler``
+        from the shared launcher path) or the collective deadlocks."""
+        if jax.process_count() == 1:
+            return Engine.preempted()
+        if not Engine.preemption_armed():
+            if Engine.preempted():
+                from bigdl_tpu.utils.log import warn_every
+                warn_every(
+                    logger, "preempt-unarmed", 30.0,
+                    "preemption requested but the handler is not armed: "
+                    "a multi-host run only honors the notice when "
+                    "Engine.install_preemption_handler() ran on EVERY "
+                    "process (the stop flag must merge as a collective); "
+                    "ignoring it")
+            return False
+        from jax.experimental import multihost_utils
+        flags = np.asarray(multihost_utils.process_allgather(
+            np.asarray(1.0 if Engine.preempted() else 0.0, np.float32)))
+        return bool(flags.max() > 0)
+
     def _expert_param_specs(self, params):
         """Path-aware sharding tree: the expert-stacked leaves of ``MoE``
         modules (w1/b1/w2/b2, leading dim = n_experts) shard dim 0 over
@@ -373,12 +402,17 @@ class DistriOptimizer(LocalOptimizer):
         return reps(params), reps(net_state), reps(opt_state), data
 
     def _core_step(self, fold_axis=None, grad_transform=None,
-                   state_merge=None, update_transform=None):
+                   state_merge=None, update_transform=None,
+                   finite_merge=None):
         """The train step both builders share: loss_fn, value_and_grad,
         optimizer update.  ``fold_axis`` decorrelates the dropout key per
         replica; ``grad_transform``/``state_merge`` hook the compressed
         path's collectives in; ``update_transform`` replaces the plain
-        ``method.update`` (the compressed-ZeRO-1 owner-partition path)."""
+        ``method.update`` (the compressed-ZeRO-1 owner-partition path).
+        ``finite_merge`` reconciles the non-finite-guard flag across
+        replicas inside shard_map (local grads can be finite on one
+        replica and not another; a divergent skip decision would fork the
+        replicated params)."""
         model, criterion, method = self.model, self.criterion, self.optim_method
         static_hyper = self._hyper(None)
         del static_hyper["lr"]
@@ -412,13 +446,19 @@ class DistriOptimizer(LocalOptimizer):
                 grads, loss = grad_transform(grads, loss)
             if state_merge is not None:
                 new_net_state = state_merge(new_net_state)
+            finite = _finite_all(loss, grads)
+            if finite_merge is not None:
+                finite = finite_merge(finite)
             if update_transform is not None:
                 new_params, new_opt_state = update_transform(
                     grads, opt_state, params, hyper)
             else:
                 new_params, new_opt_state = method.update(
                     grads, opt_state, params, hyper)
-            return new_params, new_net_state, new_opt_state, loss
+            new_params = _where_finite(finite, new_params, params)
+            new_opt_state = _where_finite(finite, new_opt_state, opt_state)
+            new_net_state = _where_finite(finite, new_net_state, net_state)
+            return new_params, new_net_state, new_opt_state, loss, finite
 
         return step
 
@@ -443,7 +483,7 @@ class DistriOptimizer(LocalOptimizer):
                 step,
                 in_shardings=(ps, ns, os_, x_s or data_s, data_s,
                               rep, rep, rep) + tuple(extra_in),
-                out_shardings=(ps, ns, os_, rep),
+                out_shardings=(ps, ns, os_, rep, rep),
                 donate_argnums=(0, 1, 2),
             )
 
@@ -455,7 +495,7 @@ class DistriOptimizer(LocalOptimizer):
             self._scan_chunk(step, n),
             in_shardings=(ps, ns, os_, x_chunk_s or chunk_data_s,
                           chunk_data_s, rep, rep, rep),
-            out_shardings=(ps, ns, os_, rep),
+            out_shardings=(ps, ns, os_, rep, rep),
             donate_argnums=(0, 1, 2),
         )
 
@@ -580,7 +620,13 @@ class DistriOptimizer(LocalOptimizer):
         core = self._core_step(
             fold_axis="data",
             grad_transform=loss_mean if self.zero1 else grad_transform,
-            state_merge=state_merge, update_transform=update_transform)
+            state_merge=state_merge, update_transform=update_transform,
+            # non-finite guard: replicas see LOCAL grads here (the zero1
+            # path aggregates inside update_transform), so one replica's
+            # NaN must veto the update on every replica or the
+            # where-select forks the replicated params
+            finite_merge=lambda f: jax.lax.pmin(
+                f.astype(jnp.int32), "data").astype(jnp.bool_))
         if masked:
             # 9th operand: the (n_tasks,) 0/1 drop mask, replicated —
             # push (w_this_replica, finished_count) for the hooks above
@@ -602,11 +648,11 @@ class DistriOptimizer(LocalOptimizer):
                 self._z1c_leaf_spec, self._z1c_opt_shape())
         else:
             ospec = rep
-        sharded = jax.shard_map(
+        sharded = shard_map(
             step, mesh=mesh,
             in_specs=(rep, rep, ospec, data, data, rep, rep, rep)
             + ((rep,) if masked else ()),
-            out_specs=(rep, rep, ospec, rep),
+            out_specs=(rep, rep, ospec, rep, rep),
             check_vma=False,
         )
         params, net_state, opt_state = self._state_trees()
@@ -738,9 +784,13 @@ class DistriOptimizer(LocalOptimizer):
 
                 (loss, new_s), grads = jax.value_and_grad(
                     gpipe_loss, has_aux=True)(stacked_p, stacked_s)
+            finite = _finite_all(loss, grads)
             new_p, new_opt = method.update(grads, opt_state, stacked_p,
                                            hyper)
-            return new_p, new_s, new_opt, loss
+            new_p = _where_finite(finite, new_p, stacked_p)
+            new_opt = _where_finite(finite, new_opt, opt_state)
+            new_s = _where_finite(finite, new_s, stacked_s)
+            return new_p, new_s, new_opt, loss, finite
 
         pipe = NamedSharding(mesh, P("pipe"))
         rep = NamedSharding(mesh, P())
@@ -757,7 +807,7 @@ class DistriOptimizer(LocalOptimizer):
         return jax.jit(
             fn,
             in_shardings=(pipe, pipe, opt_s, rep, rep, rep, rep, rep),
-            out_shardings=(pipe, pipe, opt_s, rep),
+            out_shardings=(pipe, pipe, opt_s, rep, rep),
             donate_argnums=(0, 1, 2),
         )
 
@@ -818,6 +868,9 @@ class DistriOptimizer(LocalOptimizer):
         state = self.state
         state.get_or_update("epoch", 1)
         state.get_or_update("neval", 1)
+        # see LocalOptimizer.optimize: a resumed state blob may carry the
+        # previous run's preemption mark
+        state["preempted"] = False
 
         step_fn = self._build_step()  # pipeline mode builds its plan here
         params = jax.tree_util.tree_map(jnp.copy, self.model.params())
@@ -843,10 +896,12 @@ class DistriOptimizer(LocalOptimizer):
             with self.metrics.timer("data fetch time"):
                 if n_disp <= 1:
                     batch = next(data_iter)
-                    x, y = self._device_put_batch(batch.data, batch.labels)
+                    xh = self._chaos_prestep(batch.data, state["neval"])
+                    x, y = self._device_put_batch(xh, batch.labels)
                     global_b = x.shape[0]
                 else:
                     xh, yh = self._next_chunk(data_iter, n_disp)
+                    xh = self._chaos_prestep(xh, state["neval"])
                     x, y = self._device_put_batch(xh, yh, stacked=True)
                     global_b = x.shape[0] * x.shape[1]
             fetch_wall = time.perf_counter() - fetch_start
@@ -870,10 +925,11 @@ class DistriOptimizer(LocalOptimizer):
                 step_args = (params, net_state, opt_state, x, y,
                              jnp.float32(lr), key, self._lr_scales_arg)
                 if straggler is not None:
-                    params, net_state, opt_state, loss = step_fn(
+                    params, net_state, opt_state, loss, finite = step_fn(
                         *step_args, jnp.asarray(drop_mask))
                 else:
-                    params, net_state, opt_state, loss = step_fn(*step_args)
+                    params, net_state, opt_state, loss, finite = step_fn(
+                        *step_args)
                 # float() blocks on the device result, so the timer (and
                 # the straggler's task clock) sees the real dispatch wall
                 loss = float(loss[-1]) if n_disp > 1 else float(loss)
@@ -900,10 +956,15 @@ class DistriOptimizer(LocalOptimizer):
                 "on %d devices", state["epoch"], count, epoch_size, loss, lr,
                 global_b / max(step_time, 1e-9), n_dev)
 
+            self._note_finite(finite, state)
             count, data_iter = self._advance_epochs(state, count,
                                                     epoch_size, n_disp,
                                                     data_iter)
             self._fire_triggers(params, net_state, opt_state, state, n_disp)
+            if self._preemption_pending():
+                self._checkpoint_and_stop(params, net_state, opt_state,
+                                          state)
+                break
 
         # gather (replicated -> host) and write back, ref getModel :475-499
         if self._pipe_plan is not None:

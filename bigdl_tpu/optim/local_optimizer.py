@@ -17,6 +17,7 @@ JVM-thread artifact).  What is kept, capability-for-capability:
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import jax
@@ -29,9 +30,36 @@ from bigdl_tpu.optim import trigger as triggers
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils import file as File
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.log import warn_every
 from bigdl_tpu.utils.random import RNG
 
 logger = logging.getLogger("bigdl_tpu.optim")
+
+
+class NonFiniteGradError(RuntimeError):
+    """Training aborted: non-finite gradients for more consecutive steps
+    than the abort threshold (``set_nonfinite_policy`` /
+    ``BIGDL_NONFINITE_ABORT``) — the run has diverged and skipping can no
+    longer save it."""
+
+
+def _finite_all(loss, grads):
+    """One scalar: loss AND every gradient leaf finite.  Computed inside
+    the existing jit step (a handful of VPU reductions fused into the
+    backward), so the happy path pays no extra dispatch."""
+    finite = jnp.all(jnp.isfinite(loss))
+    for g in jax.tree_util.tree_leaves(grads):
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return finite
+
+
+def _where_finite(finite, new_tree, old_tree):
+    """Skip-step select: keep the pre-step value on every leaf when the
+    step produced non-finite gradients (the update, optimizer state and
+    BN running stats are all poisoned by one NaN)."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)
 
 
 class LocalOptimizer:
@@ -51,6 +79,22 @@ class LocalOptimizer:
         self.remat = False
         self._resume_opt_state = None
         self.iters_per_dispatch = 1
+        # non-finite-grad policy: skip the update (params/opt-state/BN
+        # stats keep their pre-step values), count, abort after this many
+        # CONSECUTIVE bad steps (0/None = never abort)
+        self.nonfinite_abort = int(
+            os.environ.get("BIGDL_NONFINITE_ABORT", "10"))
+        self._nonfinite_skips = 0
+        self._nonfinite_streak = 0
+
+    def set_nonfinite_policy(self, abort_after: int | None = 10):
+        """Abort training (NonFiniteGradError) after ``abort_after``
+        consecutive skipped steps; 0/None keeps skipping forever.  The
+        detection itself is always on — it folds into the jit step for
+        free (ref has no equivalent: a NaN there poisons the
+        AllReduceParameter weights silently)."""
+        self.nonfinite_abort = int(abort_after or 0)
+        return self
 
     def set_gradient_checkpointing(self, enabled: bool = True):
         """Rematerialize the forward inside backward (``jax.checkpoint``):
@@ -182,8 +226,12 @@ class LocalOptimizer:
                 return criterion.apply_loss(out, y), ns
 
             (loss, new_net_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            finite = _finite_all(loss, grads)
             new_params, new_opt_state = method.update(grads, opt_state, params, hyper)
-            return new_params, new_net_state, new_opt_state, loss
+            new_params = _where_finite(finite, new_params, params)
+            new_opt_state = _where_finite(finite, new_opt_state, opt_state)
+            new_net_state = _where_finite(finite, new_net_state, net_state)
+            return new_params, new_net_state, new_opt_state, loss, finite
 
         # donate the carried state: the old params/opt-state buffers are
         # dead after each step, so XLA reuses them instead of allocating a
@@ -206,12 +254,13 @@ class LocalOptimizer:
             def body(carry, xyk):
                 p, ns, o = carry
                 x, y, k = xyk
-                p, ns, o, loss = step(p, ns, o, x, y, lr, k, lr_scales)
-                return (p, ns, o), loss
+                p, ns, o, loss, finite = step(p, ns, o, x, y, lr, k,
+                                              lr_scales)
+                return (p, ns, o), (loss, finite)
 
-            (params, net_state, opt_state), losses = lax.scan(
+            (params, net_state, opt_state), (losses, finites) = lax.scan(
                 body, (params, net_state, opt_state), (xs, ys, keys))
-            return params, net_state, opt_state, losses
+            return params, net_state, opt_state, losses, finites
 
         return chunk
 
@@ -232,6 +281,9 @@ class LocalOptimizer:
         state = self.state
         state.get_or_update("epoch", 1)
         state.get_or_update("neval", 1)
+        # a resumed state blob may carry the previous run's preemption
+        # mark; this run hasn't been preempted (yet)
+        state["preempted"] = False
 
         # copy the model's arrays: the jit step donates its carried state,
         # and donating the module's own buffers would leave the user's model
@@ -251,17 +303,19 @@ class LocalOptimizer:
             fetch_start = time.perf_counter()
             if n_disp <= 1:
                 batch = next(data_iter)
-                x = jnp.asarray(batch.data)
+                xh = self._chaos_prestep(batch.data, state["neval"])
+                x = jnp.asarray(xh)
                 y = jnp.asarray(batch.labels)
             else:
                 xh, yh = self._next_chunk(data_iter, n_disp)
+                xh = self._chaos_prestep(xh, state["neval"])
                 x, y = jnp.asarray(xh), jnp.asarray(yh)
             fetch_time = time.perf_counter() - fetch_start
 
             train_start = time.perf_counter()
             lr = self._current_lr()
             key = RNG.next_key()
-            params, net_state, opt_state, loss = step_fn(
+            params, net_state, opt_state, loss, finite = step_fn(
                 params, net_state, opt_state, x, y, jnp.float32(lr), key,
                 self._lr_scales_arg)
             if n_disp > 1:
@@ -283,15 +337,107 @@ class LocalOptimizer:
                 state["epoch"], count, epoch_size, loss, lr,
                 b / max(train_time + fetch_time, 1e-9), fetch_time, train_time)
 
+            self._note_finite(finite, state)
             count, data_iter = self._advance_epochs(state, count,
                                                     epoch_size, n_disp,
                                                     data_iter)
             self._fire_triggers(params, net_state, opt_state, state, n_disp)
+            if self._preemption_pending():
+                self._checkpoint_and_stop(params, net_state, opt_state,
+                                          state)
+                break
 
         self.model.load_params(params)
         self.model.load_state(net_state)
         logger.info("Training finished in %.1fs", time.perf_counter() - wall_start)
         return self.model
+
+    # -- resilience hooks (docs/resilience.md) ----------------------------
+    def _chaos_prestep(self, x_host, neval: int):
+        """FaultInjector sites threaded through the train loop: NaN/Inf
+        batch poisoning (drives the non-finite guard end-to-end through
+        the real backward), slow-worker delay, induced process death.
+        Returns the (possibly poisoned) host batch; a no-op None-check
+        when chaos is off."""
+        from bigdl_tpu.resilience import faults
+        inj = faults.get()
+        if inj is None:
+            return x_host
+        spec = inj.fires("slow_worker", step=neval)
+        if spec is not None:
+            time.sleep(spec.delay)
+        if inj.fires("proc_kill", step=neval) is not None:
+            logger.error("FaultInjector: induced process death at "
+                         "iteration %d", neval)
+            os._exit(1)
+        poison = None
+        if inj.fires("nan_grad", step=neval) is not None:
+            poison = np.nan
+        elif inj.fires("inf_grad", step=neval) is not None:
+            poison = np.inf
+        if poison is not None:
+            x_host = np.array(x_host, dtype=np.float32, copy=True)
+            x_host.reshape(-1)[0] = poison
+        return x_host
+
+    def _note_finite(self, finite, state):
+        """Host-side accounting for the jit-folded finite flag(s): count
+        skipped steps, track the consecutive streak, abort past the
+        threshold.  ``finite`` is a scalar (or (n,) per-chunk array —
+        the streak then continues across dispatch boundaries)."""
+        flags = np.atleast_1d(np.asarray(finite)).astype(bool)
+        n_bad = int((~flags).sum())
+        if n_bad == 0:
+            self._nonfinite_streak = 0
+            return
+        self._nonfinite_skips += n_bad
+        # longest consecutive bad run, seeded with the streak carried in
+        # from earlier dispatches — a >=threshold run INSIDE one chunk
+        # must abort even if the chunk's last step recovered
+        streak = self._nonfinite_streak
+        worst = streak
+        for f in flags:
+            streak = 0 if f else streak + 1
+            worst = max(worst, streak)
+        self._nonfinite_streak = streak
+        state["nonFiniteSkips"] = self._nonfinite_skips
+        warn_every(
+            logger, "nonfinite", 5.0,
+            "non-finite gradients at iteration %d: update skipped, "
+            "params/optimizer state kept (%d skipped total, %d "
+            "consecutive, abort threshold %s)",
+            int(state["neval"]), self._nonfinite_skips,
+            worst, self.nonfinite_abort or "off")
+        if self.nonfinite_abort and worst >= self.nonfinite_abort:
+            raise NonFiniteGradError(
+                f"{worst} consecutive non-finite-gradient "
+                f"steps (threshold {self.nonfinite_abort}, iteration "
+                f"{int(state['neval'])}): loss has diverged — lower the "
+                "learning rate or resume from an earlier checkpoint")
+
+    def _preemption_pending(self) -> bool:
+        """SIGTERM arrived (``Engine.install_preemption_handler``)?  The
+        distributed loop overrides this with an any-process merge so every
+        host agrees to stop at the same iteration."""
+        return Engine.preempted()
+
+    def _checkpoint_and_stop(self, params, net_state, opt_state, state):
+        """Preemption epilogue: force one final checkpoint (when a
+        checkpoint path is configured) and mark the state so callers can
+        tell a preempted run from a completed one — flag first, so it
+        rides the snapshot payload."""
+        state["preempted"] = True
+        if self.checkpoint_path:
+            self._maybe_checkpoint(params, net_state, opt_state, state,
+                                   force=True)
+        # the notice has been honored; a LATER optimize() in this process
+        # (restart after resume) must not stop on the stale flag — a new
+        # SIGTERM sets it again
+        Engine.clear_preemption()
+        logger.warning(
+            "preemption: checkpointed at iteration %d, leaving the "
+            "training loop (resume with load_latest_checkpoint)",
+            int(state["neval"]))
 
     def _advance_epochs(self, state, count, epoch_size, n_disp, data_iter):
         """Epoch rollover shared by both optimizers' loops.  Single-step
@@ -375,8 +521,12 @@ class LocalOptimizer:
         File.save_module(self.model, f"{self.checkpoint_path}/model.{neval}")
         # "neval": the file label (= the nominal firing iteration under
         # the device-side loop, which may be < state['neval']); kept in
-        # the payload so resume tooling can detect the chunked case
-        File.save({"state": state, "opt_state": opt_state, "neval": neval},
+        # the payload so resume tooling can detect the chunked case.
+        # "rng": host-stream snapshot so a resume can replay the
+        # uninterrupted run's shuffle/augmentation draws
+        # (load_latest_checkpoint(restore_rng=True)).
+        File.save({"state": state, "opt_state": opt_state, "neval": neval,
+                   "rng": RNG.snapshot()},
                   f"{self.checkpoint_path}/state.{neval}")
 
 

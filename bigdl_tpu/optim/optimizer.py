@@ -54,6 +54,69 @@ def Optimizer(model, dataset=None, criterion=None, *, training_rdd=None,
     return opt
 
 
+def list_checkpoints(path):
+    """Iteration labels of the ``model.N``/``state.N`` snapshot pairs
+    under ``path``, newest first (CRC sidecars and temp files ignored).
+    Pairs only: a ``model.N`` whose ``state.N`` is missing (crash between
+    the two writes) is not a resumable snapshot."""
+    from bigdl_tpu.utils import fs
+    try:
+        names = fs.listdir(path)
+    except (FileNotFoundError, OSError):
+        return []
+
+    def labels(prefix):
+        return {int(f[len(prefix):]) for f in names
+                if f.startswith(prefix) and f[len(prefix):].isdigit()}
+
+    return sorted(labels("model.") & labels("state."), reverse=True)
+
+
+def load_latest_checkpoint(path, restore_rng: bool = False):
+    """Newest VALID snapshot under ``path`` — the resume entry point.
+
+    Scans ``model.N``/``state.N`` pairs newest-first; the loads verify
+    against the CRC sidecars (and unpickle), so corrupt or partial
+    snapshots (bit flips, truncated writes, crash between the pair's two
+    writes) are logged and skipped, falling back to the next older pair —
+    a chaos-injected checkpoint failure costs at most one checkpoint
+    interval of retraining, never the run.  Each candidate is read once
+    (no separate verify pre-pass: checkpoints can be multi-GB).
+
+    Returns ``(module, state_blob, neval)`` or ``None`` when no valid
+    snapshot exists (caller starts fresh).  ``restore_rng=True`` also
+    restores the host RNG stream snapshotted into the payload
+    (``RandomGenerator.restore``), so resumed data augmentation replays
+    the uninterrupted run's stream.
+    """
+    import logging
+
+    from bigdl_tpu.utils import file as File
+    from bigdl_tpu.utils import fs
+    logger = logging.getLogger("bigdl_tpu.optim")
+    for neval in list_checkpoints(path):
+        mp = fs.join(path, f"model.{neval}")
+        sp = fs.join(path, f"state.{neval}")
+        try:
+            module = File.load_module(mp)
+            blob = File.load(sp)
+        except File.ChecksumError as e:
+            logger.warning("resume: snapshot %d under %s is corrupt or "
+                           "partial (%s) — skipping to an older one",
+                           neval, path, e)
+            continue
+        except Exception as e:
+            logger.warning("resume: snapshot %d under %s failed to load "
+                           "(%s) — skipping to an older one", neval, path, e)
+            continue
+        if restore_rng and blob.get("rng") is not None:
+            from bigdl_tpu.utils.random import RNG
+            RNG.restore(blob["rng"])
+        logger.info("resume: loaded snapshot %d from %s", neval, path)
+        return module, blob, neval
+    return None
+
+
 def save_model(model, path, overwrite: bool = False):
     """(ref Optimizer.saveModel Optimizer.scala:137-143; like the
     reference, refuses to clobber an existing file unless asked)"""

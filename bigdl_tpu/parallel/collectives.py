@@ -46,12 +46,15 @@ def pvary(x, axis_names):
     jax>=0.9 in favor of ``lax.pcast(..., to='varying')``."""
     if hasattr(lax, "pcast"):
         return lax.pcast(x, axis_names, to="varying")
-    return lax.pvary(x, axis_names)
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis_names)
+    return x  # pre-vma jax (check_rep model): nothing to mark
 
 
 def ring_shift(x, axis_name: str, shift: int = 1):
     """Shift values around the axis ring by ``shift`` positions."""
-    n = lax.axis_size(axis_name)
+    from bigdl_tpu.parallel.compat import axis_size as _axis_size
+    n = _axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name, perm)
 
@@ -67,4 +70,5 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    from bigdl_tpu.parallel.compat import axis_size as _axis_size
+    return _axis_size(axis_name)

@@ -19,6 +19,8 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from bigdl_tpu.parallel.compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -86,7 +88,7 @@ def moe_apply(router_w, expert_w1, expert_b1, expert_w2, expert_b2, x,
         return jnp.einsum("ecd,tec->td", all_out, combine)
 
     pspec_e = P(axis)
-    f = jax.shard_map(
+    f = shard_map(
         ranked, mesh=mesh,
         in_specs=(P(), pspec_e, pspec_e, pspec_e, pspec_e, P()),
         out_specs=P(), check_vma=False)  # replication holds post-all_gather
@@ -134,7 +136,7 @@ def moe_apply_sharded_tokens(router_w, expert_w1, expert_b1, expert_w2,
         return jnp.einsum("ecd,tec->td", expert_out, combine)
 
     pspec_e = P(expert_axis)
-    f = jax.shard_map(
+    f = shard_map(
         ranked, mesh=mesh,
         in_specs=(P(), pspec_e, pspec_e, pspec_e, pspec_e, P(data_axis)),
         out_specs=P(data_axis), check_vma=False)

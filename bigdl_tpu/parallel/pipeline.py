@@ -24,6 +24,8 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from bigdl_tpu.parallel.compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 
@@ -148,7 +150,7 @@ def pipeline_apply(stage_fn, stage_params, x_micro, mesh: Mesh,
     pspec = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
     sspec = jax.tree_util.tree_map(lambda _: P(axis), stage_state)
     xspec = P(None, data_axis) if data_axis is not None else P()
-    f = jax.shard_map(ranked, mesh=mesh,
+    f = shard_map(ranked, mesh=mesh,
                       in_specs=(pspec, sspec, xspec),
                       out_specs=(xspec, sspec))
     outs, new_state = f(stage_params, stage_state, x_micro)
@@ -361,7 +363,7 @@ def pipeline_train_1f1b(stage_fn, loss_fn, stage_params, x_micro, t_micro,
         xspec = P(None, data_axis)   # (M, mb, ...): shard the batch dim
     else:
         xspec = P()
-    f = jax.shard_map(ranked, mesh=mesh,
+    f = shard_map(ranked, mesh=mesh,
                       in_specs=(pspec, sspec, xspec, xspec),
                       out_specs=(P(), pspec, sspec))
     loss, grads, new_state = f(stage_params, stage_state, x_micro, t_micro)

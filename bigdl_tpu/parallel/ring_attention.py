@@ -22,6 +22,10 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+from bigdl_tpu.parallel.compat import typeof as _compat_typeof
+
+from bigdl_tpu.parallel.compat import shard_map
 import jax.numpy as jnp
 from jax import lax
 
@@ -59,7 +63,8 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False):
     """Collective ring attention: call inside shard_map with q/k/v sequence-
     sharded over ``axis_name``.  Shapes per device: (B, T_local, H, D)."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
-    n = lax.axis_size(axis_name)
+    from bigdl_tpu.parallel.compat import axis_size as _axis_size
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     t_local = q.shape[1]
     q_off = idx * t_local
@@ -85,7 +90,7 @@ def ring_attention(q, k, v, axis_name: str = "seq", causal: bool = False):
     b, _, h, d = q.shape
     # pvary: initial accumulators must carry the same varying type as the
     # operands (the ring axis, plus a batch axis under hybrid dp x sp)
-    vary_axes = tuple(getattr(jax.typeof(q), "vma", None) or (axis_name,))
+    vary_axes = tuple(getattr(_compat_typeof(q), "vma", None) or (axis_name,))
     m0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), vary_axes)
     l0 = pvary(jnp.zeros((b, h, t_local), jnp.float32), vary_axes)
     o0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), vary_axes)
@@ -106,7 +111,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     HAS a data axis would replicate (all-gather) the batch into every
     data slice."""
     spec = P(batch_axis, axis_name)
-    f = jax.shard_map(
+    f = shard_map(
         partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return f(q, k, v)

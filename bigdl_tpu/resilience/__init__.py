@@ -1,0 +1,19 @@
+"""Resilience layer: deterministic fault injection + the defenses it
+exercises (docs/resilience.md).
+
+- ``faults``: ``FaultInjector`` — reproducible chaos keyed by
+  ``(step, process_index, site)``, configured via ``BIGDL_FAULTS``.
+- ``watchdog``: heartbeat/timeout peer-death detector for multi-host
+  runs (fail fast out of a dead collective).
+
+The defenses themselves live where the work happens: checksummed atomic
+checkpoints in ``utils/fs.py``/``utils/file.py``, the non-finite-grad
+skip in ``optim/local_optimizer.py``, the preemption barrier in
+``utils/engine.py`` + the optimizer loops, resume scanning in
+``optim/optimizer.py``.
+"""
+from bigdl_tpu.resilience.faults import (  # noqa: F401
+    ENV_VAR, SITES, FaultInjector, FaultSpec, clear, configure, get,
+    parse_faults,
+)
+from bigdl_tpu.resilience.watchdog import Watchdog, EXIT_CODE  # noqa: F401

@@ -1,0 +1,252 @@
+"""Deterministic fault injection for chaos testing.
+
+The reference survives worker loss by leaning on Spark's task retry and
+lineage; this port has to *prove* its own defenses (checksummed
+checkpoints, non-finite-grad skip, preemption barrier, watchdog) work —
+forever, in CI.  That needs faults that fire the same way on every run
+and on every process, so a chaos failure reproduces from its command
+line alone.
+
+A fault decision is a pure function of ``(step, process_index, site)``
+plus the spec parsed from ``BIGDL_FAULTS`` (or passed to ``configure``):
+scheduled clauses (``at=`` / ``every=``) compare the step counter
+directly; probabilistic clauses (``p=``) hash the key tuple with a
+seeded blake2 — no RNG state, no ordering sensitivity between sites.
+
+``BIGDL_FAULTS`` syntax — semicolon-separated clauses::
+
+    site[@key=value[,key=value...]]
+
+    BIGDL_FAULTS="nan_grad@every=5"
+    BIGDL_FAULTS="ckpt_bitflip@at=2;ckpt_write_fail@at=0"
+    BIGDL_FAULTS="proc_kill@at=4,proc=3;slow_worker@every=3,delay=0.05"
+    BIGDL_FAULTS="record_truncate@p=0.01,seed=7"
+
+Keys: ``at`` (fire at these steps, ``|``-separated), ``every`` (fire
+when ``step % every == 0``, step > 0), ``p`` (probability per query,
+hashed deterministically), ``proc`` (only on this process index),
+``delay`` (seconds, ``slow_worker``), ``seed`` (decorrelates ``p``
+clauses).  Sites and where they are threaded:
+
+====================  ====================================================
+``record_corrupt``    dataset/seqfile.py — flip a byte of a record payload
+``record_truncate``   dataset/seqfile.py — short-read a record (exercises
+                      the read-length validation)
+``nan_grad``          optim train loop — poison the step's batch with NaN
+``inf_grad``          optim train loop — poison the step's batch with Inf
+``slow_worker``       optim train loop — sleep ``delay`` s before the step
+``ckpt_write_fail``   utils/fs.py — first write attempt raises OSError
+                      (exercises the bounded-retry path)
+``ckpt_partial``      utils/fs.py — write truncated bytes straight to the
+                      target, no atomic rename (a crash mid-write)
+``ckpt_bitflip``      utils/fs.py — flip one bit of the stored bytes
+                      (below the CRC sidecar, so verification must catch)
+``proc_kill``         optim train loop — os._exit(1) (induced host death)
+====================  ====================================================
+"""
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import struct
+
+logger = logging.getLogger("bigdl_tpu.resilience")
+
+SITES = (
+    "record_corrupt", "record_truncate",
+    "nan_grad", "inf_grad", "slow_worker",
+    "ckpt_write_fail", "ckpt_partial", "ckpt_bitflip",
+    "proc_kill",
+)
+
+ENV_VAR = "BIGDL_FAULTS"
+
+
+class FaultSpec:
+    """One parsed clause of a fault plan."""
+
+    __slots__ = ("site", "at", "every", "p", "proc", "delay", "seed")
+
+    def __init__(self, site, at=None, every=None, p=None, proc=None,
+                 delay=0.05, seed=0):
+        if site not in SITES:
+            raise ValueError(
+                f"unknown fault site {site!r}; known sites: {SITES}")
+        if at is None and every is None and p is None:
+            raise ValueError(
+                f"fault clause {site!r} needs a schedule: at=, every= or p=")
+        self.site = site
+        self.at = frozenset(int(v) for v in at) if at is not None else None
+        self.every = int(every) if every is not None else None
+        self.p = float(p) if p is not None else None
+        self.proc = int(proc) if proc is not None else None
+        self.delay = float(delay)
+        self.seed = int(seed)
+
+    def fires(self, step: int, process_index: int) -> bool:
+        if self.proc is not None and process_index != self.proc:
+            return False
+        if self.at is not None and step in self.at:
+            return True
+        if self.every is not None and step > 0 and step % self.every == 0:
+            return True
+        if self.p is not None:
+            return _hash_unit(step, process_index, self.site,
+                              self.seed) < self.p
+        return False
+
+    def __repr__(self):
+        sched = (f"at={sorted(self.at)}" if self.at is not None else
+                 f"every={self.every}" if self.every is not None else
+                 f"p={self.p}")
+        proc = "" if self.proc is None else f",proc={self.proc}"
+        return f"FaultSpec({self.site}@{sched}{proc})"
+
+
+def _hash_unit(step: int, process_index: int, site: str, seed: int) -> float:
+    """Deterministic uniform [0,1) from the fault key — blake2 of the
+    packed tuple (Python's ``hash`` is salted per process, useless
+    here)."""
+    h = hashlib.blake2s(
+        struct.pack(">qqq", step, process_index, seed) + site.encode(),
+        digest_size=8).digest()
+    return struct.unpack(">Q", h)[0] / 2.0 ** 64
+
+
+def parse_faults(spec: str):
+    """``BIGDL_FAULTS`` string -> list of FaultSpec (see module doc)."""
+    out = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        site, _, argstr = clause.partition("@")
+        kwargs = {}
+        if argstr:
+            for kv in argstr.split(","):
+                k, sep, v = kv.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"bad fault arg {kv!r} in clause {clause!r} "
+                        "(want key=value)")
+                k = k.strip()
+                if k == "at":
+                    kwargs["at"] = [int(x) for x in v.split("|")]
+                elif k in ("every", "p", "proc", "delay", "seed"):
+                    kwargs[k] = v
+                else:
+                    raise ValueError(
+                        f"unknown fault arg {k!r} in clause {clause!r}")
+        out.append(FaultSpec(site.strip(), **kwargs))
+    return out
+
+
+class FaultInjector:
+    """A parsed fault plan plus per-site query counters.
+
+    ``fires(site, step)`` is the single decision point every injection
+    site calls.  ``step`` defaults to a per-site query counter (data
+    sites count records; checkpoint sites count writes); the train loop
+    passes its iteration number explicitly so faults line up with
+    ``neval``.  Process identity is resolved lazily from jax (overridable
+    for tests / pre-jax-init paths via ``process_index``)."""
+
+    def __init__(self, specs, process_index: int | None = None):
+        if isinstance(specs, str):
+            specs = parse_faults(specs)
+        self.specs = list(specs)
+        self._proc = process_index
+        self._counters = {}
+        self._by_site = {}
+        for s in self.specs:
+            self._by_site.setdefault(s.site, []).append(s)
+
+    def process_index(self) -> int:
+        if self._proc is None:
+            try:
+                import jax
+                self._proc = jax.process_index()
+            except Exception:
+                self._proc = 0
+        return self._proc
+
+    def armed(self, site: str) -> bool:
+        """True if any clause targets ``site`` (cheap hot-path guard)."""
+        return site in self._by_site
+
+    def fires(self, site: str, step: int | None = None):
+        """The matching FaultSpec if ``site`` should fault now, else
+        None.  With ``step=None`` the site's own query counter is used
+        (and advanced)."""
+        specs = self._by_site.get(site)
+        if not specs:
+            return None
+        if step is None:
+            step = self._counters.get(site, 0)
+            self._counters[site] = step + 1
+        proc = self.process_index()
+        for s in specs:
+            if s.fires(step, proc):
+                logger.warning("FaultInjector: %s fires at step %d "
+                               "(process %d)", s, step, proc)
+                return s
+        return None
+
+    def __bool__(self):
+        return bool(self.specs)
+
+    def __repr__(self):
+        return f"FaultInjector({self.specs})"
+
+
+# -- process-wide plan (env-configured; tests use configure) ---------------
+
+_INJECTOR: FaultInjector | None = None
+_LOADED = False
+
+
+def get() -> FaultInjector | None:
+    """The process fault plan, or None when chaos is off.  Reads
+    ``BIGDL_FAULTS`` once; ``configure``/``clear`` override.  Call sites
+    keep the disabled path to one None-check."""
+    global _INJECTOR, _LOADED
+    if not _LOADED:
+        _LOADED = True
+        spec = os.environ.get(ENV_VAR, "").strip()
+        if spec:
+            _INJECTOR = FaultInjector(spec)
+            logger.warning("chaos mode: %s=%r", ENV_VAR, spec)
+    return _INJECTOR
+
+
+def configure(spec, process_index: int | None = None) -> FaultInjector:
+    """Install a fault plan programmatically (tests, drills)."""
+    global _INJECTOR, _LOADED
+    _INJECTOR = (spec if isinstance(spec, FaultInjector) or spec is None
+                 else FaultInjector(spec, process_index=process_index))
+    _LOADED = True
+    return _INJECTOR
+
+
+def clear():
+    """Disable chaos mode (and forget the env plan until re-read)."""
+    global _INJECTOR, _LOADED
+    _INJECTOR = None
+    _LOADED = True
+
+
+# -- payload corruptors shared by the injection sites ----------------------
+
+def flip_bit(data: bytes, spec: FaultSpec, step: int = 0) -> bytes:
+    """Flip one deterministic bit of ``data`` (storage corruption)."""
+    if not data:
+        return data
+    u = _hash_unit(step, 0, spec.site + ".pos", spec.seed)
+    pos = int(u * len(data))
+    return data[:pos] + bytes([data[pos] ^ (1 << (step % 8))]) + data[pos + 1:]
+
+
+def truncate(data: bytes, frac: float = 0.5) -> bytes:
+    """Drop the tail of ``data`` (a partial write / short read)."""
+    return data[:int(len(data) * frac)]

@@ -1,0 +1,133 @@
+"""Heartbeat/timeout watchdog for multi-host training.
+
+A killed process leaves its peers blocked inside the next collective —
+XLA cannot time out a dead all-reduce, so without outside help a 4-host
+job with one dead host hangs until the cluster scheduler reaps it (the
+reference fails fast instead: spark.task.maxFailures=1 kills the job and
+the operator restarts from the checkpoint).
+
+This watchdog is that fail-fast signal: every process runs a heartbeat
+thread touching ``<dir>/hb.<process_index>`` each ``interval`` seconds
+and a monitor thread checking every peer's file mtime.  A peer silent
+for ``timeout`` seconds means the job is dead — the monitor fires
+``on_stale`` (default: log loudly and ``os._exit(EXIT_CODE)``), so the
+survivors exit promptly and the restart-from-checkpoint path
+(``optim.optimizer.load_latest_checkpoint``) takes over.
+
+The heartbeat directory must be shared across the hosts being watched
+(NFS/GCS-fuse in production; a tmp dir in the 4-process CPU drill,
+tests/test_resilience.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+logger = logging.getLogger("bigdl_tpu.resilience")
+
+#: survivors exit with this code when a peer goes silent — distinct from
+#: crash (1) and clean exit (0) so drills can assert the watchdog fired
+EXIT_CODE = 43
+
+
+class Watchdog:
+    def __init__(self, directory: str, process_index: int, n_processes: int,
+                 interval: float = 0.5, timeout: float = 10.0,
+                 on_stale=None):
+        if timeout <= interval:
+            raise ValueError(
+                f"timeout ({timeout}) must exceed the heartbeat interval "
+                f"({interval}) or every process looks stale")
+        self.dir = directory
+        self.process_index = int(process_index)
+        self.n_processes = int(n_processes)
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        self.on_stale = on_stale or self._default_on_stale
+        self._stop = threading.Event()
+        self._threads = []
+        # peers get a grace period from watchdog start until their first
+        # beat: process bring-up (jax.distributed handshake, first
+        # compile) must not read as death
+        self._started_at = None
+        os.makedirs(directory, exist_ok=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._started_at = time.time()
+        self._beat()  # own file exists before any peer can probe it
+        for fn in (self._heartbeat_loop, self._monitor_loop):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"bigdl-watchdog-{fn.__name__}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2 * self.interval)
+        self._threads = []
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- heartbeat side ----------------------------------------------------
+    def _path(self, index: int) -> str:
+        return os.path.join(self.dir, f"hb.{index}")
+
+    def _beat(self):
+        path = self._path(self.process_index)
+        with open(path, "a"):
+            os.utime(path, None)
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat()
+            except OSError as e:  # transient FS hiccup: keep beating
+                logger.warning("watchdog heartbeat write failed: %s", e)
+
+    # -- monitor side ------------------------------------------------------
+    def stale_peers(self, now: float | None = None):
+        """Process indices whose heartbeat is older than ``timeout``
+        (missing files count only after the bring-up grace period)."""
+        now = time.time() if now is None else now
+        # probing before start(): the grace clock hasn't begun — nothing
+        # can be stale yet
+        started = self._started_at if self._started_at is not None else now
+        stale = []
+        for i in range(self.n_processes):
+            if i == self.process_index:
+                continue
+            try:
+                age = now - os.path.getmtime(self._path(i))
+            except OSError:
+                # no beat yet: stale only once the grace period passed
+                age = now - started
+            if age > self.timeout:
+                stale.append(i)
+        return stale
+
+    def _monitor_loop(self):
+        while not self._stop.wait(self.interval):
+            stale = self.stale_peers()
+            if stale:
+                self._stop.set()
+                self.on_stale(stale)
+                return
+
+    def _default_on_stale(self, stale):
+        logger.error(
+            "watchdog: process(es) %s silent > %.1fs — peer death; "
+            "exiting with code %d so the job fails fast (restart resumes "
+            "from the last valid checkpoint)", stale, self.timeout,
+            EXIT_CODE)
+        # os._exit, not sys.exit: the main thread is likely blocked inside
+        # a dead collective and would never unwind a SystemExit
+        os._exit(EXIT_CODE)

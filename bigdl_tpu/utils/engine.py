@@ -13,8 +13,30 @@ plus mesh construction for the distributed optimizer.
 from __future__ import annotations
 
 import os
+import signal
+import threading
 import numpy as np
 import jax
+
+
+def set_cpu_device_count(n: int):
+    """Pin ``n`` virtual CPU devices (must run before the first backend
+    touch).  Newer jax exposes ``jax_num_cpu_devices``; older jaxlibs only
+    read ``--xla_force_host_platform_device_count`` from XLA_FLAGS at
+    backend init — route through whichever this build supports so the
+    no-cluster test meshes (conftest, multiproc workers, BIGDL_CPU_MESH)
+    work on both."""
+    n = max(int(n), 1)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        import re
+        opt = "--xla_force_host_platform_device_count"
+        # replace, don't append: a subprocess inherits its parent's flag
+        # (the 8-device test mesh) and must still be able to pin its own
+        flags = re.sub(opt + r"=\d+", "",
+                       os.environ.get("XLA_FLAGS", "")).strip()
+        os.environ["XLA_FLAGS"] = f"{flags} {opt}={n}".strip()
 
 
 class _Engine:
@@ -24,6 +46,9 @@ class _Engine:
         self._core_number = 1
         self._mesh = None
         self._singleton_fd = None
+        self._preempted = threading.Event()
+        self._preempt_armed = False
+        self._prev_handlers = {}
 
     # -- lifecycle (ref Engine.init Engine.scala:339) ---------------------
     def init(self, node_number: int | None = None, core_number: int | None = None,
@@ -106,6 +131,55 @@ class _Engine:
         self._singleton_fd = fd  # keep open for the process lifetime
         return True
 
+    # -- preemption (docs/resilience.md) ----------------------------------
+    def install_preemption_handler(self, signals=(signal.SIGTERM,)):
+        """Arm SIGTERM-as-preemption: the cluster scheduler's eviction
+        notice (GCE preemption, k8s pod termination, SLURM timeout) sets
+        a flag instead of killing the process, and the training loop's
+        next iteration checkpoints and exits cleanly
+        (checkpoint-and-exit, ``LocalOptimizer._checkpoint_and_stop``).
+
+        Multi-host: install on EVERY process (same launcher code path) —
+        the distributed loop merges the flag across hosts each iteration
+        while armed, so one host's SIGTERM stops all of them at the same
+        step and nobody hangs in a half-abandoned collective.  Previous
+        handlers are chained.  Idempotent."""
+        if self._preempt_armed:
+            return self
+        for sig in signals:
+            def _handler(signum, frame, _sig=sig):
+                self._preempted.set()
+                prev = self._prev_handlers.get(_sig)
+                if callable(prev):
+                    prev(signum, frame)
+            self._prev_handlers[sig] = signal.signal(sig, _handler)
+        self._preempt_armed = True
+        return self
+
+    def preemption_armed(self) -> bool:
+        return self._preempt_armed
+
+    def preempted(self) -> bool:
+        """True once a preemption notice arrived (signal or
+        ``request_preemption``)."""
+        return self._preempted.is_set()
+
+    def request_preemption(self):
+        """Programmatic preemption notice (tests, custom schedulers) —
+        same effect as the armed signal arriving.  Multi-host: the
+        distributed loop only merges (and honors) the flag while
+        ``install_preemption_handler`` has been called on every process;
+        requesting preemption unarmed in a multi-process run is ignored
+        with a warning (an unmerged one-host stop would strand the other
+        hosts in a dead collective)."""
+        self._preempted.set()
+        return self
+
+    def clear_preemption(self):
+        """Reset the flag (a new run in the same process)."""
+        self._preempted.clear()
+        return self
+
     def engine_type(self) -> str:
         """Compute-backend tag (the reference returns MklBlas,
         Engine.scala:273-289); here the backend is XLA on the visible
@@ -161,6 +235,12 @@ class _Engine:
     def reset(self):
         if self._singleton_fd is not None:
             os.close(self._singleton_fd)  # releases the flock
+        for sig, prev in self._prev_handlers.items():
+            try:  # un-arm preemption: restore whatever was there before
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, TypeError, OSError):
+                pass  # non-main thread / exotic prior handler
         self.__init__()
 
 

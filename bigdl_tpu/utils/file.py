@@ -11,11 +11,61 @@ from __future__ import annotations
 
 import os
 import pickle
+import zlib
 
 import jax
 import numpy as np
 
 from bigdl_tpu.utils import fs
+
+#: sidecar holding "crc32hex length" of the payload bytes — written with
+#: every save so resume can reject bit-flipped / truncated snapshots
+CRC_SUFFIX = ".crc32"
+
+
+class ChecksumError(ValueError):
+    """Snapshot bytes do not match their CRC32 sidecar (bit rot, partial
+    write, torn copy) — the snapshot must not be trusted."""
+
+
+def _crc_path(path: str) -> str:
+    return str(path) + CRC_SUFFIX
+
+
+def _verify_bytes(path: str, data: bytes):
+    """Raise ChecksumError if ``path``'s sidecar disagrees with ``data``.
+    Pre-sidecar snapshots (no ``.crc32`` file) pass — unpickling is their
+    only integrity check, as before."""
+    sc = _crc_path(path)
+    if not fs.exists(sc):
+        return
+    try:
+        want_crc_hex, want_len = fs.read_bytes(sc).split()
+        want_crc, want_len = int(want_crc_hex, 16), int(want_len)
+    except (ValueError, OSError) as e:
+        raise ChecksumError(f"{path}: unreadable CRC sidecar {sc}: {e}")
+    got_crc = zlib.crc32(data)
+    if len(data) != want_len or got_crc != want_crc:
+        raise ChecksumError(
+            f"{path}: checksum mismatch — sidecar says crc32 "
+            f"{want_crc:08x} / {want_len} bytes, payload is "
+            f"{got_crc:08x} / {len(data)} bytes (corrupt or partial "
+            "snapshot; resume should fall back to an older one)")
+
+
+def verify(path: str) -> bool:
+    """True iff ``path`` holds a loadable snapshot: bytes match the CRC
+    sidecar when one exists, else the pickle at least parses.  Used by
+    the resume scan (``optim.optimizer.load_latest_checkpoint``) to skip
+    corrupt/partial snapshots without aborting."""
+    try:
+        data = fs.read_bytes(path)
+        _verify_bytes(path, data)
+        if not fs.exists(_crc_path(path)):
+            pickle.loads(data)  # no sidecar: parsing is the only check
+        return True
+    except Exception:
+        return False
 
 
 def _to_numpy(tree):
@@ -32,15 +82,30 @@ def _to_jax(tree):
 def save(obj, path, overwrite: bool = True):
     """Save an arbitrary pytree (ref File.save File.scala:63).  ``path``
     may be any fsspec URL (gs://, s3://, memory://) — the HDFS role of
-    File.scala:81-116 — or a plain local path (atomic tmp+rename)."""
+    File.scala:81-116 — or a plain local path (atomic tmp+rename).
+
+    A CRC32 sidecar (``path + ".crc32"``) is written AFTER the payload:
+    a crash between the two writes leaves either the old consistent
+    pair untouched (payload write died before its atomic rename) or a
+    new payload with a stale sidecar, which ``load``/``verify`` reject
+    and resume falls back past — never an undetectably torn snapshot,
+    and never a still-valid old snapshot poisoned by a fresher
+    sidecar."""
     if fs.exists(path) and not overwrite:
         raise FileExistsError(path)
-    fs.write_bytes_atomic(path, pickle.dumps(_to_numpy(obj)))
+    data = pickle.dumps(_to_numpy(obj))
+    fs.write_bytes_atomic(path, data)
+    fs.write_bytes_atomic(
+        _crc_path(path), b"%08x %d\n" % (zlib.crc32(data), len(data)),
+        faultable=False)
 
 
 def load(path):
-    with fs.open_file(path, "rb") as f:
-        return _to_jax(pickle.load(f))
+    """Load a snapshot, verifying it against its CRC sidecar when one
+    exists (raises ChecksumError on mismatch)."""
+    data = fs.read_bytes(path)
+    _verify_bytes(path, data)
+    return _to_jax(pickle.loads(data))
 
 
 def _pickle_architecture(module):

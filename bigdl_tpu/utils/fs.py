@@ -12,7 +12,11 @@ fsspec-registered scheme works end to end.
 """
 from __future__ import annotations
 
+import logging
 import os
+import time
+
+logger = logging.getLogger("bigdl_tpu.utils")
 
 
 def is_url(path: str) -> bool:
@@ -75,7 +79,7 @@ def parent(path: str) -> str:
     return scheme + "://" + head
 
 
-def write_bytes_atomic(path: str, data: bytes):
+def _write_once(path: str, data: bytes):
     """Local: tmp + atomic rename (a crashed writer never corrupts the
     target).  Remote object stores upload whole objects, which is already
     atomic-visible, so the tmp dance is skipped there."""
@@ -89,6 +93,62 @@ def write_bytes_atomic(path: str, data: bytes):
     makedirs(parent(path))
     with open_file(path, "wb") as f:
         f.write(data)
+
+
+def write_bytes_atomic(path: str, data: bytes, attempts: int = 3,
+                       backoff: float = 0.1, faultable: bool = True):
+    """Atomic write with bounded retry + exponential backoff — transient
+    checkpoint-write failures (remote store hiccup, NFS blip) must not
+    kill a training run (the HDFS-retry role the reference inherits from
+    Hadoop).  After ``attempts`` consecutive OSErrors the last one
+    propagates.
+
+    ``faultable=False`` exempts a write from chaos injection — used for
+    CRC sidecars, which model the *detector*, not the corruptible
+    payload (``bigdl_tpu.resilience.faults``, sites ``ckpt_write_fail``/
+    ``ckpt_partial``/``ckpt_bitflip``)."""
+    inj = None
+    if faultable:
+        from bigdl_tpu.resilience import faults
+        inj = faults.get()
+    last = None
+    for attempt in range(max(int(attempts), 1)):
+        try:
+            if inj is not None and attempt == 0:
+                # injected faults fire on the first attempt only: the
+                # retry path is exactly what ckpt_write_fail exercises
+                if inj.fires("ckpt_write_fail") is not None:
+                    raise OSError(f"injected checkpoint write failure: "
+                                  f"{path}")
+                spec = inj.fires("ckpt_partial")
+                if spec is not None:
+                    # a crash mid-write: truncated bytes land on the
+                    # TARGET (no tmp+rename) — what resume must survive
+                    from bigdl_tpu.resilience.faults import truncate
+                    short = truncate(data)
+                    if is_url(path):
+                        _write_once(path, short)
+                    else:
+                        makedirs(os.path.dirname(os.path.abspath(path)))
+                        with open(path, "wb") as f:
+                            f.write(short)
+                    return
+                spec = inj.fires("ckpt_bitflip")
+                if spec is not None:
+                    from bigdl_tpu.resilience.faults import flip_bit
+                    data = flip_bit(data, spec)
+            _write_once(path, data)
+            return
+        except OSError as e:
+            last = e
+            if attempt == attempts - 1:
+                raise
+            delay = backoff * (2 ** attempt)
+            logger.warning("write %s failed (%s); retry %d/%d in %.2fs",
+                           path, e, attempt + 1, attempts - 1, delay)
+            if delay > 0:
+                time.sleep(delay)
+    raise last  # pragma: no cover — loop always returns or raises
 
 
 def read_bytes(path: str) -> bytes:

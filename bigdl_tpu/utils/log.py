@@ -10,8 +10,26 @@ from __future__ import annotations
 
 import logging
 import sys
+import time
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+_LAST_WARN: dict = {}
+
+
+def warn_every(logger: logging.Logger, key: str, interval: float,
+               msg: str, *args) -> bool:
+    """Rate-limited warning: at most one ``key`` warning per ``interval``
+    seconds (the first always fires).  A chaos run skipping thousands of
+    non-finite steps must not drown the progress log; returns whether the
+    line was emitted."""
+    now = time.monotonic()
+    last = _LAST_WARN.get(key)
+    if last is not None and now - last < interval:
+        return False
+    _LAST_WARN[key] = now
+    logger.warning(msg, *args)
+    return True
 
 
 def init_logging(level=logging.INFO, log_file: str = None, fmt: str = _FORMAT):

@@ -43,6 +43,59 @@ class RandomGenerator:
     def get_seed(self) -> int:
         return self._seed
 
+    # -- snapshot/restore (checkpoint payload + scoped borrowing) ---------
+    def snapshot(self) -> dict:
+        """Portable copy of the host-stream state: seed, epoch, derived-
+        thread counter, device-key counter and the full numpy MT state.
+        Rides the checkpoint payload (``state.N["rng"]``) so a resumed
+        run replays the uninterrupted run's shuffle/augmentation stream;
+        also the supported way for helpers to borrow the process RNG
+        (``scoped``) instead of poking privates."""
+        with self._lock:
+            return {
+                "seed": self._seed,
+                "epoch": self._epoch,
+                "thread_counter": self._thread_counter,
+                "key_counter": self._key_counter,
+                "np_state": self._np.get_state(),
+                "device_impl": self._device_impl,
+            }
+
+    def restore(self, snap: dict):
+        """Inverse of ``snapshot``.  ``_epoch`` is restored too, so live
+        worker threads whose derived streams postdate the snapshot
+        re-derive (same ordinals -> same streams) on their next draw.
+        The restoring thread becomes the seed-stream owner."""
+        with self._lock:
+            self._seed = int(snap["seed"])
+            self._epoch = int(snap["epoch"])
+            self._thread_counter = int(snap["thread_counter"])
+            self._key_counter = int(snap["key_counter"])
+            self._device_impl = snap.get("device_impl")
+            self._main_thread = threading.get_ident()
+            st = snap["np_state"]
+            # checkpoint round-trips may hand the 624-word key back as a
+            # jax array; RandomState.set_state wants numpy uint32
+            st = (st[0], np.asarray(st[1], np.uint32)) + tuple(st[2:])
+            self._np = np.random.RandomState()
+            self._np.set_state(st)
+        return self
+
+    def scoped(self):
+        """Context manager: snapshot on entry, restore on exit — for
+        helpers that reseed mid-run (bench drills, data peeks) and must
+        leave the caller's stream untouched."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _scope():
+            snap = self.snapshot()
+            try:
+                yield self
+            finally:
+                self.restore(snap)
+        return _scope()
+
     # -- host-side (parameter init, shuffles) -----------------------------
     def uniform(self, a=0.0, b=1.0, size=None):
         return self.np_rng().uniform(a, b, size)

@@ -28,6 +28,13 @@ def main(argv=None):
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--model", default=None, help="model snapshot to resume")
     p.add_argument("--state", default=None, help="state snapshot to resume")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest VALID snapshot under "
+                        "--checkpoint (corrupt/partial ones are skipped; "
+                        "docs/resilience.md)")
+    p.add_argument("--preemptible", action="store_true",
+                   help="SIGTERM checkpoints and exits cleanly instead of "
+                        "killing the run (docs/resilience.md)")
     p.add_argument("--distributed", action="store_true")
     args = p.parse_args(argv)
 
@@ -60,15 +67,33 @@ def main(argv=None):
     if args.model:
         File.load_module_into(model, args.model)
 
+    resume_blob = None
+    if args.resume:
+        if not args.checkpoint:
+            p.error("--resume needs --checkpoint (the snapshot folder)")
+        from bigdl_tpu.optim import load_latest_checkpoint
+        found = load_latest_checkpoint(args.checkpoint, restore_rng=True)
+        if found is not None:
+            model, resume_blob, neval = found
+            logging.info("resuming from snapshot %d under %s", neval,
+                         args.checkpoint)
+        else:
+            logging.warning("no valid snapshot under %s — starting fresh",
+                            args.checkpoint)
+
     optimizer = Optimizer(model, train_ds, nn.ClassNLLCriterion())
     state = T(learningRate=args.learningRate, momentum=args.momentum,
               weightDecay=args.weightDecay)
     if args.state:
-        blob = File.load(args.state)
-        state.update(blob["state"])
-        if blob.get("opt_state") is not None:
-            optimizer.set_optim_state(blob["opt_state"])  # momentum etc.
+        resume_blob = File.load(args.state)
+    if resume_blob is not None:
+        state.update(resume_blob["state"])
+        if resume_blob.get("opt_state") is not None:
+            optimizer.set_optim_state(resume_blob["opt_state"])  # momentum
     optimizer.set_state(state)
+    if args.preemptible:
+        from bigdl_tpu.utils.engine import Engine
+        Engine.install_preemption_handler()
     optimizer.set_end_when(max_epoch(args.maxEpoch))
     optimizer.set_validation(every_epoch(), test_ds, [Top1Accuracy()])
     if args.checkpoint:

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Full chaos matrix: every injected-fault resilience test, INCLUDING the
+# multi-process drills the tier-1 run skips (watchdog peer-death, SIGTERM
+# preemption barrier across 4 processes).
+#
+#   scripts/chaos_drill.sh            # full matrix
+#   scripts/chaos_drill.sh -k ckpt    # usual pytest filters pass through
+#
+# Fault model / BIGDL_FAULTS syntax: docs/resilience.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== chaos drill: fast injected-fault smokes =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+    -m "chaos and not slow" -p no:cacheprovider "$@"
+
+echo "== chaos drill: multi-process fault drills (slow) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+    -m "chaos and slow" -p no:cacheprovider "$@"
+
+echo "chaos drill: all green"

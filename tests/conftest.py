@@ -18,7 +18,9 @@ import pytest
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from bigdl_tpu.utils.engine import set_cpu_device_count  # noqa: E402
+
+set_cpu_device_count(8)
 # Persistent XLA compilation cache: the suite is dominated by XLA
 # recompiles (each parametrized crosscheck compiles fresh); warm runs pull
 # the executable from disk instead.  Threshold 0 = cache every compile.

@@ -16,6 +16,16 @@ spark.task.maxFailures=1, lenet Train.scala:46 — a failed task kills the
 job; restart resumes from the checkpoint).
 ``--resume``: load the newest model.N/state.N from ckpt_dir before
 training, so the run continues from the recorded neval.
+
+Resilience drills (tests/test_resilience.py):
+``--faults SPEC``: install a FaultInjector plan (BIGDL_FAULTS syntax;
+per-process targeting via the spec's own ``proc=`` filter).
+``--watchdog DIR``: run under the heartbeat watchdog; a silent peer makes
+this worker exit with resilience.watchdog.EXIT_CODE instead of hanging
+in the dead collective.
+``--preempt``: arm Engine.install_preemption_handler (pass to EVERY
+process — the merged stop flag is a collective).
+``--preempt-at N``: this worker SIGTERMs itself once neval reaches N.
 """
 import json
 import os as _os
@@ -28,6 +38,24 @@ def main():
     if "--die-at" in argv:
         i = argv.index("--die-at")
         die_at = int(argv[i + 1])
+        del argv[i:i + 2]
+    faults_spec = None
+    if "--faults" in argv:
+        i = argv.index("--faults")
+        faults_spec = argv[i + 1]
+        del argv[i:i + 2]
+    watchdog_dir = None
+    if "--watchdog" in argv:
+        i = argv.index("--watchdog")
+        watchdog_dir = argv[i + 1]
+        del argv[i:i + 2]
+    preempt = "--preempt" in argv
+    if preempt:
+        argv.remove("--preempt")
+    preempt_at = None
+    if "--preempt-at" in argv:
+        i = argv.index("--preempt-at")
+        preempt_at = int(argv[i + 1])
         del argv[i:i + 2]
     resume = "--resume" in argv
     if resume:
@@ -47,8 +75,19 @@ def main():
 
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    from bigdl_tpu.utils.engine import set_cpu_device_count
+    set_cpu_device_count(2)
     jax.config.update("jax_default_matmul_precision", "highest")
+    if nproc > 1:
+        try:
+            # older jax: multi-process CPU collectives need gloo selected
+            # explicitly ("Multiprocess computations aren't implemented
+            # on the CPU backend" otherwise; with one process the gloo
+            # factory instead crashes on the absent distributed client);
+            # newer jax defaults sensibly and dropped the knob
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass
 
     import os
     os.environ["BIGDL_CHECK_SINGLETON"] = "0"
@@ -59,6 +98,17 @@ def main():
                                 num_processes=nproc, process_id=pid)
     assert jax.process_count() == nproc
     assert jax.device_count() == 2 * nproc
+
+    watchdog = None
+    if watchdog_dir:
+        from bigdl_tpu.resilience import Watchdog
+        watchdog = Watchdog(watchdog_dir, pid, nproc,
+                            interval=0.3, timeout=6.0).start()
+    if faults_spec:
+        from bigdl_tpu.resilience import faults as _faults
+        _faults.configure(faults_spec, process_index=pid)
+    if preempt:
+        Engine.install_preemption_handler()
 
     import numpy as np
     import bigdl_tpu.nn as nn
@@ -137,7 +187,8 @@ def main():
         # state carries neval, so max_iteration(6) resumes mid-count
         nevals = sorted(int(f.split(".")[-1])
                         for f in _os.listdir(ckpt_dir)
-                        if f.startswith("model."))
+                        if f.startswith("model.")
+                        and f.split(".")[-1].isdigit())
         latest = nevals[-1]
         model = File.load_module(_os.path.join(ckpt_dir,
                                                "model.%d" % latest))
@@ -171,18 +222,37 @@ def main():
                 _os._exit(1)   # simulated mid-training crash
             return s.get("neval", 0) > 6
         opt.set_end_when(Trigger(die_or_end, "die-at-%d" % die_at))
+    elif preempt_at is not None:
+        import signal as _signal
+
+        def sigterm_or_end(s):
+            # the scheduler's eviction notice, self-inflicted: the armed
+            # handler flips the flag, the loop's merged check stops every
+            # process at the same iteration with a final checkpoint
+            if s.get("neval", 0) >= preempt_at and not Engine.preempted():
+                _os.kill(_os.getpid(), _signal.SIGTERM)
+            return s.get("neval", 0) > 6
+        opt.set_end_when(Trigger(sigterm_or_end,
+                                 "preempt-at-%d" % preempt_at))
     else:
         opt.set_end_when(max_iteration(6))
     if ckpt_dir and not resume:
         opt.set_checkpoint(ckpt_dir, several_iteration(3))
 
     opt.optimize()
+    if watchdog is not None:
+        # training survived; peers exit at slightly different times from
+        # here on, which must not read as peer death
+        watchdog.stop()
     losses = [float(opt.state["loss"])]
 
     psum = float(sum(np.abs(np.asarray(p)).sum()
                      for p in jax.tree_util.tree_leaves(model.params())))
 
     out = {"process_id": pid, "losses": losses, "psum": psum,
+           "preempted": bool(opt.state.get("preempted", False)),
+           "final_neval": int(opt.state.get("neval", 0)),
+           "nonfinite_skips": int(opt.state.get("nonFiniteSkips", 0)),
            # per-node metric breakdown (ref Metrics.scala "computing time
            # for each node"): one entry per process
            "compute_per_node": opt.metrics.per_node(
@@ -208,7 +278,8 @@ def main():
         # every process reads the same files process 0 wrote
         from bigdl_tpu.utils import file as File
         nevals = sorted(int(f.split(".")[-1]) for f in out["ckpt_files"]
-                        if f.startswith("model."))
+                        if f.startswith("model.")
+                        and f.split(".")[-1].isdigit())
         m2 = File.load_module(_os.path.join(ckpt_dir,
                                             "model.%d" % nevals[-1]))
         opt2 = DistriOptimizer(m2, ds, nn.ClassNLLCriterion())

@@ -7,6 +7,8 @@ Ref-optimizer oracle pattern, RefLocalOptimizer.scala:30), ring attention.
 """
 import numpy as np
 import jax
+
+from bigdl_tpu.parallel.compat import shard_map
 import jax.numpy as jnp
 import pytest
 from functools import partial
@@ -32,7 +34,7 @@ def test_collectives_shard_map():
     mesh = data_parallel_mesh()
     n = mesh.size
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def f(x):
         return coll.all_reduce(x.sum(keepdims=True), "data") * jnp.ones_like(x)
 
@@ -47,12 +49,12 @@ def test_reduce_scatter_all_gather_roundtrip():
     mesh = data_parallel_mesh()
     n = mesh.size
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def rs_ag(x):
         scattered = coll.reduce_scatter(x, "data")
         return coll.all_gather(scattered, "data")
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def ar(x):
         return coll.all_reduce(x, "data")
 
@@ -65,7 +67,7 @@ def test_ring_shift():
     mesh = data_parallel_mesh()
     n = mesh.size
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @partial(shard_map, mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def f(x):
         return coll.ring_shift(x, "data", 1)
 

@@ -109,7 +109,7 @@ def test_two_process_pipeline_matches_single_process(tmp_path):
     assert any(f.startswith("model.") for f in files), files
     from bigdl_tpu.utils import file as File
     latest = max(int(f.split(".")[-1]) for f in files
-                 if f.startswith("model."))
+                 if f.startswith("model.") and f.split(".")[-1].isdigit())
     m = File.load_module(str(ck / f"model.{latest}"))
     total = sum(float(np.abs(np.asarray(p)).sum())
                 for p in m.parameters()[0])
@@ -136,7 +136,7 @@ def test_two_process_hybrid_dp_pp_checkpoint_dedups_replicas(tmp_path):
     from bigdl_tpu.utils import file as File
     files = two[0]["ckpt_files"]
     latest = max(int(f.split(".")[-1]) for f in files
-                 if f.startswith("model."))
+                 if f.startswith("model.") and f.split(".")[-1].isdigit())
     m = File.load_module(str(ck / f"model.{latest}"))
     # every layer's params present exactly once with the right shapes
     shapes = sorted(tuple(p.shape) for p in m.parameters()[0])

@@ -287,7 +287,8 @@ def test_pipeline_checkpoint_resume_matches_uninterrupted(tmp_path):
     # draws (a throwaway model init) and skip its consumed batches.
     nevals = sorted(int(f.name.split(".")[-1])
                     for f in tmp_path.iterdir()
-                    if f.name.startswith("model."))
+                    if f.name.startswith("model.")
+                    and f.name.split(".")[-1].isdigit())
     latest = nevals[-1]
     m_b = File.load_module(str(tmp_path / f"model.{latest}"))
     snap = File.load(str(tmp_path / f"state.{latest}"))

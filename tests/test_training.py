@@ -102,7 +102,8 @@ class TestLocalOptimizer:
         assert any(f.startswith("state.") for f in files)
 
         # resume: load snapshot into a fresh model; params match trained ones
-        snap = [f for f in files if f.startswith("model.")][-1]
+        snap = [f for f in files if f.startswith("model.")
+                and f.split(".")[-1].isdigit()][-1]
         set_seed(99)
         model2 = linear_model()
         File.load_module_into(model2, str(tmp_path / snap))

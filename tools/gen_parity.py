@@ -193,6 +193,15 @@ audits should not flag these):
   (one-dispatch lag vs the reference's in-flight `invokeAndWait2` timeout);
   threshold arithmetic, finished-count division, and the max-drop rejection
   follow the reference exactly (`optim/straggler.py`).
+- Maxpool gradient tie rule (`_RESHAPE_POOL`, `bigdl_tpu/nn/pooling.py`):
+  exact non-overlapping pools (kernel == stride, unpadded — the VGG/LeNet
+  shape) use a reshape+max formulation whose backward splits the gradient
+  EVENLY among tied in-window maxima; the reference/Torch routes the full
+  gradient to the FIRST maximum in row-major order (overlapping/padded
+  pools here use XLA select-and-scatter: one winner, possibly a different
+  tie).  Ties are common with byte-quantized image inputs, so gradients
+  diverge from the reference there while per-window gradient mass is
+  identical (porting guide #6).
 - RNG: seeded determinism is preserved, but streams are JAX counter-based
   PRNG, not Torch's Mersenne-Twister (SURVEY §7 hard parts).
 - RNN generation (`models/rnn.generate`) samples the standard inverse-CDF
