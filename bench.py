@@ -526,7 +526,17 @@ def _summary_line(entries, primary, roof, device, roof_src="measured",
                   eval_entry=None):
     """The driver-contract JSON line for whatever has been measured so
     far.  Printed after EVERY config (the driver takes the LAST line), so
-    a mid-run kill still reports the completed configs."""
+    a mid-run kill still reports the completed configs.
+
+    FENCED (VERDICT r5 weak 1): the driver captures only the last
+    ~2000 bytes of stdout, and round 5's summary — which inlined every
+    full config entry plus the eval block — outgrew that window, so
+    BENCH_r05.json shipped ``parsed: null``.  The summary now carries
+    only the headline keys plus a COMPACT per-config digest
+    (config/value/mfu) and a trimmed eval; the full per-config detail
+    (bands, flops, losses) lives in the per-config lines main() re-emits
+    just above.  tests/test_bench_contract.py asserts a fully-populated
+    summary stays under 2000 bytes."""
     if primary is None and entries:
         primary = entries[0]
     if primary is None:
@@ -544,10 +554,19 @@ def _summary_line(entries, primary, roof, device, roof_src="measured",
         "measured_matmul_roofline_tflops": roof,
         "roofline_source": roof_src if roof is not None else "unavailable",
         "device": device,
-        "configs": entries,
+        # digest only — full entries are their own stdout lines
+        "configs": [{"config": e.get("config"), "value": e.get("value"),
+                     "mfu": e.get("mfu")} for e in entries],
     }
     if eval_entry is not None:
-        detail["eval"] = eval_entry
+        ev = {k: eval_entry[k] for k in
+              ("records_per_sec", "step_time_ms", "top1", "top5")
+              if k in eval_entry}
+        rd = eval_entry.get("real_data")
+        if isinstance(rd, dict):
+            ev["real_data"] = {k: rd[k] for k in
+                               ("top1", "top5", "n_records") if k in rd}
+        detail["eval"] = ev
     return json.dumps({
         "metric": "images/sec/chip (Inception-v1 bs128 sync-SGD train)",
         "value": primary["value"],
@@ -584,8 +603,12 @@ def main():
                 continue
             if "eval" in entry:
                 eval_entry = entry["eval"]
+                print(json.dumps(entry), flush=True)   # full eval detail
                 continue
             entries.append(entry)
+            # re-emit the FULL per-config entry as its own stdout line:
+            # the fenced summary below carries only a digest of it
+            print(json.dumps(entry), flush=True)
             if "Inception" in entry["config"]:
                 primary = entry
         print(_summary_line(entries, primary, roof, device, roof_src,

@@ -168,11 +168,16 @@ class SpatialCrossMapLRN(TensorModule):
 
     _STENCIL = False  # module-level A/B switches, see tools/ab_step.py:
     _SQRT_POW = True  # in-model grid measured rw-LRN+sqrt fastest (PERF_NOTES)
-    # Fused Pallas LRN (ops/pallas_kernels.lrn_channel) measured SLOWER
-    # than this XLA path on the v5e (538 vs 808-852 us fwd+bwd on the
-    # Inception C64 56x56 shape, device-clock) — XLA's channel
-    # reduce_window + fusions already run well here, unlike its maxpool
-    # emitter.  Kernel kept as tested evidence; off by default.
+    # Fused Pallas LRN (ops/pallas_kernels.lrn_channel).  The round-3
+    # form measured SLOWER than this XLA path on the v5e (538 vs
+    # 808-852 us fwd+bwd on the Inception C64 56x56 shape,
+    # device-clock).  Round 6 rebuilt the kernel pair — the forward now
+    # stores z (the window-sum denominator base) as the VJP residual so
+    # the backward is ONE pass with a single adjoint window sum, where
+    # the round-3 backward recomputed z from x — and the verdict must
+    # be re-measured (tools/ab_device_clock.py pallas_lrn variant).
+    # DEFAULT OFF until that device A/B wins; "interpret" forces the
+    # Pallas interpreter on any backend (tests).
     _PALLAS = False
     _ANALYTIC_VJP = True   # see _lrn below
     _COMPUTE_DTYPE = True  # run the LRN chain in the policy compute dtype

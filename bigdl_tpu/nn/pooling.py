@@ -21,6 +21,13 @@ _COMPUTE_DTYPE_POOL = True  # run max pools in the policy compute dtype
 _RESHAPE_POOL = True  # exact non-overlapping max pools via reshape+max
 _SEPARABLE_POOL = False  # kxk max pool as (1,k)+(k,1) passes (A/B, r5)
 _NHWC_POOL = False  # windowed pools transposed to NHWC (A/B, r5)
+# Round-6 Mosaic kernel pair (ops/pallas_kernels.mosaic_maxpool2d):
+# argmax-storing forward + scatter-free gather backward replacing
+# select_and_scatter, C-on-lanes layout, strides via index maps + phase
+# folding.  DEFAULT OFF pending a device-clock A/B win (the adoption
+# rule every pool formulation has had to meet — PERF_NOTES round 6);
+# "interpret" forces the Pallas interpreter on any backend (tests).
+_PALLAS_POOL = False
 
 
 def _max_pool2d(x, window, strides, padding):
@@ -53,6 +60,12 @@ def _max_pool2d(x, window, strides, padding):
             and x.dtype == jnp.float32)
     xin = x.astype(p.compute_dtype) if cast else x
     n, c, h, w = xin.shape
+    if _PALLAS_POOL:
+        from bigdl_tpu.ops.pallas_kernels import mosaic_maxpool2d, _on_tpu
+        interp = _PALLAS_POOL == "interpret"
+        if interp or _on_tpu():
+            y = mosaic_maxpool2d(xin, window, strides, padding, interp)
+            return y.astype(x.dtype) if cast else y
     if (_RESHAPE_POOL and (kh, kw) == (dh, dw)
             and padding == ((0, 0), (0, 0))
             and h % kh == 0 and w % kw == 0):

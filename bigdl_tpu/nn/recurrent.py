@@ -34,6 +34,15 @@ from bigdl_tpu.utils.table import Table
 # output dtype is f32 (FP32/BF16_COMPUTE); BF16_ACT keeps the scan,
 # whose gates round through bf16.
 _PALLAS_BILSTM = True
+# Multi-timestep blocking (round 6): timesteps per kernel grid step for
+# ALL five recurrence paths (LSTM/Bi-LSTM/GRU/BiGRU/RNN).  >1 amortizes
+# per-grid-step overhead, moves the zx/h streams in block-sized DMAs
+# and batches the backward's weight-grad gemms over the block (the
+# serial dh chain is untouched — it is the real dependency).  Exact
+# math (time axis zero-padded; weight-grad f32 summation order
+# differs).  DEFAULT 1 (= round-5 behavior) pending a device-clock A/B
+# win, per the adoption rule (PERF_NOTES round 6).
+_BLOCK_T = 1
 
 
 def _pallas_gate():
@@ -221,7 +230,8 @@ class Recurrent(Container):
                              preferred_element_type=jnp.float32)
                   + cp["bias_i"] + cp["bias_h"])      # (T, N, H)
             wh = p.cast_compute(cp["h2h"].T)          # (H, H)
-            outs = rnn_recurrence(zx[:, None], wh[None], interp)[:, 0]
+            outs = rnn_recurrence(zx[:, None], wh[None], interp,
+                                  _BLOCK_T)[:, 0]
             return self._finish_pallas(outs), state
         if use_pallas and type(cell) is GRUCell:
             # GRU case of the VMEM-carry kernel pattern
@@ -235,7 +245,8 @@ class Recurrent(Container):
             zn = jnp.matmul(xs, cp["w_h"][:, :d].T) + cp["b_h"]
             outs = gru_recurrence(zrz[:, None], zn[:, None],
                                   cp["w_rz"][:, d:].T[None],
-                                  cp["w_h"][:, d:].T[None], interp)[:, 0]
+                                  cp["w_h"][:, d:].T[None], interp,
+                                  _BLOCK_T)[:, 0]
             return self._finish_pallas(outs), state
         if use_pallas:
             # single-direction case of the same VMEM-carry kernel pair
@@ -251,7 +262,8 @@ class Recurrent(Container):
             zx = (jnp.matmul(p.cast_compute(xs), wx,
                              preferred_element_type=jnp.float32)
                   + cp["bias"])                       # (T, N, 4H)
-            outs = bilstm_recurrence(zx[:, None], wh[None], interp)[:, 0]
+            outs = bilstm_recurrence(zx[:, None], wh[None], interp,
+                                     _BLOCK_T)[:, 0]
             return self._finish_pallas(outs), state
 
         def step(carry, x_t):
@@ -358,7 +370,7 @@ class BiRecurrent(Container):
         outs = gru_recurrence(zrz, zn,
                               jnp.swapaxes(wrz2[:, :, d:], 1, 2),
                               jnp.swapaxes(wh2[:, :, d:], 1, 2),
-                              _pallas_gate()[1])
+                              _pallas_gate()[1], _BLOCK_T)
         yf = jnp.swapaxes(outs[:, 0], 0, 1)               # (N, T, H)
         yb = jnp.swapaxes(jnp.flip(outs[:, 1], axis=0), 0, 1)
         return (jnp.concatenate([yf, yb], axis=-1)
@@ -432,7 +444,8 @@ class BiRecurrent(Container):
             # forward bit-exact vs the scan body; grads differ by f32
             # accumulation order.
             from bigdl_tpu.ops.pallas_kernels import bilstm_recurrence
-            outs = bilstm_recurrence(zx, wh, interp)       # (T, 2, N, H)
+            outs = bilstm_recurrence(zx, wh, interp,
+                                     _BLOCK_T)        # (T, 2, N, H)
             if reduced:
                 outs = outs.astype(p.compute_dtype)
         else:

@@ -47,3 +47,15 @@ def axis_size(axis_name):
     if hasattr(jax.lax, "axis_size"):
         return jax.lax.axis_size(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+def grad_psum_is_explicit():
+    """True when this jax's shard_map AD does NOT auto-psum cotangents
+    of replicated operands — the old ``jax.experimental.shard_map``
+    path, which this compat layer runs with ``check_rep=False`` (the
+    flag that also carried the efficient-transpose rewrite).  Callers
+    that accumulate parameter gradients against data-replicated params
+    inside shard_map must then reduce the accumulator over the data
+    axis themselves; on new jax the vjp already delivers the
+    cross-replica sum and an extra psum would double-count."""
+    return not hasattr(jax, "shard_map")

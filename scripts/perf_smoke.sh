@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Pallas-kernel regression smoke (round 6): run every kernel-equivalence
+# test in FORCED-INTERPRETER mode on CPU — JAX_PLATFORMS=cpu makes every
+# kernel gate pick interpret=True — so tier-1 machines without a chip
+# still catch kernel math regressions (fwd + bwd vs the XLA oracles:
+# reduce_window/select_and_scatter, lax.scan autodiff, SGD reference).
+#
+# The same tests carry the `perf` pytest marker and already run inside
+# the default tier-1 set (they are not marked slow); this script is the
+# one-command subset for a quick pre-commit check:
+#
+#   scripts/perf_smoke.sh            # the full perf-marked set
+#   scripts/perf_smoke.sh -k maxpool # narrow further
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest -q -m perf \
+    -p no:cacheprovider -p no:randomly \
+    tests/test_pallas_ops.py tests/test_recurrent.py tests/test_training.py \
+    "$@"

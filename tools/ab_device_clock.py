@@ -3,7 +3,20 @@ us/step per variant from jax.profiler traces (the relay-noise-immune
 comparison used for every round-4/5 perf decision).
 
 Usage: python tools/ab_device_clock.py vgg_cifar 128 [variant ...]
-Variants: base rbg  (dropout key impl)
+Variants:
+  base          defaults
+  rbg           hardware RngBitGenerator dropout keys
+  pallas_pool   round-6 Mosaic maxpool kernel pair (nn/pooling.py
+                _PALLAS_POOL — argmax fwd + gather bwd)
+  pallas_lrn    round-6 fused LRN kernel pair (SpatialCrossMapLRN._PALLAS
+                — stored-z residual backward)
+  pallas_winops pallas_pool + pallas_lrn together (the Inception case)
+  blockt4/blockt8
+                multi-timestep recurrence blocking (recurrent._BLOCK_T)
+The round-6 adoption A/Bs (run when a chip is attached):
+  python tools/ab_device_clock.py inception 128 base pallas_pool \
+      pallas_lrn pallas_winops
+  python tools/ab_device_clock.py bilstm 128 base blockt4 blockt8
 """
 import os as _os, sys as _sys
 _REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
@@ -85,6 +98,25 @@ def device_us_per_step(step, st, n=8, dispatches=4):
     return kernel_us / (n * dispatches), per_op
 
 
+def _apply_variant(name):
+    """Set the module flags for ``name``; returns an undo callable."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn import pooling, recurrent
+    old = (pooling._PALLAS_POOL, nn.SpatialCrossMapLRN._PALLAS,
+           recurrent._BLOCK_T)
+    if name in ("pallas_pool", "pallas_winops"):
+        pooling._PALLAS_POOL = True
+    if name in ("pallas_lrn", "pallas_winops"):
+        nn.SpatialCrossMapLRN._PALLAS = True
+    if name.startswith("blockt"):
+        recurrent._BLOCK_T = int(name[len("blockt"):])
+
+    def undo():
+        (pooling._PALLAS_POOL, nn.SpatialCrossMapLRN._PALLAS,
+         recurrent._BLOCK_T) = old
+    return undo
+
+
 def main():
     from bigdl_tpu import tensor as bt
     import bench
@@ -98,8 +130,12 @@ def main():
         impl = "rbg" if name == "rbg" else "threefry2x32"
         t0 = time.perf_counter()
         jax.config.update("jax_default_prng_impl", impl)
-        step, st = build_chunk(model_name, batch, impl)
-        us, per_op = device_us_per_step(step, st)
+        undo = _apply_variant(name)
+        try:
+            step, st = build_chunk(model_name, batch, impl)
+            us, per_op = device_us_per_step(step, st)
+        finally:
+            undo()
         print(f"{model_name} bs{batch} {name}: device-busy "
               f"{us/1e3:.3f} ms/step  (setup {time.perf_counter()-t0:.0f}s)",
               flush=True)

@@ -206,6 +206,12 @@ def conv_sig(it, comp_map) -> str:
 
 def categorize(opcode: str, op_name: str, src: str) -> str:
     o = op_name
+    if (opcode == "custom-call" and "tpu_custom_call" in o) \
+            or "pallas" in o or "mosaic" in o.lower():
+        # Pallas kernels compile to tpu_custom_call; attribute them to
+        # their own bucket so a pool/LRN/recurrence kernel adoption
+        # shows up as PALLAS time, not ELTWISE/OTHER (round 6)
+        return "PALLAS-KERNEL"
     if opcode == "select-and-scatter" or "select_and_scatter" in o:
         return "POOL-BWD"
     if "conv_general_dilated" in o or opcode == "convolution":
