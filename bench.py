@@ -431,6 +431,18 @@ def run_one(only: str):
         # entry goes out BEFORE any roofline attempt: a roofline wedge
         # must never cost an already-measured config
         print(json.dumps(entry), flush=True)
+        # mirror the measurement into the obs event stream
+        # (docs/observability.md): with BIGDL_OBS_DIR set, a bench run
+        # leaves the same machine-readable trail as training — a no-op
+        # in-memory ring otherwise
+        try:
+            from bigdl_tpu.obs import events as obs_events
+            obs_events.emit("phase", name=f"bench/{name}",
+                            seconds=ms / 1e3, step=0,
+                            records_per_sec=round(rps, 2),
+                            mfu=entry["mfu"], device=device_kind)
+        except Exception:
+            pass
         if "Inception" in name:
             # eval apparatus FIRST (bounded forward loop), roofline probe
             # LAST: the probe is the wedge-prone step under a degraded

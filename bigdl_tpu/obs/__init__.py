@@ -1,0 +1,33 @@
+"""Unified runtime telemetry (docs/observability.md).
+
+Four cooperating parts, wired through the optimizers, Engine and the
+resilience layer:
+
+- ``taps``: in-jit scalar taps (grad norm, param norm, update ratio,
+  non-finite counts) returned by the SAME compiled train step, host-
+  materialized only every ``BIGDL_OBS_TAPS_CADENCE`` steps;
+- ``events``: schema-versioned JSONL event stream per process + an
+  in-memory ring buffer (``BIGDL_OBS_DIR`` enables the file sink);
+- ``spans``: nested wall-clock phase spans layered on ``optim.Metrics``
+  and ``jax.profiler`` annotations, gathered once per run via the
+  deadlock-safe ``collect_per_node`` pattern;
+- ``diagnostics``: crash bundles (ring tail, device memory, config,
+  thread stacks) dumped on watchdog trips, preemption and non-finite
+  aborts;
+- ``summary``: TensorBoard-compatible scalar export (the
+  ``TrainSummary``/``ValidationSummary`` parity piece), no TF dep.
+
+Master switch: ``BIGDL_OBS=0`` turns the event/diagnostic machinery
+off; ``BIGDL_OBS_TAPS=0`` removes the taps from the compiled step.
+``tools/obs_report.py`` renders a run directory into markdown.
+"""
+from bigdl_tpu.obs import diagnostics, events, spans, taps  # noqa: F401
+from bigdl_tpu.obs.diagnostics import dump_crash_bundle  # noqa: F401
+from bigdl_tpu.obs.events import (  # noqa: F401
+    SCHEMA_VERSION, EventLog, read_events, validate_event,
+)
+from bigdl_tpu.obs.spans import PHASES, SpanTracker  # noqa: F401
+from bigdl_tpu.obs.summary import (  # noqa: F401
+    ScalarWriter, TrainSummary, ValidationSummary, read_scalars,
+)
+from bigdl_tpu.obs.taps import TAP_NAMES, TapsMonitor  # noqa: F401

@@ -1,0 +1,230 @@
+"""Structured event log — schema-versioned JSONL per process plus an
+in-memory ring buffer (docs/observability.md).
+
+The reference explains a run through the driver log (Optimizer.header
+progress lines + Metrics summaries); that is unparseable after the fact
+and says nothing about *why* a step was skipped or a host died.  Here
+every notable runtime moment — step, phase, validation, checkpoint,
+fault injection, watchdog trip, preemption, abort — is one JSON object
+with a fixed schema, so ``tools/obs_report.py`` (or any jq one-liner)
+can reconstruct the run, and the crash-bundle path
+(``obs/diagnostics.py``) can dump the last-N events even when the
+process is going down inside a signal handler or a watchdog thread.
+
+Layout: one ``events.p<process_index>.jsonl`` per process under the run
+directory (``BIGDL_OBS_DIR`` or :func:`configure`), mirroring the
+one-log-per-executor shape of the reference's Spark stdout collection.
+With no run directory the log is ring-only: events are still retained
+in memory for crash bundles, nothing touches the filesystem.
+
+Master switch ``BIGDL_OBS=0`` disables the subsystem entirely (``get``
+returns None and the convenience :func:`emit` becomes a no-op).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+logger = logging.getLogger("bigdl_tpu.obs")
+
+#: bump when an event type gains/loses REQUIRED fields; readers accept
+#: unknown optional fields at any version
+SCHEMA_VERSION = 1
+
+ENV_OBS = "BIGDL_OBS"
+ENV_DIR = "BIGDL_OBS_DIR"
+ENV_RING = "BIGDL_OBS_RING"
+
+#: required fields per event type (beyond the common envelope); optional
+#: fields (taps, straggler_dropped, skips, ...) are free-form
+EVENT_TYPES = {
+    "run_start": ("flags",),
+    "run_end": ("steps", "wall"),
+    "step": ("step", "loss", "lr", "throughput"),
+    "phase": ("name", "seconds"),
+    "validation": ("step", "method", "value"),
+    "checkpoint": ("step", "path"),
+    "fault": ("site", "step"),
+    "watchdog": ("stale",),
+    "preempt": ("step",),
+    "abort": ("step", "reason"),
+    "crash_bundle": ("reason", "path"),
+}
+
+_COMMON = ("v", "ts", "proc", "type")
+
+
+def validate_event(event: dict) -> dict:
+    """Check one decoded event against the schema; returns the event or
+    raises ValueError naming the violation.  Used by the smoke script
+    and report tool so a malformed emitter fails CI, not a postmortem."""
+    if not isinstance(event, dict):
+        raise ValueError(f"event must be an object, got {type(event)}")
+    for k in _COMMON:
+        if k not in event:
+            raise ValueError(f"event missing common field {k!r}: {event}")
+    if not isinstance(event["v"], int):
+        raise ValueError(f"schema version must be int: {event['v']!r}")
+    if event["v"] > SCHEMA_VERSION:
+        raise ValueError(f"event schema v{event['v']} is newer than this "
+                         f"reader (v{SCHEMA_VERSION})")
+    etype = event["type"]
+    required = EVENT_TYPES.get(etype)
+    if required is None:
+        raise ValueError(f"unknown event type {etype!r} "
+                         f"(known: {sorted(EVENT_TYPES)})")
+    missing = [k for k in required if k not in event]
+    if missing:
+        raise ValueError(f"{etype!r} event missing {missing}: {event}")
+    return event
+
+
+def _process_index() -> int:
+    """Lazy jax process index (0 pre-init / jax-less contexts, e.g. a
+    watchdog thread before the distributed client is up)."""
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class EventLog:
+    """Ring buffer + optional JSONL sink for one process.
+
+    Thread-safe: the training loop, the watchdog monitor thread and a
+    signal-handler epilogue may all emit concurrently."""
+
+    def __init__(self, run_dir: str | None = None, ring: int | None = None,
+                 process_index: int | None = None):
+        if ring is None:
+            ring = int(os.environ.get(ENV_RING, "512"))
+        self.run_dir = run_dir
+        self._proc = process_index
+        self._ring = deque(maxlen=max(int(ring), 1))
+        self._lock = threading.Lock()
+        self._fh = None
+        self.path = None
+        if run_dir:
+            os.makedirs(run_dir, exist_ok=True)
+            self.path = os.path.join(
+                run_dir, f"events.p{self.process_index()}.jsonl")
+            self._fh = open(self.path, "a")
+
+    def process_index(self) -> int:
+        if self._proc is None:
+            self._proc = _process_index()
+        return self._proc
+
+    def emit(self, etype: str, **fields) -> dict:
+        """Append one event (common envelope added here).  Never raises
+        past the sink: a full disk must not kill the training loop."""
+        event = {"v": SCHEMA_VERSION, "ts": time.time(),
+                 "proc": self.process_index(), "type": etype}
+        event.update(fields)
+        with self._lock:
+            self._ring.append(event)
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(event, default=_jsonable))
+                    self._fh.write("\n")
+                    self._fh.flush()
+                except (OSError, ValueError) as e:
+                    logger.warning("event sink write failed: %s", e)
+        return event
+
+    def ring_events(self) -> list:
+        """Snapshot of the in-memory ring (oldest first)."""
+        with self._lock:
+            return list(self._ring)
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+
+def _jsonable(v):
+    """json.dumps default: numpy/jax scalars degrade to floats, anything
+    else to repr — an event must never fail to serialize."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def read_events(path: str) -> list:
+    """Decode one JSONL file (no validation — see validate_event)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# -- process-wide log (env-configured; tests use configure) ----------------
+
+_LOG: EventLog | None = None
+_LOADED = False
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_OBS, "1") != "0"
+
+
+def get() -> EventLog | None:
+    """The process event log, or None when obs is off (``BIGDL_OBS=0``).
+    Created lazily: ring-only unless ``BIGDL_OBS_DIR`` names a run
+    directory.  ``configure``/``reset`` override."""
+    global _LOG, _LOADED
+    if not _LOADED:
+        _LOADED = True
+        if enabled():
+            run_dir = os.environ.get(ENV_DIR, "").strip() or None
+            _LOG = EventLog(run_dir=run_dir)
+    return _LOG
+
+
+def configure(run_dir: str | None = None, ring: int | None = None,
+              process_index: int | None = None) -> EventLog:
+    """Install a process event log programmatically (launchers, tests)."""
+    global _LOG, _LOADED
+    if _LOG is not None:
+        _LOG.close()
+    _LOG = EventLog(run_dir=run_dir, ring=ring, process_index=process_index)
+    _LOADED = True
+    return _LOG
+
+
+def reset():
+    """Close and forget the process log (re-reads env on next get())."""
+    global _LOG, _LOADED
+    if _LOG is not None:
+        _LOG.close()
+    _LOG = None
+    _LOADED = False
+
+
+def emit(etype: str, **fields):
+    """Convenience: emit to the process log if obs is on; no-op (None)
+    otherwise.  Swallows everything — emission sites include fault
+    injectors and exit paths where a telemetry bug must not mask the
+    real failure."""
+    try:
+        log = get()
+        if log is None:
+            return None
+        return log.emit(etype, **fields)
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("event emit failed: %s", e)
+        return None

@@ -403,7 +403,7 @@ class DistriOptimizer(LocalOptimizer):
 
     def _core_step(self, fold_axis=None, grad_transform=None,
                    state_merge=None, update_transform=None,
-                   finite_merge=None):
+                   finite_merge=None, taps_merge=None):
         """The train step both builders share: loss_fn, value_and_grad,
         optimizer update.  ``fold_axis`` decorrelates the dropout key per
         replica; ``grad_transform``/``state_merge`` hook the compressed
@@ -412,11 +412,17 @@ class DistriOptimizer(LocalOptimizer):
         ``finite_merge`` reconciles the non-finite-guard flag across
         replicas inside shard_map (local grads can be finite on one
         replica and not another; a divergent skip decision would fork the
-        replicated params)."""
+        replicated params).  ``taps_merge`` does the same for the in-jit
+        tap scalars (obs/taps.py): under shard_map they are computed from
+        LOCAL gradients, so the shard_map builder pmean-merges them —
+        divergent per-replica values behind a replicated out_spec would
+        silently report one arbitrary replica."""
+        from bigdl_tpu.obs import taps as obs_taps
         model, criterion, method = self.model, self.criterion, self.optim_method
         static_hyper = self._hyper(None)
         del static_hyper["lr"]
         has_scales = self._setup_lr_scales(static_hyper)
+        taps_on = obs_taps.enabled(self._taps_enabled)
         # sequence-parallel trainers hand attention layers the mesh so
         # they route through the exact ring collective (nn/attention.py)
         seq_mesh = self.mesh if self.sequence_parallel else None
@@ -458,7 +464,12 @@ class DistriOptimizer(LocalOptimizer):
             new_params = _where_finite(finite, new_params, params)
             new_opt_state = _where_finite(finite, new_opt_state, opt_state)
             new_net_state = _where_finite(finite, new_net_state, net_state)
-            return new_params, new_net_state, new_opt_state, loss, finite
+            taps = (obs_taps.compute(grads, params, new_params)
+                    if taps_on else {})
+            if taps and taps_merge is not None:
+                taps = taps_merge(taps)
+            return (new_params, new_net_state, new_opt_state, loss, finite,
+                    taps)
 
         return step
 
@@ -483,7 +494,7 @@ class DistriOptimizer(LocalOptimizer):
                 step,
                 in_shardings=(ps, ns, os_, x_s or data_s, data_s,
                               rep, rep, rep) + tuple(extra_in),
-                out_shardings=(ps, ns, os_, rep, rep),
+                out_shardings=(ps, ns, os_, rep, rep, rep),
                 donate_argnums=(0, 1, 2),
             )
 
@@ -495,7 +506,7 @@ class DistriOptimizer(LocalOptimizer):
             self._scan_chunk(step, n),
             in_shardings=(ps, ns, os_, x_chunk_s or chunk_data_s,
                           chunk_data_s, rep, rep, rep),
-            out_shardings=(ps, ns, os_, rep, rep),
+            out_shardings=(ps, ns, os_, rep, rep, rep),
             donate_argnums=(0, 1, 2),
         )
 
@@ -626,7 +637,12 @@ class DistriOptimizer(LocalOptimizer):
             # NaN must veto the update on every replica or the
             # where-select forks the replicated params
             finite_merge=lambda f: jax.lax.pmin(
-                f.astype(jnp.int32), "data").astype(jnp.bool_))
+                f.astype(jnp.int32), "data").astype(jnp.bool_),
+            # tap scalars are per-replica inside shard_map: pmean to a
+            # truly replicated value (grad_norm then reads as the
+            # replica-mean of local-gradient norms — docs/observability.md)
+            taps_merge=lambda t: {k: jax.lax.pmean(v, "data")
+                                  for k, v in t.items()})
         if masked:
             # 9th operand: the (n_tasks,) 0/1 drop mask, replicated —
             # push (w_this_replica, finished_count) for the hooks above
@@ -652,7 +668,7 @@ class DistriOptimizer(LocalOptimizer):
             step, mesh=mesh,
             in_specs=(rep, rep, ospec, data, data, rep, rep, rep)
             + ((rep,) if masked else ()),
-            out_specs=(rep, rep, ospec, rep, rep),
+            out_specs=(rep, rep, ospec, rep, rep, rep),
             check_vma=False,
         )
         params, net_state, opt_state = self._state_trees()
@@ -765,6 +781,8 @@ class DistriOptimizer(LocalOptimizer):
                              "is not supported with pipeline_stages")
         mesh, schedule, remat = self.mesh, self.pipeline_schedule, self.remat
         loss_fn = plan.make_loss_fn(criterion)
+        from bigdl_tpu.obs import taps as obs_taps
+        taps_on = obs_taps.enabled(self._taps_enabled)
 
         def step(stacked_p, stacked_s, opt_state, x, y, lr, key, lr_scales):
             hyper = dict(static_hyper, lr=lr)
@@ -790,7 +808,11 @@ class DistriOptimizer(LocalOptimizer):
             new_p = _where_finite(finite, new_p, stacked_p)
             new_opt = _where_finite(finite, new_opt, opt_state)
             new_s = _where_finite(finite, new_s, stacked_s)
-            return new_p, new_s, new_opt, loss, finite
+            # taps over the stage-stacked trees: norms cover every
+            # stage's params/grads at once (the stacking is just layout)
+            taps = (obs_taps.compute(grads, stacked_p, new_p)
+                    if taps_on else {})
+            return new_p, new_s, new_opt, loss, finite, taps
 
         pipe = NamedSharding(mesh, P("pipe"))
         rep = NamedSharding(mesh, P())
@@ -807,7 +829,7 @@ class DistriOptimizer(LocalOptimizer):
         return jax.jit(
             fn,
             in_shardings=(pipe, pipe, opt_s, rep, rep, rep, rep, rep),
-            out_shardings=(pipe, pipe, opt_s, rep, rep),
+            out_shardings=(pipe, pipe, opt_s, rep, rep, rep),
             donate_argnums=(0, 1, 2),
         )
 
@@ -882,6 +904,7 @@ class DistriOptimizer(LocalOptimizer):
             net_state = jax.device_put(self._pipe_plan.pack_state(net_state),
                                        pipe_s)
         opt_state = self._initial_opt_state(params)
+        monitor = self._start_obs_run()
 
         count = 0
         epoch_size = self.dataset.size()
@@ -892,8 +915,10 @@ class DistriOptimizer(LocalOptimizer):
         n_disp = self.iters_per_dispatch
         straggler = self._straggler
         while not self.end_when(state):
+            neval0 = int(state["neval"])
             fetch_start = time.perf_counter()
-            with self.metrics.timer("data fetch time"):
+            with self.spans.span("data-load"), \
+                    self.metrics.timer("data fetch time"):
                 if n_disp <= 1:
                     batch = next(data_iter)
                     xh = self._chaos_prestep(batch.data, state["neval"])
@@ -918,26 +943,31 @@ class DistriOptimizer(LocalOptimizer):
             # distributed: summary() adds the per-process breakdown, the
             # reference's "computing time for each node" accumulator
             it_start = time.perf_counter()
-            with self.metrics.timer("computing time average",
-                                    distributed=True):
+            with self.spans.span("dispatch"), \
+                    self.metrics.timer("computing time average",
+                                       distributed=True):
                 lr = self._current_lr()
                 key = RNG.next_key()
                 step_args = (params, net_state, opt_state, x, y,
                              jnp.float32(lr), key, self._lr_scales_arg)
                 if straggler is not None:
-                    params, net_state, opt_state, loss, finite = step_fn(
-                        *step_args, jnp.asarray(drop_mask))
+                    (params, net_state, opt_state, loss, finite,
+                     taps) = step_fn(*step_args, jnp.asarray(drop_mask))
                 else:
-                    params, net_state, opt_state, loss, finite = step_fn(
-                        *step_args)
+                    (params, net_state, opt_state, loss, finite,
+                     taps) = step_fn(*step_args)
                 # float() blocks on the device result, so the timer (and
                 # the straggler's task clock) sees the real dispatch wall
                 loss = float(loss[-1]) if n_disp > 1 else float(loss)
 
             step_time = self.metrics.mean("computing time average")
+            n_dropped = 0
             if straggler is not None:
-                straggler.record(self._straggler_task_times(
-                    fetch_wall, time.perf_counter() - it_start), drop_mask)
+                with self.spans.span("aggregate"):
+                    # the cross-process task-time merge (allgather)
+                    straggler.record(self._straggler_task_times(
+                        fetch_wall, time.perf_counter() - it_start),
+                        drop_mask)
                 n_dropped = int(len(drop_mask) - drop_mask.sum())
                 if n_dropped:
                     # ref logger.debug("Dropped modules: " + ...) :248
@@ -951,12 +981,16 @@ class DistriOptimizer(LocalOptimizer):
             state["neval"] = state["neval"] + n_disp
             state["loss"] = loss
             state["evalCounter"] = state.get("evalCounter", 0) + n_disp
+            throughput = global_b / max(step_time, 1e-9)
             logger.info(
                 "Epoch %d %d/%d loss %.6f lr %.5g throughput %.1f records/s "
                 "on %d devices", state["epoch"], count, epoch_size, loss, lr,
-                global_b / max(step_time, 1e-9), n_dev)
+                throughput, n_dev)
 
             self._note_finite(finite, state)
+            extra = {"straggler_dropped": n_dropped} if n_dropped else {}
+            self._emit_step_event(neval0, loss, lr, throughput,
+                                  monitor.push(neval0, taps), **extra)
             count, data_iter = self._advance_epochs(state, count,
                                                     epoch_size, n_disp,
                                                     data_iter)
@@ -974,6 +1008,12 @@ class DistriOptimizer(LocalOptimizer):
         self.model.load_state(jax.device_get(net_state))
         # snapshot per-node metrics while every process is still here, so
         # post-training summary(per_node=True) from one process is safe
+        # (also what makes the per-host span table below deadlock-free:
+        # process 0 renders from the cache, no late collective)
         self.metrics.collect_per_node()
+        self._end_obs_run(state, wall_start)
+        if jax.process_index() == 0:
+            logger.info("per-host phase breakdown (mean s/iter):\n%s",
+                        self.spans.per_host_report())
         logger.info("Training finished in %.1fs", time.perf_counter() - wall_start)
         return self.model

@@ -25,6 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.nn.module import Context
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.obs import taps as obs_taps
+from bigdl_tpu.obs.spans import SpanTracker
 from bigdl_tpu.optim.optim_method import SGD, OptimMethod, Default
 from bigdl_tpu.optim import trigger as triggers
 from bigdl_tpu.optim.metrics import Metrics
@@ -86,6 +89,39 @@ class LocalOptimizer:
             os.environ.get("BIGDL_NONFINITE_ABORT", "10"))
         self._nonfinite_skips = 0
         self._nonfinite_streak = 0
+        # observability (docs/observability.md): in-jit taps (None =
+        # BIGDL_OBS_TAPS / _CADENCE env defaults), phase spans, optional
+        # TensorBoard sinks
+        self._taps_enabled = None
+        self._taps_cadence = None
+        self._taps_monitor = None
+        self._train_summary = None
+        self._val_summary = None
+        self.spans = SpanTracker(self.metrics)
+
+    def set_taps(self, enabled: bool | None = None,
+                 cadence: int | None = None):
+        """Override the in-jit tap gating for this run (None defers to
+        ``BIGDL_OBS_TAPS`` / ``BIGDL_OBS_TAPS_CADENCE``).  Takes effect
+        at the next ``optimize()`` — the taps are part of the compiled
+        step."""
+        self._taps_enabled = enabled
+        self._taps_cadence = cadence
+        return self
+
+    def set_train_summary(self, summary):
+        """TensorBoard training-curve sink (``obs.TrainSummary``):
+        Loss/LearningRate/Throughput per iteration, tap scalars at the
+        taps cadence.  Multi-host: attach on process 0 only (the
+        reference's driver-side TrainSummary)."""
+        self._train_summary = summary
+        return self
+
+    def set_val_summary(self, summary):
+        """TensorBoard validation sink (``obs.ValidationSummary``): one
+        scalar per validation method at each validation trigger."""
+        self._val_summary = summary
+        return self
 
     def set_nonfinite_policy(self, abort_after: int | None = 10):
         """Abort training (NonFiniteGradError) after ``abort_after``
@@ -208,6 +244,7 @@ class LocalOptimizer:
         has_scales = self._setup_lr_scales(static_hyper)
 
         remat = self.remat
+        taps_on = obs_taps.enabled(self._taps_enabled)
 
         def step(params, net_state, opt_state, x, y, lr, key, lr_scales):
             hyper = dict(static_hyper, lr=lr)
@@ -231,7 +268,12 @@ class LocalOptimizer:
             new_params = _where_finite(finite, new_params, params)
             new_opt_state = _where_finite(finite, new_opt_state, opt_state)
             new_net_state = _where_finite(finite, new_net_state, net_state)
-            return new_params, new_net_state, new_opt_state, loss, finite
+            # in-jit taps: extra outputs of the SAME dispatch, post-skip-
+            # select so update_ratio reads 0 on a skipped step
+            taps = (obs_taps.compute(grads, params, new_params)
+                    if taps_on else {})
+            return (new_params, new_net_state, new_opt_state, loss, finite,
+                    taps)
 
         # donate the carried state: the old params/opt-state buffers are
         # dead after each step, so XLA reuses them instead of allocating a
@@ -254,13 +296,16 @@ class LocalOptimizer:
             def body(carry, xyk):
                 p, ns, o = carry
                 x, y, k = xyk
-                p, ns, o, loss, finite = step(p, ns, o, x, y, lr, k,
-                                              lr_scales)
-                return (p, ns, o), (loss, finite)
+                p, ns, o, loss, finite, taps = step(p, ns, o, x, y, lr, k,
+                                                    lr_scales)
+                return (p, ns, o), (loss, finite, taps)
 
-            (params, net_state, opt_state), (losses, finites) = lax.scan(
+            ((params, net_state, opt_state),
+             (losses, finites, taps)) = lax.scan(
                 body, (params, net_state, opt_state), (xs, ys, keys))
-            return params, net_state, opt_state, losses, finites
+            # taps leaves arrive stacked (n,); the host monitor reports
+            # the chunk's last step, matching state['loss']
+            return params, net_state, opt_state, losses, finites, taps
 
         return chunk
 
@@ -292,6 +337,7 @@ class LocalOptimizer:
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
         opt_state = self._initial_opt_state(params)
         step_fn = self._build_step()
+        monitor = self._start_obs_run()
 
         count = 0
         epoch_size = self.dataset.size()
@@ -300,28 +346,31 @@ class LocalOptimizer:
 
         n_disp = self.iters_per_dispatch
         while not self.end_when(state):
+            neval0 = int(state["neval"])
             fetch_start = time.perf_counter()
-            if n_disp <= 1:
-                batch = next(data_iter)
-                xh = self._chaos_prestep(batch.data, state["neval"])
-                x = jnp.asarray(xh)
-                y = jnp.asarray(batch.labels)
-            else:
-                xh, yh = self._next_chunk(data_iter, n_disp)
-                xh = self._chaos_prestep(xh, state["neval"])
-                x, y = jnp.asarray(xh), jnp.asarray(yh)
+            with self.spans.span("data-load"):
+                if n_disp <= 1:
+                    batch = next(data_iter)
+                    xh = self._chaos_prestep(batch.data, state["neval"])
+                    x = jnp.asarray(xh)
+                    y = jnp.asarray(batch.labels)
+                else:
+                    xh, yh = self._next_chunk(data_iter, n_disp)
+                    xh = self._chaos_prestep(xh, state["neval"])
+                    x, y = jnp.asarray(xh), jnp.asarray(yh)
             fetch_time = time.perf_counter() - fetch_start
 
             train_start = time.perf_counter()
-            lr = self._current_lr()
-            key = RNG.next_key()
-            params, net_state, opt_state, loss, finite = step_fn(
-                params, net_state, opt_state, x, y, jnp.float32(lr), key,
-                self._lr_scales_arg)
-            if n_disp > 1:
-                loss = float(loss[-1])   # chunk's last step (syncs)
-            else:
-                loss = float(loss)  # syncs; keeps per-iter timing honest
+            with self.spans.span("dispatch"):
+                lr = self._current_lr()
+                key = RNG.next_key()
+                params, net_state, opt_state, loss, finite, taps = step_fn(
+                    params, net_state, opt_state, x, y, jnp.float32(lr), key,
+                    self._lr_scales_arg)
+                if n_disp > 1:
+                    loss = float(loss[-1])   # chunk's last step (syncs)
+                else:
+                    loss = float(loss)  # syncs; keeps per-iter timing honest
             train_time = time.perf_counter() - train_start
 
             b = x.shape[0] * x.shape[1] if n_disp > 1 else x.shape[0]
@@ -331,13 +380,16 @@ class LocalOptimizer:
             state["evalCounter"] = state.get("evalCounter", 0) + n_disp
             self.metrics.add("data fetch time", fetch_time)
             self.metrics.add("train time", train_time)
+            throughput = b / max(train_time + fetch_time, 1e-9)
             logger.info(
                 "Epoch %d %d/%d loss %.6f lr %.5g throughput %.1f records/s "
                 "(fetch %.4fs train %.4fs)",
                 state["epoch"], count, epoch_size, loss, lr,
-                b / max(train_time + fetch_time, 1e-9), fetch_time, train_time)
+                throughput, fetch_time, train_time)
 
             self._note_finite(finite, state)
+            self._emit_step_event(neval0, loss, lr, throughput,
+                                  monitor.push(neval0, taps))
             count, data_iter = self._advance_epochs(state, count,
                                                     epoch_size, n_disp,
                                                     data_iter)
@@ -349,6 +401,7 @@ class LocalOptimizer:
 
         self.model.load_params(params)
         self.model.load_state(net_state)
+        self._end_obs_run(state, wall_start)
         logger.info("Training finished in %.1fs", time.perf_counter() - wall_start)
         return self.model
 
@@ -409,6 +462,18 @@ class LocalOptimizer:
             int(state["neval"]), self._nonfinite_skips,
             worst, self.nonfinite_abort or "off")
         if self.nonfinite_abort and worst >= self.nonfinite_abort:
+            # postmortem before the raise: the abort event + crash bundle
+            # are what explains this death from the run directory alone
+            from bigdl_tpu.obs import diagnostics
+            obs_events.emit("abort", step=int(state["neval"]),
+                            reason="nonfinite",
+                            skips=int(self._nonfinite_skips),
+                            streak=int(worst))
+            diagnostics.dump_crash_bundle(
+                "nonfinite-abort",
+                extra={"neval": int(state["neval"]), "streak": int(worst),
+                       "skips": int(self._nonfinite_skips),
+                       "threshold": int(self.nonfinite_abort)})
             raise NonFiniteGradError(
                 f"{worst} consecutive non-finite-gradient "
                 f"steps (threshold {self.nonfinite_abort}, iteration "
@@ -427,9 +492,17 @@ class LocalOptimizer:
         tell a preempted run from a completed one — flag first, so it
         rides the snapshot payload."""
         state["preempted"] = True
+        obs_events.emit("preempt", step=int(state["neval"]),
+                        signal_at=Engine.preempted_at())
         if self.checkpoint_path:
             self._maybe_checkpoint(params, net_state, opt_state, state,
                                    force=True)
+        # the exit is clean, but the bundle records WHERE the notice
+        # landed (docs/observability.md: preemption postmortems)
+        from bigdl_tpu.obs import diagnostics
+        diagnostics.dump_crash_bundle(
+            "preemption", extra={"neval": int(state["neval"]),
+                                 "signal_at": Engine.preempted_at()})
         # the notice has been honored; a LATER optimize() in this process
         # (restart after resume) must not stop on the stale flag — a new
         # SIGTERM sets it again
@@ -450,12 +523,18 @@ class LocalOptimizer:
                 count = 0
                 self.dataset.shuffle()
                 data_iter = self.dataset.data(train=True)
+                self.spans.emit_phase_events(obs_events.get(),
+                                             int(state["neval"]))
             return count, data_iter
+        rolled = count >= epoch_size
         while count >= epoch_size:
             state["epoch"] = state["epoch"] + 1
             count -= epoch_size
             self.dataset.shuffle()
             data_iter = self.dataset.data(train=True)
+        if rolled:
+            self.spans.emit_phase_events(obs_events.get(),
+                                         int(state["neval"]))
         return count, data_iter
 
     def _fire_triggers(self, params, net_state, opt_state, state, n_disp):
@@ -497,16 +576,84 @@ class LocalOptimizer:
                 return ne
         return None
 
+    # -- observability plumbing (docs/observability.md) -------------------
+    def _obs_flags(self) -> dict:
+        """The run-configuration snapshot stamped into the run_start
+        event — enough to tell two runs apart in a pile of JSONL."""
+        flags = {"optimizer": type(self).__name__,
+                 "taps": obs_taps.enabled(self._taps_enabled),
+                 "taps_cadence": obs_taps.cadence(self._taps_cadence),
+                 "iters_per_dispatch": self.iters_per_dispatch,
+                 "nonfinite_abort": self.nonfinite_abort,
+                 "optim_method": type(self.optim_method).__name__}
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None:
+            flags["mesh"] = {k: int(v) for k, v in dict(mesh.shape).items()}
+        return flags
+
+    def _start_obs_run(self):
+        """Fresh taps monitor + run_start event at each optimize()."""
+        self._taps_monitor = obs_taps.TapsMonitor(self._taps_cadence,
+                                                  self._taps_enabled)
+        obs_events.emit("run_start", flags=self._obs_flags())
+        return self._taps_monitor
+
+    def _end_obs_run(self, state, wall_start):
+        """Flush the tap tail (short runs still log one sample), emit
+        the cumulative phase breakdown and the run_end event."""
+        ev = obs_events.get()
+        tail = self._taps_monitor.flush() if self._taps_monitor else None
+        if ev is not None:
+            self.spans.emit_phase_events(ev, int(state["neval"]))
+            fields = {"steps": int(state["neval"]) - 1,
+                      "wall": time.perf_counter() - wall_start}
+            if tail:
+                fields["taps"] = tail
+            ev.emit("run_end", **fields)
+
+    def _emit_step_event(self, neval, loss, lr, throughput, tap_vals,
+                         **extra):
+        """One structured step event + TensorBoard scalars.  ``tap_vals``
+        is the monitor's cadence-gated dict (None off-boundary)."""
+        ev = obs_events.get()
+        if ev is None and self._train_summary is None:
+            return
+        fields = dict(step=int(neval), loss=float(loss), lr=float(lr),
+                      throughput=float(throughput))
+        if tap_vals:
+            fields["taps"] = tap_vals
+        if self._nonfinite_skips:
+            fields["skips"] = int(self._nonfinite_skips)
+        fields.update(extra)
+        if ev is not None:
+            ev.emit("step", **fields)
+        ts = self._train_summary
+        if ts is not None:
+            ts.add_scalar("Loss", loss, neval)
+            ts.add_scalar("LearningRate", lr, neval)
+            ts.add_scalar("Throughput", throughput, neval)
+            if tap_vals:
+                for k, v in tap_vals.items():
+                    ts.add_scalar("Taps/" + k, v, neval)
+
     # -- validation (ref LocalOptimizer.scala:196-242) --------------------
     def _maybe_validate(self, params, net_state, state, force=False):
         if not force and (self.validation_trigger is None
                           or not self.validation_trigger(state)):
             return
-        results = validate(self.model, params, net_state,
-                           self.validation_dataset, self.validation_methods)
+        with self.spans.span("validate"):
+            results = validate(self.model, params, net_state,
+                               self.validation_dataset,
+                               self.validation_methods)
         for method, result in results:
             logger.info("%s is %s", method, result)
-            state[str(method)] = result.result()[0]
+            val = result.result()[0]
+            state[str(method)] = val
+            obs_events.emit("validation", step=int(state["neval"]),
+                            method=str(method), value=float(val))
+            if self._val_summary is not None:
+                self._val_summary.add_scalar(str(method), val,
+                                             int(state["neval"]))
 
     def _maybe_checkpoint(self, params, net_state, opt_state, state,
                           force=False, neval_label=None):
@@ -514,20 +661,24 @@ class LocalOptimizer:
                           or not self.checkpoint_trigger(state)):
             return
         neval = state["neval"] if neval_label is None else neval_label
-        # load host copies: loading the live pytree would leave the module
-        # referencing buffers the next (donating) step deletes
-        self.model.load_params(jax.device_get(params))
-        self.model.load_state(jax.device_get(net_state))
-        File.save_module(self.model, f"{self.checkpoint_path}/model.{neval}")
-        # "neval": the file label (= the nominal firing iteration under
-        # the device-side loop, which may be < state['neval']); kept in
-        # the payload so resume tooling can detect the chunked case.
-        # "rng": host-stream snapshot so a resume can replay the
-        # uninterrupted run's shuffle/augmentation draws
-        # (load_latest_checkpoint(restore_rng=True)).
-        File.save({"state": state, "opt_state": opt_state, "neval": neval,
-                   "rng": RNG.snapshot()},
-                  f"{self.checkpoint_path}/state.{neval}")
+        with self.spans.span("checkpoint"):
+            # load host copies: loading the live pytree would leave the
+            # module referencing buffers the next (donating) step deletes
+            self.model.load_params(jax.device_get(params))
+            self.model.load_state(jax.device_get(net_state))
+            File.save_module(self.model,
+                             f"{self.checkpoint_path}/model.{neval}")
+            # "neval": the file label (= the nominal firing iteration under
+            # the device-side loop, which may be < state['neval']); kept in
+            # the payload so resume tooling can detect the chunked case.
+            # "rng": host-stream snapshot so a resume can replay the
+            # uninterrupted run's shuffle/augmentation draws
+            # (load_latest_checkpoint(restore_rng=True)).
+            File.save({"state": state, "opt_state": opt_state,
+                       "neval": neval, "rng": RNG.snapshot()},
+                      f"{self.checkpoint_path}/state.{neval}")
+        obs_events.emit("checkpoint", step=int(neval),
+                        path=f"{self.checkpoint_path}/model.{neval}")
 
 
 def _model_fingerprint(model):

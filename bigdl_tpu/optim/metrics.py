@@ -76,11 +76,35 @@ class Metrics:
             self._per_node_cache[name] = self.per_node(name)
         return self
 
+    def declare(self, name: str, distributed: bool = True):
+        """Register an entry with no samples yet (sum 0, count 0).
+
+        Multi-process: ``collect_per_node`` walks THIS process's
+        distributed-name set — if a name only ever gets samples on some
+        processes (e.g. the checkpoint span: process 0 writes, the rest
+        return early), the gather counts would diverge and the
+        processes deadlock mid-allgather.  Declaring the full fixed
+        name set on every process up front (obs.SpanTracker does this
+        for its phase names) keeps the collective schedule identical
+        everywhere; undeclared processes simply report a 0.0 mean."""
+        self._sums[name] += 0.0
+        self._counts[name] += 0
+        if distributed:
+            self._distributed.add(name)
+        return self
+
     @contextmanager
     def timer(self, name: str, distributed: bool = False):
+        # try/finally: a timed body that raises (a failing dispatch, a
+        # KeyboardInterrupt mid-fetch) must still record its elapsed
+        # time, or the postmortem phase breakdown silently loses exactly
+        # the phase that broke
         t0 = time.perf_counter()
-        yield
-        self.add(name, time.perf_counter() - t0, distributed=distributed)
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0,
+                     distributed=distributed)
 
     def summary(self, unit_scale: float = 1.0,
                 per_node: bool = False) -> str:

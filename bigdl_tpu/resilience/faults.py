@@ -190,6 +190,12 @@ class FaultInjector:
             if s.fires(step, proc):
                 logger.warning("FaultInjector: %s fires at step %d "
                                "(process %d)", s, step, proc)
+                # chaos runs are exactly the runs whose postmortems
+                # matter: record the injection in the event stream so
+                # the report can line faults up with skips/aborts
+                from bigdl_tpu.obs import events as obs_events
+                obs_events.emit("fault", site=s.site, step=int(step),
+                                spec=repr(s))
                 return s
         return None
 

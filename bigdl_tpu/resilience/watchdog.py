@@ -128,6 +128,30 @@ class Watchdog:
             "exiting with code %d so the job fails fast (restart resumes "
             "from the last valid checkpoint)", stale, self.timeout,
             EXIT_CODE)
+        # postmortem on the way down (docs/observability.md): the event
+        # names the dead peers, the bundle captures where THIS process
+        # was blocked (threads.txt: usually inside the dead collective).
+        # Strictly best-effort AND time-bounded: the whole point of this
+        # exit is to beat the hang, so the dump runs on a side thread
+        # with a hard 3s budget — a wedged device-stats query must not
+        # turn fail-fast back into a hang.
+        def _postmortem():
+            try:
+                from bigdl_tpu.obs import diagnostics, events
+                events.emit("watchdog", stale=list(stale),
+                            timeout=self.timeout,
+                            process_index=self.process_index)
+                diagnostics.dump_crash_bundle(
+                    "watchdog-peer-death",
+                    extra={"stale": list(stale), "timeout": self.timeout,
+                           "process_index": self.process_index})
+            except Exception:
+                logger.exception("watchdog crash bundle failed")
+
+        t = threading.Thread(target=_postmortem, daemon=True,
+                             name="bigdl-watchdog-postmortem")
+        t.start()
+        t.join(timeout=3.0)
         # os._exit, not sys.exit: the main thread is likely blocked inside
         # a dead collective and would never unwind a SystemExit
         os._exit(EXIT_CODE)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import signal
 import threading
+import time
 import numpy as np
 import jax
 
@@ -47,6 +48,7 @@ class _Engine:
         self._mesh = None
         self._singleton_fd = None
         self._preempted = threading.Event()
+        self._preempted_at = None
         self._preempt_armed = False
         self._prev_handlers = {}
 
@@ -148,6 +150,10 @@ class _Engine:
             return self
         for sig in signals:
             def _handler(signum, frame, _sig=sig):
+                # flag + timestamp only: anything heavier (logging, I/O)
+                # is unsafe here; the obs layer reads preempted_at() from
+                # the training loop's clean epilogue instead
+                self._preempted_at = time.time()
                 self._preempted.set()
                 prev = self._prev_handlers.get(_sig)
                 if callable(prev):
@@ -172,12 +178,20 @@ class _Engine:
         requesting preemption unarmed in a multi-process run is ignored
         with a warning (an unmerged one-host stop would strand the other
         hosts in a dead collective)."""
+        self._preempted_at = time.time()
         self._preempted.set()
         return self
+
+    def preempted_at(self) -> float | None:
+        """Unix timestamp of the preemption notice (None if never
+        preempted) — stamped into the obs ``preempt`` event so the
+        postmortem can measure notice-to-checkpoint latency."""
+        return self._preempted_at
 
     def clear_preemption(self):
         """Reset the flag (a new run in the same process)."""
         self._preempted.clear()
+        self._preempted_at = None
         return self
 
     def engine_type(self) -> str:

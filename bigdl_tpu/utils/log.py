@@ -9,6 +9,7 @@ console lines, optional file sink, INFO default.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 import time
 
@@ -17,13 +18,49 @@ _FORMAT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
 _LAST_WARN: dict = {}
 
 
+def reset_warn_cache():
+    """Forget every ``warn_every`` timestamp.  The cache is process-
+    global, so without this a warning rate-limited in one run (or test)
+    stays suppressed in the next — test fixtures call it between cases
+    (tests/conftest.py), long-lived drivers call it between runs."""
+    _LAST_WARN.clear()
+
+
+_BAD_OVERRIDES_WARNED: set = set()
+
+
+def warn_interval(logger: logging.Logger, interval: float) -> float:
+    """The effective rate-limit interval for ``logger``: the env
+    override ``BIGDL_WARN_INTERVAL_<LOGGER_NAME, dots as underscores,
+    uppercased>`` wins, then the global ``BIGDL_WARN_INTERVAL``, then
+    the call site's default.  Lets an operator silence (large value) or
+    un-rate-limit (0) one subsystem's warnings without a code change."""
+    per_logger = "BIGDL_WARN_INTERVAL_" + \
+        logger.name.upper().replace(".", "_")
+    v = os.environ.get(per_logger, os.environ.get("BIGDL_WARN_INTERVAL"))
+    if v:
+        try:
+            return float(v)
+        except ValueError:
+            # complain ONCE per bad value: this runs inside warn_every's
+            # hot path, and an unthrottled complaint would be exactly
+            # the log flood warn_every exists to prevent
+            if v not in _BAD_OVERRIDES_WARNED:
+                _BAD_OVERRIDES_WARNED.add(v)
+                logger.warning("ignoring non-numeric warn-interval "
+                               "override %r", v)
+    return interval
+
+
 def warn_every(logger: logging.Logger, key: str, interval: float,
                msg: str, *args) -> bool:
     """Rate-limited warning: at most one ``key`` warning per ``interval``
     seconds (the first always fires).  A chaos run skipping thousands of
     non-finite steps must not drown the progress log; returns whether the
-    line was emitted."""
+    line was emitted.  ``interval`` is a default — see ``warn_interval``
+    for the per-logger env override."""
     now = time.monotonic()
+    interval = warn_interval(logger, interval)
     last = _LAST_WARN.get(key)
     if last is not None and now - last < interval:
         return False

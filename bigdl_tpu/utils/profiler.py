@@ -38,6 +38,15 @@ def step_annotation(name: str):
         yield
 
 
+@contextmanager
+def annotation(name: str):
+    """Plain named trace range (non-step): phase spans (obs/spans.py)
+    use this so data-load/dispatch/validate line up in XProf under the
+    same names as the event log."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
 def device_memory_stats():
     """Per-device HBM usage, when the backend exposes it."""
     stats = {}

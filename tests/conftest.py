@@ -39,9 +39,24 @@ jax.config.update("jax_default_matmul_precision", "highest")
 @pytest.fixture(autouse=True)
 def _seed():
     from bigdl_tpu.utils.random import set_seed
+    from bigdl_tpu.utils.log import reset_warn_cache
     set_seed(1)
     np.random.seed(1)
+    # warn_every's cache is process-global: a warning rate-limited by an
+    # earlier test must not stay suppressed in this one
+    reset_warn_cache()
     yield
+
+
+@pytest.fixture
+def obs_run_dir(tmp_path):
+    """A configured obs run directory (JSONL sink under tmp_path), torn
+    back down to the env-default (ring-only) log afterwards."""
+    from bigdl_tpu.obs import events
+    run_dir = tmp_path / "obs"
+    events.configure(str(run_dir))
+    yield str(run_dir)
+    events.reset()
 
 
 @pytest.fixture

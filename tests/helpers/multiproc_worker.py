@@ -26,6 +26,13 @@ in the dead collective.
 ``--preempt``: arm Engine.install_preemption_handler (pass to EVERY
 process — the merged stop flag is a collective).
 ``--preempt-at N``: this worker SIGTERMs itself once neval reaches N.
+
+Observability drills (tests/test_obs.py):
+``--obs DIR``: enable the structured event log (JSONL per process under
+DIR, docs/observability.md).  Process 0 additionally renders the
+per-host span breakdown AFTER training (from the collect_per_node cache
+— the deadlock-safety claim the 4-process obs drill asserts) and ships
+it in the JSON as ``span_report``.
 """
 import json
 import os as _os
@@ -56,6 +63,11 @@ def main():
     if "--preempt-at" in argv:
         i = argv.index("--preempt-at")
         preempt_at = int(argv[i + 1])
+        del argv[i:i + 2]
+    obs_dir = None
+    if "--obs" in argv:
+        i = argv.index("--obs")
+        obs_dir = argv[i + 1]
         del argv[i:i + 2]
     resume = "--resume" in argv
     if resume:
@@ -99,6 +111,9 @@ def main():
     assert jax.process_count() == nproc
     assert jax.device_count() == 2 * nproc
 
+    if obs_dir:
+        from bigdl_tpu.obs import events as obs_events
+        obs_events.configure(obs_dir, process_index=pid)
     watchdog = None
     if watchdog_dir:
         from bigdl_tpu.resilience import Watchdog
@@ -259,6 +274,11 @@ def main():
                "computing time average")}
     if straggler:
         out["drop_mask"] = [float(v) for v in opt._straggler.mask()]
+    if obs_dir and pid == 0:
+        # ONLY process 0 renders — proving the epoch-end span gather in
+        # optimize() cached everything and this is collective-free
+        out["span_report"] = opt.spans.per_host_report()
+        out["dispatch_per_node"] = opt.metrics.per_node("span: dispatch")
 
     # cross-process validation merge (ref DistriValidator.scala:32): each
     # process sees its shard; merged counts must cover the GLOBAL set

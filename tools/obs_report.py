@@ -1,0 +1,185 @@
+"""Render a run's obs event stream (JSONL) into a markdown report.
+
+Usage:
+    python tools/obs_report.py RUN_DIR [-o report.md]
+    python tools/obs_report.py events.p0.jsonl
+
+RUN_DIR is a ``BIGDL_OBS_DIR`` directory: every ``events.p*.jsonl`` in
+it is loaded (one per process), crash bundles (``crash-*/``) are
+listed.  The report covers: run configuration, the throughput/loss
+trajectory (bucketed), tap trends, phase breakdown, skip/straggler
+summary, fault/watchdog/preemption timeline, crash bundles.
+
+Lines that fail schema validation are counted and quoted, not fatal —
+a postmortem tool that dies on the interesting input is useless.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bigdl_tpu.obs.events import validate_event  # noqa: E402
+
+
+def load_run(path):
+    """(events, bad_lines, bundle_dirs) from a run dir or one jsonl."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "events.p*.jsonl")))
+        bundles = sorted(g for g in glob.glob(os.path.join(path, "crash-*"))
+                         if os.path.isdir(g))
+    else:
+        files, bundles = [path], []
+    events, bad = [], []
+    for f in files:
+        with open(f) as fh:
+            for i, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(validate_event(json.loads(line)))
+                except (ValueError, json.JSONDecodeError) as e:
+                    bad.append((f, i, str(e)[:120]))
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events, bad, bundles
+
+
+def _by_type(events, etype):
+    return [e for e in events if e["type"] == etype]
+
+
+def _fmt(v):
+    return f"{v:.4g}" if isinstance(v, float) else str(v)
+
+
+def _trajectory(steps, n_buckets=8):
+    """Bucket step events into at most n_buckets rows of
+    (step range, mean loss, mean throughput, last taps)."""
+    if not steps:
+        return []
+    size = max(1, (len(steps) + n_buckets - 1) // n_buckets)
+    rows = []
+    for i in range(0, len(steps), size):
+        chunk = steps[i:i + size]
+        taps = next((e["taps"] for e in reversed(chunk) if "taps" in e), None)
+        rows.append((chunk[0]["step"], chunk[-1]["step"],
+                     sum(e["loss"] for e in chunk) / len(chunk),
+                     sum(e["throughput"] for e in chunk) / len(chunk),
+                     taps))
+    return rows
+
+
+def render(events, bad, bundles, title="obs run report") -> str:
+    out = [f"# {title}", ""]
+    procs = sorted({e["proc"] for e in events})
+    steps = _by_type(events, "step")
+    out.append(f"- events: **{len(events)}** across {len(procs)} "
+               f"process(es) {procs}; invalid lines: {len(bad)}")
+    for start in _by_type(events, "run_start"):
+        flags = ", ".join(f"{k}={_fmt(v)}" for k, v in
+                          sorted(start.get("flags", {}).items()))
+        out.append(f"- run_start (p{start['proc']}): {flags}")
+    for end in _by_type(events, "run_end"):
+        out.append(f"- run_end (p{end['proc']}): {end['steps']} steps in "
+                   f"{end['wall']:.1f}s")
+    out.append("")
+
+    if steps:
+        out += ["## Throughput / loss trajectory", "",
+                "| steps | mean loss | mean records/s | grad_norm | "
+                "update_ratio |", "|---|---|---|---|---|"]
+        for s0, s1, loss, thr, taps in _trajectory(steps):
+            g = _fmt(taps["grad_norm"]) if taps else "-"
+            u = _fmt(taps["update_ratio"]) if taps else "-"
+            out.append(f"| {s0}-{s1} | {loss:.5f} | {thr:.1f} | {g} | {u} |")
+        out.append("")
+
+    phases = _by_type(events, "phase")
+    if phases:
+        # keep the LAST cumulative sample per (proc, name)
+        latest = {}
+        for e in phases:
+            latest[(e["proc"], e["name"])] = e
+        out += ["## Phase breakdown (cumulative mean s/iter)", "",
+                "| phase | " + " | ".join(f"p{p}" for p in procs) + " |",
+                "|---|" + "---|" * len(procs)]
+        names = sorted({n for _, n in latest})
+        for name in names:
+            cells = []
+            for p in procs:
+                e = latest.get((p, name))
+                cells.append(f"{e['seconds']:.4f}" if e else "-")
+            out.append(f"| {name} | " + " | ".join(cells) + " |")
+        out.append("")
+
+    skips = max((e.get("skips", 0) for e in steps), default=0)
+    dropped = sum(e.get("straggler_dropped", 0) for e in steps)
+    vals = _by_type(events, "validation")
+    if skips or dropped or vals:
+        out.append("## Skips / stragglers / validation")
+        out.append("")
+        if skips:
+            out.append(f"- non-finite steps skipped: **{skips}**")
+        if dropped:
+            out.append(f"- straggler replicas dropped (replica-steps): "
+                       f"**{dropped}**")
+        for e in vals[-8:]:
+            out.append(f"- step {e['step']}: {e['method']} = "
+                       f"{_fmt(e['value'])}")
+        out.append("")
+
+    incidents = [e for e in events if e["type"] in
+                 ("fault", "watchdog", "preempt", "abort", "crash_bundle")]
+    if incidents:
+        out += ["## Incident timeline", ""]
+        for e in incidents:
+            detail = {k: v for k, v in e.items()
+                      if k not in ("v", "ts", "proc", "type")}
+            out.append(f"- p{e['proc']} **{e['type']}**: " + ", ".join(
+                f"{k}={_fmt(v)}" for k, v in sorted(detail.items())))
+        out.append("")
+
+    if bundles:
+        out += ["## Crash bundles", ""]
+        for b in bundles:
+            files = ", ".join(sorted(os.listdir(b)))
+            out.append(f"- `{os.path.basename(b)}`: {files}")
+        out.append("")
+
+    if bad:
+        out += ["## Invalid event lines", ""]
+        for f, i, err in bad[:20]:
+            out.append(f"- {os.path.basename(f)}:{i}: {err}")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir (BIGDL_OBS_DIR) or one .jsonl")
+    ap.add_argument("-o", "--output", help="write markdown here "
+                    "(default: stdout)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any event line fails validation")
+    args = ap.parse_args(argv)
+    events, bad, bundles = load_run(args.path)
+    md = render(events, bad, bundles,
+                title=f"obs report: {os.path.basename(args.path.rstrip('/'))}")
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(md + "\n")
+    else:
+        print(md)
+    if args.strict and bad:
+        print(f"STRICT: {len(bad)} invalid event line(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
