@@ -6,6 +6,7 @@ from bigdl_tpu.dataset.dataset import (
     DataSet, LocalDataSet, LocalArrayDataSet, DistributedDataSet,
     ShardedDataSet,
 )
+from bigdl_tpu.dataset.prefetch import PipelineRunner
 from bigdl_tpu.dataset.image import (
     LabeledImage, BytesToImg, BytesToBGRImg, BytesToGreyImg, ImgNormalizer,
     ImgPixelNormalizer, ImgCropper, BGRImgCropper, ImgRdmCropper, HFlip,
@@ -38,7 +39,7 @@ __all__ = [
     "Transformer", "ChainedTransformer", "Identity", "SampleToBatch",
     "PreFetch",
     "DataSet", "LocalDataSet", "LocalArrayDataSet", "DistributedDataSet",
-    "ShardedDataSet",
+    "ShardedDataSet", "PipelineRunner",
     "LabeledImage", "BytesToImg", "BytesToGreyImg", "ImgNormalizer",
     "ImgPixelNormalizer", "ImgCropper", "ImgRdmCropper", "HFlip",
     "ColorJitter", "Lighting", "ImgToBatch", "ImgToSample",

@@ -77,7 +77,10 @@ class LocalArrayDataSet(LocalDataSet):
                     for i in idx:
                         yield self._data[i]
             return looped()
-        return iter(list(self._data))
+        # index-based view, no per-call copy of the backing list (an
+        # ImageNet-scale list is ~1M pointers per validation pass); a
+        # shuffle between passes is visible to the NEXT iterator
+        return (self._data[i] for i in range(len(self._data)))
 
 
 class TransformedDataSet(AbstractDataSet):
@@ -131,7 +134,8 @@ class ShardedDataSet(AbstractDataSet):
                     for i in idx:
                         yield self._shard[i]
             return looped()
-        return iter(list(self._shard))
+        # same snapshot-free view as LocalArrayDataSet.data(train=False)
+        return (self._shard[i] for i in range(len(self._shard)))
 
 
 # DistributedDataSet is the reference's name for the concept; ShardedDataSet

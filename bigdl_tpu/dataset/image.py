@@ -59,6 +59,8 @@ class BytesToImg(Transformer):
     order to the reference's BGR so reference-ordered per-channel
     constants (normalizer means/stds, jitter weights) apply unchanged."""
 
+    pure_per_record = True   # decode: 1-to-1, no RNG (prefetch fan-out ok)
+
     def __init__(self, scale_to: int = None, to_bgr: bool = False):
         self.scale_to = scale_to
         self.to_bgr = to_bgr
@@ -110,6 +112,8 @@ class BytesToGreyImg(Transformer):
     """Decode ByteRecord bytes to greyscale LabeledImage
     (ref BytesToGreyImg.scala); ``row x col`` raw-u8 records."""
 
+    pure_per_record = True
+
     def __init__(self, row: int, col: int):
         self.row = row
         self.col = col
@@ -124,6 +128,8 @@ class ImgNormalizer(Transformer):
     """Subtract mean, divide std, per channel (ref BGRImgNormalizer /
     GreyImgNormalizer).  Means/stds are scalars or per-channel tuples.
     Routes through the native hostops kernel when built (numpy fallback)."""
+
+    pure_per_record = True
 
     def __init__(self, mean, std):
         self.mean = np.asarray(mean, np.float32)
@@ -159,6 +165,8 @@ class ImgNormalizer(Transformer):
 class ImgPixelNormalizer(Transformer):
     """Subtract a full per-pixel mean image (ref BGRImgPixelNormalizer)."""
 
+    pure_per_record = True
+
     def __init__(self, mean_image):
         self.mean_image = np.asarray(mean_image, np.float32)
 
@@ -182,6 +190,10 @@ class ImgCropper(Transformer):
                 f"cropper_method must be center|random, got {cropper_method}")
         self.cw, self.ch = crop_width, crop_height
         self.cropper_method = cropper_method
+        # center crops are pure 1-to-1 maps; random crops draw RNG and
+        # must stay on the prefetch producer (dataset/prefetch.py)
+        self.stochastic = cropper_method == "random"
+        self.pure_per_record = not self.stochastic
 
     def __call__(self, iterator):
         for img in iterator:
@@ -210,6 +222,8 @@ class ImgRdmCropper(Transformer):
     """Random-position crop with optional zero padding
     (ref BGRImgRdmCropper / GreyImgCropper)."""
 
+    stochastic = True        # RNG draws: stays on the prefetch producer
+
     def __init__(self, crop_width: int, crop_height: int, padding: int = 0):
         self.cw, self.ch = crop_width, crop_height
         self.padding = padding
@@ -231,6 +245,8 @@ class ImgRdmCropper(Transformer):
 class HFlip(Transformer):
     """Random horizontal flip (ref HFlip.scala)."""
 
+    stochastic = True
+
     def __init__(self, threshold: float = 0.5):
         self.threshold = threshold
 
@@ -246,6 +262,8 @@ class ColorJitter(Transformer):
     (ref ColoJitter.scala).  Channel layout is read from each image's
     ``order`` (set by the decoders); pass ``channel_order`` only to
     override it."""
+
+    stochastic = True
 
     def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
                  saturation: float = 0.4, channel_order: str = None):
@@ -300,6 +318,8 @@ class Lighting(Transformer):
     - the RGB-ordered shift row is flipped for BGR-decoded images so each
       eigen-component lands on its own channel, while the reference applies
       the RGB rows to BGR pixels unflipped."""
+
+    stochastic = True
 
     alphastd = 0.1
     eig_val = np.asarray([0.2175, 0.0188, 0.0045], np.float32)
@@ -438,6 +458,8 @@ class MTLabeledImgToBatch(Transformer):
 class ImgToSample(Transformer):
     """LabeledImage -> Sample (for RDD-of-Sample style ingestion)."""
 
+    pure_per_record = True
+
     def __init__(self, to_chw: bool = True):
         self.to_chw = to_chw
 
@@ -460,6 +482,8 @@ class ImgToImageVector(Transformer):
     interleaved channels flipped to RGB plane order (plane 0 = R, 1 = G,
     2 = B); this transformer emits exactly that layout for 3-channel
     images.  Greyscale (2-D) images flatten as-is."""
+
+    pure_per_record = True
 
     def __call__(self, iterator):
         for img in iterator:

@@ -1,0 +1,537 @@
+"""Asynchronous host input pipeline: bounded background fetch +
+prefetch-to-device (docs/performance.md "host pipeline",
+docs/observability.md "host pipeline" spans/events).
+
+The reference hides data loading behind compute — Spark executors
+materialize the next partition's mini-batches while the current
+super-step trains (MTLabeledBGRImgToBatch + PreFetch) — while our serial
+loop ran the whole Transformer chain on the main thread inside the
+``data-load`` span.  This module moves that work off the critical path:
+
+- :class:`PipelineRunner` executes the dataset's transformer chain on ONE
+  background producer thread feeding a bounded queue.  The producer
+  *owns the process seed stream* (``RNG.own_seed_stream``), so shuffle
+  permutations and RNG-bearing transforms (random crop/flip/jitter) draw
+  the exact values, in the exact order, the serial loop would have drawn
+  — the loss trajectory is bit-identical with prefetch on or off
+  (asserted by ``tests/test_prefetch.py``).  Pure per-record stages
+  (decode, normalize — ``Transformer.pure_per_record``) may additionally
+  fan out across a thread pool (``BIGDL_PREFETCH_WORKERS``) with order
+  preserved; stochastic stages always stay on the single producer.
+- Epoch semantics move WITH the draws: the producer mirrors the
+  optimizer's rollover arithmetic (count/reset for single-step,
+  count/subtract for chunked dispatch) and performs the epoch-boundary
+  ``dataset.shuffle()`` + iterator rebuild itself, so the stream sees the
+  identical draw sequence.  The consuming loop only advances its epoch
+  counters.
+- ``to_device`` adds a second stage: a transfer thread double-buffers
+  batches onto the device (the optimizer passes its own
+  ``_device_put_batch``, so local, sharded and multi-host layouts all
+  overlap H2D with compute).  Its wall time is credited to the ``h2d``
+  span via :meth:`PipelineRunner.take_h2d_seconds`.
+- Checkpoint/resume: every produced item carries the stream snapshot
+  taken right after its draws.  :meth:`rng_snapshot` splices the snapshot
+  of the last CONSUMED item with the live device-key counter, so a resume
+  replays exactly the batches the interrupted run had consumed — not the
+  ones it had merely prefetched.  :meth:`close` restores that state, so a
+  finished run leaves the stream exactly where a serial run would.
+
+Flags: ``BIGDL_PREFETCH`` (default on; ``0`` disables, ``N>=2`` sets the
+queue depth), ``BIGDL_SYNC_EVERY_STEP=1`` (escape hatch: the training
+loops also sync the loss every step, for debugging/chaos drills),
+``BIGDL_PREFETCH_WORKERS`` (pure-stage fan-out width, default 0).
+
+Chaos: the optimizers do NOT hand ``to_device`` to the runner while a
+``FaultInjector`` is installed — batches then stay on host until consume
+time so ``_chaos_prestep`` keys every site by the *consuming* step and
+``BIGDL_FAULTS`` drills are unchanged (docs/resilience.md).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from bigdl_tpu.utils.random import RNG
+
+logger = logging.getLogger("bigdl_tpu.dataset")
+
+ENV_PREFETCH = "BIGDL_PREFETCH"
+ENV_SYNC_EVERY_STEP = "BIGDL_SYNC_EVERY_STEP"
+ENV_WORKERS = "BIGDL_PREFETCH_WORKERS"
+
+DEFAULT_DEPTH = 2
+
+
+def enabled() -> bool:
+    """Master switch: ``BIGDL_PREFETCH`` (default on)."""
+    return os.environ.get(ENV_PREFETCH, "1").strip() != "0"
+
+
+def depth() -> int:
+    """Queue depth per stage.  ``BIGDL_PREFETCH=N`` with N >= 2 sets the
+    depth; any other truthy value keeps the default double-buffer."""
+    raw = os.environ.get(ENV_PREFETCH, "").strip()
+    try:
+        n = int(raw)
+    except ValueError:
+        return DEFAULT_DEPTH
+    return n if n >= 2 else DEFAULT_DEPTH
+
+
+def sync_every_step() -> bool:
+    """``BIGDL_SYNC_EVERY_STEP=1``: the loops materialize loss/finite on
+    the host every iteration (the pre-cadence behavior)."""
+    return os.environ.get(ENV_SYNC_EVERY_STEP, "0").strip() == "1"
+
+
+def workers() -> int:
+    try:
+        return max(0, int(os.environ.get(ENV_WORKERS, "0")))
+    except ValueError:
+        return 0
+
+
+def stack_chunk(batches):
+    """Stack n uniform-shape MiniBatches into (n, B, ...) host arrays.
+
+    Each batch is converted ONCE — the converted arrays serve both the
+    shape check and the stack (the old ``_next_chunk`` converted every
+    batch twice: ``np.asarray`` for the check, ``np.stack`` again)."""
+    xs = [np.asarray(b.data) for b in batches]
+    ys = [np.asarray(b.labels) for b in batches]
+    shapes = {a.shape for a in xs}
+    if len(shapes) != 1:
+        raise ValueError(
+            "iterations_per_dispatch needs uniform batch shapes "
+            f"within a chunk, got {shapes}")
+    return np.stack(xs), np.stack(ys)
+
+
+def background(iterator, depth: int = DEFAULT_DEPTH):
+    """Plain bounded background prefetch of an iterator (no RNG
+    ownership, no epoch machinery) — what validation batches ride."""
+    from bigdl_tpu.dataset.transformer import PreFetch
+    return PreFetch(depth)(iterator)
+
+
+def has_stochastic_stage(dataset) -> bool:
+    """True when the dataset's transformer chain contains an RNG-bearing
+    stage.  ``validate`` keeps such (unconventional) eval pipelines on
+    the calling thread instead of a background one, so their draws at
+    least come from a deterministic per-thread stream rather than a
+    fresh derived stream per validation pass."""
+    return any(getattr(s, "stochastic", False)
+               for s in _decompose(dataset)[1])
+
+
+class Item:
+    """One produced batch: host arrays, optional device arrays, the
+    stream snapshot taken after its draws, and fetch-side telemetry."""
+
+    __slots__ = ("x", "y", "device", "rng", "seq", "fetch_wall",
+                 "queue_depth")
+
+    def __init__(self, x, y, rng=None, seq=0, fetch_wall=0.0):
+        self.x = x
+        self.y = y
+        self.device = None
+        self.rng = rng
+        self.seq = seq
+        self.fetch_wall = fetch_wall
+        self.queue_depth = 0
+
+
+class _End:
+    pass
+
+
+class _Error:
+    # private wrapper so a pipeline legitimately yielding exception
+    # objects as data is never confused with a worker failure
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_END = _End()
+
+
+def _decompose(dataset):
+    """Peel a TransformedDataSet chain into (base_dataset, [stages]),
+    flattening ChainedTransformer trees into stage order."""
+    from bigdl_tpu.dataset.dataset import TransformedDataSet
+    from bigdl_tpu.dataset.transformer import ChainedTransformer
+
+    def flatten(t):
+        if isinstance(t, ChainedTransformer):
+            return flatten(t.first) + flatten(t.last)
+        return [t]
+
+    stages = []
+    while isinstance(dataset, TransformedDataSet):
+        stages = flatten(dataset.transformer) + stages
+        dataset = dataset.base
+    return dataset, stages
+
+
+def _is_pure_map(stage) -> bool:
+    """A stage eligible for worker fan-out: declared 1-to-1 per record
+    (``pure_per_record``) and free of RNG draws (not ``stochastic``)."""
+    return bool(getattr(stage, "pure_per_record", False)) and \
+        not bool(getattr(stage, "stochastic", False))
+
+
+class PipelineRunner:
+    """Bounded background input pipeline over one dataset.
+
+    ``chunk > 1`` assembles stacked (n, B, ...) chunks for the device-side
+    scanned loop (``set_iterations_per_dispatch``).  ``epoch_size``
+    enables producer-side epoch rollover (training); ``records_scale``
+    converts a local host batch to the GLOBAL record count the consuming
+    loop's epoch arithmetic uses (multi-host data sharding).
+
+    ``to_device(xh, yh) -> (x, y)`` arms the second stage: a transfer
+    thread that double-buffers batches onto the device ahead of
+    consumption.  ``own_rng`` (default: ``train``) moves the process seed
+    stream onto the producer — see the module docstring.
+    """
+
+    def __init__(self, dataset, *, train: bool = True, chunk: int = 1,
+                 epoch_size: int | None = None, depth: int | None = None,
+                 to_device=None, records_scale: int = 1,
+                 own_rng: bool | None = None, n_workers: int | None = None):
+        self._dataset = dataset
+        self._train = train
+        self._chunk = max(1, int(chunk))
+        self._epoch_size = int(epoch_size) if epoch_size else None
+        self.depth = int(depth) if depth else globals()["depth"]()
+        self._records_scale = max(1, int(records_scale))
+        self._own_rng = train if own_rng is None else bool(own_rng)
+        self._n_workers = workers() if n_workers is None else int(n_workers)
+        self._to_device = to_device
+
+        self._host_q = queue.Queue(maxsize=self.depth)
+        self._out_q = (self._host_q if to_device is None
+                       else queue.Queue(maxsize=self.depth))
+        self._stop = threading.Event()
+        self._pause = threading.Event()
+        # held by the producer for the whole of one draw (transform chain
+        # + epoch rollover); pause() acquires it to wait out an in-flight
+        # draw — an Event-flag handshake alone would race (the producer
+        # could pass the pause check right before the flag is set)
+        self._work_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._closed = False
+        self._count = 0          # records into the current epoch
+        self._pool = None
+        self._split = None       # (base, pure_prefix, rest) when fanning out
+        if self._train and self._n_workers > 0:
+            base, stages = _decompose(dataset)
+            prefix = []
+            i = 0
+            while i < len(stages) and _is_pure_map(stages[i]):
+                prefix.append(stages[i])
+                i += 1
+            # records per base-iterator cycle: the looped iterator draws
+            # its shuffle permutation at each cycle start, so the
+            # fan-out window must drain before crossing a boundary or
+            # that draw lands early in the stream.  Only the list-backed
+            # datasets have a knowable cycle (ShardedDataSet loops its
+            # LOCAL shard — size() would be the global count; streaming
+            # sets like ShardFolder reshuffle on their own schedule):
+            # everything else keeps the single producer, preserving the
+            # bit-parity guarantee over a fan-out speedup.
+            from bigdl_tpu.dataset.dataset import (LocalArrayDataSet,
+                                                   ShardedDataSet)
+            if isinstance(base, ShardedDataSet):
+                cycle = base.shard_size()
+            elif isinstance(base, LocalArrayDataSet):
+                cycle = base.size()
+            else:
+                cycle = None
+                if prefix:
+                    logger.info(
+                        "prefetch worker fan-out disabled: %s has no "
+                        "knowable shuffle-cycle length, so read-ahead "
+                        "could reorder its RNG draws",
+                        type(base).__name__)
+            if prefix and cycle:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._n_workers,
+                    thread_name_prefix="bigdl-prefetch-worker")
+                self._cycle = cycle
+                self._split = (base, prefix, stages[i:])
+
+        # telemetry drained by the consuming loop
+        self.consumed = 0
+        self.produced = 0
+        self.epochs_rolled = 0
+        self.stall_seconds = 0.0
+        self._h2d_seconds = 0.0
+        self._h2d_count = 0
+        self._fetch_seconds = 0.0
+        self._fetch_count = 0
+
+        self._start_snap = RNG.snapshot() if self._own_rng else None
+        self._last_rng = None    # snapshot of the last CONSUMED item
+
+        self._producer = threading.Thread(
+            target=self._produce, daemon=True,
+            name="bigdl-prefetch-producer")
+        self._transfer = None
+        if to_device is not None:
+            self._transfer = threading.Thread(
+                target=self._transfer_loop, daemon=True,
+                name="bigdl-prefetch-h2d")
+        self._producer.start()
+        if self._transfer is not None:
+            self._transfer.start()
+
+    # -- producer side -----------------------------------------------------
+    def _make_iter(self):
+        if self._split is None:
+            return self._dataset.data(train=self._train)
+        base, prefix, rest = self._split
+        it = self._parallel_map(base.data(train=self._train), prefix)
+        for stage in rest:
+            it = stage(it)
+        return it
+
+    def _parallel_map(self, records, prefix):
+        """Ordered fan-out of the pure per-record stage prefix across the
+        worker pool (a bounded window of in-flight futures)."""
+        pool, window = self._pool, self._n_workers * 2
+
+        def apply(rec):
+            out = rec
+            for stage in prefix:
+                res = list(stage(iter([out])))
+                if len(res) != 1:
+                    raise ValueError(
+                        f"{type(stage).__name__} declared pure_per_record "
+                        f"but produced {len(res)} records from 1")
+                out = res[0]
+            return out
+
+        cycle = self._cycle if self._train else None
+
+        def gen():
+            """Bounded in-flight window, record order preserved.  The
+            stream's draw interleaving must match the serial chain:
+            stochastic downstream stages draw per YIELDED record, and
+            pulling the base iterator across a cycle boundary draws the
+            next shuffle permutation — so the window drains fully before
+            the first pull of a new cycle."""
+            futs = deque()
+            pulled = 0
+            it = iter(records)
+            while True:
+                if cycle and pulled and pulled % cycle == 0 and futs:
+                    while futs:
+                        yield futs.popleft().result()
+                try:
+                    rec = next(it)
+                except StopIteration:
+                    break
+                futs.append(pool.submit(apply, rec))
+                pulled += 1
+                if len(futs) >= window:
+                    yield futs.popleft().result()
+            while futs:
+                yield futs.popleft().result()
+
+        return gen()
+
+    def _advance_epoch(self, records: int):
+        """Mirror of the optimizers' ``_advance_epochs`` arithmetic, run
+        at PRODUCE time so the epoch-boundary shuffle + permutation draws
+        land at the same point of the stream as in the serial loop."""
+        if not self._epoch_size or not self._train:
+            return
+        self._count += records
+        if self._chunk <= 1:
+            if self._count >= self._epoch_size:
+                self._count = 0
+                self._rollover()
+        else:
+            while self._count >= self._epoch_size:
+                self._count -= self._epoch_size
+                self._rollover()
+
+    def _rollover(self):
+        self._dataset.shuffle()
+        self._it = self._make_iter()
+        self.epochs_rolled += 1
+
+    def _produce(self):
+        try:
+            if self._own_rng:
+                RNG.own_seed_stream()
+            self._it = self._make_iter()
+            seq = 0
+            while not self._stop.is_set():
+                if self._pause.is_set():
+                    time.sleep(0.002)
+                    continue
+                with self._work_lock:
+                    if self._pause.is_set():  # re-check under the lock
+                        continue
+                    t0 = time.perf_counter()
+                    if self._chunk <= 1:
+                        try:
+                            b = next(self._it)
+                        except StopIteration:
+                            self._put(self._host_q, _END)
+                            return
+                        x, y = b.data, b.labels
+                        records = int(np.asarray(x).shape[0])
+                    else:
+                        x, y = stack_chunk(
+                            [next(self._it) for _ in range(self._chunk)])
+                        records = int(x.shape[0] * x.shape[1])
+                    self._advance_epoch(records * self._records_scale)
+                    snap = RNG.snapshot() if self._own_rng else None
+                    wall = time.perf_counter() - t0
+                item = Item(x, y, rng=snap, seq=seq, fetch_wall=wall)
+                with self._stats_lock:
+                    self._fetch_seconds += wall
+                    self._fetch_count += 1
+                if not self._put(self._host_q, item):
+                    return
+                self.produced += 1
+                seq += 1
+        except BaseException as e:  # surface on the consumer thread
+            self._put(self._host_q, _Error(e))
+
+    def _put(self, q, item) -> bool:
+        """Bounded put that gives up once the consumer is gone, so an
+        abandoned runner never leaves its threads blocked forever."""
+        while not self._stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _transfer_loop(self):
+        while not self._stop.is_set():
+            try:
+                item = self._host_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if isinstance(item, (_End, _Error)):
+                self._put(self._out_q, item)
+                return
+            try:
+                t0 = time.perf_counter()
+                item.device = self._to_device(item.x, item.y)
+                dt = time.perf_counter() - t0
+                with self._stats_lock:
+                    self._h2d_seconds += dt
+                    self._h2d_count += 1
+            except BaseException as e:
+                self._put(self._out_q, _Error(e))
+                return
+            if not self._put(self._out_q, item):
+                return
+
+    # -- consumer side -----------------------------------------------------
+    def get(self):
+        """Next item, blocking.  Returns ``(item, waited_seconds)``;
+        raises StopIteration when a one-pass (eval) stream is exhausted
+        and re-raises any producer/transfer failure."""
+        t0 = time.perf_counter()
+        item = self._out_q.get()
+        waited = time.perf_counter() - t0
+        if isinstance(item, _End):
+            raise StopIteration
+        if isinstance(item, _Error):
+            raise item.exc
+        self.stall_seconds += waited
+        self.consumed += 1
+        item.queue_depth = self._out_q.qsize()
+        if item.rng is not None:
+            self._last_rng = item.rng
+        return item, waited
+
+    def __iter__(self):
+        while True:
+            try:
+                yield self.get()[0]
+            except StopIteration:
+                return
+
+    def take_h2d(self):
+        """Drain the transfer thread's accumulated (seconds, batches) —
+        credited to the ``h2d`` span by the consuming loop."""
+        with self._stats_lock:
+            out = (self._h2d_seconds, self._h2d_count)
+            self._h2d_seconds, self._h2d_count = 0.0, 0
+        return out
+
+    def take_fetch(self):
+        """Drain the producer's accumulated (seconds, batches) of
+        transform-chain wall — the ``data-load/fetch`` span."""
+        with self._stats_lock:
+            out = (self._fetch_seconds, self._fetch_count)
+            self._fetch_seconds, self._fetch_count = 0.0, 0
+        return out
+
+    def rng_snapshot(self) -> dict:
+        """Host-stream state as of the last CONSUMED batch, with the
+        LIVE device-key counter spliced in — the checkpoint payload that
+        makes a resumed run replay the serial trajectory (keys are
+        minted at consume time on the loop thread, np draws at fetch
+        time on the producer)."""
+        base = self._last_rng or self._start_snap
+        if base is None:
+            return RNG.snapshot()
+        snap = dict(base)
+        snap["key_counter"] = RNG.key_counter()
+        return snap
+
+    def pause(self):
+        """Hold the producer before its next draw (validation borrows the
+        dataset's backing store; an epoch shuffle must not interleave).
+        Acquiring the work lock waits out a draw already in flight."""
+        self._pause.set()
+        with self._work_lock:
+            pass
+        return self
+
+    def resume(self):
+        self._pause.clear()
+        return self
+
+    def close(self, restore_rng: bool = True):
+        """Stop both threads, then (training runners) hand the seed
+        stream back to the calling thread restored to the last-consumed
+        state — erasing the ahead-draws of merely-prefetched batches so
+        the process RNG ends exactly where a serial run would."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        for q in {id(self._host_q): self._host_q,
+                  id(self._out_q): self._out_q}.values():
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        self._producer.join(timeout=5.0)
+        if self._transfer is not None:
+            self._transfer.join(timeout=5.0)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        if self._producer.is_alive():  # pragma: no cover - defensive
+            logger.warning("prefetch producer did not stop within 5s")
+        if self._own_rng and restore_rng:
+            RNG.restore(self.rng_snapshot())

@@ -16,6 +16,16 @@ from bigdl_tpu.dataset.sample import Sample, MiniBatch
 class Transformer:
     """Iterator-to-iterator stage. Subclasses override __call__."""
 
+    #: exactly one output record per input record, no RNG draws, no
+    #: cross-record state — eligible for ordered worker fan-out in the
+    #: prefetch pipeline (``dataset/prefetch.py``); decode/normalize
+    #: stages set this
+    pure_per_record = False
+    #: draws from the framework RNG (``utils.random.RNG``) — must run on
+    #: the prefetch producer thread (the seed-stream owner) so the draw
+    #: sequence stays bit-identical to the serial path
+    stochastic = False
+
     def __call__(self, iterator):
         raise NotImplementedError
 
@@ -63,19 +73,101 @@ class SampleToBatch(Transformer):
     features/labels.  ``fixed_length``: pad every batch to this length
     (keeps one static shape for jit instead of per-batch max).
     ``partition_num``: drop the tail so every partition yields whole batches.
+
+    ``reuse_buffers=N`` (N >= 2) assembles batches into a ring of N
+    preallocated arrays instead of a fresh ``np.stack`` allocation per
+    batch — sample rows are copied straight into the slot.  A yielded
+    MiniBatch is then only valid until N-1 more batches have been drawn:
+    use it with consumers that copy promptly (the training loops convert
+    to device arrays immediately; a prefetch pipeline of depth d needs
+    ``N >= d + 2`` to cover queued + in-flight batches).  Off (0) by
+    default because collecting batches into a list is a valid use of the
+    default path.
     """
 
     def __init__(self, batch_size: int, feature_padding=None, label_padding=None,
-                 fixed_length: int = None, drop_last: bool = False):
+                 fixed_length: int = None, drop_last: bool = False,
+                 reuse_buffers: int = 0):
+        if reuse_buffers and reuse_buffers < 2:
+            raise ValueError(
+                f"reuse_buffers needs a ring of >= 2 slots, got "
+                f"{reuse_buffers} (the consumer still holds the previous "
+                "batch while the next is assembled)")
         self.batch_size = batch_size
         self.feature_padding = feature_padding
         self.label_padding = label_padding
         self.fixed_length = fixed_length
         self.drop_last = drop_last
+        self.reuse_buffers = int(reuse_buffers)
+        self._ring = None
+        self._ring_i = 0
+
+    def _ring_slot(self, feats, labels):
+        """The next preallocated (feature, label) buffer pair, or None
+        when the batch doesn't fit the ring (partial tail batch, shape
+        drift) — those fall back to a fresh allocation."""
+        if not self.reuse_buffers:
+            return None
+        if self._ring is None:
+            f0, l0 = np.asarray(feats[0]), np.asarray(labels[0])
+            # padded sides have data-dependent dim 1 unless pinned
+            if self.feature_padding is not None:
+                if self.fixed_length is None:
+                    return None
+                fshape = (self.batch_size, self.fixed_length) + f0.shape[1:]
+            else:
+                fshape = (self.batch_size,) + f0.shape
+            if self.label_padding is not None:
+                if self.fixed_length is None:
+                    return None
+                lshape = (self.batch_size, self.fixed_length) + l0.shape[1:]
+            else:
+                lshape = (self.batch_size,) + l0.shape
+            self._ring = [
+                (np.empty(fshape, f0.dtype), np.empty(lshape, l0.dtype))
+                for _ in range(self.reuse_buffers)]
+        fbuf, lbuf = self._ring[self._ring_i]
+        if len(feats) != fbuf.shape[0] \
+                or not self._rows_fit(fbuf, feats, self.feature_padding) \
+                or not self._rows_fit(lbuf, labels, self.label_padding):
+            return None
+        self._ring_i = (self._ring_i + 1) % len(self._ring)
+        return fbuf, lbuf
+
+    @staticmethod
+    def _rows_fit(buf, rows, pad_value):
+        """Every row must match the buffer's row shape exactly (padded
+        sides: the trailing dims; dim 0 is clipped/padded) — a drifting
+        shape falls back to fresh allocation instead of crashing on the
+        copy or, worse, broadcasting silently into wrong data."""
+        if pad_value is None:
+            want = buf.shape[1:]
+            return all(np.shape(r) == want for r in rows)
+        want = buf.shape[2:]
+        return all(np.shape(r)[1:] == want for r in rows)
+
+    @staticmethod
+    def _fill(buf, arrays, pad_value):
+        """Copy sample rows into a preallocated batch buffer (the padded
+        path pre-fills with the pad value, then writes each prefix)."""
+        if pad_value is None:
+            for i, a in enumerate(arrays):
+                buf[i] = a
+            return buf
+        buf.fill(pad_value)
+        max_len = buf.shape[1]
+        for i, a in enumerate(arrays):
+            n = min(np.shape(a)[0], max_len)
+            buf[i, :n] = a[:n]
+        return buf
 
     def _assemble(self, samples):
         feats = [s.feature for s in samples]
         labels = [s.label for s in samples]
+        slot = self._ring_slot(feats, labels)
+        if slot is not None:
+            return MiniBatch(self._fill(slot[0], feats, self.feature_padding),
+                             self._fill(slot[1], labels, self.label_padding))
         if self.feature_padding is not None:
             feats = _pad_stack(feats, self.feature_padding, self.fixed_length)
         else:

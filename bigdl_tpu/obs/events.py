@@ -49,6 +49,9 @@ EVENT_TYPES = {
     "validation": ("step", "method", "value"),
     "checkpoint": ("step", "path"),
     "fault": ("site", "step"),
+    # the input pipeline failed to hide the fetch: the consuming loop
+    # waited `seconds` for the prefetch queue at `step` (queue was empty)
+    "prefetch_stall": ("step", "seconds"),
     "watchdog": ("stale",),
     "preempt": ("step",),
     "abort": ("step", "reason"),

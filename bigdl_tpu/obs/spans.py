@@ -24,8 +24,13 @@ import time
 from contextlib import contextmanager
 
 #: top-level phases every optimizer declares — the fixed, every-process
-#: name set that keeps the per-node allgather deadlock-free
-PHASES = ("data-load", "dispatch", "aggregate", "validate", "checkpoint")
+#: name set that keeps the per-node allgather deadlock-free.  ``h2d``
+#: is the host→device batch transfer (inline, or credited from the
+#: prefetch transfer thread via :meth:`SpanTracker.record`); ``host-wait``
+#: is the cadence-boundary device→host sync the loops pay instead of a
+#: per-step ``float(loss)`` (docs/observability.md "host pipeline").
+PHASES = ("data-load", "h2d", "dispatch", "host-wait", "aggregate",
+          "validate", "checkpoint")
 
 _PREFIX = "span: "
 
@@ -58,6 +63,19 @@ class SpanTracker:
                 self._paths.append(path)
             self.metrics.add(_PREFIX + path, dt,
                              distributed=(path in self.phases))
+
+    def record(self, name: str, seconds: float, count: int = 1):
+        """Credit an externally-timed interval to a span — work measured
+        on a background thread (the prefetch pipeline's H2D transfers)
+        whose timing the main thread drains and books here.  ``count=0``
+        adds seconds to an interval already counted once (accumulating a
+        phase across drains without inflating its sample count)."""
+        if seconds <= 0 and count <= 0:
+            return
+        if name not in self._paths:
+            self._paths.append(name)
+        self.metrics.accumulate(_PREFIX + name, seconds, count=count,
+                                distributed=(name in self.phases))
 
     # -- rendering ---------------------------------------------------------
     def rows(self):

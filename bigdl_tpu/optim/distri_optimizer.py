@@ -43,8 +43,10 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import Context
-from bigdl_tpu.optim.local_optimizer import (LocalOptimizer, _finite_all,
-                                             _where_finite, validate)
+from bigdl_tpu.optim.local_optimizer import (LocalOptimizer,
+                                             _HostSyncWindow, _PendingStep,
+                                             _finite_all, _where_finite,
+                                             validate)
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.parallel.mesh import data_parallel_mesh
 from bigdl_tpu.utils.engine import Engine
@@ -886,6 +888,18 @@ class DistriOptimizer(LocalOptimizer):
         return (jax.make_array_from_process_local_data(xsh, np.asarray(x)),
                 jax.make_array_from_process_local_data(ysh, np.asarray(y)))
 
+    def _global_records_factor(self) -> int:
+        """Host-batch → global-record multiplier for the prefetch
+        producer's epoch arithmetic: multi-host data-sharded batches
+        assemble ``process_count`` local shards into one global array
+        (``make_array_from_process_local_data``); pipeline operands ride
+        replicated, so their global batch equals the local one."""
+        if jax.process_count() == 1 or self.pipeline_stages is not None:
+            return 1
+        if "data" in self.mesh.axis_names:
+            return jax.process_count()
+        return 1
+
     def optimize(self):
         state = self.state
         state.get_or_update("epoch", 1)
@@ -908,97 +922,156 @@ class DistriOptimizer(LocalOptimizer):
 
         count = 0
         epoch_size = self.dataset.size()
-        data_iter = self.dataset.data(train=True)
-        n_dev = self.mesh.size
-        wall_start = time.perf_counter()
-
         n_disp = self.iters_per_dispatch
         straggler = self._straggler
-        while not self.end_when(state):
-            neval0 = int(state["neval"])
-            fetch_start = time.perf_counter()
-            with self.spans.span("data-load"), \
-                    self.metrics.timer("data fetch time"):
-                if n_disp <= 1:
-                    batch = next(data_iter)
-                    xh = self._chaos_prestep(batch.data, state["neval"])
-                    x, y = self._device_put_batch(xh, batch.labels)
-                    global_b = x.shape[0]
-                else:
-                    xh, yh = self._next_chunk(data_iter, n_disp)
-                    xh = self._chaos_prestep(xh, state["neval"])
-                    x, y = self._device_put_batch(xh, yh, stacked=True)
-                    global_b = x.shape[0] * x.shape[1]
-            fetch_wall = time.perf_counter() - fetch_start
+        # straggler drop re-times and accepts/rejects every iteration on
+        # the host, so it keeps the per-step sync; _make_train_pipeline
+        # already returns None for it
+        pipeline = self._make_train_pipeline(n_disp, epoch_size)
+        self._train_pipeline = pipeline
+        data_iter = None if pipeline is not None \
+            else self.dataset.data(train=True)
+        self._window = _HostSyncWindow(
+            1 if straggler is not None else self._sync_cadence())
+        wall_start = time.perf_counter()
 
-            drop_mask = None
-            if straggler is not None:
-                drop_mask = straggler.mask()
-                if not straggler.accepts(drop_mask):
-                    # iteration rejected: batch consumed, no update, no
-                    # neval advance (ref DistriOptimizer.scala:224 guard)
-                    straggler.reject(drop_mask)
-                    continue
+        try:
+            while not self.end_when(state):
+                neval0 = int(state["neval"])
+                epoch0 = int(state["epoch"])
+                self._window.arm()
+                fetch_start = time.perf_counter()
+                dev = qdepth = None
+                with self.spans.span("data-load"), \
+                        self.metrics.timer("data fetch time"):
+                    if pipeline is not None:
+                        # the span measures the CONSUMER's wait only; the
+                        # producer's transform wall rides data-load/fetch
+                        item, waited = pipeline.get()
+                        self._drain_pipeline_obs(pipeline, item, waited,
+                                                 neval0)
+                        qdepth = item.queue_depth
+                        if item.device is not None:
+                            dev = item.device
+                    elif n_disp <= 1:
+                        batch = next(data_iter)
+                        xh = self._chaos_prestep(batch.data, neval0)
+                        yh = batch.labels
+                    else:
+                        xh, yh = self._next_chunk(data_iter, n_disp)
+                        xh = self._chaos_prestep(xh, neval0)
+                if dev is None:
+                    if pipeline is not None:
+                        # chaos host mode: poison at CONSUME time, so
+                        # every site stays keyed by the consuming step
+                        xh = self._chaos_prestep(item.x, neval0)
+                        yh = item.y
+                    with self.spans.span("h2d"):
+                        dev = self._device_put_batch(xh, yh,
+                                                     stacked=n_disp > 1)
+                x, y = dev
+                global_b = (x.shape[0] * x.shape[1] if n_disp > 1
+                            else x.shape[0])
+                fetch_wall = time.perf_counter() - fetch_start
 
-            # distributed: summary() adds the per-process breakdown, the
-            # reference's "computing time for each node" accumulator
-            it_start = time.perf_counter()
-            with self.spans.span("dispatch"), \
-                    self.metrics.timer("computing time average",
-                                       distributed=True):
-                lr = self._current_lr()
-                key = RNG.next_key()
-                step_args = (params, net_state, opt_state, x, y,
-                             jnp.float32(lr), key, self._lr_scales_arg)
+                drop_mask = None
                 if straggler is not None:
-                    (params, net_state, opt_state, loss, finite,
-                     taps) = step_fn(*step_args, jnp.asarray(drop_mask))
-                else:
-                    (params, net_state, opt_state, loss, finite,
-                     taps) = step_fn(*step_args)
-                # float() blocks on the device result, so the timer (and
-                # the straggler's task clock) sees the real dispatch wall
-                loss = float(loss[-1]) if n_disp > 1 else float(loss)
+                    drop_mask = straggler.mask()
+                    if not straggler.accepts(drop_mask):
+                        # iteration rejected: batch consumed, no update, no
+                        # neval advance (ref DistriOptimizer.scala:224 guard)
+                        straggler.reject(drop_mask)
+                        continue
 
-            step_time = self.metrics.mean("computing time average")
-            n_dropped = 0
-            if straggler is not None:
-                with self.spans.span("aggregate"):
-                    # the cross-process task-time merge (allgather)
-                    straggler.record(self._straggler_task_times(
-                        fetch_wall, time.perf_counter() - it_start),
-                        drop_mask)
-                n_dropped = int(len(drop_mask) - drop_mask.sum())
+                # distributed: summary() adds the per-process breakdown,
+                # the reference's "computing time for each node" accumulator
+                it_start = time.perf_counter()
+                with self.spans.span("dispatch"), \
+                        self.metrics.timer("computing time average",
+                                           distributed=True):
+                    lr = self._current_lr()
+                    key = RNG.next_key()
+                    step_args = (params, net_state, opt_state, x, y,
+                                 jnp.float32(lr), key, self._lr_scales_arg)
+                    if straggler is not None:
+                        (params, net_state, opt_state, loss, finite,
+                         taps) = step_fn(*step_args, jnp.asarray(drop_mask))
+                        # the device→host transfer blocks, so the timer
+                        # (and the straggler's task clock) sees the real
+                        # dispatch wall — the one mode that syncs per
+                        # step.  The HOST array rides the window so the
+                        # cadence-1 flush does not transfer a second time.
+                        loss = np.asarray(loss)
+                    else:
+                        (params, net_state, opt_state, loss, finite,
+                         taps) = step_fn(*step_args)
+                train_time = time.perf_counter() - it_start
+
+                n_dropped = 0
+                if straggler is not None:
+                    with self.spans.span("aggregate"):
+                        # the cross-process task-time merge (allgather)
+                        straggler.record(self._straggler_task_times(
+                            fetch_wall, time.perf_counter() - it_start),
+                            drop_mask)
+                    n_dropped = int(len(drop_mask) - drop_mask.sum())
+                    if n_dropped:
+                        # ref logger.debug("Dropped modules: " + ...) :248
+                        logger.debug("Dropped modules: %d", n_dropped)
+                        # only the finished tasks' records count toward the
+                        # epoch (ref recordsNum += finishedThreads.size *
+                        # stackSize, accumulateCount += recordsNum :236)
+                        global_b = int(global_b * float(drop_mask.sum())
+                                       / len(drop_mask))
+                count += global_b
+                state["neval"] = neval0 + n_disp
+                state["evalCounter"] = state.get("evalCounter", 0) + n_disp
+                extra = {}
                 if n_dropped:
-                    # ref logger.debug("Dropped modules: " + ...) :248
-                    logger.debug("Dropped modules: %d", n_dropped)
-                    # only the finished tasks' records count toward the
-                    # epoch (ref recordsNum += finishedThreads.size *
-                    # stackSize, accumulateCount += recordsNum :236)
-                    global_b = int(global_b * float(drop_mask.sum())
-                                   / len(drop_mask))
-            count += global_b
-            state["neval"] = state["neval"] + n_disp
-            state["loss"] = loss
-            state["evalCounter"] = state.get("evalCounter", 0) + n_disp
-            throughput = global_b / max(step_time, 1e-9)
-            logger.info(
-                "Epoch %d %d/%d loss %.6f lr %.5g throughput %.1f records/s "
-                "on %d devices", state["epoch"], count, epoch_size, loss, lr,
-                throughput, n_dev)
+                    extra["straggler_dropped"] = n_dropped
+                if qdepth is not None:
+                    extra["queue_depth"] = int(qdepth)
+                self._window.push(_PendingStep(
+                    neval0, epoch0, count, loss, finite, taps, lr,
+                    global_b, fetch_wall, train_time, extra))
 
-            self._note_finite(finite, state)
-            extra = {"straggler_dropped": n_dropped} if n_dropped else {}
-            self._emit_step_event(neval0, loss, lr, throughput,
-                                  monitor.push(neval0, taps), **extra)
-            count, data_iter = self._advance_epochs(state, count,
-                                                    epoch_size, n_disp,
-                                                    data_iter)
-            self._fire_triggers(params, net_state, opt_state, state, n_disp)
-            if self._preemption_pending():
-                self._checkpoint_and_stop(params, net_state, opt_state,
-                                          state)
-                break
+                rolled = count >= epoch_size
+                count, data_iter = self._advance_epochs(
+                    state, count, epoch_size, n_disp, data_iter, pipeline)
+                if self._window.due() or rolled:
+                    self._flush_window(state, monitor,
+                                       "epoch" if rolled else "cadence")
+                ne_val = self._fired_within(self.validation_trigger, state,
+                                            n_disp)
+                ne_ck = self._fired_within(self.checkpoint_trigger, state,
+                                           n_disp)
+                preempt = self._preemption_pending()
+                if preempt or ne_val is not None or ne_ck is not None:
+                    self._flush_window(state, monitor,
+                                       "preempt" if preempt else "trigger")
+                if ne_val is not None:
+                    self._maybe_validate(params, net_state, state,
+                                         force=True)
+                if ne_ck is not None:
+                    self._maybe_checkpoint(params, net_state, opt_state,
+                                           state, force=True,
+                                           neval_label=ne_ck)
+                if preempt:
+                    self._checkpoint_and_stop(params, net_state, opt_state,
+                                              state)
+                    break
+            self._flush_window(state, monitor, "run-end")
+        finally:
+            try:
+                # see LocalOptimizer.optimize: crash-adjacent steps must
+                # reach the event stream before the pipeline tears down
+                self._flush_window(state, monitor, "exception")
+            except Exception as e:
+                logger.warning("pending-step flush during unwind "
+                               "failed: %s", e)
+            if pipeline is not None:
+                pipeline.close()
+            self._train_pipeline = None
 
         # gather (replicated -> host) and write back, ref getModel :475-499
         if self._pipe_plan is not None:

@@ -19,11 +19,13 @@ from __future__ import annotations
 import logging
 import os
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.dataset import prefetch as prefetch_mod
 from bigdl_tpu.nn.module import Context
 from bigdl_tpu.obs import events as obs_events
 from bigdl_tpu.obs import taps as obs_taps
@@ -65,6 +67,84 @@ def _where_finite(finite, new_tree, old_tree):
         lambda n, o: jnp.where(finite, n, o), new_tree, old_tree)
 
 
+class _PendingStep:
+    """One dispatched-but-not-yet-synced iteration: device scalars (loss,
+    finite flag, tap dict) plus the host-side bookkeeping captured at
+    dispatch time, held until the next cadence flush."""
+
+    __slots__ = ("neval0", "epoch", "count", "loss", "finite", "taps",
+                 "lr", "records", "fetch_t", "train_t", "extra")
+
+    def __init__(self, neval0, epoch, count, loss, finite, taps, lr,
+                 records, fetch_t, train_t, extra):
+        self.neval0 = neval0
+        self.epoch = epoch
+        self.count = count
+        self.loss = loss
+        self.finite = finite
+        self.taps = taps
+        self.lr = lr
+        self.records = records
+        self.fetch_t = fetch_t
+        self.train_t = train_t
+        self.extra = extra
+
+
+class _HostSyncWindow:
+    """Cadence-gated device→host synchronization for the training loops
+    (docs/observability.md "host pipeline").
+
+    The serial loop ended every iteration in ``float(loss)`` — an
+    80–120 ms device→host round-trip on relay-attached chips
+    (PERF_NOTES).  Instead the loop now parks each step's device scalars
+    here and materializes them in one blocking batch every ``cadence``
+    iterations (the same elapsed-iterations gate, and therefore the same
+    boundaries, as ``obs.taps.TapsMonitor``), at epoch/validation/
+    checkpoint boundaries, on preemption, and at run end.  The in-jit
+    skip-step guard (PR 1) keeps params safe between syncs.
+
+    ``flush_steps``/``flush_reasons`` are the audit trail the sync-count
+    test asserts on: host syncs happen at flush boundaries, nowhere else.
+    """
+
+    def __init__(self, cadence: int):
+        self.cadence = max(1, int(cadence))
+        self.pending: list[_PendingStep] = []
+        self._last_flush = 0
+        self._t0 = None
+        self.flush_steps = deque(maxlen=1024)
+        self.flush_reasons = deque(maxlen=1024)
+
+    def arm(self):
+        """Start the window wall clock — called at the top of the first
+        iteration the window covers, so the flushed throughput spans
+        fetch + dispatch + sync like the serial per-step number did."""
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+
+    def push(self, entry: _PendingStep):
+        self.arm()
+        self.pending.append(entry)
+
+    def due(self) -> bool:
+        """Same chunk-safe gate as ``TapsMonitor``: at least ``cadence``
+        iterations have begun since the last flushed step."""
+        return bool(self.pending) and \
+            (self.pending[-1].neval0 - self._last_flush) >= self.cadence
+
+    def flush(self):
+        """Materialize every pending step (the only device→host block in
+        the loop).  Returns (entries, losses, finites, window_wall)."""
+        entries, self.pending = self.pending, []
+        losses = [np.asarray(e.loss) for e in entries]
+        finites = [np.asarray(e.finite) for e in entries]
+        wall = (time.perf_counter() - self._t0) if self._t0 else 0.0
+        self._t0 = None
+        if entries:
+            self._last_flush = entries[-1].neval0
+        return entries, losses, finites, wall
+
+
 class LocalOptimizer:
     def __init__(self, model, dataset, criterion):
         self.model = model
@@ -98,6 +178,10 @@ class LocalOptimizer:
         self._train_summary = None
         self._val_summary = None
         self.spans = SpanTracker(self.metrics)
+        # async host pipeline (dataset/prefetch.py): live runner + the
+        # cadence window, both set up per optimize() run
+        self._train_pipeline = None
+        self._window = None
 
     def set_taps(self, enabled: bool | None = None,
                  cadence: int | None = None):
@@ -311,15 +395,102 @@ class LocalOptimizer:
 
     @staticmethod
     def _next_chunk(data_iter, n):
-        """Draw n uniform-shape batches and stack them host-side."""
-        batches = [next(data_iter) for _ in range(n)]
-        shapes = {np.asarray(b_.data).shape for b_ in batches}
-        if len(shapes) != 1:
-            raise ValueError(
-                "iterations_per_dispatch needs uniform batch shapes "
-                f"within a chunk, got {shapes}")
-        return (np.stack([b_.data for b_ in batches]),
-                np.stack([b_.labels for b_ in batches]))
+        """Draw n uniform-shape batches and stack them host-side (each
+        batch converted once — see ``prefetch.stack_chunk``)."""
+        return prefetch_mod.stack_chunk([next(data_iter) for _ in range(n)])
+
+    def _device_put_batch(self, x, y, stacked: bool = False):
+        """Host batch → device arrays.  The Distri override shards over
+        the mesh; the prefetch transfer thread calls this off the main
+        thread to overlap H2D with compute."""
+        del stacked
+        return jnp.asarray(x), jnp.asarray(y)
+
+    def _global_records_factor(self) -> int:
+        """Host-batch → global-record multiplier for the producer's epoch
+        arithmetic (multi-host data sharding overrides this)."""
+        return 1
+
+    def _sync_cadence(self) -> int:
+        """Iterations between host materializations of loss/finite — the
+        taps cadence (``BIGDL_OBS_TAPS_CADENCE`` / ``set_taps``), or 1
+        under the ``BIGDL_SYNC_EVERY_STEP`` escape hatch."""
+        if prefetch_mod.sync_every_step():
+            return 1
+        return obs_taps.cadence(self._taps_cadence)
+
+    def _make_train_pipeline(self, n_disp: int, epoch_size: int):
+        """The background input pipeline for this run, or None (prefetch
+        disabled, or a mode that needs per-iteration host feedback).
+        With a FaultInjector installed the runner stays host-side so
+        ``_chaos_prestep`` keys every site by the CONSUMING step and H2D
+        happens after poisoning — ``BIGDL_FAULTS`` drills are unchanged."""
+        from bigdl_tpu.resilience import faults
+        if not prefetch_mod.enabled():
+            return None
+        if getattr(self, "_straggler", None) is not None:
+            # straggler drop accepts/rejects and re-times every iteration
+            # on the host; producing ahead would decouple its clock
+            return None
+        to_device = None
+        if faults.get() is None:
+            stacked = n_disp > 1
+            to_device = lambda xh, yh: self._device_put_batch(
+                xh, yh, stacked=stacked)
+        return prefetch_mod.PipelineRunner(
+            self.dataset, train=True, chunk=n_disp, epoch_size=epoch_size,
+            to_device=to_device,
+            records_scale=self._global_records_factor())
+
+    def _drain_pipeline_obs(self, pipeline, item, waited, neval0):
+        """Book the background threads' telemetry onto the main-thread
+        spans/events: producer fetch + H2D walls, and a prefetch_stall
+        event when the queue failed to hide the fetch."""
+        sec, n = pipeline.take_h2d()
+        if n:
+            self.spans.record("h2d", sec, count=n)
+        sec, n = pipeline.take_fetch()
+        if n:
+            self.spans.record("data-load/fetch", sec, count=n)
+        if waited > 0.01 and item.seq >= pipeline.depth:
+            obs_events.emit("prefetch_stall", step=int(neval0),
+                            seconds=round(waited, 6),
+                            queue_depth=int(item.queue_depth))
+
+    def _flush_window(self, state, monitor, reason: str):
+        """Materialize the pending window: one blocking device→host sync
+        (the ``host-wait`` span), then the per-step host work the serial
+        loop did eagerly — loss logging, the non-finite ledger, step
+        events and TensorBoard scalars.  An abort raised by the ledger is
+        deferred until every pending step's events are out."""
+        w = self._window
+        if w is None or not w.pending:
+            return
+        with self.spans.span("host-wait"):
+            entries, losses, finites, wall = w.flush()
+        w.flush_steps.append(entries[-1].neval0)
+        w.flush_reasons.append(reason)
+        records = sum(e.records for e in entries)
+        rate = records / max(wall, 1e-9)
+        epoch_size = self.dataset.size()
+        abort = None
+        for e, lv, fv in zip(entries, losses, finites):
+            loss_f = float(lv.reshape(-1)[-1])
+            state["loss"] = loss_f
+            logger.info(
+                "Epoch %d %d/%d loss %.6f lr %.5g throughput %.1f "
+                "records/s (fetch %.4fs dispatch %.4fs, synced %s)",
+                e.epoch, e.count, epoch_size, loss_f, e.lr, rate,
+                e.fetch_t, e.train_t, reason)
+            if abort is None:
+                try:
+                    self._note_finite(fv, state)
+                except NonFiniteGradError as exc:
+                    abort = exc  # emit the remaining step events first
+            self._emit_step_event(e.neval0, loss_f, e.lr, rate,
+                                  monitor.push(e.neval0, e.taps), **e.extra)
+        if abort is not None:
+            raise abort
 
     # -- main loop (ref LocalOptimizer.optimize :77) ----------------------
     def optimize(self):
@@ -341,63 +512,118 @@ class LocalOptimizer:
 
         count = 0
         epoch_size = self.dataset.size()
-        data_iter = self.dataset.data(train=True)
+        n_disp = self.iters_per_dispatch
+        pipeline = self._make_train_pipeline(n_disp, epoch_size)
+        self._train_pipeline = pipeline
+        data_iter = None if pipeline is not None \
+            else self.dataset.data(train=True)
+        self._window = _HostSyncWindow(self._sync_cadence())
         wall_start = time.perf_counter()
 
-        n_disp = self.iters_per_dispatch
-        while not self.end_when(state):
-            neval0 = int(state["neval"])
-            fetch_start = time.perf_counter()
-            with self.spans.span("data-load"):
-                if n_disp <= 1:
-                    batch = next(data_iter)
-                    xh = self._chaos_prestep(batch.data, state["neval"])
-                    x = jnp.asarray(xh)
-                    y = jnp.asarray(batch.labels)
-                else:
-                    xh, yh = self._next_chunk(data_iter, n_disp)
-                    xh = self._chaos_prestep(xh, state["neval"])
-                    x, y = jnp.asarray(xh), jnp.asarray(yh)
-            fetch_time = time.perf_counter() - fetch_start
+        try:
+            while not self.end_when(state):
+                neval0 = int(state["neval"])
+                epoch0 = int(state["epoch"])
+                self._window.arm()
+                fetch_start = time.perf_counter()
+                dev = qdepth = None
+                with self.spans.span("data-load"):
+                    if pipeline is not None:
+                        # the span measures the CONSUMER's wait only; the
+                        # producer's transform wall rides data-load/fetch
+                        item, waited = pipeline.get()
+                        self._drain_pipeline_obs(pipeline, item, waited,
+                                                 neval0)
+                        qdepth = item.queue_depth
+                        if item.device is not None:
+                            dev = item.device
+                    elif n_disp <= 1:
+                        batch = next(data_iter)
+                        xh = self._chaos_prestep(batch.data, neval0)
+                        yh = batch.labels
+                    else:
+                        xh, yh = self._next_chunk(data_iter, n_disp)
+                        xh = self._chaos_prestep(xh, neval0)
+                if dev is None:
+                    if pipeline is not None:
+                        # chaos host mode: poison at CONSUME time, so
+                        # every site stays keyed by the consuming step
+                        xh = self._chaos_prestep(item.x, neval0)
+                        yh = item.y
+                    with self.spans.span("h2d"):
+                        dev = self._device_put_batch(xh, yh,
+                                                     stacked=n_disp > 1)
+                x, y = dev
+                fetch_time = time.perf_counter() - fetch_start
 
-            train_start = time.perf_counter()
-            with self.spans.span("dispatch"):
-                lr = self._current_lr()
-                key = RNG.next_key()
-                params, net_state, opt_state, loss, finite, taps = step_fn(
-                    params, net_state, opt_state, x, y, jnp.float32(lr), key,
-                    self._lr_scales_arg)
-                if n_disp > 1:
-                    loss = float(loss[-1])   # chunk's last step (syncs)
-                else:
-                    loss = float(loss)  # syncs; keeps per-iter timing honest
-            train_time = time.perf_counter() - train_start
+                train_start = time.perf_counter()
+                with self.spans.span("dispatch"):
+                    lr = self._current_lr()
+                    key = RNG.next_key()
+                    params, net_state, opt_state, loss, finite, taps = \
+                        step_fn(params, net_state, opt_state, x, y,
+                                jnp.float32(lr), key, self._lr_scales_arg)
+                train_time = time.perf_counter() - train_start
 
-            b = x.shape[0] * x.shape[1] if n_disp > 1 else x.shape[0]
-            count += b
-            state["neval"] = state["neval"] + n_disp
-            state["loss"] = loss
-            state["evalCounter"] = state.get("evalCounter", 0) + n_disp
-            self.metrics.add("data fetch time", fetch_time)
-            self.metrics.add("train time", train_time)
-            throughput = b / max(train_time + fetch_time, 1e-9)
-            logger.info(
-                "Epoch %d %d/%d loss %.6f lr %.5g throughput %.1f records/s "
-                "(fetch %.4fs train %.4fs)",
-                state["epoch"], count, epoch_size, loss, lr,
-                throughput, fetch_time, train_time)
+                b = x.shape[0] * x.shape[1] if n_disp > 1 else x.shape[0]
+                count += b
+                state["neval"] = neval0 + n_disp
+                state["evalCounter"] = state.get("evalCounter", 0) + n_disp
+                self.metrics.add("data fetch time", fetch_time)
+                self.metrics.add("train time", train_time)
+                extra = ({"queue_depth": int(qdepth)}
+                         if qdepth is not None else {})
+                # loss/finite/taps stay ON DEVICE; the window materializes
+                # them at the next cadence/boundary flush (no per-step
+                # device→host sync — the tentpole of this layer)
+                self._window.push(_PendingStep(
+                    neval0, epoch0, count, loss, finite, taps, lr, b,
+                    fetch_time, train_time, extra))
 
-            self._note_finite(finite, state)
-            self._emit_step_event(neval0, loss, lr, throughput,
-                                  monitor.push(neval0, taps))
-            count, data_iter = self._advance_epochs(state, count,
-                                                    epoch_size, n_disp,
-                                                    data_iter)
-            self._fire_triggers(params, net_state, opt_state, state, n_disp)
-            if self._preemption_pending():
-                self._checkpoint_and_stop(params, net_state, opt_state,
-                                          state)
-                break
+                rolled = count >= epoch_size
+                count, data_iter = self._advance_epochs(
+                    state, count, epoch_size, n_disp, data_iter, pipeline)
+                if self._window.due() or rolled:
+                    self._flush_window(state, monitor,
+                                       "epoch" if rolled else "cadence")
+                # trigger predicates are host-only (no device sync); a
+                # firing one forces its own flush below so validation/
+                # checkpoint always see materialized loss + finite ledger
+                ne_val = self._fired_within(self.validation_trigger, state,
+                                            n_disp)
+                ne_ck = self._fired_within(self.checkpoint_trigger, state,
+                                           n_disp)
+                preempt = self._preemption_pending()
+                if preempt or ne_val is not None or ne_ck is not None:
+                    self._flush_window(state, monitor,
+                                       "preempt" if preempt else "trigger")
+                if ne_val is not None:
+                    self._maybe_validate(params, net_state, state,
+                                         force=True)
+                if ne_ck is not None:
+                    self._maybe_checkpoint(params, net_state, opt_state,
+                                           state, force=True,
+                                           neval_label=ne_ck)
+                if preempt:
+                    self._checkpoint_and_stop(params, net_state, opt_state,
+                                              state)
+                    break
+            self._flush_window(state, monitor, "run-end")
+        finally:
+            try:
+                # best-effort: an exception between cadence boundaries
+                # (fault, dispatch error, watchdog exit) must not lose
+                # the already-dispatched steps' events + finite ledger —
+                # the postmortem needs the steps NEAREST the crash.  A
+                # no-op on clean exit (run-end already flushed); never
+                # masks the propagating exception.
+                self._flush_window(state, monitor, "exception")
+            except Exception as e:
+                logger.warning("pending-step flush during unwind "
+                               "failed: %s", e)
+            if pipeline is not None:
+                pipeline.close()
+            self._train_pipeline = None
 
         self.model.load_params(params)
         self.model.load_state(net_state)
@@ -512,17 +738,22 @@ class LocalOptimizer:
             "training loop (resume with load_latest_checkpoint)",
             int(state["neval"]))
 
-    def _advance_epochs(self, state, count, epoch_size, n_disp, data_iter):
+    def _advance_epochs(self, state, count, epoch_size, n_disp, data_iter,
+                        pipeline=None):
         """Epoch rollover shared by both optimizers' loops.  Single-step
         keeps the historical semantics (leftover count resets — it came
         from the discarded iterator); a chunk can span several epochs of
-        a small dataset, so it rolls the epoch counter through."""
+        a small dataset, so it rolls the epoch counter through.  With a
+        prefetch pipeline the PRODUCER already performed the shuffle and
+        iterator rebuild at the same point of the draw stream
+        (``PipelineRunner._advance_epoch``); only the counters move here."""
         if n_disp <= 1:
             if count >= epoch_size:
                 state["epoch"] = state["epoch"] + 1
                 count = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+                if pipeline is None:
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
                 self.spans.emit_phase_events(obs_events.get(),
                                              int(state["neval"]))
             return count, data_iter
@@ -530,41 +761,25 @@ class LocalOptimizer:
         while count >= epoch_size:
             state["epoch"] = state["epoch"] + 1
             count -= epoch_size
-            self.dataset.shuffle()
-            data_iter = self.dataset.data(train=True)
+            if pipeline is None:
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
         if rolled:
             self.spans.emit_phase_events(obs_events.get(),
                                          int(state["neval"]))
         return count, data_iter
 
-    def _fire_triggers(self, params, net_state, opt_state, state, n_disp):
-        """Dispatch-granularity trigger firing shared by both loops.
-        Periodic neval triggers (several_iteration(k)) must not be
-        skipped because neval jumps by n per dispatch: fire if the
-        trigger would have fired at ANY intermediate iteration of the
-        chunk (at most once per dispatch)."""
-        if n_disp > 1:
-            if self._fired_within(self.validation_trigger, state,
-                                  n_disp) is not None:
-                self._maybe_validate(params, net_state, state, force=True)
-            ne = self._fired_within(self.checkpoint_trigger, state, n_disp)
-            if ne is not None:
-                # label the snapshot with the nominal firing iteration
-                # (the first matched neval inside the chunk), so a
-                # several_iteration(k) run numbers its files at the
-                # k-multiples resume tooling expects even when k < n
-                self._maybe_checkpoint(params, net_state, opt_state, state,
-                                       force=True, neval_label=ne)
-        else:
-            self._maybe_validate(params, net_state, state)
-            self._maybe_checkpoint(params, net_state, opt_state, state)
-
     @staticmethod
     def _fired_within(trig, state, n):
-        """The first neval in this chunk's (neval-n, neval] interval at
-        which ``trig`` would have fired, or None.  Probes a shallow state
-        copy per intermediate iteration (triggers are cheap
-        predicates)."""
+        """The first neval in this dispatch's (neval-n, neval] interval
+        at which ``trig`` would have fired, or None — periodic triggers
+        (several_iteration(k)) must not be skipped because neval jumps by
+        n per dispatch, and the probe keeps trigger evaluation host-only
+        so a non-firing iteration costs no device sync.  Probes a shallow
+        state copy per intermediate iteration (triggers are cheap
+        predicates); the caller then invokes the action with force=True
+        (stateful triggers like every_epoch must be probed exactly
+        once)."""
         if trig is None:
             return None
         neval = state["neval"]
@@ -585,6 +800,9 @@ class LocalOptimizer:
                  "taps_cadence": obs_taps.cadence(self._taps_cadence),
                  "iters_per_dispatch": self.iters_per_dispatch,
                  "nonfinite_abort": self.nonfinite_abort,
+                 "prefetch": prefetch_mod.enabled(),
+                 "prefetch_depth": prefetch_mod.depth(),
+                 "sync_cadence": self._sync_cadence(),
                  "optim_method": type(self.optim_method).__name__}
         mesh = getattr(self, "mesh", None)
         if mesh is not None:
@@ -641,10 +859,19 @@ class LocalOptimizer:
         if not force and (self.validation_trigger is None
                           or not self.validation_trigger(state)):
             return
-        with self.spans.span("validate"):
-            results = validate(self.model, params, net_state,
-                               self.validation_dataset,
-                               self.validation_methods)
+        pipeline = self._train_pipeline
+        if pipeline is not None:
+            # hold the producer before its next draw: validation may
+            # iterate the same backing store an epoch shuffle mutates
+            pipeline.pause()
+        try:
+            with self.spans.span("validate"):
+                results = validate(self.model, params, net_state,
+                                   self.validation_dataset,
+                                   self.validation_methods)
+        finally:
+            if pipeline is not None:
+                pipeline.resume()
         for method, result in results:
             logger.info("%s is %s", method, result)
             val = result.result()[0]
@@ -673,9 +900,15 @@ class LocalOptimizer:
             # the payload so resume tooling can detect the chunked case.
             # "rng": host-stream snapshot so a resume can replay the
             # uninterrupted run's shuffle/augmentation draws
-            # (load_latest_checkpoint(restore_rng=True)).
+            # (load_latest_checkpoint(restore_rng=True)).  With the
+            # prefetch pipeline the stream has advanced past the batches
+            # merely PREFETCHED; the runner's snapshot is pinned to the
+            # last CONSUMED batch so the resumed trajectory matches.
+            pipeline = self._train_pipeline
+            rng_snap = (pipeline.rng_snapshot() if pipeline is not None
+                        else RNG.snapshot())
             File.save({"state": state, "opt_state": opt_state,
-                       "neval": neval, "rng": RNG.snapshot()},
+                       "neval": neval, "rng": rng_snap},
                       f"{self.checkpoint_path}/state.{neval}")
         obs_events.emit("checkpoint", step=int(neval),
                         path=f"{self.checkpoint_path}/model.{neval}")
@@ -733,12 +966,23 @@ def validate(model, params, net_state, dataset, methods, batch_to_device=jnp.asa
     Returns [(method, merged_result)].  Logs eval throughput, the
     reference's "validate model throughput is %.2f records / second"
     line (LocalOptimizer.scala:231-233).
+
+    Validation batches ride the background prefetcher too (bounded, one
+    pass): batch k+1 decodes while batch k's forward + host-side compare
+    run.  ``BIGDL_PREFETCH=0`` restores the serial iterator, and a chain
+    with RNG-bearing stages (unconventional for eval) stays serial so
+    its draws come from the calling thread's stream, not a fresh derived
+    stream per validation pass.
     """
     fwd = _eval_fn(model)
     totals = [None] * len(methods)
     count = timed_count = 0
     t0 = None
-    for batch in dataset.data(train=False):
+    batches = dataset.data(train=False)
+    if prefetch_mod.enabled() and not prefetch_mod.has_stochastic_stage(
+            dataset):
+        batches = prefetch_mod.background(batches, prefetch_mod.depth())
+    for batch in batches:
         out = fwd(params, net_state, batch_to_device(batch.data))
         b = int(np.asarray(batch.labels).shape[0])
         count += b

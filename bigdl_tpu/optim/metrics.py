@@ -35,6 +35,16 @@ class Metrics:
         if distributed:
             self._distributed.add(name)
 
+    def accumulate(self, name: str, value: float, count: int = 1,
+                   distributed: bool = False):
+        """``add`` with an explicit sample count — for intervals timed on
+        a background thread and drained in lumps (``count=0`` folds more
+        seconds into samples already counted, keeping the mean honest)."""
+        self._sums[name] += value
+        self._counts[name] += count
+        if distributed:
+            self._distributed.add(name)
+
     def get(self, name: str):
         return self._sums[name], self._counts[name]
 

@@ -81,6 +81,35 @@ class RandomGenerator:
             self._np.set_state(st)
         return self
 
+    def own_seed_stream(self):
+        """Make the CALLING thread the owner of the process seed stream.
+
+        The serial training loop draws shuffles/augmentations from the
+        seeding thread's stream; a prefetch pipeline moves those exact
+        draws onto its single producer thread (``dataset/prefetch.py``).
+        For the draw sequence to stay bit-identical to the serial path,
+        the producer must continue the seed stream itself rather than a
+        derived per-thread stream — this is the supported handoff.  Any
+        other thread's ``np_rng()`` then returns a derived stream, so
+        the previous owner must not draw host randomness until it takes
+        the stream back (``own_seed_stream`` again, or ``restore``)."""
+        with self._lock:
+            self._main_thread = threading.get_ident()
+        return self
+
+    def seed_stream_owner(self) -> int:
+        """Thread ident currently owning the seed stream (tests/debug)."""
+        return self._main_thread
+
+    def key_counter(self) -> int:
+        """Current device-key ordinal (``next_key`` calls so far).  The
+        prefetch checkpoint path splices this LIVE value into a
+        producer-side stream snapshot: np draws happen at fetch time (on
+        the producer) while keys are minted at consume time (on the
+        loop), so the two counters advance on different threads."""
+        with self._lock:
+            return self._key_counter
+
     def scoped(self):
         """Context manager: snapshot on entry, restore on exit — for
         helpers that reseed mid-run (bench drills, data peeks) and must

@@ -40,7 +40,6 @@ def main(argv=None):
     from bigdl_tpu.dataset.image import (
         LabeledImage, BytesToImg, ImgRdmCropper, HFlip, ColorJitter,
         Lighting, ImgNormalizer, ImgToBatch)
-    from bigdl_tpu.dataset.transformer import PreFetch
     from bigdl_tpu.models.resnet import ResNet
     from bigdl_tpu.optim import (
         Optimizer, DistriOptimizer, max_epoch, every_epoch)
@@ -56,16 +55,18 @@ def main(argv=None):
         train_ds = (DataSet.array(data, distributed=True)
                     >> HFlip()
                     >> ImgNormalizer((123.0, 117.0, 104.0), (58.4, 57.1, 57.4))
-                    >> ImgToBatch(args.batchSize) >> PreFetch(2))
+                    >> ImgToBatch(args.batchSize))
     else:
-        # streaming: shards -> decode -> augment -> batch, with a
-        # background prefetch thread overlapping host work and device steps
+        # streaming: shards -> decode -> augment -> batch.  No explicit
+        # PreFetch stage: the optimizer's built-in pipeline
+        # (BIGDL_PREFETCH, dataset/prefetch.py) runs this whole chain on
+        # a background producer and double-buffers batches onto device
         train_ds = (ShardFolder(args.shardFolder, distributed=True)
                     >> BytesToImg(256)
                     >> ImgRdmCropper(224, 224) >> HFlip()
                     >> ColorJitter(channel_order="rgb") >> Lighting()
                     >> ImgNormalizer((123.0, 117.0, 104.0), (58.4, 57.1, 57.4))
-                    >> ImgToBatch(args.batchSize) >> PreFetch(2))
+                    >> ImgToBatch(args.batchSize))
 
     model = ResNet(depth=50, class_num=args.classNumber)
     if args.caffeWeights:

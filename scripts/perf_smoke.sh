@@ -1,19 +1,75 @@
 #!/usr/bin/env bash
-# Pallas-kernel regression smoke (round 6): run every kernel-equivalence
-# test in FORCED-INTERPRETER mode on CPU — JAX_PLATFORMS=cpu makes every
+# Perf regression smoke: every perf-marked equivalence test in
+# FORCED-INTERPRETER mode on CPU — JAX_PLATFORMS=cpu makes every Pallas
 # kernel gate pick interpret=True — so tier-1 machines without a chip
 # still catch kernel math regressions (fwd + bwd vs the XLA oracles:
-# reduce_window/select_and_scatter, lax.scan autodiff, SGD reference).
+# reduce_window/select_and_scatter, lax.scan autodiff, SGD reference),
+# plus the ISSUE-4 host-pipeline set (tests/test_prefetch.py: prefetch
+# on/off trajectory bit-parity, cadenced-sync audit, overlap).
 #
 # The same tests carry the `perf` pytest marker and already run inside
 # the default tier-1 set (they are not marked slow); this script is the
 # one-command subset for a quick pre-commit check:
 #
-#   scripts/perf_smoke.sh            # the full perf-marked set
-#   scripts/perf_smoke.sh -k maxpool # narrow further
+#   scripts/perf_smoke.sh            # the full perf-marked set + drill
+#   scripts/perf_smoke.sh -k maxpool # narrow further (skips the drill)
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest -q -m perf \
+export JAX_PLATFORMS=cpu
+
+python -m pytest -q -m perf \
     -p no:cacheprovider -p no:randomly \
     tests/test_pallas_ops.py tests/test_recurrent.py tests/test_training.py \
+    tests/test_prefetch.py \
     "$@"
+
+# The narrowed form (-k ...) is a targeted kernel check; the loop drill
+# below only makes sense for the full run.
+if [ "$#" -gt 0 ]; then exit 0; fi
+
+echo "== perf smoke: 5-step LeNet drill (prefetch on, cadenced sync) =="
+BIGDL_PREFETCH=1 python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.dataset.transformer import SampleToBatch
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.optim import LocalOptimizer, max_iteration
+from bigdl_tpu.utils.random import set_seed
+from bigdl_tpu.utils.table import T
+
+# jit-count probe: the whole optimize() run — prefetch producer, H2D
+# transfer thread, cadence window — must build exactly ONE jitted program
+calls = []
+real_jit = jax.jit
+jax.jit = lambda fn, *a, **kw: (calls.append(fn), real_jit(fn, *a, **kw))[1]
+
+rng = np.random.RandomState(0)
+samples = [Sample(rng.rand(28, 28).astype(np.float32),
+                  np.asarray([float(rng.randint(1, 11))]))
+           for _ in range(64)]
+ds = DataSet.array(samples) >> SampleToBatch(8)
+set_seed(1)
+opt = LocalOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
+opt.set_state(T(learningRate=0.05))
+opt.set_taps(enabled=True, cadence=2)
+opt.set_end_when(max_iteration(5))
+opt.optimize()
+jax.jit = real_jit
+
+assert len(calls) == 1, f"train loop built {len(calls)} jitted programs"
+assert opt._train_pipeline is None, "prefetch runner not closed"
+# cadence audit: host syncs at the cadence-2 boundaries and run end only,
+# and the taps monitor materialized at the SAME boundaries (one
+# host-wait covers both)
+assert list(opt._window.flush_steps) == [2, 4, 5], \
+    list(opt._window.flush_steps)
+assert list(opt._taps_monitor.materialized_steps) == [2, 4, 5], \
+    list(opt._taps_monitor.materialized_steps)
+print("OK: 1 jitted dispatch; host sync only at cadence boundaries "
+      f"{list(opt._window.flush_steps)} with prefetch on")
+PY
+echo "perf smoke: all green"

@@ -1,0 +1,644 @@
+"""Asynchronous host pipeline tests (ISSUE 4): prefetch-to-device input
+path + cadenced host sync.
+
+The contract under test, in order of importance:
+
+1. bit-identical loss trajectory with ``BIGDL_PREFETCH`` on vs off —
+   same seed, same per-step losses, same final params — for
+   LocalOptimizer and DistriOptimizer, single-step and chunked dispatch,
+   including an RNG-bearing pipeline (random crop + flip) across epoch
+   boundaries;
+2. no per-step device→host sync outside cadence boundaries (the
+   ``_HostSyncWindow`` audit trail), and the train step stays ONE jitted
+   dispatch with prefetch on;
+3. overlap is real: with an artificially slow transform the wall clock
+   lands strictly below the serial fetch+train sum;
+4. chaos hooks stay keyed by the CONSUMING step, and checkpoint/resume
+   replays the serial trajectory (the runner pins the RNG payload to the
+   last consumed batch).
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.dataset import prefetch as pf
+from bigdl_tpu.dataset.image import (HFlip, ImgRdmCropper, ImgToBatch,
+                                     LabeledImage)
+from bigdl_tpu.dataset.transformer import FuncTransformer, SampleToBatch
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.optim import (DistriOptimizer, LocalOptimizer, Top1Accuracy,
+                             max_iteration, several_iteration)
+from bigdl_tpu.optim.local_optimizer import validate
+from bigdl_tpu.utils.random import RNG, set_seed
+from bigdl_tpu.utils.table import T
+
+pytestmark = pytest.mark.perf
+
+
+@pytest.fixture
+def ring_log():
+    """Fresh in-memory event ring per test (step events carry the
+    per-step losses the trajectory assertions read)."""
+    log = obs_events.configure(None)
+    yield log
+    obs_events.reset()
+
+
+def _samples(n=24, d=5, seed=0):
+    rs = np.random.RandomState(seed)
+    xs = rs.randn(n, d).astype(np.float32)
+    ys = (rs.randint(0, 3, n) + 1).astype(np.float32)
+    return [Sample(x, np.asarray([y])) for x, y in zip(xs, ys)]
+
+
+def _mlp(d=5):
+    return nn.Sequential(nn.Linear(d, 8), nn.Tanh(), nn.Linear(8, 3),
+                         nn.LogSoftMax())
+
+
+def _grey_images(n=16, hw=8, seed=1):
+    rs = np.random.RandomState(seed)
+    return [LabeledImage(rs.rand(hw, hw).astype(np.float32),
+                         float(i % 3 + 1)) for i in range(n)]
+
+
+def _step_events(log):
+    return [e for e in log.ring_events() if e["type"] == "step"]
+
+
+def _losses(log):
+    return [e["loss"] for e in _step_events(log)]
+
+
+def _params_vec(model):
+    return np.concatenate([np.asarray(l).reshape(-1) for l in
+                           jax.tree_util.tree_leaves(model.params())])
+
+
+def _train(make_opt, steps, seed=5, dropout=False):
+    set_seed(seed)
+    opt = make_opt(dropout)
+    opt.set_end_when(max_iteration(steps))
+    opt.optimize()
+    return opt
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-identical trajectories, prefetch on vs off
+# ---------------------------------------------------------------------------
+
+class TestTrajectoryParity:
+    def _run_mlp(self, monkeypatch, ring_log, prefetch_on, n_disp=1,
+                 steps=8, distri=False, dropout=False):
+        monkeypatch.setenv(pf.ENV_PREFETCH, "1" if prefetch_on else "0")
+        obs_events.configure(None)
+
+        def make(dropout):
+            layers = [nn.Linear(5, 8), nn.Tanh()]
+            if dropout:
+                layers.append(nn.Dropout(0.5))
+            layers += [nn.Linear(8, 3), nn.LogSoftMax()]
+            model = nn.Sequential(*layers)
+            ds = DataSet.array(_samples()) >> SampleToBatch(8)
+            cls = DistriOptimizer if distri else LocalOptimizer
+            opt = cls(model, ds, nn.ClassNLLCriterion())
+            opt.set_state(T(learningRate=0.2, momentum=0.9))
+            if n_disp > 1:
+                opt.set_iterations_per_dispatch(n_disp)
+            return opt
+
+        opt = _train(make, steps, dropout=dropout)
+        return _losses(obs_events.get()), _params_vec(opt.model), opt
+
+    @pytest.mark.parametrize("n_disp", [1, 2])
+    def test_local(self, monkeypatch, ring_log, n_disp):
+        # 8 iterations over a 24-sample epoch (3 steps/epoch): the
+        # trajectory crosses epoch shuffles with dropout keys live
+        l_on, p_on, _ = self._run_mlp(monkeypatch, ring_log, True,
+                                      n_disp=n_disp, dropout=True)
+        l_off, p_off, _ = self._run_mlp(monkeypatch, ring_log, False,
+                                        n_disp=n_disp, dropout=True)
+        assert l_on == l_off
+        np.testing.assert_array_equal(p_on, p_off)
+
+    @pytest.mark.parametrize("n_disp", [1, 2])
+    def test_distri(self, monkeypatch, ring_log, n_disp):
+        l_on, p_on, _ = self._run_mlp(monkeypatch, ring_log, True,
+                                      n_disp=n_disp, distri=True,
+                                      dropout=True)
+        l_off, p_off, _ = self._run_mlp(monkeypatch, ring_log, False,
+                                        n_disp=n_disp, distri=True,
+                                        dropout=True)
+        assert l_on == l_off
+        np.testing.assert_array_equal(p_on, p_off)
+
+    def _run_image(self, monkeypatch, prefetch_on, steps=7):
+        """RNG-bearing pipeline: random crop + flip draw from the seed
+        stream per record — the draws must come off the producer thread
+        in the exact serial order (16 images / batch 8 = 2 steps per
+        epoch, so 7 steps cross three epoch shuffles)."""
+        monkeypatch.setenv(pf.ENV_PREFETCH, "1" if prefetch_on else "0")
+        obs_events.configure(None)
+
+        def make(_):
+            ds = (DataSet.array(_grey_images())
+                  >> ImgRdmCropper(6, 6) >> HFlip() >> ImgToBatch(8))
+            model = nn.Sequential(nn.Reshape([36]), nn.Linear(36, 3),
+                                  nn.LogSoftMax())
+            opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+            opt.set_state(T(learningRate=0.1))
+            return opt
+
+        opt = _train(make, steps)
+        return _losses(obs_events.get()), _params_vec(opt.model)
+
+    def test_rng_bearing_image_pipeline(self, monkeypatch, ring_log):
+        l_on, p_on = self._run_image(monkeypatch, True)
+        l_off, p_off = self._run_image(monkeypatch, False)
+        assert len(l_on) == 7
+        assert l_on == l_off
+        np.testing.assert_array_equal(p_on, p_off)
+
+    def test_rng_state_after_run_matches_serial(self, monkeypatch,
+                                                ring_log):
+        """close() must leave the process stream where a serial run
+        would: the ahead-draws of merely-prefetched batches are erased,
+        so back-to-back optimize() calls stay on the serial trajectory
+        (the parity runs above call optimize once per process state)."""
+        def end_state(prefetch_on):
+            self._run_image(monkeypatch, prefetch_on, steps=5)
+            snap = RNG.snapshot()
+            return snap["key_counter"], np.asarray(snap["np_state"][1]), \
+                snap["np_state"][2]
+
+        kc_on, key_on, pos_on = end_state(True)
+        kc_off, key_off, pos_off = end_state(False)
+        assert kc_on == kc_off
+        assert pos_on == pos_off
+        np.testing.assert_array_equal(key_on, key_off)
+
+
+# ---------------------------------------------------------------------------
+# 2. cadenced host sync: no per-step device→host sync, one jit dispatch
+# ---------------------------------------------------------------------------
+
+class TestCadencedSync:
+    def _opt(self, cadence=None):
+        ds = DataSet.array(_samples(n=64)) >> SampleToBatch(8)
+        opt = LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=0.2))
+        if cadence is not None:
+            opt.set_taps(enabled=True, cadence=cadence)
+        return opt
+
+    def test_sync_only_at_cadence_boundaries(self, ring_log):
+        """The sync-count probe: the window's audit trail shows host
+        materializations at cadence boundaries and run end, nowhere else
+        (64-sample epoch = 8 steps, so no epoch flush inside 7 steps)."""
+        set_seed(5)
+        opt = self._opt(cadence=3)
+        opt.set_end_when(max_iteration(7))
+        opt.optimize()
+        assert list(opt._window.flush_steps) == [3, 6, 7]
+        assert list(opt._window.flush_reasons) == ["cadence", "cadence",
+                                                  "run-end"]
+        # the taps monitor synced at the same boundaries (one host-wait
+        # covers both), and every step still produced its event
+        assert list(opt._taps_monitor.materialized_steps) == [3, 6, 7]
+        assert len(_step_events(obs_events.get())) == 7
+
+    def test_sync_every_step_escape_hatch(self, monkeypatch, ring_log):
+        monkeypatch.setenv(pf.ENV_SYNC_EVERY_STEP, "1")
+        set_seed(5)
+        opt = self._opt(cadence=10)
+        opt.set_end_when(max_iteration(4))
+        opt.optimize()
+        assert list(opt._window.flush_steps) == [1, 2, 3, 4]
+
+    def test_cadenced_losses_match_every_step_sync(self, monkeypatch,
+                                                   ring_log):
+        def run(sync_env):
+            monkeypatch.setenv(pf.ENV_SYNC_EVERY_STEP, sync_env)
+            obs_events.configure(None)
+            set_seed(5)
+            opt = self._opt(cadence=4)
+            opt.set_end_when(max_iteration(9))
+            opt.optimize()
+            return _losses(obs_events.get()), _params_vec(opt.model)
+
+        l_cad, p_cad = run("0")
+        l_sync, p_sync = run("1")
+        assert len(l_cad) == 9
+        assert l_cad == l_sync
+        np.testing.assert_array_equal(p_cad, p_sync)
+
+    def test_trigger_and_epoch_boundaries_force_flush(self, ring_log,
+                                                      tmp_path):
+        set_seed(5)
+        ds = DataSet.array(_samples(n=24)) >> SampleToBatch(8)
+        opt = LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=0.2))
+        opt.set_taps(enabled=True, cadence=100)   # cadence never fires
+        opt.set_checkpoint(str(tmp_path), several_iteration(5))
+        opt.set_end_when(max_iteration(7))
+        opt.optimize()
+        # 24-sample epoch = 3 steps: epoch flushes at 3 and 6; the
+        # checkpoint trigger fires once neval reaches 5 (after step 4 —
+        # neval is the NEXT iteration index, the historical semantics)
+        # and forces its own flush; run-end covers 7
+        assert list(opt._window.flush_steps) == [3, 4, 6, 7]
+        assert list(opt._window.flush_reasons) == ["epoch", "trigger",
+                                                   "epoch", "run-end"]
+        assert os.path.exists(tmp_path / "model.5")
+
+    def test_unwind_flushes_pending_steps(self, ring_log):
+        """A crash between cadence boundaries must not lose the already-
+        dispatched steps: the unwind flush emits their events (the
+        postmortem needs the steps nearest the failure)."""
+        def boom(batch):
+            boom.n += 1
+            if boom.n > 4:
+                raise RuntimeError("source died")
+            return batch
+        boom.n = 0
+
+        set_seed(5)
+        ds = (DataSet.array(_samples(n=64)) >> SampleToBatch(8)
+              >> FuncTransformer(boom))
+        opt = LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=0.2))
+        opt.set_taps(enabled=True, cadence=100)  # cadence never fires
+        opt.set_end_when(max_iteration(50))
+        with pytest.raises(RuntimeError, match="source died"):
+            opt.optimize()
+        assert [e["step"] for e in _step_events(obs_events.get())] == \
+            [1, 2, 3, 4]
+        assert list(opt._window.flush_reasons) == ["exception"]
+
+    def test_single_jit_dispatch_with_prefetch(self, monkeypatch,
+                                               ring_log):
+        """The jit-count invariant extended to the prefetch path: the
+        whole optimize() run — prefetcher, H2D thread, cadence window —
+        builds exactly ONE jitted program."""
+        calls = []
+        real_jit = jax.jit
+
+        def counting_jit(fn, *a, **kw):
+            calls.append(fn)
+            return real_jit(fn, *a, **kw)
+
+        monkeypatch.setattr(jax, "jit", counting_jit)
+        set_seed(5)
+        opt = self._opt()
+        opt.set_end_when(max_iteration(5))
+        opt.optimize()
+        assert len(calls) == 1
+
+    def test_queue_depth_in_step_events(self, ring_log):
+        set_seed(5)
+        opt = self._opt(cadence=2)
+        opt.set_end_when(max_iteration(5))
+        opt.optimize()
+        steps = _step_events(obs_events.get())
+        assert steps and all("queue_depth" in e for e in steps)
+
+
+# ---------------------------------------------------------------------------
+# 3. overlap: wall clock strictly below the serial fetch+train sum
+# ---------------------------------------------------------------------------
+
+class TestOverlap:
+    DELAY = 0.05
+    STEPS = 8
+
+    def _run(self, monkeypatch, prefetch_on, steps=None):
+        from bigdl_tpu.resilience import faults
+        monkeypatch.setenv(pf.ENV_PREFETCH, "1" if prefetch_on else "0")
+
+        def slow(batch):                      # producer-side stall
+            time.sleep(self.DELAY)            # per BATCH (after assembly)
+            return batch
+
+        set_seed(5)
+        ds = (DataSet.array(_samples(n=64)) >> SampleToBatch(8)
+              >> FuncTransformer(slow))
+        opt = LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=0.2))
+        opt.set_end_when(max_iteration(steps or self.STEPS))
+        # consumer-side work the producer can hide behind: the
+        # slow_worker chaos site sleeps at CONSUME time every step
+        faults.configure(f"slow_worker@every=1,delay={self.DELAY}")
+        t0 = time.perf_counter()
+        try:
+            opt.optimize()
+        finally:
+            faults.clear()
+        return time.perf_counter() - t0, opt
+
+    def test_stall_injection_overlap(self, monkeypatch, ring_log):
+        # warm the persistent XLA cache so both timed runs pay the same
+        # (small) compile cost — the sleeps dominate, not the compiler
+        self._run(monkeypatch, False, steps=2)
+        wall_on, opt_on = self._run(monkeypatch, True)
+        wall_off, _ = self._run(monkeypatch, False)
+        # serial pays DELAY (producer) + DELAY (consumer) per step; the
+        # pipeline hides the producer sleep behind the consumer's work,
+        # so at least ~STEPS*DELAY of wall must disappear
+        assert wall_on < wall_off - 0.15, (wall_on, wall_off)
+        assert wall_on < 0.85 * wall_off, (wall_on, wall_off)
+        # the spans tell the same story from the prefetch run alone: the
+        # producer paid the transform wall (data-load/fetch), the
+        # consumer's data-load wait stayed a fraction of it
+        fetch_total, fetch_n = opt_on.metrics.get("span: data-load/fetch")
+        wait_total, _ = opt_on.metrics.get("span: data-load")
+        assert fetch_n >= self.STEPS
+        assert wait_total < 0.6 * fetch_total, (wait_total, fetch_total)
+        # wall < this same run's serial fetch+train sum (the components
+        # it would have paid back-to-back without overlap)
+        disp_total, _ = opt_on.metrics.get("span: dispatch")
+        hw_total, _ = opt_on.metrics.get("span: host-wait")
+        chaos_total = self.STEPS * self.DELAY
+        assert wall_on < fetch_total + disp_total + hw_total \
+            + chaos_total, (wall_on, fetch_total, disp_total, hw_total)
+
+    def test_stall_events_emitted(self, monkeypatch, ring_log):
+        """A producer slower than the consumer must surface as
+        prefetch_stall events keyed by the waiting step."""
+        def slow(batch):
+            time.sleep(0.1)
+            return batch
+
+        monkeypatch.setenv(pf.ENV_PREFETCH, "1")
+        set_seed(5)
+        ds = (DataSet.array(_samples(n=64)) >> SampleToBatch(8)
+              >> FuncTransformer(slow))
+        opt = LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion())
+        opt.set_state(T(learningRate=0.2))
+        opt.set_end_when(max_iteration(6))
+        opt.optimize()
+        stalls = [e for e in obs_events.get().ring_events()
+                  if e["type"] == "prefetch_stall"]
+        assert stalls
+        assert all(e["seconds"] > 0 and e["step"] >= 1 for e in stalls)
+
+
+# ---------------------------------------------------------------------------
+# 4. chaos keyed by consuming step + checkpoint/resume
+# ---------------------------------------------------------------------------
+
+class TestChaosAndResume:
+    def test_fault_keyed_by_consuming_step(self, ring_log):
+        """nan_grad@at=3 must poison the batch CONSUMED at iteration 3,
+        not the batch fetched third — with prefetch on, those differ by
+        the queue depth.  The taps ledger pins it."""
+        from bigdl_tpu.resilience import faults
+        faults.configure("nan_grad@at=3")
+        try:
+            set_seed(5)
+            ds = DataSet.array(_samples(n=64)) >> SampleToBatch(8)
+            opt = LocalOptimizer(_mlp(), ds, nn.ClassNLLCriterion())
+            opt.set_state(T(learningRate=0.2))
+            opt.set_taps(enabled=True, cadence=1)
+            opt.set_nonfinite_policy(0)
+            opt.set_end_when(max_iteration(5))
+            opt.optimize()
+        finally:
+            faults.clear()
+        hist = dict(opt._taps_monitor.history)
+        assert hist[3]["nonfinite_grads"] > 0
+        assert hist[3]["update_ratio"] == 0.0
+        assert hist[2]["nonfinite_grads"] == 0.0
+        assert hist[4]["nonfinite_grads"] == 0.0
+        ev = obs_events.get().ring_events()
+        assert any(e["type"] == "fault" and e["site"] == "nan_grad"
+                   and e["step"] == 3 for e in ev)
+
+    def test_resume_replays_serial_trajectory(self, tmp_path, ring_log):
+        """The checkpoint RNG payload is pinned to the last CONSUMED
+        batch (not the prefetch head): resuming replays the exact
+        uninterrupted trajectory — crop/flip draws and dropout keys
+        included.  Scenario shape follows the resilience resume test:
+        the pipeline decodes fresh records from bytes each epoch, all
+        records are identical (the dataset's shuffled list order is not
+        part of a checkpoint), and batch == dataset so every checkpoint
+        lands on an epoch boundary (a mid-epoch permutation is not
+        replayable, with or without prefetch)."""
+        from bigdl_tpu.dataset import ByteRecord
+        from bigdl_tpu.dataset.image import BytesToGreyImg, ImgNormalizer
+        raw = np.random.RandomState(2).randint(
+            0, 255, 64, dtype=np.uint8).tobytes()
+        records = [ByteRecord(raw, 1.0) for _ in range(16)]
+
+        def make_ds():
+            return (DataSet.array(list(records)) >> BytesToGreyImg(8, 8)
+                    >> ImgNormalizer(128.0, 128.0)
+                    >> ImgRdmCropper(6, 6) >> HFlip() >> ImgToBatch(16))
+
+        def build(seed):
+            set_seed(seed)
+            model = nn.Sequential(nn.Reshape([36]), nn.Dropout(0.5),
+                                  nn.Linear(36, 3), nn.LogSoftMax())
+            opt = LocalOptimizer(model, make_ds(), nn.ClassNLLCriterion())
+            opt.set_state(T(learningRate=0.05))
+            return opt
+
+        opt_a = build(7)
+        opt_a.set_checkpoint(str(tmp_path), several_iteration(2))
+        opt_a.set_end_when(max_iteration(5))
+        opt_a.optimize()
+        assert opt_a.state["loss"] > 0    # gradients stayed live
+        final = _params_vec(opt_a.model)
+
+        from bigdl_tpu.optim import load_latest_checkpoint
+        # corrupt the newer snapshots (several_iteration(2) fired at
+        # neval 2, 4 and 6) so resume falls back to neval 2 — mid-run,
+        # where the prefetch head had drawn past the consumed batches
+        (tmp_path / "model.4").write_bytes(b"rot")
+        (tmp_path / "model.6").write_bytes(b"rot")
+
+        def resume(restore_rng):
+            set_seed(12345 if restore_rng else 999)
+            module, blob, neval = load_latest_checkpoint(
+                str(tmp_path), restore_rng=restore_rng)
+            assert neval == 2
+            opt_b = LocalOptimizer(module, make_ds(),
+                                   nn.ClassNLLCriterion())
+            opt_b.set_state(blob["state"])
+            opt_b.set_optim_state(blob["opt_state"])
+            opt_b.set_end_when(max_iteration(5))
+            opt_b.optimize()
+            return _params_vec(opt_b.model)
+
+        np.testing.assert_array_equal(resume(restore_rng=True), final)
+        # negative control: without the rng payload the crops/flips and
+        # dropout masks of steps 2-5 differ and the trajectory forks
+        assert not np.array_equal(resume(restore_rng=False), final)
+
+
+# ---------------------------------------------------------------------------
+# PipelineRunner / satellite units
+# ---------------------------------------------------------------------------
+
+class TestPipelineRunner:
+    def test_matches_serial_iterator(self):
+        # no epoch_size: compares against the RAW looped iterator (the
+        # rollover-shuffle parity is covered by the trajectory tests)
+        ds = DataSet.array(_samples(n=32)) >> SampleToBatch(8)
+        set_seed(11)
+        serial = [np.array(b.data) for b, _ in
+                  zip(ds.data(train=True), range(6))]
+        set_seed(11)
+        runner = pf.PipelineRunner(ds, train=True)
+        got = [np.array(runner.get()[0].x) for _ in range(6)]
+        runner.close()
+        for a, b in zip(serial, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_close_restores_consumed_rng_state(self):
+        def make_ds():
+            # fresh images per pass: the croppers mutate records in
+            # place, and a reused (already-cropped) image changes the
+            # randint RANGES and with them the words-per-draw
+            return (DataSet.array(_grey_images(n=16))
+                    >> ImgRdmCropper(6, 6) >> HFlip() >> ImgToBatch(8))
+
+        set_seed(13)
+        it = make_ds().data(train=True)
+        for _ in range(3):      # exactly 3 batches (zip would pull a 4th)
+            next(it)
+        serial_state = RNG.snapshot()["np_state"]
+        set_seed(13)
+        runner = pf.PipelineRunner(make_ds(), train=True,
+                                   epoch_size=10 ** 9)
+        for _ in range(3):
+            runner.get()
+        runner.close()          # producer drew ahead; close rewinds
+        got_state = RNG.snapshot()["np_state"]
+        np.testing.assert_array_equal(np.asarray(serial_state[1]),
+                                      np.asarray(got_state[1]))
+        assert serial_state[2] == got_state[2]
+        assert RNG.seed_stream_owner() is not None
+
+    def test_producer_error_propagates(self):
+        def boom(sample):
+            raise RuntimeError("decode failed")
+
+        ds = DataSet.array(_samples()) >> FuncTransformer(boom) \
+            >> SampleToBatch(8)
+        runner = pf.PipelineRunner(ds, train=True, epoch_size=24)
+        with pytest.raises(RuntimeError, match="decode failed"):
+            runner.get()
+        runner.close()
+
+    def test_worker_fanout_preserves_order_and_trajectory(self):
+        """Pure per-record stages fan out across workers; the record
+        order and the stochastic stages' draw sequence are unchanged."""
+        from bigdl_tpu.dataset.image import ImgNormalizer
+
+        def run(n_workers):
+            ds = (DataSet.array(_grey_images(n=16))
+                  >> ImgNormalizer(0.5, 2.0)      # pure: fans out
+                  >> ImgRdmCropper(6, 6) >> HFlip()   # stochastic: stays
+                  >> ImgToBatch(8))
+            set_seed(17)
+            runner = pf.PipelineRunner(ds, train=True, epoch_size=16,
+                                       n_workers=n_workers)
+            out = [np.array(runner.get()[0].x) for _ in range(5)]
+            runner.close()
+            return out
+
+        fanout = run(4)
+        serial = run(0)
+        for a, b in zip(serial, fanout):
+            np.testing.assert_array_equal(a, b)
+
+    def test_eval_background_prefetch_one_pass(self):
+        ds = DataSet.array(_samples(n=20)) >> SampleToBatch(8)
+        serial = [np.array(b.data) for b in ds.data(train=False)]
+        got = [np.array(b.data) for b in
+               pf.background(ds.data(train=False), 2)]
+        assert len(got) == len(serial) == 3   # 8 + 8 + 4 tail
+        for a, b in zip(serial, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_validate_results_match_serial(self, monkeypatch):
+        ds = DataSet.array(_samples(n=40)) >> SampleToBatch(8)
+        set_seed(3)
+        model = _mlp()
+
+        def run(on):
+            monkeypatch.setenv(pf.ENV_PREFETCH, "1" if on else "0")
+            res = validate(model, model.params(), model.state(), ds,
+                           [Top1Accuracy()])
+            return res[0][1]
+
+        assert run(True) == run(False)
+
+
+class TestSatellites:
+    def test_stack_chunk_converts_once_and_checks_shapes(self):
+        from bigdl_tpu.dataset.sample import MiniBatch
+        a = MiniBatch(np.ones((4, 3), np.float32), np.ones((4,)))
+        b = MiniBatch(np.zeros((4, 3), np.float32), np.zeros((4,)))
+        xs, ys = pf.stack_chunk([a, b])
+        assert xs.shape == (2, 4, 3) and ys.shape == (2, 4)
+        bad = MiniBatch(np.ones((5, 3), np.float32), np.ones((5,)))
+        with pytest.raises(ValueError, match="uniform batch shapes"):
+            pf.stack_chunk([a, bad])
+
+    def test_eval_iteration_is_snapshot_free(self):
+        from bigdl_tpu.dataset.dataset import (LocalArrayDataSet,
+                                               ShardedDataSet)
+        for cls in (LocalArrayDataSet,
+                    lambda d: ShardedDataSet(d, n_shards=1, shard_index=0)):
+            ds = cls(list(range(10)))
+            assert list(ds.data(train=False)) == list(range(10))
+            # the view is lazy: a shuffle between passes is visible to
+            # the NEXT iterator without any per-call list copy
+            it = ds.data(train=False)
+            assert not isinstance(it, list)
+            set_seed(4)
+            ds.shuffle()
+            assert sorted(ds.data(train=False)) == list(range(10))
+
+    def test_sampletobatch_reuse_buffers_ring(self):
+        samples = _samples(n=32)
+        plain = list(SampleToBatch(8)(iter(samples)))
+        ring = SampleToBatch(8, reuse_buffers=2)
+        reused = []
+        ids = []
+        for b in ring(iter(samples)):
+            reused.append(np.array(b.data))    # copy before reuse
+            ids.append(id(b.data))
+        assert len(reused) == 4
+        for a, b in zip(plain, reused):
+            np.testing.assert_array_equal(a.data, b)
+        # the ring really recycles: slot 0 backs batches 0 and 2
+        assert ids[0] == ids[2] and ids[1] == ids[3]
+        assert ids[0] != ids[1]
+
+    def test_sampletobatch_reuse_tail_falls_back(self):
+        samples = _samples(n=20)               # 8 + 8 + 4 tail
+        ring = SampleToBatch(8, reuse_buffers=2)
+        batches = list(ring(iter(samples)))
+        assert [b.data.shape[0] for b in batches] == [8, 8, 4]
+        with pytest.raises(ValueError, match="ring of >= 2"):
+            SampleToBatch(8, reuse_buffers=1)
+
+    def test_transformer_purity_attrs(self):
+        from bigdl_tpu.dataset.image import (BytesToImg, ColorJitter,
+                                             ImgCropper, ImgNormalizer,
+                                             Lighting)
+        assert BytesToImg().pure_per_record
+        assert ImgNormalizer(0.0, 1.0).pure_per_record
+        assert not ImgNormalizer(0.0, 1.0).stochastic
+        for t in (HFlip(), ColorJitter(), Lighting(),
+                  ImgRdmCropper(2, 2), ImgCropper(2, 2, "random")):
+            assert t.stochastic, type(t).__name__
+        assert ImgCropper(2, 2, "center").pure_per_record

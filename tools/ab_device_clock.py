@@ -17,6 +17,12 @@ The round-6 adoption A/Bs (run when a chip is attached):
   python tools/ab_device_clock.py inception 128 base pallas_pool \
       pallas_lrn pallas_winops
   python tools/ab_device_clock.py bilstm 128 base blockt4 blockt8
+
+The ISSUE-4 host-pipeline change (prefetch-to-device + cadenced sync) is
+invisible to this device-clock instrument by construction — its staged
+on-chip A/B is the WALL-clock loop comparison:
+  python tools/ab_host_pipeline.py lenet 256 40
+  python tools/ab_host_pipeline.py inception 128 20
 """
 import os as _os, sys as _sys
 _REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
