@@ -163,8 +163,16 @@ def _lm_handles(model):
 def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
     """One decode position for all rows: token ids (B,) at position i
     with per-layer KV caches (layers, B, n_pos, H, hd) -> (log-probs
-    (B, vocab), updated caches).  The shared inner body of lm_decode and
-    lm_beam_search."""
+    (B, vocab), updated caches).  The shared inner body of lm_decode,
+    lm_beam_search and the continuous-batching decoder.
+
+    ``i`` is either a scalar position (every row at the same step — the
+    lock-step scans here) or a per-row (B,) vector (``serve/decode.py``
+    slots at independent positions): the cache write scatters per row
+    and the causal mask compares against each row's own position, so
+    the math per row is IDENTICAL to the scalar path at that row's
+    position — the bit-parity contract ``tests/test_serve.py`` holds
+    the decoder to."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -175,6 +183,9 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
     ln_f, eps_f, head = h_.ln_f, h_.eps_f, h_.head
     kcache, vcache = caches
     bsz = tok.shape[0]
+    per_row = getattr(i, "ndim", 0) == 1
+    rows = jnp.arange(bsz)
+    limit = i[:, None, None] if per_row else i
     scale = 1.0 / np.sqrt(hd)
 
     def layernorm(x, p, eps):
@@ -188,10 +199,15 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
         q = (a @ m["wq"] + m["bq"]).reshape(bsz, n_heads, hd)
         k = (a @ m["wk"] + m["bk"]).reshape(bsz, n_heads, hd)
         v = (a @ m["wv"] + m["bv"]).reshape(bsz, n_heads, hd)
-        kcache = kcache.at[li, :, i].set(k)
-        vcache = vcache.at[li, :, i].set(v)
+        if per_row:
+            kcache = kcache.at[li, rows, i].set(k)
+            vcache = vcache.at[li, rows, i].set(v)
+        else:
+            kcache = kcache.at[li, :, i].set(k)
+            vcache = vcache.at[li, :, i].set(v)
         s = jnp.einsum("bhd,bthd->bht", q, kcache[li]) * scale
-        s = jnp.where(jnp.arange(n_pos)[None, None, :] <= i, s, -jnp.inf)
+        s = jnp.where(jnp.arange(n_pos)[None, None, :] <= limit, s,
+                      -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bht,bthd->bhd", p,
                        vcache[li]).reshape(bsz, d_model)

@@ -52,6 +52,10 @@ EVENT_TYPES = {
     # the input pipeline failed to hide the fetch: the consuming loop
     # waited `seconds` for the prefetch queue at `step` (queue was empty)
     "prefetch_stall": ("step", "seconds"),
+    # serving-engine lifecycle/telemetry (serve/engine.py, serve/decode.py):
+    # kind in {start, stop, error, decode}; error events carry the failed
+    # request count + message, stop events a stats snapshot
+    "serve": ("kind",),
     "watchdog": ("stale",),
     "preempt": ("step",),
     "abort": ("step", "reason"),

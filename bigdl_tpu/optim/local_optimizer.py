@@ -973,17 +973,33 @@ def validate(model, params, net_state, dataset, methods, batch_to_device=jnp.asa
     with RNG-bearing stages (unconventional for eval) stays serial so
     its draws come from the calling thread's stream, not a fresh derived
     stream per validation pass.
+
+    The last PARTIAL batch is zero-padded back to the full batch's row
+    count through the serve bucket helper (``serve/bucketing.pad_rows``)
+    and its outputs trimmed, so an odd tail reuses the executable the
+    first batch compiled instead of paying a second XLA compile per
+    distinct tail shape (docs/serving.md).
     """
+    from bigdl_tpu.serve import bucketing
     fwd = _eval_fn(model)
     totals = [None] * len(methods)
     count = timed_count = 0
     t0 = None
+    full_rows = None
     batches = dataset.data(train=False)
     if prefetch_mod.enabled() and not prefetch_mod.has_stochastic_stage(
             dataset):
         batches = prefetch_mod.background(batches, prefetch_mod.depth())
     for batch in batches:
-        out = fwd(params, net_state, batch_to_device(batch.data))
+        data = np.asarray(batch.data)   # converted ONCE: shape probe,
+        rows = int(data.shape[0])       # pad and device transfer all
+        if full_rows is None:           # reuse the same array
+            full_rows = rows
+        if rows < full_rows:
+            data, _ = bucketing.pad_rows(data, full_rows)
+        out = fwd(params, net_state, batch_to_device(data))
+        if rows < full_rows:
+            out = bucketing.trim(out, rows)
         b = int(np.asarray(batch.labels).shape[0])
         count += b
         for i, m in enumerate(methods):

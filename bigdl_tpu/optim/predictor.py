@@ -3,51 +3,61 @@ ref org/apache/spark/ml/DLClassifier.scala:37-140 and
 PythonBigDL.modelPredictRDD :231).
 
 The reference wraps a trained Module as a Spark ML Transformer for
-DataFrame batch scoring; here ``Predictor`` maps any array / iterable of
-features through a jit-compiled forward in fixed-size batches (the last
-partial batch is padded, then trimmed — keeping one compiled shape).
+DataFrame batch scoring; here ``Predictor`` is a thin SYNCHRONOUS
+wrapper over :class:`bigdl_tpu.serve.ServeEngine` — there is exactly one
+compiled-forward inference path in the codebase (docs/serving.md).  The
+engine buckets and zero-pads batches (the old standalone loop padded the
+tail chunk with host-side ``np.repeat`` copies of the last row), keeps
+the weights pinned on device, and never cold-compiles after warmup.
+
+**Capture semantics**: parameters and state are captured ONCE, at
+construction (matching the reference, whose DLClassifier holds a trained
+Module snapshot).  Training the model afterwards does NOT change what
+``predict`` returns until :meth:`refresh` re-captures the module tree's
+current weights (same shapes, so nothing recompiles).
+
+**Behavior change vs the old standalone loop**: rows containing
+non-finite values now raise ``serve.PoisonedRequestError`` from
+``predict`` (the engine fails poisoned rows' futures instead of
+forwarding NaN/Inf into the model silently); finite rows are unaffected.
 """
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 import numpy as np
-
-from bigdl_tpu.nn.module import Context
 
 
 class Predictor:
-    def __init__(self, model, batch_size: int = 128):
+    def __init__(self, model, batch_size: int = 128, policy=None):
+        from bigdl_tpu.serve import ServeEngine
         self.model = model
         self.batch_size = batch_size
-        params = model.params()
-        state = model.state()
+        self._engine = ServeEngine(model, max_batch=batch_size,
+                                   policy=policy)
 
-        @jax.jit
-        def fwd(x):
-            out, _ = model.apply(params, x, state,
-                                 Context(training=False, key=jax.random.PRNGKey(0)))
-            return out
-
-        self._fwd = fwd
+    def refresh(self):
+        """Re-capture the model's CURRENT params/state (see the module
+        docstring for the capture contract)."""
+        self._engine.refresh()
+        return self
 
     def predict(self, features) -> np.ndarray:
         """Forward all rows; returns stacked outputs (n, ...)."""
         features = np.asarray(features)
-        n = features.shape[0]
-        outs = []
-        for start in range(0, n, self.batch_size):
-            chunk = features[start:start + self.batch_size]
-            pad = self.batch_size - chunk.shape[0]
-            if pad:
-                chunk = np.concatenate([chunk, np.repeat(chunk[-1:], pad, 0)])
-            out = np.asarray(self._fwd(jnp.asarray(chunk)))
-            outs.append(out[:out.shape[0] - pad] if pad else out)
-        return np.concatenate(outs)
+        futs = self._engine.submit_many(features)
+        return np.stack([f.result() for f in futs])
 
     def predict_class(self, features) -> np.ndarray:
         """Argmax class, 1-based (the DLClassifier 'predict' column)."""
         return self.predict(features).argmax(axis=-1) + 1
+
+    def close(self):
+        self._engine.close()
+
+    def __del__(self):  # pragma: no cover - gc-timing dependent
+        try:
+            self._engine.close(drain=False)
+        except Exception:
+            pass
 
 
 class DLClassifier(Predictor):

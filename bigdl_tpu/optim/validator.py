@@ -6,6 +6,11 @@ with a Local/Distri split chosen by dataset type; the computation itself lives
 in :func:`bigdl_tpu.optim.local_optimizer.validate` /
 :func:`~bigdl_tpu.optim.local_optimizer.distri_validate`.  These classes keep
 that API shape for users coming from the reference.
+
+Both paths route the last PARTIAL batch through the serve bucket
+pad-and-trim helper (``serve/bucketing.py``), so an eval pass compiles
+exactly one forward shape — the odd tail no longer costs a second XLA
+compile (docs/serving.md).
 """
 from __future__ import annotations
 

@@ -42,6 +42,9 @@ clauses).  Sites and where they are threaded:
 ``ckpt_bitflip``      utils/fs.py — flip one bit of the stored bytes
                       (below the CRC sidecar, so verification must catch)
 ``proc_kill``         optim train loop — os._exit(1) (induced host death)
+``serve_h2d``         serve/engine.py — the serving engine's H2D transfer
+                      raises OSError (the batch's futures fail; the
+                      engine must keep serving subsequent batches)
 ====================  ====================================================
 """
 from __future__ import annotations
@@ -57,7 +60,7 @@ SITES = (
     "record_corrupt", "record_truncate",
     "nan_grad", "inf_grad", "slow_worker",
     "ckpt_write_fail", "ckpt_partial", "ckpt_bitflip",
-    "proc_kill",
+    "proc_kill", "serve_h2d",
 )
 
 ENV_VAR = "BIGDL_FAULTS"

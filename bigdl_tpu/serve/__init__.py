@@ -1,0 +1,35 @@
+"""TPU-native serving engine (docs/serving.md).
+
+The reference exposed batch inference as DLClassifier / ``Module.predict``
+over Spark partitions; this package is the throughput-oriented TPU
+counterpart, reusing the training stack's pipeline idioms:
+
+- :mod:`bigdl_tpu.serve.bucketing` — power-of-two batch buckets +
+  zero-pad/trim helpers (shared with the validators' tail batches);
+- :mod:`bigdl_tpu.serve.engine` — :class:`ServeEngine`: futures-based
+  submit API, size-or-deadline micro-batching, a dedicated H2D transfer
+  thread, device-pinned weights and an ahead-of-time compiled executable
+  per bucket (zero cold compiles after warmup);
+- :mod:`bigdl_tpu.serve.decode` — :class:`ContinuousDecoder`: slot-based
+  continuous batching over the ``TransformerLM`` KV-cache step, with
+  admissions/retirements at step boundaries and cadenced host syncs.
+
+Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
+(default 2), ``BIGDL_SERVE_SYNC`` (decode boundary interval, default 8).
+"""
+from bigdl_tpu.serve import bucketing  # noqa: F401
+from bigdl_tpu.serve.bucketing import (  # noqa: F401
+    bucket_for, bucket_sizes, pad_rows, trim, valid_mask,
+)
+from bigdl_tpu.serve.decode import (  # noqa: F401
+    ContinuousDecoder, continuous_decode,
+)
+from bigdl_tpu.serve.engine import (  # noqa: F401
+    PoisonedRequestError, ServeEngine,
+)
+
+__all__ = [
+    "bucketing", "bucket_sizes", "bucket_for", "pad_rows", "trim",
+    "valid_mask", "ServeEngine", "PoisonedRequestError",
+    "ContinuousDecoder", "continuous_decode",
+]

@@ -1,0 +1,81 @@
+"""Shape-bucketed batch padding (docs/serving.md).
+
+XLA compiles one executable per input shape, so a serving path that
+forwards whatever batch size the queue happened to close on would pay a
+cold compile for every distinct size it ever sees — tens of seconds on a
+TPU, in the latency path of live requests.  The fix is the standard one:
+quantize batch sizes to a small fixed set of power-of-two **buckets**,
+zero-pad each assembled batch up to its bucket, and trim the pad rows
+off the outputs.  Every bucket's executable is built once (ahead of
+time, at engine start — `ServeEngine.warmup`), so after warmup a mixed
+request stream touches ZERO cold compiles no matter how sizes arrive.
+
+The same helper serves validation: the last partial batch of an eval
+pass used to compile a second program for its odd shape
+(`optim/local_optimizer.validate` now pads the tail back to the full
+batch shape and trims — one compiled shape per pass).
+
+Rows are padded with ZEROS, not repeats of the last row: a repeated real
+row costs the same FLOPs but means a poisoned/non-finite final row is
+forwarded multiple times, and it makes the pad rows indistinguishable
+from data in a crash dump.  Pad rows never reach a caller either way —
+`trim` drops them before futures resolve.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def bucket_sizes(max_batch: int) -> tuple:
+    """The bucket ladder for ``max_batch``: powers of two up to and
+    including ``max_batch`` (with ``max_batch`` itself appended when it
+    is not a power of two, so a full batch pads by zero rows)."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    out = []
+    b = 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return tuple(out)
+
+
+def bucket_for(n: int, max_batch: int) -> int:
+    """Smallest bucket >= ``n`` on the ``max_batch`` ladder."""
+    if n < 1:
+        raise ValueError(f"batch of {n} rows has no bucket")
+    if n > max_batch:
+        raise ValueError(f"{n} rows exceeds max_batch={max_batch}")
+    for b in bucket_sizes(max_batch):
+        if b >= n:
+            return b
+    raise AssertionError("unreachable: ladder ends at max_batch")
+
+
+def pad_rows(x, target: int):
+    """Zero-pad ``x`` (n, ...) up to ``target`` rows.
+
+    Returns ``(padded, n)`` where ``padded`` shares no rows with any
+    real record beyond the first ``n``.  ``n == target`` returns ``x``
+    unchanged (no copy)."""
+    x = np.asarray(x)
+    n = x.shape[0]
+    if n == target:
+        return x, n
+    if n > target:
+        raise ValueError(f"cannot pad {n} rows down to {target}")
+    pad = np.zeros((target - n,) + x.shape[1:], dtype=x.dtype)
+    return np.concatenate([x, pad]), n
+
+
+def valid_mask(n: int, target: int) -> np.ndarray:
+    """Boolean (target,) mask of the real rows of a padded batch."""
+    m = np.zeros((target,), dtype=bool)
+    m[:n] = True
+    return m
+
+
+def trim(out, n: int):
+    """Drop the pad rows of a bucketed output (no-op when full)."""
+    return out if out.shape[0] == n else out[:n]

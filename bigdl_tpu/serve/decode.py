@@ -1,0 +1,240 @@
+"""Continuous-batching decode: a slot-based driver over the
+``TransformerLM`` KV-cache step (docs/serving.md).
+
+``models.transformer.lm_decode`` compiles one lock-step scan: every row
+starts together, ends together, and a new request waits for the whole
+batch to finish.  A serving decoder cannot run lock-step — requests
+arrive whenever they arrive and finish at their own lengths.  This
+driver keeps a fixed (B, n_pos) KV-cache slab on device and treats its
+B rows as **slots**:
+
+- each slot independently consumes its own seed and generates its own
+  continuation (per-row positions — ``_lm_forward_one`` scatters the
+  cache write and masks attention per row);
+- requests are **admitted** into free slots and **retired** at step
+  boundaries only, so the device sees one fixed-shape compiled step
+  program for the engine's whole lifetime (slot index is a traced
+  argument — admission never recompiles);
+- the host syncs only every ``sync_interval`` steps (the
+  ``BIGDL_OBS_TAPS_CADENCE``-style boundary, env ``BIGDL_SERVE_SYNC``):
+  generated tokens feed back device-side, completion steps are known
+  arithmetically on the host, and the generated-token slab is
+  materialized once per boundary that retires anything — never per
+  token.
+
+Greedy decoding only (the serial oracle is ``lm_decode(greedy=True)``;
+sampling needs per-slot key streams, which would change the draw order
+vs the serial scan and break the bit-parity contract).
+"""
+from __future__ import annotations
+
+import logging
+import os
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+ENV_SYNC = "BIGDL_SERVE_SYNC"
+DEFAULT_SYNC = 8
+
+
+def sync_interval_default() -> int:
+    try:
+        return max(1, int(os.environ.get(ENV_SYNC, DEFAULT_SYNC)))
+    except ValueError:
+        return DEFAULT_SYNC
+
+
+class _DecodeReq:
+    __slots__ = ("seed", "n_words", "future", "slot", "steps_needed",
+                 "steps_run")
+
+    def __init__(self, seed, n_words):
+        self.seed = [int(t) for t in seed]
+        self.n_words = int(n_words)
+        self.future = Future()
+        self.slot = None
+        # positions fed through = n_seed + n_words - 1 (lm_decode's n_pos)
+        self.steps_needed = len(self.seed) + self.n_words - 1
+        self.steps_run = 0
+
+
+class ContinuousDecoder:
+    """Fixed-slab continuous-batching decoder for one ``TransformerLM``.
+
+    ``max_slots`` is the device batch width B; ``n_pos`` the slab's
+    position capacity — a request needs ``len(seed) + n_words - 1 <=
+    n_pos``.  :meth:`submit` queues a request (future of the full token
+    row, seed included, matching ``lm_decode``'s return); :meth:`run`
+    drives admitted slots until queue and slots drain.
+    """
+
+    def __init__(self, model, max_slots: int = 4, n_pos: int = 64,
+                 sync_interval: int | None = None):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.models.transformer import (_lm_forward_one,
+                                                  _lm_handles)
+
+        self.model = model
+        self.B = int(max_slots)
+        self.n_pos = int(n_pos)
+        self.sync_interval = (sync_interval_default()
+                              if sync_interval is None
+                              else max(1, int(sync_interval)))
+        handles = _lm_handles(model)
+        self._vocab = handles.vocab
+        pe = jnp.asarray(model.modules[1].table(self.n_pos))
+        B, n_pos = self.B, self.n_pos
+        L, H, hd = handles.n_layers, handles.n_heads, handles.hd
+
+        def step(kc, vc, pos, prev, active, seeds, seed_len, gen):
+            rows = jnp.arange(B)
+            live = active & (pos < n_pos)
+            wp = jnp.clip(pos, 0, n_pos - 1)
+            tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
+            logp, (kc, vc) = _lm_forward_one(
+                tok.astype(jnp.int32), wp, (kc, vc), handles, n_pos, pe)
+            nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+            # parked/finished slots must not advance or write tokens
+            gen = gen.at[rows, wp].set(jnp.where(live, nxt, gen[rows, wp]))
+            prev = jnp.where(live, nxt, prev)
+            pos = jnp.where(live, pos + 1, pos)
+            return kc, vc, pos, prev, gen
+
+        def admit(kc, vc, pos, active, seeds, seed_len, gen, slot,
+                  seed_row, s_len):
+            kc = kc.at[:, slot].set(0.0)
+            vc = vc.at[:, slot].set(0.0)
+            pos = pos.at[slot].set(0)
+            active = active.at[slot].set(True)
+            seeds = seeds.at[slot].set(seed_row)
+            seed_len = seed_len.at[slot].set(s_len)
+            gen = gen.at[slot].set(0)
+            return kc, vc, pos, active, seeds, seed_len, gen
+
+        def retire(active, slot):
+            return active.at[slot].set(False)
+
+        self._step = jax.jit(step)
+        self._admit_fn = jax.jit(admit)
+        self._retire_fn = jax.jit(retire)
+
+        z = jnp.zeros
+        self._kc = z((L, B, n_pos, H, hd), jnp.float32)
+        self._vc = z((L, B, n_pos, H, hd), jnp.float32)
+        self._pos = z((B,), jnp.int32)
+        self._prev = z((B,), jnp.int32)
+        self._active = z((B,), bool)
+        self._seeds = z((B, n_pos), jnp.int32)
+        self._seed_len = z((B,), jnp.int32)
+        self._gen = z((B, n_pos), jnp.int32)
+
+        self._pending: "deque[_DecodeReq]" = deque()
+        self._slots: list = [None] * B
+
+        # telemetry
+        self.steps = 0
+        self.host_syncs = 0
+        self.admitted = 0
+        self.retired = 0
+
+    # -- submit -------------------------------------------------------------
+    def submit(self, seed_ids, n_words: int) -> Future:
+        """Queue one request; the future resolves to the full token row
+        (seed + ``n_words`` generated ids), exactly ``lm_decode``'s
+        greedy output for the same seed."""
+        seed = np.asarray(seed_ids, np.int32)
+        if seed.ndim != 1 or seed.size == 0:
+            raise ValueError("seed_ids must be one flat non-empty id row")
+        if n_words < 1:
+            raise ValueError("n_words must be >= 1")
+        req = _DecodeReq(seed.tolist(), n_words)
+        if req.steps_needed > self.n_pos:
+            raise ValueError(
+                f"request needs {req.steps_needed} positions but the "
+                f"slab holds n_pos={self.n_pos}")
+        self._pending.append(req)
+        return req.future
+
+    # -- drive --------------------------------------------------------------
+    def _admit_waiting(self):
+        for slot in range(self.B):
+            if self._slots[slot] is not None or not self._pending:
+                continue
+            req = self._pending.popleft()
+            req.slot = slot
+            seed_row = np.zeros((self.n_pos,), np.int32)
+            seed_row[:len(req.seed)] = req.seed
+            (self._kc, self._vc, self._pos, self._active, self._seeds,
+             self._seed_len, self._gen) = self._admit_fn(
+                self._kc, self._vc, self._pos, self._active, self._seeds,
+                self._seed_len, self._gen, np.int32(slot), seed_row,
+                np.int32(len(req.seed)))
+            self._slots[slot] = req
+            self.admitted += 1
+
+    def run(self):
+        """Drive the slab until every submitted request has resolved.
+        Admissions and retirements happen only at ``sync_interval``
+        step boundaries; the only device->host reads are one
+        generated-slab fetch per boundary that retires a request."""
+        while self._pending or any(r is not None for r in self._slots):
+            self._admit_waiting()
+            live = [r for r in self._slots if r is not None]
+            if not live:   # pragma: no cover - defensive
+                break
+            for _ in range(self.sync_interval):
+                (self._kc, self._vc, self._pos, self._prev,
+                 self._gen) = self._step(
+                    self._kc, self._vc, self._pos, self._prev,
+                    self._active, self._seeds, self._seed_len, self._gen)
+            self.steps += self.sync_interval
+            for r in live:
+                r.steps_run += self.sync_interval
+            done = [r for r in live if r.steps_run >= r.steps_needed]
+            if not done:
+                continue
+            gen_host = np.asarray(self._gen)   # the boundary host sync
+            self.host_syncs += 1
+            for r in done:
+                s = len(r.seed)
+                toks = gen_host[r.slot, s - 1:s - 1 + r.n_words]
+                r.future.set_result(r.seed + [int(t) for t in toks])
+                self._active = self._retire_fn(self._active,
+                                               np.int32(r.slot))
+                self._slots[r.slot] = None
+                self.retired += 1
+        from bigdl_tpu.obs import events
+        events.emit("serve", kind="decode", steps=self.steps,
+                    host_syncs=self.host_syncs, admitted=self.admitted,
+                    retired=self.retired, slots=self.B)
+        return self
+
+    def stats(self) -> dict:
+        return {"steps": self.steps, "host_syncs": self.host_syncs,
+                "admitted": self.admitted, "retired": self.retired,
+                "slots": self.B, "n_pos": self.n_pos,
+                "sync_interval": self.sync_interval}
+
+
+def continuous_decode(model, seed_rows, n_words, max_slots: int = 4,
+                      n_pos: int | None = None,
+                      sync_interval: int | None = None):
+    """Convenience one-shot: decode every seed row with a shared slab.
+
+    ``n_pos`` defaults to the largest request's need, so a mixed set of
+    seed lengths shares one compiled step.  Returns the extended rows in
+    submission order (``lm_decode`` greedy semantics per row)."""
+    reqs = [np.asarray(s, np.int32) for s in seed_rows]
+    if n_pos is None:
+        n_pos = max(int(s.size) + int(n_words) - 1 for s in reqs)
+    dec = ContinuousDecoder(model, max_slots=max_slots, n_pos=n_pos,
+                            sync_interval=sync_interval)
+    futs = [dec.submit(s, n_words) for s in reqs]
+    dec.run()
+    return [f.result() for f in futs]

@@ -1,0 +1,507 @@
+"""Throughput-oriented inference engine: request queue + dynamic
+batching + shape-bucketed AOT executable cache (docs/serving.md).
+
+The reference shipped batch scoring as a first-class subsystem
+(DLClassifier / ``Module.predict`` over an RDD); this is the TPU-native
+version, built on the same pipeline idioms the training path already
+proved out (``dataset/prefetch.py`` double-buffering, the obs event
+stream, ``BIGDL_FAULTS`` chaos sites):
+
+- **Submit**: :meth:`ServeEngine.submit` / :meth:`submit_many` enqueue
+  single rows and return ``concurrent.futures.Future`` objects — the
+  async API a request handler calls.
+- **Assemble**: a batcher thread closes a micro-batch on
+  size-or-deadline (``BIGDL_SERVE_MAX_BATCH`` rows, or
+  ``BIGDL_SERVE_MAX_WAIT_MS`` after the first queued row), rejects
+  poisoned rows (non-finite values fail ONLY their own future, with an
+  obs ``serve`` error event — the batch proceeds without them) and
+  zero-pads to the power-of-two bucket (`serve/bucketing.py`).
+- **Transfer**: a dedicated H2D thread double-buffers padded batches to
+  the device (the ``prefetch.py`` transfer-thread pattern; bounded
+  queues give backpressure).  This is a ``BIGDL_FAULTS`` site
+  (``serve_h2d``) so the chaos matrix covers serving.
+- **Execute**: a compute thread runs the bucket's ahead-of-time
+  compiled executable (``jit(fwd).lower(...).compile()`` per bucket at
+  warmup, riding the persistent XLA compilation cache) and resolves the
+  futures with trimmed per-row outputs.  After warmup a mixed-size
+  stream triggers ZERO new compiles — the single-compile invariant
+  ``tests/test_serve.py`` audits.
+
+Weights are captured and pinned to device ONCE at engine start
+(``jax.device_put``); :meth:`refresh` re-captures them from the model
+(same shapes/dtypes, so the executable cache survives).  An optional
+``DTypePolicy`` (e.g. ``tensor.BF16_COMPUTE``) scopes bf16 MXU compute
+to the serving forward without touching the process default.
+
+Telemetry: per-request latency histogram (p50/p95/p99), queue depth,
+per-bucket hit counts and compile count via :meth:`stats`; ``serve``
+events (start/stop/error) in the obs stream (docs/observability.md).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from bigdl_tpu.serve import bucketing
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+ENV_MAX_BATCH = "BIGDL_SERVE_MAX_BATCH"
+ENV_MAX_WAIT_MS = "BIGDL_SERVE_MAX_WAIT_MS"
+
+DEFAULT_MAX_BATCH = 64
+DEFAULT_MAX_WAIT_MS = 2.0
+#: bounded hand-off depth between assembler -> H2D -> compute (the
+#: prefetch double-buffer: one batch in flight per stage, one queued)
+_STAGE_DEPTH = 2
+#: latency reservoir size for the percentile stats
+_LATENCY_WINDOW = 8192
+
+
+def max_batch_default() -> int:
+    try:
+        return max(1, int(os.environ.get(ENV_MAX_BATCH, DEFAULT_MAX_BATCH)))
+    except ValueError:
+        return DEFAULT_MAX_BATCH
+
+
+def max_wait_ms_default() -> float:
+    try:
+        return max(0.0, float(os.environ.get(ENV_MAX_WAIT_MS,
+                                             DEFAULT_MAX_WAIT_MS)))
+    except ValueError:
+        return DEFAULT_MAX_WAIT_MS
+
+
+class _Request:
+    __slots__ = ("x", "future", "t_submit")
+
+    def __init__(self, x):
+        self.x = x
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class _End:
+    pass
+
+
+_END = _End()
+
+
+class PoisonedRequestError(ValueError):
+    """A submitted row contained non-finite values; only its own future
+    fails — the rest of the micro-batch is served normally."""
+
+
+class ServeEngine:
+    """Dynamic-batching inference engine over one model.
+
+    ``ServeEngine(model)`` captures ``model.params()``/``state()`` once
+    and pins them to device; call :meth:`refresh` after training updates
+    the module tree.  ``input_shape``/``input_dtype`` (per-ROW shape,
+    no batch dim) enable eager warmup at construction; otherwise every
+    bucket compiles on the first batch (still one warmup moment — never
+    per mixed size).
+
+    ``policy`` caveat: the dtype policy is process-global at trace time
+    (``tensor.set_policy`` is swapped around the warmup lowering and
+    restored after), so when serving with a non-default policy NEXT TO
+    concurrent training/tracing on other threads, pass ``input_shape``
+    so the whole warmup happens synchronously at construction on the
+    calling thread — lazy warmup would otherwise briefly apply the
+    serving policy to traces racing it.
+    """
+
+    def __init__(self, model, max_batch: int | None = None,
+                 max_wait_ms: float | None = None, policy=None,
+                 input_shape=None, input_dtype=np.float32):
+        import jax
+
+        self.model = model
+        self.max_batch = (max_batch_default() if max_batch is None
+                          else max(1, int(max_batch)))
+        self.max_wait_s = (max_wait_ms_default() if max_wait_ms is None
+                           else max(0.0, float(max_wait_ms))) / 1e3
+        self.buckets = bucketing.bucket_sizes(self.max_batch)
+        self._policy = policy
+        self._params = jax.device_put(model.params())
+        self._state = jax.device_put(model.state())
+
+        # ONE compiled-forward path per model: the same cached jitted
+        # eval fn the validators use (optim.local_optimizer._eval_fn),
+        # so a process that validates AND serves traces it once
+        from bigdl_tpu.optim.local_optimizer import _eval_fn
+        self._fwd = _eval_fn(model)
+        self._executables: dict = {}   # bucket -> compiled executable
+        self._row_shape = None
+        self._row_dtype = None
+
+        self._lock = threading.Lock()
+        self._closed = False
+        self._queue: "queue.Queue" = queue.Queue()
+        self._h2d_q: "queue.Queue" = queue.Queue(maxsize=_STAGE_DEPTH)
+        self._exec_q: "queue.Queue" = queue.Queue(maxsize=_STAGE_DEPTH)
+
+        # telemetry (guarded by _lock)
+        self._inflight = 0       # submitted, future not yet resolved
+        self.compiles = 0
+        self.served = 0
+        self.batches = 0
+        self.errors = 0
+        self._latencies = deque(maxlen=_LATENCY_WINDOW)
+        self._bucket_hits = {b: 0 for b in self.buckets}
+        self._max_queue_depth = 0
+
+        if input_shape is not None:
+            self.warmup(tuple(input_shape), input_dtype)
+
+        self._assembler = threading.Thread(
+            target=self._assemble_loop, daemon=True,
+            name="bigdl-serve-batcher")
+        self._transfer = threading.Thread(
+            target=self._h2d_loop, daemon=True, name="bigdl-serve-h2d")
+        self._compute = threading.Thread(
+            target=self._compute_loop, daemon=True,
+            name="bigdl-serve-compute")
+        self._assembler.start()
+        self._transfer.start()
+        self._compute.start()
+        self._emit("start", max_batch=self.max_batch,
+                   max_wait_ms=self.max_wait_s * 1e3,
+                   buckets=list(self.buckets))
+
+    # -- compilation --------------------------------------------------------
+    def warmup(self, row_shape: tuple, row_dtype=np.float32):
+        """Pre-lower-and-compile EVERY bucket for rows of ``row_shape``.
+
+        Rides the persistent XLA compilation cache (``bench.py`` proves
+        1.15 s cold -> 0.01 s warm across processes), so a restarted
+        server re-warms from disk, not from the compiler.  Idempotent;
+        returns the number of fresh compiles."""
+        import jax
+
+        row_shape = tuple(int(d) for d in row_shape)
+        row_dtype = np.dtype(row_dtype)
+        with self._lock:
+            if self._row_shape is None:
+                self._row_shape, self._row_dtype = row_shape, row_dtype
+            elif (row_shape != self._row_shape
+                  or row_dtype != self._row_dtype):
+                raise ValueError(
+                    f"engine is warmed for rows {self._row_shape} "
+                    f"{self._row_dtype}, not {row_shape} {row_dtype}")
+        fresh = 0
+        from bigdl_tpu import tensor as bt
+        prev = bt.policy()
+        if self._policy is not None:
+            bt.set_policy(self._policy)
+        try:
+            for b in self.buckets:
+                if b in self._executables:
+                    continue
+                spec = jax.ShapeDtypeStruct((b,) + row_shape, row_dtype)
+                t0 = time.perf_counter()
+                exe = self._fwd.lower(self._params, self._state,
+                                      spec).compile()
+                dt = time.perf_counter() - t0
+                with self._lock:
+                    self._executables[b] = exe
+                    self.compiles += 1
+                fresh += 1
+                logger.info("serve warmup: bucket %d compiled in %.3fs",
+                            b, dt)
+        finally:
+            if self._policy is not None:
+                bt.set_policy(prev)
+        return fresh
+
+    def refresh(self):
+        """Re-capture (and re-pin) the model's CURRENT params/state.
+
+        The engine freezes weights at construction — training the model
+        afterwards does NOT change what is served until this is called.
+        Shapes/dtypes must be unchanged, so the per-bucket executables
+        (which take params as arguments, not constants) are reused:
+        refresh never recompiles."""
+        import jax
+        params = jax.device_put(self.model.params())
+        state = jax.device_put(self.model.state())
+        with self._lock:
+            self._params, self._state = params, state
+        return self
+
+    # -- submit side --------------------------------------------------------
+    def submit(self, x) -> Future:
+        """Queue one row (shape = model input WITHOUT the batch dim);
+        returns a future resolving to that row's output array.
+
+        A request whose payload is non-finite fails its OWN future with
+        :class:`PoisonedRequestError` (the rest of its micro-batch is
+        served) — stricter than the pre-engine Predictor loop, which
+        forwarded NaN/Inf rows to the model silently."""
+        req = _Request(np.asarray(x))
+        # closed-check and enqueue under the lock: close() flips _closed
+        # under the same lock, so a request can never slip into the
+        # queue after close()'s final leftover drain (its future would
+        # hang forever)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("ServeEngine is closed")
+            self._inflight += 1
+            depth = self._queue.qsize() + 1
+            if depth > self._max_queue_depth:
+                self._max_queue_depth = depth
+            self._queue.put(req)   # unbounded put: never blocks
+        return req.future
+
+    def submit_many(self, rows) -> list:
+        """Queue an iterable of rows; returns their futures in order."""
+        return [self.submit(r) for r in rows]
+
+    def predict(self, features) -> np.ndarray:
+        """Synchronous convenience: submit every row of ``features``
+        (n, ...) and return the stacked outputs (n, ...)."""
+        futs = self.submit_many(np.asarray(features))
+        return np.stack([f.result() for f in futs])
+
+    # -- pipeline stages ----------------------------------------------------
+    def _assemble_loop(self):
+        while True:
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if isinstance(first, _End):
+                self._h2d_q.put(_END)
+                return
+            reqs = [first]
+            deadline = time.perf_counter() + self.max_wait_s
+            while len(reqs) < self.max_batch:
+                try:
+                    # drain whatever is already queued without paying a
+                    # condition-variable wakeup per row (measured ~ms
+                    # each under load); the timed wait is only for the
+                    # deadline tail
+                    nxt = self._queue.get_nowait()
+                except queue.Empty:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    try:
+                        nxt = self._queue.get(timeout=remaining)
+                    except queue.Empty:
+                        break
+                if isinstance(nxt, _End):
+                    # flush what we have, then propagate shutdown
+                    self._dispatch(reqs)
+                    self._h2d_q.put(_END)
+                    return
+                reqs.append(nxt)
+            self._dispatch(reqs)
+
+    def _dispatch(self, reqs):
+        """Validate rows, pad to the bucket, hand to the H2D stage.
+        Never raises: a bad batch fails its own futures, the batcher
+        thread lives on."""
+        good = []
+        for r in reqs:
+            err = self._vet(r.x)
+            if err is None:
+                good.append(r)
+            else:
+                self._fail([r], err)
+        if not good:
+            return
+        try:
+            bucket = bucketing.bucket_for(len(good), self.max_batch)
+            xs, n = bucketing.pad_rows(np.stack([r.x for r in good]),
+                                       bucket)
+            # finiteness is vetted on the STACKED batch (one fused
+            # reduction, ~5x cheaper than per-row on the hot thread);
+            # only a failing batch pays the per-row scan to isolate and
+            # fail the poisoned rows, then the clean rest re-dispatches
+            if (np.issubdtype(xs.dtype, np.floating)
+                    and not np.all(np.isfinite(xs))):
+                clean = []
+                for r in good:
+                    if np.all(np.isfinite(r.x)):
+                        clean.append(r)
+                    else:
+                        self._fail([r], PoisonedRequestError(
+                            "request contains non-finite values"))
+                if not clean:
+                    return
+                bucket = bucketing.bucket_for(len(clean), self.max_batch)
+                xs, n = bucketing.pad_rows(
+                    np.stack([r.x for r in clean]), bucket)
+                good = clean
+        except BaseException as e:
+            self._fail(good, e)
+            return
+        with self._lock:
+            self._bucket_hits[bucket] += 1
+        self._h2d_q.put((good, xs, bucket, n))
+
+    def _vet(self, x):
+        """Admission check for one row: shape against the warmed spec.
+        Returns an exception to fail the row's future with, or None.
+        (Finiteness is checked batch-level in ``_dispatch``.)"""
+        if self._row_shape is not None and tuple(x.shape) != self._row_shape:
+            return ValueError(
+                f"row shape {tuple(x.shape)} != engine shape "
+                f"{self._row_shape}")
+        return None
+
+    def _h2d_loop(self):
+        import jax
+        while True:
+            item = self._h2d_q.get()
+            if isinstance(item, _End):
+                self._exec_q.put(_END)
+                return
+            reqs, xs, bucket, n = item
+            try:
+                self._chaos_h2d()
+                xdev = jax.device_put(xs)
+            except BaseException as e:
+                self._fail(reqs, e)
+                continue
+            self._exec_q.put((reqs, xdev, bucket, n))
+
+    def _chaos_h2d(self):
+        from bigdl_tpu.resilience import faults
+        inj = faults.get()
+        if inj is not None and inj.armed("serve_h2d"):
+            if inj.fires("serve_h2d"):
+                raise OSError("injected serve_h2d transfer failure")
+
+    def _compute_loop(self):
+        while True:
+            item = self._exec_q.get()
+            if isinstance(item, _End):
+                return
+            reqs, xdev, bucket, n = item
+            try:
+                exe = self._executables.get(bucket)
+                if exe is None:
+                    # first traffic before an explicit warmup: compile
+                    # the whole ladder NOW so this is the last cold stop
+                    self.warmup(tuple(xdev.shape[1:]), xdev.dtype)
+                    exe = self._executables[bucket]
+                out = np.asarray(exe(self._params, self._state, xdev))
+            except BaseException as e:
+                self._fail(reqs, e)
+                continue
+            out = bucketing.trim(out, n)
+            done = time.perf_counter()
+            with self._lock:
+                self.batches += 1
+                self.served += len(reqs)
+                self._inflight -= len(reqs)
+                for r in reqs:
+                    self._latencies.append(done - r.t_submit)
+            for i, r in enumerate(reqs):
+                r.future.set_result(out[i])
+
+    def _fail(self, reqs, exc):
+        with self._lock:
+            self.errors += len(reqs)
+            self._inflight -= len(reqs)
+        self._emit("error", error=f"{type(exc).__name__}: {exc}",
+                   requests=len(reqs))
+        for r in reqs:
+            if not r.future.done():
+                r.future.set_exception(exc)
+
+    # -- telemetry ----------------------------------------------------------
+    def _emit(self, kind: str, **fields):
+        from bigdl_tpu.obs import events
+        events.emit("serve", kind=kind, **fields)
+
+    def latency_quantiles(self, qs=(50, 95, 99)) -> dict:
+        with self._lock:
+            lat = np.asarray(self._latencies, np.float64)
+        if lat.size == 0:
+            return {f"p{int(q)}": None for q in qs}
+        return {f"p{int(q)}": float(np.percentile(lat, q)) for q in qs}
+
+    def stats(self) -> dict:
+        """Snapshot: latency percentiles (seconds), queue depth, bucket
+        hit counts, compile count, served/error totals."""
+        with self._lock:
+            out = {
+                "served": self.served,
+                "batches": self.batches,
+                "errors": self.errors,
+                "compiles": self.compiles,
+                "queue_depth": self._queue.qsize(),
+                "max_queue_depth": self._max_queue_depth,
+                "bucket_hits": dict(self._bucket_hits),
+                "buckets": list(self.buckets),
+            }
+        out.update(self.latency_quantiles())
+        return out
+
+    # -- lifecycle ----------------------------------------------------------
+    def drain(self, timeout: float = 30.0):
+        """Block until every submitted request has resolved (the batcher
+        deadline flushes partial batches, so this terminates)."""
+        t0 = time.perf_counter()
+        while True:
+            with self._lock:
+                if self._inflight == 0:
+                    return self
+            if time.perf_counter() - t0 > timeout:
+                raise TimeoutError("serve drain timed out")
+            time.sleep(0.002)
+
+    def close(self, drain: bool = True):
+        """Stop the engine.  ``drain=True`` (default) serves everything
+        already queued first; ``drain=False`` fails pending futures."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if not drain:
+            pending = []
+            try:
+                while True:
+                    r = self._queue.get_nowait()
+                    if not isinstance(r, _End):
+                        pending.append(r)
+            except queue.Empty:
+                pass
+            if pending:
+                self._fail(pending, RuntimeError("ServeEngine closed"))
+        self._queue.put(_END)
+        self._assembler.join(timeout=30.0)
+        self._transfer.join(timeout=30.0)
+        self._compute.join(timeout=30.0)
+        # a submit racing close() may have queued behind the shutdown
+        # sentinel; nothing will serve it now — fail it, don't hang it
+        leftovers = []
+        try:
+            while True:
+                r = self._queue.get_nowait()
+                if not isinstance(r, _End):
+                    leftovers.append(r)
+        except queue.Empty:
+            pass
+        if leftovers:
+            self._fail(leftovers, RuntimeError("ServeEngine closed"))
+        self._emit("stop", **{k: v for k, v in self.stats().items()
+                              if not isinstance(v, (dict, list))})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

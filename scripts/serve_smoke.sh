@@ -1,0 +1,68 @@
+#!/usr/bin/env bash
+# Serving smoke: the serve-marked suite (dynamic batching, bucketed AOT
+# executable cache, continuous-batching decode, Predictor/validator
+# regressions) plus a 200-request LeNet drill that holds the two serving
+# invariants end to end:
+#
+#   - ZERO cold compiles after warmup across a mixed-size request
+#     stream (the shape-bucket contract, docs/serving.md);
+#   - a sane tail latency (p95) for the whole drill — generous on the
+#     CPU CI mesh, but a hang or a per-request compile blows straight
+#     through it.
+#
+#   scripts/serve_smoke.sh              # full set + drill
+#   scripts/serve_smoke.sh -k deadline  # narrow (skips the drill)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+python -m pytest -q -m serve \
+    -p no:cacheprovider -p no:randomly \
+    tests/test_serve.py \
+    "$@"
+
+# The narrowed form is a targeted check; the drill needs the full run.
+if [ "$#" -gt 0 ]; then exit 0; fi
+
+echo "== serve smoke: 200-request LeNet drill =="
+python - <<'PY'
+import time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.serve import ServeEngine
+from bigdl_tpu.utils.random import set_seed
+
+set_seed(1)
+eng = ServeEngine(LeNet5(10), max_batch=16, max_wait_ms=2,
+                  input_shape=(28, 28))
+warm_compiles = eng.compiles
+assert warm_compiles == len(eng.buckets), (warm_compiles, eng.buckets)
+
+rng = np.random.RandomState(0)
+rows = rng.rand(200, 28, 28).astype(np.float32)
+# mixed submission pattern: bursts of every size class incl. singles
+futs, at = [], 0
+for burst in (1, 16, 3, 16, 1, 9, 16, 5) * 4:
+    futs += eng.submit_many(rows[at:at + burst])
+    at += burst
+futs += eng.submit_many(rows[at:])
+t0 = time.perf_counter()
+outs = np.stack([f.result(timeout=60) for f in futs])
+stats = eng.stats()
+eng.close()
+
+assert outs.shape == (200, 10), outs.shape
+assert stats["errors"] == 0, stats
+assert stats["compiles"] == warm_compiles, (
+    f"cold compile on the serving path: {stats['compiles']} vs "
+    f"{warm_compiles} at warmup")
+p95 = stats["p95"]
+assert p95 is not None and p95 < 5.0, f"p95 {p95}s out of bounds"
+print(f"OK: 200 requests, zero cold compiles after warmup "
+      f"({warm_compiles} buckets), p95 {p95*1e3:.1f} ms, "
+      f"bucket hits {stats['bucket_hits']}")
+PY
+echo "serve smoke: all green"
