@@ -160,7 +160,7 @@ def _lm_handles(model):
                       n_heads, hd, ln_f, eps_f, head, vocab)
 
 
-def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
+def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None):
     """One decode position for all rows: token ids (B,) at position i
     with per-layer KV caches (layers, B, n_pos, H, hd) -> (log-probs
     (B, vocab), updated caches).  The shared inner body of lm_decode,
@@ -172,7 +172,17 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
     and the causal mask compares against each row's own position, so
     the math per row is IDENTICAL to the scalar path at that row's
     position — the bit-parity contract ``tests/test_serve.py`` holds
-    the decoder to."""
+    the decoder to.
+
+    ``tp_axis`` names a mesh axis when this body runs INSIDE shard_map
+    with Megatron-style tensor parallelism (serve/decode.py TP path):
+    ``handles`` then carries the LOCAL shard of each block — attention
+    heads split over the axis (wq/wk/wv columns, wo rows, and the KV
+    caches on their head dim) and the FFN hidden dim likewise (lin1
+    rows, lin2 columns).  The only cross-shard communication is one
+    psum after each branch's output projection, with the replicated
+    bias added after the sum — per-head/per-hidden-unit math is
+    untouched, so the TP decode stays token-identical to one device."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -193,6 +203,10 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
         inv = jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps)
         return (x - mean) * inv * p["~"]["weight"] + p["~"]["bias"]
 
+    def merge(partial):
+        return (partial if tp_axis is None
+                else jax.lax.psum(partial, tp_axis))
+
     x = emb["weight"][:, tok].T + emb["bias"] + pe[i]
     for li, (ln1, m, ln2, lin1, lin2) in enumerate(blocks):
         a = layernorm(x, ln1, block_eps[li][0])
@@ -210,11 +224,11 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe):
                       -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
         o = jnp.einsum("bht,bthd->bhd", p,
-                       vcache[li]).reshape(bsz, d_model)
-        x = x + o @ m["wo"] + m["bo"]
+                       vcache[li]).reshape(bsz, n_heads * hd)
+        x = x + merge(o @ m["wo"]) + m["bo"]
         a2 = layernorm(x, ln2, block_eps[li][1])
         h = jax.nn.relu(a2 @ lin1["weight"].T + lin1["bias"])
-        x = x + h @ lin2["weight"].T + lin2["bias"]
+        x = x + merge(h @ lin2["weight"].T) + lin2["bias"]
     xf = ((x - x.mean(axis=-1, keepdims=True))
           * jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps_f)
           * ln_f["weight"] + ln_f["bias"])

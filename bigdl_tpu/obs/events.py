@@ -52,9 +52,13 @@ EVENT_TYPES = {
     # the input pipeline failed to hide the fetch: the consuming loop
     # waited `seconds` for the prefetch queue at `step` (queue was empty)
     "prefetch_stall": ("step", "seconds"),
-    # serving-engine lifecycle/telemetry (serve/engine.py, serve/decode.py):
-    # kind in {start, stop, error, decode}; error events carry the failed
-    # request count + message, stop events a stats snapshot
+    # serving lifecycle/telemetry (serve/engine.py, serve/decode.py,
+    # serve/router.py, serve/cluster.py): kind in {start, stop, error,
+    # decode, shed, weights_commit, weights_revert, router_start,
+    # router_stop, replica_dead, rollout_begin, rollout_commit,
+    # rollout_rollback}; error events carry the failed request count +
+    # message, stop events a stats snapshot, rollout events the weight
+    # version (the hot-swap audit trail, docs/serving.md)
     "serve": ("kind",),
     "watchdog": ("stale",),
     "preempt": ("step",),

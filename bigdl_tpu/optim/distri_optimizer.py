@@ -45,8 +45,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from bigdl_tpu.nn.module import Context
 from bigdl_tpu.optim.local_optimizer import (LocalOptimizer,
                                              _HostSyncWindow, _PendingStep,
-                                             _finite_all, _where_finite,
-                                             validate)
+                                             _finite_all,
+                                             _model_fingerprint,
+                                             _where_finite, validate)
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.parallel.mesh import data_parallel_mesh
 from bigdl_tpu.utils.engine import Engine
@@ -489,11 +490,14 @@ class DistriOptimizer(LocalOptimizer):
         lax.scan over stacked (n, B, ...) batches — same device-side
         training loop as LocalOptimizer (set_iterations_per_dispatch),
         batch sharded over "data" on dim 1."""
+        from bigdl_tpu.serve import xcache
+        fn_key = ("distri_step", _model_fingerprint(self.model),
+                  type(self.optim_method).__name__)
         rep = NamedSharding(self.mesh, P())
         n = self.iters_per_dispatch
         if n <= 1:
-            return jax.jit(
-                step,
+            return xcache.tracked_jit(
+                step, fn_key, key_argnums=(3, 4), mesh=self.mesh,
                 in_shardings=(ps, ns, os_, x_s or data_s, data_s,
                               rep, rep, rep) + tuple(extra_in),
                 out_shardings=(ps, ns, os_, rep, rep, rep),
@@ -504,8 +508,9 @@ class DistriOptimizer(LocalOptimizer):
             raise ValueError("extra step operands are single-dispatch "
                              "only (no chunked-scan wiring for them)")
         chunk_data_s = NamedSharding(self.mesh, P(None, "data"))
-        return jax.jit(
-            self._scan_chunk(step, n),
+        return xcache.tracked_jit(
+            self._scan_chunk(step, n), fn_key + ("chunk%d" % n,),
+            key_argnums=(3, 4), mesh=self.mesh,
             in_shardings=(ps, ns, os_, x_chunk_s or chunk_data_s,
                           chunk_data_s, rep, rep, rep),
             out_shardings=(ps, ns, os_, rep, rep, rep),
@@ -828,8 +833,12 @@ class DistriOptimizer(LocalOptimizer):
             and l.shape[0] % plan.n_stages == 0 else rep, opt_shape)
         n = self.iters_per_dispatch
         fn = step if n <= 1 else self._scan_chunk(step, n)
-        return jax.jit(
-            fn,
+        from bigdl_tpu.serve import xcache
+        return xcache.tracked_jit(
+            fn, ("pipeline_step", _model_fingerprint(self.model),
+                 type(method).__name__, plan.n_stages,
+                 "chunk%d" % n if n > 1 else "single"),
+            key_argnums=(3, 4), mesh=mesh,
             in_shardings=(pipe, pipe, opt_s, rep, rep, rep, rep, rep),
             out_shardings=(pipe, pipe, opt_s, rep, rep, rep),
             donate_argnums=(0, 1, 2),

@@ -362,11 +362,20 @@ class LocalOptimizer:
         # donate the carried state: the old params/opt-state buffers are
         # dead after each step, so XLA reuses them instead of allocating a
         # second copy of the model per step (lr_scales is reused each call
-        # and must NOT be donated)
+        # and must NOT be donated).  Dispatches register in the shared
+        # executable cache (serve/xcache.py) keyed on the batch operands
+        # only, so train rides the same compile accounting as eval/serve.
+        from bigdl_tpu.serve import xcache
+        fn_key = ("train_step", _model_fingerprint(self.model),
+                  type(self.optim_method).__name__)
         n = self.iters_per_dispatch
         if n <= 1:
-            return jax.jit(step, donate_argnums=(0, 1, 2))
-        return jax.jit(self._scan_chunk(step, n), donate_argnums=(0, 1, 2))
+            return xcache.tracked_jit(step, fn_key, key_argnums=(3, 4),
+                                      donate_argnums=(0, 1, 2))
+        return xcache.tracked_jit(self._scan_chunk(step, n),
+                                  fn_key + ("chunk%d" % n,),
+                                  key_argnums=(3, 4),
+                                  donate_argnums=(0, 1, 2))
 
     @staticmethod
     def _scan_chunk(step, n):
@@ -941,14 +950,19 @@ def _model_fingerprint(model):
 
 
 def _eval_fn(model):
-    """One jitted eval forward per model instance, cached on the model: a
-    fresh closure per validate() call would recompile at every validation
-    trigger.  (The model->fn->model cycle is ordinary gc fodder.)"""
+    """One eval forward per model instance, cached on the model (a fresh
+    closure per validate() call would recompile at every validation
+    trigger; the model->fn->model cycle is ordinary gc fodder) and
+    routed through the shared executable cache (``serve/xcache.py``):
+    the returned callable resolves an AOT executable per batch shape
+    keyed by the model FINGERPRINT, so a process that validates AND
+    serves the same (model, shape) pair compiles it exactly once."""
     fp = _model_fingerprint(model)
     cached = getattr(model, "_cached_eval_fn", None)
     if cached is not None and cached[0] == fp:
         return cached[1]
     from bigdl_tpu.nn.module import Context
+    from bigdl_tpu.serve import xcache
 
     @jax.jit
     def fwd(p, s, x):
@@ -956,8 +970,9 @@ def _eval_fn(model):
                              Context(training=False, key=jax.random.PRNGKey(0)))
         return out
 
-    model._cached_eval_fn = (fp, fwd)
-    return fwd
+    wrapped = xcache.ShapedCallable(fwd, fn_key=("eval", fp))
+    model._cached_eval_fn = (fp, wrapped)
+    return wrapped
 
 
 def validate(model, params, net_state, dataset, methods, batch_to_device=jnp.asarray):

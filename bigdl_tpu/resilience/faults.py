@@ -45,6 +45,9 @@ clauses).  Sites and where they are threaded:
 ``serve_h2d``         serve/engine.py — the serving engine's H2D transfer
                       raises OSError (the batch's futures fail; the
                       engine must keep serving subsequent batches)
+``serve_kill``        serve/cluster.py replica worker — os._exit(1) at
+                      the Nth submitted request (the router must requeue
+                      the dead replica's outstanding work on survivors)
 ====================  ====================================================
 """
 from __future__ import annotations
@@ -60,7 +63,7 @@ SITES = (
     "record_corrupt", "record_truncate",
     "nan_grad", "inf_grad", "slow_worker",
     "ckpt_write_fail", "ckpt_partial", "ckpt_bitflip",
-    "proc_kill", "serve_h2d",
+    "proc_kill", "serve_h2d", "serve_kill",
 )
 
 ENV_VAR = "BIGDL_FAULTS"

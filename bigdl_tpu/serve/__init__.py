@@ -1,4 +1,4 @@
-"""TPU-native serving engine (docs/serving.md).
+"""TPU-native serving stack (docs/serving.md).
 
 The reference exposed batch inference as DLClassifier / ``Module.predict``
 over Spark partitions; this package is the throughput-oriented TPU
@@ -6,30 +6,52 @@ counterpart, reusing the training stack's pipeline idioms:
 
 - :mod:`bigdl_tpu.serve.bucketing` — power-of-two batch buckets +
   zero-pad/trim helpers (shared with the validators' tail batches);
+- :mod:`bigdl_tpu.serve.xcache` — the SHARED executable cache keyed by
+  (fn, shapes, mesh, dtype-policy); train dispatch, ``optim.validate``
+  and every serve replica resolve compiles through it, so all entry
+  points get the zero-cold-compile property;
 - :mod:`bigdl_tpu.serve.engine` — :class:`ServeEngine`: futures-based
   submit API, size-or-deadline micro-batching, a dedicated H2D transfer
-  thread, device-pinned weights and an ahead-of-time compiled executable
-  per bucket (zero cold compiles after warmup);
+  thread, device-pinned weights (atomic versioned hot swap) and an
+  ahead-of-time compiled executable per bucket;
 - :mod:`bigdl_tpu.serve.decode` — :class:`ContinuousDecoder`: slot-based
   continuous batching over the ``TransformerLM`` KV-cache step, with
-  admissions/retirements at step boundaries and cadenced host syncs.
+  admissions/retirements at step boundaries, cadenced host syncs, and
+  optional tensor-parallel serving over a mesh ``model`` axis;
+- :mod:`bigdl_tpu.serve.router` — :class:`Router`: SLO admission in
+  front of N replicas (priority classes, deadlines, shed-on-overload,
+  least-loaded dispatch, requeue-on-replica-death);
+- :mod:`bigdl_tpu.serve.cluster` — :class:`ReplicaPool` /
+  :class:`WeightStore`: in-process or subprocess replica fleets with
+  two-phase (stage → atomic flip, rollback on failure) weight rollout.
 
 Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
-(default 2), ``BIGDL_SERVE_SYNC`` (decode boundary interval, default 8).
+(default 2), ``BIGDL_SERVE_SYNC`` (decode boundary interval, default 8),
+``BIGDL_SERVE_REPLICAS`` (pool size, default 2), ``BIGDL_SERVE_SLO_MS``
+(default request deadline, 0 = none), ``BIGDL_SERVE_SHED`` (overload
+shedding, default on).
 """
-from bigdl_tpu.serve import bucketing  # noqa: F401
+from bigdl_tpu.serve import bucketing, xcache  # noqa: F401
 from bigdl_tpu.serve.bucketing import (  # noqa: F401
     bucket_for, bucket_sizes, pad_rows, trim, valid_mask,
+)
+from bigdl_tpu.serve.cluster import (  # noqa: F401
+    LocalReplica, ProcessReplica, ReplicaPool, RolloutError, WeightStore,
 )
 from bigdl_tpu.serve.decode import (  # noqa: F401
     ContinuousDecoder, continuous_decode,
 )
 from bigdl_tpu.serve.engine import (  # noqa: F401
-    PoisonedRequestError, ServeEngine,
+    PoisonedRequestError, ServeEngine, SheddedError,
+)
+from bigdl_tpu.serve.router import (  # noqa: F401
+    DeadReplicaError, Router,
 )
 
 __all__ = [
-    "bucketing", "bucket_sizes", "bucket_for", "pad_rows", "trim",
-    "valid_mask", "ServeEngine", "PoisonedRequestError",
-    "ContinuousDecoder", "continuous_decode",
+    "bucketing", "xcache", "bucket_sizes", "bucket_for", "pad_rows",
+    "trim", "valid_mask", "ServeEngine", "PoisonedRequestError",
+    "SheddedError", "ContinuousDecoder", "continuous_decode", "Router",
+    "DeadReplicaError", "ReplicaPool", "LocalReplica", "ProcessReplica",
+    "WeightStore", "RolloutError",
 ]

@@ -61,6 +61,11 @@ def pad_rows(x, target: int):
     unchanged (no copy)."""
     x = np.asarray(x)
     n = x.shape[0]
+    if n == 0:
+        # 0-row input: nothing to serve — hand back the empty batch
+        # unchanged instead of manufacturing an all-pad batch (or
+        # raising mid-pipeline); callers skip dispatch on n == 0
+        return x, 0
     if n == target:
         return x, n
     if n > target:
@@ -77,5 +82,8 @@ def valid_mask(n: int, target: int) -> np.ndarray:
 
 
 def trim(out, n: int):
-    """Drop the pad rows of a bucketed output (no-op when full)."""
+    """Drop the pad rows of a bucketed output (no-op when full;
+    ``n == 0`` returns the empty slice rather than the pad rows)."""
+    if n == 0:
+        return out[:0]
     return out if out.shape[0] == n else out[:n]

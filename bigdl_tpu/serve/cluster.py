@@ -1,0 +1,615 @@
+"""Replica pool: N serve engines behind one SLO router, with versioned
+hot weight rollout (docs/serving.md "Control plane").
+
+The ServeEngine (PR 5) maximizes ONE process/chip slice; production
+traffic needs the layer above it — the role the reference delegated to
+Spark's driver + task scheduler (Engine.nodeNumber executors behind one
+job queue).  Here that layer is explicit and TPU-shaped:
+
+- :class:`LocalReplica` — an in-process ServeEngine (one per chip slice
+  of this host; on the CPU CI mesh, N replicas share the virtual
+  devices).
+- :class:`ProcessReplica` — a subprocess running :func:`replica_main`
+  with its OWN jax runtime (the production shape: each replica owns its
+  slice; a replica crash is a process death, not a pool death),
+  speaking a length-prefixed pickle protocol over stdin/stdout.  Killed
+  replicas fail their outstanding futures with
+  :class:`~bigdl_tpu.serve.router.DeadReplicaError`, which the router
+  requeues onto survivors — the 4-replica chaos drill
+  (``tests/test_serve_cluster.py``, ``BIGDL_FAULTS=serve_kill@...``)
+  proves zero lost futures.
+- :class:`ReplicaPool` — replicas + :class:`~bigdl_tpu.serve.router.Router`
+  + :class:`WeightStore`, with the two-phase rollout protocol::
+
+      rollout(params, state)
+        │ 1. STAGE on all   — every replica pins version v+1 next to v;
+        │                     serving continues on v (costs HBM only)
+        │ 2. COMMIT (flip)  — each replica's flip is ONE tuple swap
+        │                     between batches: in-flight batches finish
+        │                     on v, every later batch serves v+1
+        └─ on ANY failure  — staged-only replicas drop the pair;
+                             already-committed replicas revert (one-deep
+                             history), the fleet converges back to v,
+                             zero in-flight futures dropped
+
+  Every phase emits an obs ``serve`` event (rollout_begin /
+  rollout_commit / rollout_rollback) so a postmortem can reconstruct
+  which versions served when.
+
+Flags: ``BIGDL_SERVE_REPLICAS`` (pool size default),
+``BIGDL_SERVE_SLO_MS`` / ``BIGDL_SERVE_SHED`` (router admission —
+serve/router.py).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import struct
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from bigdl_tpu.serve.engine import (PoisonedRequestError, ServeEngine,
+                                    SheddedError)
+from bigdl_tpu.serve.router import (DeadReplicaError, Router,
+                                    replicas_default)
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+_LEN = struct.Struct(">Q")
+
+#: exception names a worker may report, mapped back to real types so
+#: router retry logic and caller except-clauses behave identically for
+#: local and subprocess replicas
+_EXC_TYPES = {
+    "PoisonedRequestError": PoisonedRequestError,
+    "SheddedError": SheddedError,
+    "ValueError": ValueError,
+    "RuntimeError": RuntimeError,
+    "OSError": OSError,
+}
+
+
+class RolloutError(RuntimeError):
+    """A two-phase weight rollout failed and was rolled back; every
+    replica is serving the PREVIOUS version."""
+
+
+# ---------------------------------------------------------------------------
+# weight store
+# ---------------------------------------------------------------------------
+
+class WeightStore:
+    """Monotonically versioned in-memory checkpoint store for rollouts.
+
+    ``put`` snapshots (params, state) as HOST numpy copies — the
+    training loop's donated device buffers are dead after the next
+    step, so a rollout must never alias them.  Versions only grow;
+    ``get`` of any retained version supports rollback to it."""
+
+    def __init__(self, keep: int = 4):
+        self._lock = threading.Lock()
+        self._versions: dict = {}
+        self._next = 1
+        self.keep = max(2, int(keep))
+
+    def _snapshot(self, tree):
+        import jax
+        return jax.tree_util.tree_map(lambda l: np.array(l), tree)
+
+    def put(self, params, state) -> int:
+        snap = (self._snapshot(params), self._snapshot(state))
+        with self._lock:
+            version = self._next
+            self._next += 1
+            self._versions[version] = snap
+            while len(self._versions) > self.keep:
+                del self._versions[min(self._versions)]
+        return version
+
+    def put_model(self, model) -> int:
+        return self.put(model.params(), model.state())
+
+    def get(self, version: int):
+        with self._lock:
+            if version not in self._versions:
+                raise KeyError(f"weight version {version} not in store "
+                               f"(have {sorted(self._versions)})")
+            return self._versions[version]
+
+    def latest(self) -> int | None:
+        with self._lock:
+            return max(self._versions) if self._versions else None
+
+    def versions(self) -> list:
+        with self._lock:
+            return sorted(self._versions)
+
+
+# ---------------------------------------------------------------------------
+# replicas
+# ---------------------------------------------------------------------------
+
+class LocalReplica:
+    """One in-process ServeEngine wearing the replica surface the
+    router expects (submit/inflight/alive/stats + the rollout verbs)."""
+
+    def __init__(self, engine: ServeEngine, name: str = "local"):
+        self.engine = engine
+        self.name = name
+
+    def submit(self, x) -> Future:
+        return self.engine.submit(x)
+
+    def inflight(self) -> int:
+        return self.engine.inflight()
+
+    def alive(self) -> bool:
+        e = self.engine
+        return (not e._closed and e._assembler.is_alive()
+                and e._compute.is_alive())
+
+    def stats(self) -> dict:
+        return self.engine.stats()
+
+    def weights_version(self) -> int:
+        return self.engine.weights_version
+
+    def stage_weights(self, params, state, version=None):
+        self.engine.stage_weights(params, state, version)
+
+    def commit_weights(self) -> int:
+        return self.engine.commit_weights()
+
+    def rollback_weights(self):
+        self.engine.rollback_weights()
+
+    def revert_weights(self) -> int:
+        return self.engine.revert_weights()
+
+    def close(self, drain: bool = True):
+        self.engine.close(drain=drain)
+
+
+def _write_frame(fh, obj, lock=None):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    if lock is not None:
+        lock.acquire()
+    try:
+        fh.write(_LEN.pack(len(payload)) + payload)
+        fh.flush()
+    finally:
+        if lock is not None:
+            lock.release()
+
+
+def _read_frame(fh):
+    header = fh.read(_LEN.size)
+    if len(header) < _LEN.size:
+        return None
+    (n,) = _LEN.unpack(header)
+    payload = b""
+    while len(payload) < n:
+        chunk = fh.read(n - len(payload))
+        if not chunk:
+            return None
+        payload += chunk
+    return pickle.loads(payload)
+
+
+class ProcessReplica:
+    """A serve replica in its own OS process (its own jax runtime /
+    chip slice).  The parent ships the model once at spawn; requests and
+    rollout verbs ride length-prefixed pickle frames over stdin/stdout.
+    Process death — including a ``BIGDL_FAULTS=serve_kill@...`` chaos
+    kill — fails every outstanding future with :class:`DeadReplicaError`
+    so the router can requeue them on a surviving replica."""
+
+    def __init__(self, model, name: str = "proc", env=None,
+                 spawn_timeout: float = 120.0, **engine_kwargs):
+        self.name = name
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._futures: dict = {}
+        self._ids = iter(range(1, 1 << 62))
+        self._dead = False
+
+        child_env = dict(os.environ)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        child_env["PYTHONPATH"] = (repo_root + os.pathsep
+                                   + child_env.get("PYTHONPATH", ""))
+        if env:
+            child_env.update(env)
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "bigdl_tpu.serve.cluster"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, env=child_env)
+        _write_frame(self.proc.stdin,
+                     {"op": "init", "model": model,
+                      "engine": dict(engine_kwargs)}, self._wlock)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        daemon=True,
+                                        name=f"bigdl-serve-{name}-reader")
+        self._ready = threading.Event()
+        self._reader.start()
+        if not self._ready.wait(spawn_timeout):
+            self.proc.kill()
+            raise TimeoutError(f"replica {name} did not come up in "
+                               f"{spawn_timeout}s")
+        if self._dead:
+            raise RuntimeError(
+                f"replica {name} died during startup (exit code "
+                f"{self.proc.poll()})")
+
+    # -- wire ---------------------------------------------------------------
+    def _read_loop(self):
+        while True:
+            try:
+                msg = _read_frame(self.proc.stdout)
+            except (OSError, ValueError, EOFError, pickle.PickleError):
+                msg = None
+            if msg is None:
+                self._on_death()
+                return
+            if msg.get("op") == "ready":
+                self._ready.set()
+                continue
+            with self._lock:
+                fut = self._futures.pop(msg.get("id"), None)
+            if fut is None:
+                continue
+            if msg.get("ok"):
+                fut.set_result(msg.get("out"))
+            else:
+                cls = _EXC_TYPES.get(msg.get("etype"), RuntimeError)
+                fut.set_exception(cls(msg.get("error", "replica error")))
+
+    def _on_death(self):
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            orphans = list(self._futures.values())
+            self._futures.clear()
+        # release a constructor stuck waiting for the ready frame — a
+        # child that crashes during startup must fail fast, not after
+        # the full spawn timeout (__init__ re-checks _dead)
+        self._ready.set()
+        for fut in orphans:
+            if not fut.done():
+                fut.set_exception(DeadReplicaError(
+                    f"replica {self.name} (pid "
+                    f"{self.proc.pid}) died"))
+
+    def _rpc(self, op: str, timeout: float | None = None, **fields):
+        fut = self._send(op, **fields)
+        return fut.result(timeout=timeout)
+
+    def _send(self, op: str, **fields) -> Future:
+        rid = next(self._ids)
+        fut = Future()
+        with self._lock:
+            if self._dead:
+                fut.set_exception(DeadReplicaError(
+                    f"replica {self.name} is dead"))
+                return fut
+            self._futures[rid] = fut
+        try:
+            _write_frame(self.proc.stdin,
+                         dict(fields, op=op, id=rid), self._wlock)
+        except (OSError, ValueError):
+            self._on_death()
+        return fut
+
+    # -- replica surface ----------------------------------------------------
+    def submit(self, x) -> Future:
+        return self._send("submit", x=np.asarray(x))
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._futures)
+
+    def alive(self) -> bool:
+        return not self._dead and self.proc.poll() is None
+
+    def stats(self) -> dict:
+        return self._rpc("stats", timeout=30.0)
+
+    def weights_version(self) -> int:
+        return self._rpc("version", timeout=30.0)
+
+    def stage_weights(self, params, state, version=None):
+        self._rpc("stage", timeout=120.0, params=params, state=state,
+                  version=version)
+
+    def commit_weights(self) -> int:
+        return self._rpc("commit", timeout=30.0)
+
+    def rollback_weights(self):
+        self._rpc("rollback", timeout=30.0)
+
+    def revert_weights(self) -> int:
+        return self._rpc("revert", timeout=30.0)
+
+    def close(self, drain: bool = True):
+        if self.alive():
+            try:
+                self._rpc("close", timeout=60.0, drain=drain)
+            except Exception:
+                pass
+        try:
+            self.proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+        self._on_death()
+
+
+# ---------------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------------
+
+class ReplicaPool:
+    """N replicas + router + weight store: the serving control plane.
+
+    ``ReplicaPool(model, n_replicas=4)`` builds in-process replicas
+    (each its own ServeEngine and executable set — all riding the
+    shared xcache, so N replicas of one architecture compile each
+    bucket ONCE); ``process=True`` spawns subprocess replicas instead.
+    ``replicas=[...]`` injects pre-built replicas (tests, heterogeneous
+    pools).  Requests flow ``pool.submit(x, priority=, slo_ms=)`` →
+    router admission → least-loaded replica."""
+
+    def __init__(self, model=None, n_replicas: int | None = None,
+                 process: bool = False, replicas=None,
+                 slo_ms: float | None = None, shed: bool | None = None,
+                 est_ms: float = 50.0, store: WeightStore | None = None,
+                 **engine_kwargs):
+        if replicas is None:
+            if model is None:
+                raise ValueError("ReplicaPool needs a model or replicas")
+            n = replicas_default() if n_replicas is None else int(n_replicas)
+            if process:
+                replicas = [ProcessReplica(model, name=f"proc{i}",
+                                           **engine_kwargs)
+                            for i in range(n)]
+            else:
+                replicas = [LocalReplica(ServeEngine(model,
+                                                     **engine_kwargs),
+                                         name=f"local{i}")
+                            for i in range(n)]
+        self.replicas = list(replicas)
+        self.router = Router(self.replicas, slo_ms=slo_ms, shed=shed,
+                             est_ms=est_ms)
+        self.store = store if store is not None else WeightStore()
+
+    # -- request path -------------------------------------------------------
+    def submit(self, x, priority: int = 1,
+               slo_ms: float | None = None) -> Future:
+        return self.router.submit(x, priority=priority, slo_ms=slo_ms)
+
+    def submit_many(self, rows, priority: int = 1,
+                    slo_ms: float | None = None) -> list:
+        return self.router.submit_many(rows, priority=priority,
+                                       slo_ms=slo_ms)
+
+    def predict(self, features) -> np.ndarray:
+        futs = self.submit_many(np.asarray(features))
+        return np.stack([f.result() for f in futs])
+
+    # -- rollout ------------------------------------------------------------
+    def rollout(self, params=None, state=None,
+                version: int | None = None) -> int:
+        """Two-phase hot swap: stage on every live replica, then flip.
+        Pass (params, state) to publish new weights, or ``version`` to
+        roll the fleet to/back to a stored version.  Returns the served
+        version; raises :class:`RolloutError` (after converging every
+        replica back to the prior version) when any replica fails."""
+        from bigdl_tpu.obs import events
+
+        if params is not None:
+            version = self.store.put(params, state)
+        elif version is None:
+            version = self.store.latest()
+            if version is None:
+                raise ValueError("rollout with an empty WeightStore")
+        params, state = self.store.get(version)
+        reps = self.router.live_replicas()
+        if not reps:
+            raise RolloutError("no live replica to roll out to")
+        events.emit("serve", kind="rollout_begin", version=version,
+                    replicas=len(reps))
+
+        staged = []
+        try:
+            for r in reps:
+                r.stage_weights(params, state, version)
+                staged.append(r)
+        except Exception as e:
+            for r in staged:
+                try:
+                    r.rollback_weights()
+                except Exception:  # pragma: no cover - replica died too
+                    pass
+            events.emit("serve", kind="rollout_rollback", version=version,
+                        phase="stage", error=f"{type(e).__name__}: {e}")
+            raise RolloutError(
+                f"stage phase failed on replica "
+                f"{getattr(reps[len(staged)], 'name', '?')}: {e}") from e
+
+        committed = []
+        try:
+            for r in reps:
+                r.commit_weights()
+                committed.append(r)
+        except Exception as e:
+            # converge BACK: flip committed replicas to the previous
+            # pair, drop the stage on the rest — no mixed-version fleet
+            for r in committed:
+                try:
+                    r.revert_weights()
+                except Exception:  # pragma: no cover
+                    pass
+            for r in reps[len(committed):]:
+                try:
+                    r.rollback_weights()   # no-op when already consumed
+                except Exception:  # pragma: no cover
+                    pass
+            events.emit("serve", kind="rollout_rollback", version=version,
+                        phase="commit", error=f"{type(e).__name__}: {e}")
+            raise RolloutError(
+                f"commit phase failed; fleet reverted: {e}") from e
+
+        events.emit("serve", kind="rollout_commit", version=version,
+                    replicas=len(committed))
+        return version
+
+    # -- telemetry / lifecycle ----------------------------------------------
+    def stats(self) -> dict:
+        out = {"router": self.router.stats(), "replicas": []}
+        for r in self.replicas:
+            entry = {"name": getattr(r, "name", repr(r)),
+                     "alive": False}
+            try:
+                entry["alive"] = r.alive()
+                if entry["alive"]:
+                    entry.update(r.stats())
+            except Exception:  # pragma: no cover - racing a death
+                pass
+            out["replicas"].append(entry)
+        return out
+
+    def drain(self, timeout: float = 60.0):
+        self.router.drain(timeout)
+        return self
+
+    def close(self, drain: bool = True):
+        if drain:
+            try:
+                self.router.drain()
+            except TimeoutError:  # pragma: no cover - shutdown path
+                pass
+        self.router.close()
+        for r in self.replicas:
+            try:
+                r.close(drain=drain)
+            except Exception:  # pragma: no cover
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# subprocess replica worker
+# ---------------------------------------------------------------------------
+
+def replica_main(stdin=None, stdout=None):
+    """Entry point of a ProcessReplica child: host one ServeEngine and
+    answer frames until EOF/close.  Runs with its own jax runtime
+    (platform via ``BIGDL_SERVE_WORKER_PLATFORM``, default cpu — on a
+    real fleet each replica process owns its accelerator slice).
+
+    ``BIGDL_FAULTS=serve_kill@at=N[,proc=...]`` kills this process at
+    the Nth submitted request (``os._exit``) — the chaos drill for the
+    router's requeue-on-replica-death path."""
+    stdin = stdin or sys.stdin.buffer
+    stdout = stdout or sys.stdout.buffer
+
+    import jax
+    platform = os.environ.get("BIGDL_SERVE_WORKER_PLATFORM", "cpu")
+    jax.config.update("jax_platforms", platform)
+    if platform == "cpu":
+        from bigdl_tpu.utils.engine import set_cpu_device_count
+        set_cpu_device_count(
+            int(os.environ.get("BIGDL_SERVE_WORKER_DEVICES", "1")))
+        jax.config.update("jax_default_matmul_precision", "highest")
+    os.environ.setdefault("BIGDL_CHECK_SINGLETON", "0")
+
+    init = _read_frame(stdin)
+    if init is None or init.get("op") != "init":
+        return 2
+    from bigdl_tpu.resilience import faults
+    injector = faults.get()
+    engine = ServeEngine(init["model"], **init.get("engine", {}))
+    wlock = threading.Lock()
+    _write_frame(stdout, {"op": "ready", "pid": os.getpid()}, wlock)
+
+    def reply(rid, fut):
+        try:
+            out = fut.result()
+            _write_frame(stdout, {"id": rid, "ok": True, "out": out},
+                         wlock)
+        except BaseException as e:
+            _write_frame(stdout, {"id": rid, "ok": False,
+                                  "etype": type(e).__name__,
+                                  "error": str(e)}, wlock)
+
+    while True:
+        msg = _read_frame(stdin)
+        if msg is None:
+            break
+        op, rid = msg.get("op"), msg.get("id")
+        try:
+            if op == "submit":
+                # chaos site keyed by the per-site query counter: the
+                # Nth submitted request kills this replica mid-stream
+                if (injector is not None and injector.armed("serve_kill")
+                        and injector.fires("serve_kill")):
+                    sys.stdout.flush()
+                    os._exit(1)   # induced replica death (chaos drill)
+                fut = engine.submit(msg["x"])
+                fut.add_done_callback(
+                    lambda f, r=rid: reply(r, f))
+            elif op == "stats":
+                _write_frame(stdout, {"id": rid, "ok": True,
+                                      "out": engine.stats()}, wlock)
+            elif op == "version":
+                _write_frame(stdout, {"id": rid, "ok": True,
+                                      "out": engine.weights_version},
+                             wlock)
+            elif op == "stage":
+                engine.stage_weights(msg["params"], msg["state"],
+                                     msg.get("version"))
+                _write_frame(stdout, {"id": rid, "ok": True,
+                                      "out": None}, wlock)
+            elif op == "commit":
+                _write_frame(stdout, {"id": rid, "ok": True,
+                                      "out": engine.commit_weights()},
+                             wlock)
+            elif op == "rollback":
+                engine.rollback_weights()
+                _write_frame(stdout, {"id": rid, "ok": True,
+                                      "out": None}, wlock)
+            elif op == "revert":
+                _write_frame(stdout, {"id": rid, "ok": True,
+                                      "out": engine.revert_weights()},
+                             wlock)
+            elif op == "close":
+                engine.close(drain=msg.get("drain", True))
+                _write_frame(stdout, {"id": rid, "ok": True,
+                                      "out": None}, wlock)
+                return 0
+            else:
+                _write_frame(stdout, {"id": rid, "ok": False,
+                                      "etype": "ValueError",
+                                      "error": f"unknown op {op!r}"},
+                             wlock)
+        except BaseException as e:
+            _write_frame(stdout, {"id": rid, "ok": False,
+                                  "etype": type(e).__name__,
+                                  "error": str(e)}, wlock)
+    engine.close(drain=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(replica_main())

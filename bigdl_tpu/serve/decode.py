@@ -25,6 +25,19 @@ B rows as **slots**:
 Greedy decoding only (the serial oracle is ``lm_decode(greedy=True)``;
 sampling needs per-slot key streams, which would change the draw order
 vs the serial scan and break the bit-parity contract).
+
+**Tensor-parallel serving** (``mesh=``): a model whose KV slab + weights
+outgrow one chip's HBM serves by sharding the decode step over the
+mesh's ``model`` axis (``parallel/mesh.hybrid_mesh``) with
+``parallel/compat.shard_map`` — Megatron-style: attention heads and the
+FFN hidden dim split across shards (wq/wk/wv columns + KV-cache head
+dim; lin1 rows), each branch's output projection psum-merges once, and
+everything else (embeddings, LayerNorms, the LM head) replicates.  The
+per-head math is untouched, so TP decode is token-identical to the
+single-device driver — the parity contract ``tests/test_serve_cluster.py``
+asserts.  The step/admit/retire programs are warmed at construction
+through the shared executable cache (``serve/xcache.py``), so admission
+under TP stays compile-free exactly like the single-chip path.
 """
 from __future__ import annotations
 
@@ -46,6 +59,39 @@ def sync_interval_default() -> int:
         return max(1, int(os.environ.get(ENV_SYNC, DEFAULT_SYNC)))
     except ValueError:
         return DEFAULT_SYNC
+
+
+def _tp_weight_specs(handles, ax: str):
+    """PartitionSpec tree mirroring the decode weight pytree for
+    Megatron head/hidden sharding over mesh axis ``ax``:
+
+    - attention: wq/wk/wv split on their OUTPUT columns (head-major, so
+      a shard holds whole heads) with the matching bias slices; wo
+      splits on its input rows; bo replicates (added once, post-psum);
+    - FFN: lin1 (hidden, d) splits hidden rows + bias, lin2 (d, hidden)
+      splits hidden columns, its bias replicates;
+    - embeddings, LayerNorms and the LM head replicate.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def rep(tree):
+        return jax.tree_util.tree_map(lambda _: P(), tree)
+
+    attn = {"wq": P(None, ax), "wk": P(None, ax), "wv": P(None, ax),
+            "bq": P(ax), "bk": P(ax), "bv": P(ax),
+            "wo": P(ax, None), "bo": P()}
+    blocks = []
+    for (ln1, m, ln2, lin1, lin2) in handles.blocks:
+        if set(m) != set(attn):
+            raise ValueError(
+                f"attention param keys {sorted(m)} diverged from the TP "
+                f"sharding map {sorted(attn)} — update _tp_weight_specs")
+        blocks.append((rep(ln1), dict(attn), rep(ln2),
+                       {"weight": P(ax, None), "bias": P(ax)},
+                       {"weight": P(None, ax), "bias": P()}))
+    return {"emb": rep(handles.emb), "blocks": blocks,
+            "ln_f": rep(handles.ln_f), "head": rep(handles.head)}
 
 
 class _DecodeReq:
@@ -73,12 +119,14 @@ class ContinuousDecoder:
     """
 
     def __init__(self, model, max_slots: int = 4, n_pos: int = 64,
-                 sync_interval: int | None = None):
+                 sync_interval: int | None = None, mesh=None):
         import jax
         import jax.numpy as jnp
 
         from bigdl_tpu.models.transformer import (_lm_forward_one,
                                                   _lm_handles)
+        from bigdl_tpu.optim.local_optimizer import _model_fingerprint
+        from bigdl_tpu.serve import xcache
 
         self.model = model
         self.B = int(max_slots)
@@ -92,19 +140,87 @@ class ContinuousDecoder:
         B, n_pos = self.B, self.n_pos
         L, H, hd = handles.n_layers, handles.n_heads, handles.hd
 
-        def step(kc, vc, pos, prev, active, seeds, seed_len, gen):
+        self.mesh = mesh
+        self.tp = (int(mesh.shape["model"])
+                   if mesh is not None and "model" in mesh.axis_names
+                   else 1)
+        fp = _model_fingerprint(model)
+
+        def step_body(local_handles, kc, vc, pos, prev, active, seeds,
+                      seed_len, gen, tp_axis=None):
             rows = jnp.arange(B)
             live = active & (pos < n_pos)
             wp = jnp.clip(pos, 0, n_pos - 1)
             tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
             logp, (kc, vc) = _lm_forward_one(
-                tok.astype(jnp.int32), wp, (kc, vc), handles, n_pos, pe)
+                tok.astype(jnp.int32), wp, (kc, vc), local_handles,
+                n_pos, pe, tp_axis=tp_axis)
             nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
             # parked/finished slots must not advance or write tokens
             gen = gen.at[rows, wp].set(jnp.where(live, nxt, gen[rows, wp]))
             prev = jnp.where(live, nxt, prev)
             pos = jnp.where(live, pos + 1, pos)
             return kc, vc, pos, prev, gen
+
+        if self.tp > 1:
+            # Megatron head/hidden sharding over the mesh's "model"
+            # axis: the step body runs inside shard_map on LOCAL weight
+            # shards (passed as an argument pytree — constants cannot
+            # shard), with the KV caches split on their head dim.
+            if H % self.tp:
+                raise ValueError(
+                    f"tensor parallelism {self.tp} must divide "
+                    f"n_heads={H}")
+            for li, (_, _, _, lin1, _) in enumerate(handles.blocks):
+                hidden = int(lin1["weight"].shape[0])
+                if hidden % self.tp:
+                    raise ValueError(
+                        f"tensor parallelism {self.tp} must divide the "
+                        f"FFN hidden dim ({hidden}, block {li})")
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            from bigdl_tpu.parallel import compat
+
+            ax = "model"
+            wspec = _tp_weight_specs(handles, ax)
+            # weights pinned to the mesh ONCE, pre-sharded per the spec:
+            # passing host arrays each step would re-ship the whole
+            # model H2D per decode step
+            self._W = jax.device_put(
+                {"emb": handles.emb, "blocks": handles.blocks,
+                 "ln_f": handles.ln_f, "head": handles.head},
+                jax.tree_util.tree_map(
+                    lambda sp: NamedSharding(mesh, sp), wspec))
+            cache = P(None, None, None, ax)
+            rep = P()
+            H_local = H // self.tp
+
+            def step_tp(W, kc, vc, pos, prev, active, seeds, seed_len,
+                        gen):
+                local = handles._replace(
+                    mods=None, emb=W["emb"], blocks=W["blocks"],
+                    ln_f=W["ln_f"], head=W["head"], n_heads=H_local)
+                return step_body(local, kc, vc, pos, prev, active,
+                                 seeds, seed_len, gen, tp_axis=ax)
+
+            sharded = compat.shard_map(
+                step_tp, mesh=mesh,
+                in_specs=(wspec, cache, cache, rep, rep, rep, rep, rep,
+                          rep),
+                out_specs=(cache, cache, rep, rep, rep))
+            self._step = xcache.tracked_jit(
+                sharded, ("decode_step", fp, B, n_pos, "tp%d" % self.tp),
+                mesh=mesh)
+        else:
+            self._W = None
+
+            def step(kc, vc, pos, prev, active, seeds, seed_len, gen):
+                return step_body(handles, kc, vc, pos, prev, active,
+                                 seeds, seed_len, gen)
+
+            self._step = xcache.tracked_jit(
+                step, ("decode_step", fp, B, n_pos))
 
         def admit(kc, vc, pos, active, seeds, seed_len, gen, slot,
                   seed_row, s_len):
@@ -120,9 +236,25 @@ class ContinuousDecoder:
         def retire(active, slot):
             return active.at[slot].set(False)
 
-        self._step = jax.jit(step)
-        self._admit_fn = jax.jit(admit)
-        self._retire_fn = jax.jit(retire)
+        if self.tp > 1:
+            # admit/retire ride the SAME shard_map layout as the step:
+            # mixing plain-jit programs into the carry chain would hand
+            # the step differently-placed inputs on some paths and cost
+            # a silent recompile per (program, sharding) combination
+            from bigdl_tpu.parallel import compat
+            cache, rep = P(None, None, None, "model"), P()
+            admit = compat.shard_map(
+                admit, mesh=mesh,
+                in_specs=(cache, cache, rep, rep, rep, rep, rep, rep,
+                          rep, rep),
+                out_specs=(cache, cache, rep, rep, rep, rep, rep))
+            retire = compat.shard_map(retire, mesh=mesh,
+                                      in_specs=(rep, rep),
+                                      out_specs=rep)
+        self._admit_fn = xcache.tracked_jit(
+            admit, ("decode_admit", fp, B, n_pos), mesh=mesh)
+        self._retire_fn = xcache.tracked_jit(
+            retire, ("decode_retire", fp, B), mesh=mesh)
 
         z = jnp.zeros
         self._kc = z((L, B, n_pos, H, hd), jnp.float32)
@@ -142,6 +274,46 @@ class ContinuousDecoder:
         self.host_syncs = 0
         self.admitted = 0
         self.retired = 0
+
+        self._warm()
+
+    def _run_step(self):
+        args = (self._kc, self._vc, self._pos, self._prev, self._active,
+                self._seeds, self._seed_len, self._gen)
+        if self._W is not None:
+            args = (self._W,) + args
+        (self._kc, self._vc, self._pos, self._prev,
+         self._gen) = self._step(*args)
+
+    def _warm(self):
+        """Pre-compile the step/admit/retire programs at construction so
+        admission and decode never hit a cold compile (the serving
+        zero-cold-compile property, docs/serving.md).
+
+        The warm pass cycles the REAL state machine once — step on the
+        fresh slab, admit into slot 0, step on the admit outputs, retire,
+        step again — keeping each program's outputs as the live state, so
+        every (shape, sharding) combination the serving loop will feed
+        each program is compiled here and not mid-stream (jit caches per
+        input sharding; under TP the shard_map step and the plain-jit
+        admit/retire produce differently-placed carries).  The slot-0
+        garbage this writes is erased by ``admit``'s per-slot reset
+        before any real request serves."""
+        import numpy as np
+
+        self._run_step()
+        for _ in range(2):
+            # twice: the first admission's carries are the fresh
+            # host-placed slab, every later admission's are program
+            # outputs — both placement combinations must compile now
+            (self._kc, self._vc, self._pos, self._active, self._seeds,
+             self._seed_len, self._gen) = self._admit_fn(
+                self._kc, self._vc, self._pos, self._active, self._seeds,
+                self._seed_len, self._gen, np.int32(0),
+                np.zeros((self.n_pos,), np.int32), np.int32(0))
+        self._run_step()
+        self._active = self._retire_fn(self._active, np.int32(0))
+        self._run_step()
 
     # -- submit -------------------------------------------------------------
     def submit(self, seed_ids, n_words: int) -> Future:
@@ -189,10 +361,7 @@ class ContinuousDecoder:
             if not live:   # pragma: no cover - defensive
                 break
             for _ in range(self.sync_interval):
-                (self._kc, self._vc, self._pos, self._prev,
-                 self._gen) = self._step(
-                    self._kc, self._vc, self._pos, self._prev,
-                    self._active, self._seeds, self._seed_len, self._gen)
+                self._run_step()
             self.steps += self.sync_interval
             for r in live:
                 r.steps_run += self.sync_interval
@@ -219,22 +388,23 @@ class ContinuousDecoder:
         return {"steps": self.steps, "host_syncs": self.host_syncs,
                 "admitted": self.admitted, "retired": self.retired,
                 "slots": self.B, "n_pos": self.n_pos,
-                "sync_interval": self.sync_interval}
+                "sync_interval": self.sync_interval, "tp": self.tp}
 
 
 def continuous_decode(model, seed_rows, n_words, max_slots: int = 4,
                       n_pos: int | None = None,
-                      sync_interval: int | None = None):
+                      sync_interval: int | None = None, mesh=None):
     """Convenience one-shot: decode every seed row with a shared slab.
 
     ``n_pos`` defaults to the largest request's need, so a mixed set of
-    seed lengths shares one compiled step.  Returns the extended rows in
+    seed lengths shares one compiled step.  ``mesh`` (with a ``model``
+    axis) serves tensor-parallel.  Returns the extended rows in
     submission order (``lm_decode`` greedy semantics per row)."""
     reqs = [np.asarray(s, np.int32) for s in seed_rows]
     if n_pos is None:
         n_pos = max(int(s.size) + int(n_words) - 1 for s in reqs)
     dec = ContinuousDecoder(model, max_slots=max_slots, n_pos=n_pos,
-                            sync_interval=sync_interval)
+                            sync_interval=sync_interval, mesh=mesh)
     futs = [dec.submit(s, n_words) for s in reqs]
     dec.run()
     return [f.result() for f in futs]

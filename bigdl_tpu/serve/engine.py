@@ -101,6 +101,13 @@ class PoisonedRequestError(ValueError):
     fails — the rest of the micro-batch is served normally."""
 
 
+class SheddedError(RuntimeError):
+    """The request was rejected by admission control (engine queue bound
+    or router overload policy) instead of being served past its
+    deadline.  Carries no partial result; the caller may retry against
+    a less-loaded endpoint."""
+
+
 class ServeEngine:
     """Dynamic-batching inference engine over one model.
 
@@ -122,7 +129,8 @@ class ServeEngine:
 
     def __init__(self, model, max_batch: int | None = None,
                  max_wait_ms: float | None = None, policy=None,
-                 input_shape=None, input_dtype=np.float32):
+                 input_shape=None, input_dtype=np.float32,
+                 max_queue: int | None = None):
         import jax
 
         self.model = model
@@ -130,14 +138,27 @@ class ServeEngine:
                           else max(1, int(max_batch)))
         self.max_wait_s = (max_wait_ms_default() if max_wait_ms is None
                            else max(0.0, float(max_wait_ms))) / 1e3
+        #: admission bound: a submit seeing this many queued requests is
+        #: shed (fails fast with SheddedError) instead of growing the
+        #: backlog past any deadline.  None/0 = unbounded (the default;
+        #: the router is the usual shedding layer — docs/serving.md).
+        self.max_queue = int(max_queue) if max_queue else None
         self.buckets = bucketing.bucket_sizes(self.max_batch)
         self._policy = policy
-        self._params = jax.device_put(model.params())
-        self._state = jax.device_put(model.state())
+        # (params, state) swap as ONE tuple so a refresh/commit racing
+        # the compute thread can never pair new params with old state —
+        # the half-swap audit tests/test_serve.py holds refresh() to
+        self._weights = (jax.device_put(model.params()),
+                         jax.device_put(model.state()))
+        self.weights_version = 0
+        self._staged = None      # (version, (params, state)) or None
+        self._prev_weights = None  # one-deep history for revert_weights
 
-        # ONE compiled-forward path per model: the same cached jitted
-        # eval fn the validators use (optim.local_optimizer._eval_fn),
-        # so a process that validates AND serves traces it once
+        # ONE compiled-forward path per model: the same xcache-backed
+        # eval fn the validators use (optim.local_optimizer._eval_fn) —
+        # warmup resolves each bucket through the SHARED executable
+        # cache (serve/xcache.py), so a process that validates AND
+        # serves a common (model, shape) pair compiles it exactly once
         from bigdl_tpu.optim.local_optimizer import _eval_fn
         self._fwd = _eval_fn(model)
         self._executables: dict = {}   # bucket -> compiled executable
@@ -150,12 +171,20 @@ class ServeEngine:
         self._h2d_q: "queue.Queue" = queue.Queue(maxsize=_STAGE_DEPTH)
         self._exec_q: "queue.Queue" = queue.Queue(maxsize=_STAGE_DEPTH)
 
-        # telemetry (guarded by _lock)
+        # telemetry (guarded by _lock).  accepted/shed/completed/failed
+        # are MONOTONIC from construction and never reset — the router
+        # rate-differences consecutive stats() snapshots, so a reset
+        # would read as a huge negative rate.  completed+failed+inflight
+        # == accepted at every instant; shed requests are counted in
+        # none of the other three (their futures fail without entering
+        # the pipeline).
         self._inflight = 0       # submitted, future not yet resolved
-        self.compiles = 0
-        self.served = 0
+        self.compiles = 0        # executables installed for this engine
+        self.accepted = 0
+        self.shed = 0
+        self.served = 0          # rows completed OK (alias: completed)
         self.batches = 0
-        self.errors = 0
+        self.errors = 0          # rows failed (alias: failed)
         self._latencies = deque(maxlen=_LATENCY_WINDOW)
         self._bucket_hits = {b: 0 for b in self.buckets}
         self._max_queue_depth = 0
@@ -200,24 +229,30 @@ class ServeEngine:
                     f"{self._row_dtype}, not {row_shape} {row_dtype}")
         fresh = 0
         from bigdl_tpu import tensor as bt
+        from bigdl_tpu.serve import xcache
         prev = bt.policy()
         if self._policy is not None:
             bt.set_policy(self._policy)
         try:
+            params, state = self._weights
             for b in self.buckets:
                 if b in self._executables:
                     continue
                 spec = jax.ShapeDtypeStruct((b,) + row_shape, row_dtype)
                 t0 = time.perf_counter()
-                exe = self._fwd.lower(self._params, self._state,
-                                      spec).compile()
+                # resolve through the SHARED executable cache: another
+                # engine over the same architecture, or a validator pass
+                # at this batch shape, already paid this compile
+                exe, built = xcache.get().get_or_compile(
+                    self._fwd.jitted, self._fwd.fn_key,
+                    (params, state, spec))
                 dt = time.perf_counter() - t0
                 with self._lock:
                     self._executables[b] = exe
                     self.compiles += 1
                 fresh += 1
-                logger.info("serve warmup: bucket %d compiled in %.3fs",
-                            b, dt)
+                logger.info("serve warmup: bucket %d %s in %.3fs", b,
+                            "compiled" if built else "cache hit", dt)
         finally:
             if self._policy is not None:
                 bt.set_policy(prev)
@@ -230,13 +265,85 @@ class ServeEngine:
         afterwards does NOT change what is served until this is called.
         Shapes/dtypes must be unchanged, so the per-bucket executables
         (which take params as arguments, not constants) are reused:
-        refresh never recompiles."""
-        import jax
-        params = jax.device_put(self.model.params())
-        state = jax.device_put(self.model.state())
-        with self._lock:
-            self._params, self._state = params, state
+        refresh never recompiles.  Implemented as stage+commit, so it is
+        atomic against concurrent submits (no future ever observes new
+        params paired with old state)."""
+        self.stage_weights(self.model.params(), self.model.state())
+        self.commit_weights()
         return self
+
+    # -- versioned hot swap (serve/cluster.py rollout protocol) -------------
+    def stage_weights(self, params, state, version: int | None = None):
+        """Phase 1 of a rollout: pin a new (params, state) pair to device
+        WITHOUT serving it.  Serving continues on the committed weights;
+        a staged pair costs HBM but no latency.  Shapes must match the
+        warmed executables (params are executable ARGUMENTS)."""
+        import jax
+        cur = self._weights[0]
+        if jax.tree_util.tree_structure(params) != \
+                jax.tree_util.tree_structure(cur):
+            raise ValueError("staged params tree does not match the "
+                             "serving model's structure")
+        # leaf shapes/dtypes must match too: the warmed executables take
+        # params as ARGUMENTS at fixed avals, so a wrong-width stage
+        # that committed would fail EVERY later batch instead of this
+        # rollout (defeating the converge-back-on-failure protocol)
+        def _dt(leaf):
+            return np.dtype(getattr(leaf, "dtype", type(leaf)))
+
+        for new, old in zip(jax.tree_util.tree_leaves(params),
+                            jax.tree_util.tree_leaves(cur)):
+            if np.shape(new) != np.shape(old) or _dt(new) != _dt(old):
+                raise ValueError(
+                    f"staged param leaf {np.shape(new)} {_dt(new)} does "
+                    f"not match the served {np.shape(old)} {_dt(old)}")
+        staged = (jax.device_put(params), jax.device_put(state))
+        with self._lock:
+            if version is None:
+                version = self.weights_version + 1
+            # note: version may be LOWER than the serving version — a
+            # rollback-by-version rollout intentionally serves an older
+            # store entry; only the WeightStore numbering is monotonic
+            self._staged = (int(version), staged)
+        return self
+
+    def commit_weights(self) -> int:
+        """Phase 2: atomically flip serving to the staged weights.  The
+        swap is one tuple assignment under the lock — in-flight batches
+        finish on the version they captured; every batch assembled after
+        this call serves the new version.  Returns the new version."""
+        with self._lock:
+            if self._staged is None:
+                raise RuntimeError("commit_weights without stage_weights")
+            version, staged = self._staged
+            self._prev_weights = (self.weights_version, self._weights)
+            self._weights = staged
+            self.weights_version = version
+            self._staged = None
+        self._emit("weights_commit", version=version)
+        return version
+
+    def rollback_weights(self):
+        """Drop a staged-but-uncommitted pair (rollout aborted before
+        the flip).  No-op when nothing is staged."""
+        with self._lock:
+            self._staged = None
+        return self
+
+    def revert_weights(self) -> int:
+        """Undo the LAST commit (one-deep history): flip back to the
+        previously served pair.  The rollout coordinator uses this when
+        a peer replica fails mid-commit, so the fleet converges back to
+        one version with zero dropped futures."""
+        with self._lock:
+            if self._prev_weights is None:
+                raise RuntimeError("revert_weights without a prior commit")
+            version, weights = self._prev_weights
+            self._weights = weights
+            self.weights_version = version
+            self._prev_weights = None
+        self._emit("weights_revert", version=version)
+        return version
 
     # -- submit side --------------------------------------------------------
     def submit(self, x) -> Future:
@@ -252,14 +359,26 @@ class ServeEngine:
         # under the same lock, so a request can never slip into the
         # queue after close()'s final leftover drain (its future would
         # hang forever)
+        shed = False
         with self._lock:
             if self._closed:
                 raise RuntimeError("ServeEngine is closed")
-            self._inflight += 1
             depth = self._queue.qsize() + 1
-            if depth > self._max_queue_depth:
-                self._max_queue_depth = depth
-            self._queue.put(req)   # unbounded put: never blocks
+            if self.max_queue is not None and depth > self.max_queue:
+                # admission shed: fail fast instead of queuing past any
+                # deadline; the future fails, the pipeline never sees it
+                self.shed += 1
+                shed = True
+            else:
+                self.accepted += 1
+                self._inflight += 1
+                if depth > self._max_queue_depth:
+                    self._max_queue_depth = depth
+                self._queue.put(req)   # unbounded put: never blocks
+        if shed:
+            self._emit("shed", queue_depth=self.max_queue)
+            req.future.set_exception(SheddedError(
+                f"engine queue full ({self.max_queue} requests)"))
         return req.future
 
     def submit_many(self, rows) -> list:
@@ -396,7 +515,11 @@ class ServeEngine:
                     # the whole ladder NOW so this is the last cold stop
                     self.warmup(tuple(xdev.shape[1:]), xdev.dtype)
                     exe = self._executables[bucket]
-                out = np.asarray(exe(self._params, self._state, xdev))
+                # ONE read of the (params, state) tuple: a concurrent
+                # refresh/commit swaps the whole pair atomically, so a
+                # batch always serves a consistent weight version
+                params, state = self._weights
+                out = np.asarray(exe(params, state, xdev))
             except BaseException as e:
                 self._fail(reqs, e)
                 continue
@@ -433,15 +556,35 @@ class ServeEngine:
             return {f"p{int(q)}": None for q in qs}
         return {f"p{int(q)}": float(np.percentile(lat, q)) for q in qs}
 
+    def inflight(self) -> int:
+        """Requests accepted but not yet resolved (the router's
+        least-loaded signal)."""
+        with self._lock:
+            return self._inflight
+
     def stats(self) -> dict:
         """Snapshot: latency percentiles (seconds), queue depth, bucket
-        hit counts, compile count, served/error totals."""
+        hit counts, compile count, and the four monotonic admission
+        counters (``accepted``/``shed``/``completed``/``failed``).
+
+        Counter semantics: monotonic from engine construction, NEVER
+        reset — rate-difference two snapshots to get a rate (the router
+        does exactly that).  ``completed + failed + inflight ==
+        accepted`` at every instant; shed requests appear only in
+        ``shed``.  ``served``/``errors`` are the pre-router aliases of
+        completed/failed and stay for compatibility."""
         with self._lock:
             out = {
+                "accepted": self.accepted,
+                "shed": self.shed,
+                "completed": self.served,
+                "failed": self.errors,
+                "inflight": self._inflight,
                 "served": self.served,
                 "batches": self.batches,
                 "errors": self.errors,
                 "compiles": self.compiles,
+                "weights_version": self.weights_version,
                 "queue_depth": self._queue.qsize(),
                 "max_queue_depth": self._max_queue_depth,
                 "bucket_hits": dict(self._bucket_hits),

@@ -1,0 +1,420 @@
+"""SLO-aware admission router over a pool of serve replicas
+(docs/serving.md "Control plane").
+
+The single-engine queue (serve/engine.py) maximizes one chip; a fleet
+needs the layer the reference delegated to Spark's scheduler: one
+admission point in front of N replicas that decides *which* replica
+serves a request, *when* a request is hopeless and must be shed instead
+of served late, and *what* happens to requests parked on a replica that
+died.  This router is that layer, in the Orca/continuous-batching
+lineage (Yu et al., OSDI'22) reduced to the machinery the repo already
+has:
+
+- **Priority + deadline admission queue**: every request carries a
+  priority class (lower = more urgent) and an absolute deadline derived
+  from its SLO (``BIGDL_SERVE_SLO_MS`` default, per-request override).
+  The dispatch order is (priority, deadline, arrival) — urgent classes
+  drain first, EDF inside a class.
+- **Least-loaded dispatch**: the next request goes to the live replica
+  with the fewest outstanding requests (the ``engine.stats()``
+  queue-depth/inflight signal, rate-differenced via the monotonic
+  accepted/completed counters).
+- **Shed-on-overload** (``BIGDL_SERVE_SHED``, default on): a request
+  whose remaining deadline budget is smaller than the current service
+  estimate is failed *now* with :class:`SheddedError` instead of being
+  served past its deadline.  Because high-priority requests dispatch
+  first, overload sheds the lowest classes first — the
+  shed-before-deadline-miss ordering the overload test pins.
+- **Requeue-on-replica-death**: a replica failing with
+  :class:`DeadReplicaError` (or found dead by the health monitor — the
+  watchdog-style liveness probe) has its outstanding requests pushed
+  back into the admission queue and retried on a surviving replica, so
+  a dead replica fails no future another replica can serve.  Genuine
+  model errors (poisoned rows, shape mismatches) are NOT retried — they
+  would fail identically anywhere.
+
+The router never touches jax: replicas are anything with the small
+``submit/stats/inflight/alive`` surface (``serve/cluster.py`` provides
+in-process and subprocess implementations).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import os
+import threading
+import time
+from concurrent.futures import Future
+
+from bigdl_tpu.serve.engine import SheddedError  # noqa: F401 (re-export)
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+ENV_REPLICAS = "BIGDL_SERVE_REPLICAS"
+ENV_SLO_MS = "BIGDL_SERVE_SLO_MS"
+ENV_SHED = "BIGDL_SERVE_SHED"
+
+DEFAULT_REPLICAS = 2
+DEFAULT_SLO_MS = 0.0       # 0 = no deadline unless the request sets one
+#: EWMA weight for the service-time estimate the shed policy uses
+_EST_ALPHA = 0.2
+
+
+def replicas_default() -> int:
+    try:
+        return max(1, int(os.environ.get(ENV_REPLICAS, DEFAULT_REPLICAS)))
+    except ValueError:
+        return DEFAULT_REPLICAS
+
+
+def slo_ms_default() -> float:
+    try:
+        return max(0.0, float(os.environ.get(ENV_SLO_MS, DEFAULT_SLO_MS)))
+    except ValueError:
+        return DEFAULT_SLO_MS
+
+
+def shed_default() -> bool:
+    return os.environ.get(ENV_SHED, "1") != "0"
+
+
+class DeadReplicaError(RuntimeError):
+    """The replica holding this request died before resolving it; the
+    router requeues such requests onto a surviving replica."""
+
+
+class _RouterReq:
+    __slots__ = ("x", "future", "priority", "deadline", "t_submit",
+                 "attempts", "queued")
+
+    def __init__(self, x, priority, deadline):
+        self.x = x
+        self.future = Future()
+        self.priority = int(priority)
+        self.deadline = deadline          # absolute perf_counter, or None
+        self.t_submit = time.perf_counter()
+        self.attempts = 0
+        #: True while sitting in the admission heap — the idempotence
+        #: guard for requeue-on-death (a dying replica's request can be
+        #: seen BOTH by its failing future and by the orphan sweep)
+        self.queued = False
+
+
+class Router:
+    """Admission queue + dispatcher + health monitor over ``replicas``.
+
+    ``slo_ms``: default deadline for requests that don't set one (0 =
+    none).  ``shed``: enable the overload policy.  ``est_ms`` seeds the
+    service-time estimate before any completion has been observed.
+    ``max_requeues``: attempts per request across replica deaths before
+    the router gives up (a pool losing every replica must still fail
+    futures, not hang them).
+    """
+
+    def __init__(self, replicas, slo_ms: float | None = None,
+                 shed: bool | None = None, est_ms: float = 50.0,
+                 max_requeues: int = 3, health_interval: float = 0.2):
+        if not replicas:
+            raise ValueError("Router needs at least one replica")
+        self.replicas = list(replicas)
+        self.slo_s = (slo_ms_default() if slo_ms is None
+                      else max(0.0, float(slo_ms))) / 1e3
+        self.shed_enabled = shed_default() if shed is None else bool(shed)
+        self.max_requeues = int(max_requeues)
+        self._est_s = max(float(est_ms), 0.0) / 1e3
+        self._seq = itertools.count()
+
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._heap: list = []        # (priority, deadline, seq, req)
+        self._outstanding: dict = {id(r): {} for r in self.replicas}
+        self._dispatching = 0   # popped from the heap, not yet routed
+        self._dead: set = set()
+        self._closed = False
+
+        # monotonic counters (stats(); never reset — see engine.stats)
+        self.accepted = 0
+        self.shed = 0
+        self.completed = 0
+        self.failed = 0
+        self.requeued = 0
+
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="bigdl-serve-router")
+        self._health = threading.Thread(
+            target=self._health_loop, args=(health_interval,),
+            daemon=True, name="bigdl-serve-router-health")
+        self._dispatcher.start()
+        self._health.start()
+        self._emit("router_start", replicas=len(self.replicas),
+                   slo_ms=self.slo_s * 1e3, shed=self.shed_enabled)
+
+    # -- submit -------------------------------------------------------------
+    def submit(self, x, priority: int = 1,
+               slo_ms: float | None = None) -> Future:
+        """Queue one row; returns a future resolving to its output.
+        ``priority``: lower = more urgent (0 is the most urgent class).
+        ``slo_ms`` overrides the router default; ``None``+default-0
+        means no deadline (the request is never shed)."""
+        slo_s = self.slo_s if slo_ms is None else max(0.0, slo_ms) / 1e3
+        deadline = (time.perf_counter() + slo_s) if slo_s > 0 else None
+        req = _RouterReq(x, priority, deadline)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("Router is closed")
+            self.accepted += 1
+            self._push(req)
+            self._cv.notify()
+        return req.future
+
+    def submit_many(self, rows, priority: int = 1,
+                    slo_ms: float | None = None) -> list:
+        return [self.submit(r, priority, slo_ms) for r in rows]
+
+    def _push(self, req):
+        """Queue (or re-queue) under the lock; no-ops on a request that
+        is already queued or already resolved."""
+        if req.queued or req.future.done():
+            return False
+        req.queued = True
+        # None deadlines sort last inside their class
+        dl = req.deadline if req.deadline is not None else float("inf")
+        heapq.heappush(self._heap, (req.priority, dl, next(self._seq),
+                                    req))
+        return True
+
+    # -- dispatch -----------------------------------------------------------
+    def _dispatch_loop(self):
+        while True:
+            with self._cv:
+                while not self._heap and not self._closed:
+                    self._cv.wait(timeout=0.1)
+                if self._closed and not self._heap:
+                    return
+                _, _, _, req = heapq.heappop(self._heap)
+                req.queued = False
+                # visible to drain() while between heap and outstanding
+                self._dispatching += 1
+                est = self._est_s
+            try:
+                self._route(req, est)
+            finally:
+                with self._lock:
+                    self._dispatching -= 1
+
+    def _route(self, req, est):
+        replica, load = self._pick()
+        if replica is None:
+            self._fail(req, RuntimeError("no live replica in the pool"))
+            return
+        # shed-before-deadline-miss: the projected completion (the
+        # chosen replica's backlog + this request, at the EWMA service
+        # estimate) landing past the deadline fails the future NOW —
+        # the submitter can retry elsewhere — instead of burning
+        # replica time to miss anyway.  High-priority classes dispatch
+        # first, so overload drains budget from the LOWEST class first.
+        if (self.shed_enabled and req.deadline is not None
+                and time.perf_counter() + est * (load + 1) > req.deadline):
+            with self._lock:
+                self.shed += 1
+            self._emit("shed", priority=req.priority,
+                       wait_ms=(time.perf_counter() - req.t_submit) * 1e3)
+            req.future.set_exception(SheddedError(
+                f"projected completion past deadline (priority "
+                f"{req.priority}, backlog {load}, est "
+                f"{est * 1e3:.1f} ms)"))
+            return
+        with self._lock:
+            self._outstanding[id(replica)][id(req)] = req
+        try:
+            inner = replica.submit(req.x)
+        except Exception as e:
+            with self._lock:
+                self._outstanding[id(replica)].pop(id(req), None)
+            self._on_replica_error(replica, req, e)
+            return
+        inner.add_done_callback(
+            lambda f, r=replica, q=req: self._on_done(r, q, f))
+
+    def _pick(self):
+        """Least-loaded live replica (outstanding count through this
+        router + the replica's own inflight signal); returns
+        ``(replica, load)`` or ``(None, 0)``."""
+        best, best_load = None, None
+        with self._lock:
+            dead = set(self._dead)
+            outs = {k: len(v) for k, v in self._outstanding.items()}
+        for r in self.replicas:
+            if id(r) in dead:
+                continue
+            try:
+                if not r.alive():
+                    self._mark_dead(r)
+                    continue
+                load = outs.get(id(r), 0) + r.inflight()
+            except Exception:
+                self._mark_dead(r)
+                continue
+            if best_load is None or load < best_load:
+                best, best_load = r, load
+        return best, (best_load or 0)
+
+    def _on_done(self, replica, req, inner):
+        with self._lock:
+            self._outstanding[id(replica)].pop(id(req), None)
+        exc = inner.exception()
+        if exc is None:
+            lat = time.perf_counter() - req.t_submit
+            with self._lock:
+                self.completed += 1
+                self._est_s += _EST_ALPHA * (lat - self._est_s)
+            if not req.future.done():
+                req.future.set_result(inner.result())
+        else:
+            self._on_replica_error(replica, req, exc)
+
+    def _on_replica_error(self, replica, req, exc):
+        """Requeue when the REPLICA was the problem; fail the future
+        when the REQUEST was (a poisoned row fails identically on every
+        replica — retrying it would serve nothing and hide the error)."""
+        if isinstance(exc, SheddedError):
+            # an engine-level admission shed (max_queue) is a SHED in
+            # the router's taxonomy too, not a failure — the documented
+            # counter contract keeps shed/failed disjoint
+            with self._lock:
+                self.shed += 1
+            if not req.future.done():
+                req.future.set_exception(exc)
+            return
+        replica_died = isinstance(exc, DeadReplicaError)
+        if not replica_died:
+            try:
+                replica_died = not replica.alive()
+            except Exception:
+                replica_died = True
+        if replica_died:
+            self._mark_dead(replica)
+            if req.attempts < self.max_requeues:
+                req.attempts += 1
+                with self._cv:
+                    if self._push(req):
+                        self.requeued += 1
+                        self._cv.notify()
+                return
+        self._fail(req, exc)
+
+    def _fail(self, req, exc):
+        with self._lock:
+            self.failed += 1
+        if not req.future.done():
+            req.future.set_exception(exc)
+
+    # -- health -------------------------------------------------------------
+    def _mark_dead(self, replica):
+        with self._lock:
+            if id(replica) in self._dead:
+                return
+            self._dead.add(id(replica))
+        self._emit("replica_dead",
+                   replica=getattr(replica, "name", repr(replica)))
+        logger.warning("serve router: replica %s marked dead",
+                       getattr(replica, "name", replica))
+        # orphans: requests dispatched to the replica whose futures will
+        # never resolve (a clean DeadReplicaError failure goes through
+        # _on_done instead and finds this dict already empty)
+        with self._lock:
+            orphans = list(self._outstanding.get(id(replica), {}).values())
+            self._outstanding[id(replica)] = {}
+        for req in orphans:
+            if req.future.done() or req.queued:
+                continue
+            if req.attempts < self.max_requeues:
+                req.attempts += 1
+                with self._cv:
+                    if self._push(req):
+                        self.requeued += 1
+                        self._cv.notify()
+            else:
+                self._fail(req, DeadReplicaError(
+                    "replica died and requeue budget is exhausted"))
+
+    def _health_loop(self, interval):
+        """Watchdog-style liveness: probe every replica on a cadence so
+        a silent death (no future ever resolves) still trips requeue."""
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            for r in self.replicas:
+                with self._lock:
+                    if id(r) in self._dead:
+                        continue
+                try:
+                    ok = r.alive()
+                except Exception:
+                    ok = False
+                if not ok:
+                    self._mark_dead(r)
+            time.sleep(interval)
+
+    def live_replicas(self) -> list:
+        with self._lock:
+            dead = set(self._dead)
+        return [r for r in self.replicas if id(r) not in dead]
+
+    # -- telemetry / lifecycle ----------------------------------------------
+    def _emit(self, kind: str, **fields):
+        from bigdl_tpu.obs import events
+        events.emit("serve", kind=kind, **fields)
+
+    def stats(self) -> dict:
+        """Router counters (monotonic, never reset) + queue depth + the
+        current service-time estimate."""
+        with self._lock:
+            return {
+                "accepted": self.accepted,
+                "shed": self.shed,
+                "completed": self.completed,
+                "failed": self.failed,
+                "requeued": self.requeued,
+                "queue_depth": len(self._heap),
+                "est_ms": self._est_s * 1e3,
+                "replicas": len(self.replicas),
+                "dead_replicas": len(self._dead),
+            }
+
+    def drain(self, timeout: float = 60.0):
+        """Block until every accepted request has resolved or been
+        shed."""
+        t0 = time.perf_counter()
+        while True:
+            with self._lock:
+                pending = (len(self._heap) + self._dispatching
+                           + sum(len(v)
+                                 for v in self._outstanding.values()))
+            if pending == 0:
+                return self
+            if time.perf_counter() - t0 > timeout:
+                raise TimeoutError("router drain timed out")
+            time.sleep(0.005)
+
+    def close(self):
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            leftovers = [item[3] for item in self._heap]
+            self._heap = []
+            self._cv.notify_all()
+        for req in leftovers:
+            self._fail(req, RuntimeError("Router closed"))
+        self._dispatcher.join(timeout=10.0)
+        self._emit("router_stop", **self.stats())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

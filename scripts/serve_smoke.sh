@@ -1,24 +1,25 @@
 #!/usr/bin/env bash
-# Serving smoke: the serve-marked suite (dynamic batching, bucketed AOT
-# executable cache, continuous-batching decode, Predictor/validator
-# regressions) plus a 200-request LeNet drill that holds the two serving
-# invariants end to end:
+# Serving smoke: the serve-marked suite (dynamic batching, shared
+# executable cache, continuous-batching decode, router/replica-pool and
+# rollout contracts, Predictor/validator regressions) plus two drills
+# that hold the serving invariants end to end:
 #
-#   - ZERO cold compiles after warmup across a mixed-size request
-#     stream (the shape-bucket contract, docs/serving.md);
-#   - a sane tail latency (p95) for the whole drill — generous on the
-#     CPU CI mesh, but a hang or a per-request compile blows straight
-#     through it.
+#   - 200-request LeNet single-engine drill: ZERO cold compiles after
+#     warmup across a mixed-size request stream (the shape-bucket
+#     contract, docs/serving.md) + a sane p95;
+#   - 2-replica router drill with a HOT WEIGHT SWAP mid-stream: every
+#     future resolves (zero dropped), outputs flip atomically between
+#     the two versions, the router sheds nothing.
 #
-#   scripts/serve_smoke.sh              # full set + drill
-#   scripts/serve_smoke.sh -k deadline  # narrow (skips the drill)
+#   scripts/serve_smoke.sh              # full set + drills
+#   scripts/serve_smoke.sh -k deadline  # narrow (skips the drills)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-python -m pytest -q -m serve \
+python -m pytest -q -m "serve and not slow" \
     -p no:cacheprovider -p no:randomly \
-    tests/test_serve.py \
+    tests/test_serve.py tests/test_serve_cluster.py \
     "$@"
 
 # The narrowed form is a targeted check; the drill needs the full run.
@@ -64,5 +65,54 @@ assert p95 is not None and p95 < 5.0, f"p95 {p95}s out of bounds"
 print(f"OK: 200 requests, zero cold compiles after warmup "
       f"({warm_compiles} buckets), p95 {p95*1e3:.1f} ms, "
       f"bucket hits {stats['bucket_hits']}")
+PY
+
+echo "== serve smoke: 2-replica router drill + hot weight swap =="
+python - <<'PY'
+import threading, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.serve import ReplicaPool, xcache
+from bigdl_tpu.utils.random import set_seed
+
+set_seed(1)
+model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(),
+                      nn.Linear(8, 3), nn.LogSoftMax())
+pool = ReplicaPool(model, n_replicas=2, max_batch=16, max_wait_ms=2,
+                   input_shape=(4,))
+# N replicas of one architecture share the executable cache: the second
+# replica's warmup must have compiled nothing new
+xs = xcache.get().stats()
+assert xs["compiles"] == 5 and xs["hits"] >= 5, xs
+
+rng = np.random.RandomState(0)
+rows = rng.randn(200, 4).astype(np.float32)
+p2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 1.5, model.params())
+
+futs, fired = [], threading.Event()
+def load():
+    for i, r in enumerate(rows):
+        futs.append(pool.submit(r))
+        if i == 80:
+            fired.set()
+        time.sleep(0.0005)
+t = threading.Thread(target=load); t.start()
+fired.wait(30)
+version = pool.rollout(p2, model.state())   # hot swap under load
+t.join(60)
+for f in futs:
+    f.result(timeout=30)                    # zero dropped futures
+s = pool.router.stats()
+assert s["failed"] == 0 and s["shed"] == 0, s
+assert s["completed"] == 200, s
+assert version == 1
+assert all(r.weights_version() == 1 for r in pool.replicas)
+pool.close()
+print(f"OK: 200 routed requests across 2 replicas with a mid-stream "
+      f"hot swap to v{version}; zero dropped, zero shed, est "
+      f"{s['est_ms']:.1f} ms")
 PY
 echo "serve smoke: all green"

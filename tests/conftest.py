@@ -38,6 +38,8 @@ jax.config.update("jax_default_matmul_precision", "highest")
 
 @pytest.fixture(autouse=True)
 def _seed():
+    import sys
+
     from bigdl_tpu.utils.random import set_seed
     from bigdl_tpu.utils.log import reset_warn_cache
     set_seed(1)
@@ -45,6 +47,12 @@ def _seed():
     # warn_every's cache is process-global: a warning rate-limited by an
     # earlier test must not stay suppressed in this one
     reset_warn_cache()
+    # the shared executable cache is process-global too: identical
+    # architectures across tests share fingerprints, so compile-counter
+    # assertions need a per-test registry (reset only when loaded)
+    xc = sys.modules.get("bigdl_tpu.serve.xcache")
+    if xc is not None:
+        xc.reset()
     yield
 
 

@@ -17,6 +17,7 @@ Covers the tentpole contracts:
   the validators' tail-batch pad-and-trim single-compile routing.
 """
 import math
+import threading
 import time
 
 import jax
@@ -85,6 +86,21 @@ class TestBucketing:
         out = np.arange(8)
         assert np.array_equal(trim(out, 3), out[:3])
         assert trim(out, 8) is out
+
+    def test_zero_row_inputs_return_empty(self):
+        """0-row guard: an empty batch pads to nothing (no all-pad batch
+        manufactured, nothing raises) and trims to nothing; n >= 1
+        behavior is untouched."""
+        empty = np.zeros((0, 4), np.float32)
+        padded, n = pad_rows(empty, 8)
+        assert n == 0 and padded.shape == (0, 4)
+        assert trim(np.arange(8), 0).shape == (0,)
+        assert trim(np.zeros((0, 3)), 0).shape == (0, 3)
+        # regression: n >= 1 still pads/trims exactly as before
+        x = np.ones((2, 4), np.float32)
+        padded, n = pad_rows(x, 4)
+        assert n == 2 and padded.shape == (4, 4)
+        assert np.all(padded[2:] == 0)
 
 
 class TestServeEngine:
@@ -249,6 +265,129 @@ class TestServeEngine:
             f = eng.submit(np.ones((5,), np.float32))
             with pytest.raises(ValueError):
                 f.result(timeout=10)
+
+    def test_monotonic_counters_and_stop_event_snapshot(self):
+        """accepted/shed/completed/failed are monotonic from
+        construction (never reset — the router rate-differences
+        snapshots), accepted == completed + failed + inflight, and the
+        ``serve`` stop event carries the final snapshot."""
+        from bigdl_tpu.obs import events
+        model = _small_model()
+        log = events.configure(None)
+        try:
+            eng = ServeEngine(model, max_batch=8, max_wait_ms=10,
+                              input_shape=(4,))
+            x = np.random.RandomState(0).randn(9, 4).astype(np.float32)
+            bad = np.full((4,), np.nan, np.float32)
+            futs = eng.submit_many(list(x) + [bad])
+            for f in futs[:-1]:
+                f.result(timeout=10)
+            with pytest.raises(PoisonedRequestError):
+                futs[-1].result(timeout=10)
+            s1 = eng.stats()
+            assert s1["accepted"] == 10
+            assert s1["completed"] == 9 and s1["failed"] == 1
+            assert s1["shed"] == 0
+            assert (s1["accepted"]
+                    == s1["completed"] + s1["failed"] + s1["inflight"])
+            eng.predict(x[:3])
+            s2 = eng.stats()                      # counters only grow
+            assert s2["accepted"] == 13 and s2["completed"] == 12
+            assert s2["failed"] == s1["failed"]
+            eng.close()
+            stops = [e for e in log.ring_events()
+                     if e["type"] == "serve" and e.get("kind") == "stop"]
+            assert len(stops) == 1
+            for key in ("accepted", "shed", "completed", "failed"):
+                assert stops[0][key] == s2[key], (key, stops[0])
+        finally:
+            events.reset()
+
+    def test_queue_bound_sheds_instead_of_queuing(self):
+        """max_queue admission: requests past the bound fail fast with
+        SheddedError, count in ``shed`` only, and never enter the
+        pipeline."""
+        from bigdl_tpu.serve import SheddedError
+        model = _small_model()
+        # max_wait large: the batcher holds the first batch open so the
+        # queue visibly backs up behind it
+        eng = ServeEngine(model, max_batch=64, max_wait_ms=2000,
+                          input_shape=(4,), max_queue=4)
+        try:
+            rows = np.ones((10, 4), np.float32)
+            futs = eng.submit_many(rows)
+            shed = [f for f in futs if f.done()
+                    and isinstance(f.exception(), SheddedError)]
+            assert len(shed) >= 4                 # bound enforced
+            s = eng.stats()
+            assert s["shed"] == len(shed)
+            assert s["accepted"] == 10 - len(shed)
+        finally:
+            eng.close()
+        s = eng.stats()
+        assert s["completed"] == s["accepted"]    # drained on close
+        assert s["failed"] == 0
+
+    def test_refresh_concurrent_submit_never_tears_weights(self):
+        """The half-swap audit: a BatchNorm model makes (params, state)
+        consistency observable — eval reads running stats from STATE
+        and scale/shift from PARAMS, so pairing version-1 params with
+        version-2 state would produce an output matching neither
+        oracle.  A flipper thread hammers refresh() between two
+        versions while the main thread streams requests; every output
+        must match exactly one version."""
+        set_seed(1)
+        model = nn.Sequential(nn.Linear(4, 3),
+                              nn.BatchNormalization(3), nn.LogSoftMax())
+        p1 = jax.tree_util.tree_map(np.array, model.params())
+        s1 = jax.tree_util.tree_map(np.array, model.state())
+        p2 = jax.tree_util.tree_map(lambda a: a * 2.0, p1)
+        s2 = jax.tree_util.tree_map(lambda a: a + 0.5, s1)
+
+        def oracle(p, s):
+            @jax.jit
+            def fwd(x):
+                out, _ = model.apply(p, x, s,
+                                     Context(training=False,
+                                             key=jax.random.PRNGKey(0)))
+                return out
+            return lambda x: np.asarray(fwd(np.atleast_2d(x)))
+
+        o1, o2 = oracle(p1, s1), oracle(p2, s2)
+        rng = np.random.RandomState(0)
+        rows = rng.randn(60, 4).astype(np.float32)
+
+        eng = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                          input_shape=(4,))
+        stop = threading.Event()
+
+        def flipper():
+            flip = False
+            while not stop.is_set():
+                flip = not flip
+                model.load_params(p2 if flip else p1)
+                model.load_state(s2 if flip else s1)
+                eng.refresh()
+
+        t = threading.Thread(target=flipper, daemon=True)
+        t.start()
+        try:
+            futs = [(r, eng.submit(r)) for _ in range(5) for r in rows]
+            for r, f in futs:
+                out = f.result(timeout=30)
+                m1 = np.allclose(out, o1(r)[0], rtol=1e-5, atol=1e-6)
+                m2 = np.allclose(out, o2(r)[0], rtol=1e-5, atol=1e-6)
+                assert m1 != m2, (
+                    f"output {out} matches neither weight version: "
+                    "half-swapped (params, state) observed")
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            # leave the module on version 1 for the engine drain
+            model.load_params(p1)
+            model.load_state(s1)
+            eng.close()
+        assert eng.stats()["failed"] == 0
 
 
 class TestContinuousDecode:

@@ -1,0 +1,685 @@
+"""Serving control-plane suite (docs/serving.md "Control plane",
+marker ``serve``).
+
+Covers the PR-6 tentpole contracts:
+
+- the SHARED executable cache: ``optim.validate`` and a ServeEngine
+  over the same (model, shape) pair resolve ONE cache entry
+  (compile-counter audit), and keys separate on shape/policy/mesh;
+- the SLO router: least-loaded dispatch, monotonic counters,
+  requeue-on-replica-death (zero lost futures), and
+  shed-before-deadline-miss ordering by priority class under overload;
+- the replica pool: output parity with the serial forward through N
+  replicas, and the two-phase hot weight rollout — under continuous
+  load a versioned swap across 2 replicas completes with ZERO
+  dropped/failed futures and every output matching exactly one
+  version's oracle (no torn weights, no mixed-version batch), with
+  rollback converging the fleet back on any staged/commit failure;
+- tensor-parallel decode: ``ContinuousDecoder(mesh=...)`` over the
+  mesh's ``model`` axis decodes token-for-token what single-device
+  ``lm_decode`` produces, with zero new programs after construction;
+- the 4-replica subprocess chaos drill (slow+chaos): kill one replica
+  mid-stream via ``BIGDL_FAULTS=serve_kill`` and prove the router
+  requeues its work onto survivors with zero lost futures.
+"""
+import threading
+import time
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import Context
+from bigdl_tpu.serve import (DeadReplicaError, LocalReplica, ProcessReplica,
+                             ReplicaPool, RolloutError, Router, ServeEngine,
+                             SheddedError, WeightStore, xcache)
+from bigdl_tpu.serve.router import slo_ms_default
+from bigdl_tpu.utils.random import set_seed
+
+pytestmark = pytest.mark.serve
+
+
+def _small_model():
+    set_seed(1)
+    return nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+
+
+def _oracle(model, params=None, state=None):
+    """Serial forward closure at a FIXED weight snapshot."""
+    p = model.params() if params is None else params
+    s = model.state() if state is None else state
+
+    @jax.jit
+    def fwd(x):
+        out, _ = model.apply(p, x, s,
+                             Context(training=False,
+                                     key=jax.random.PRNGKey(0)))
+        return out
+
+    return lambda x: np.asarray(fwd(np.atleast_2d(x)))
+
+
+def _close(a, b):
+    """Per-row comparison tolerant of the XLA CPU gemm's batch-shape
+    rounding: the engine's micro-batches close at data-dependent sizes,
+    and a (3, 4) @ (4, 3) tile rounds some rows one ulp apart from the
+    (1, 4) oracle batch.  Weight-VERSION differences are at 1e-1 scale,
+    so this tolerance still discriminates versions unambiguously."""
+    return np.allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# shared executable cache
+# ---------------------------------------------------------------------------
+
+class TestXCache:
+    def test_validate_and_serve_share_one_entry(self):
+        """The tentpole audit: after the engine warms its buckets, an
+        eval pass at a bucket's batch shape costs ZERO new compiles —
+        both entry points resolve the same cache entry."""
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim.local_optimizer import validate
+        from bigdl_tpu.optim.validation import Top1Accuracy
+
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=8, max_wait_ms=5,
+                          input_shape=(4,))
+        try:
+            warm = xcache.get().stats()
+            assert warm["compiles"] == len(eng.buckets) == 4
+
+            class _Eval:
+                def data(self, train=False):
+                    rng = np.random.RandomState(0)
+                    for _ in range(3):       # full batches at bucket 8
+                        yield MiniBatch(
+                            rng.randn(8, 4).astype(np.float32),
+                            rng.randint(1, 4, (8, 1)))
+
+            res = validate(model, model.params(), model.state(), _Eval(),
+                           [Top1Accuracy()])
+            assert res[0][1].count == 24
+            after = xcache.get().stats()
+            assert after["compiles"] == warm["compiles"], (
+                "validate recompiled a shape the serve warmup already "
+                "built — the cache entry is not shared")
+            assert after["hits"] > warm["hits"]
+        finally:
+            eng.close()
+
+    def test_two_engines_same_architecture_share_executables(self):
+        model_a = _small_model()
+        eng_a = ServeEngine(model_a, max_batch=8, max_wait_ms=5,
+                            input_shape=(4,))
+        compiles_a = xcache.get().stats()["compiles"]
+        model_b = _small_model()
+        eng_b = ServeEngine(model_b, max_batch=8, max_wait_ms=5,
+                            input_shape=(4,))
+        try:
+            assert xcache.get().stats()["compiles"] == compiles_a, (
+                "a second replica of the same architecture recompiled "
+                "its buckets")
+            # identical seeds -> identical params -> identical outputs
+            x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+            assert np.array_equal(eng_a.predict(x), eng_b.predict(x))
+        finally:
+            eng_a.close()
+            eng_b.close()
+
+    def test_keys_separate_on_shape_and_policy(self):
+        from bigdl_tpu import tensor as bt
+        c = xcache.ExecutableCache()
+        key_a = c.key_for(("f",), (np.zeros((2, 4), np.float32),))
+        key_b = c.key_for(("f",), (np.zeros((4, 4), np.float32),))
+        assert key_a != key_b
+        prev = bt.policy()
+        bt.set_policy(bt.BF16_COMPUTE)
+        try:
+            key_c = c.key_for(("f",), (np.zeros((2, 4), np.float32),))
+        finally:
+            bt.set_policy(prev)
+        assert key_c != key_a
+
+    def test_tracked_jit_counts_first_dispatch_only(self):
+        calls = []
+
+        def f(a, b):
+            calls.append(1)
+            return a + b
+
+        g = xcache.tracked_jit(f, ("test_tracked",), key_argnums=(0,))
+        before = xcache.get().stats()["compiles"]
+        x = np.ones((3,), np.float32)
+        g(x, x)
+        g(x, x)
+        g(x, x)
+        assert xcache.get().stats()["compiles"] == before + 1
+        g(np.ones((5,), np.float32), np.ones((5,), np.float32))
+        assert xcache.get().stats()["compiles"] == before + 2
+
+
+# ---------------------------------------------------------------------------
+# router (replica-agnostic: fakes give deterministic service behavior)
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    """Deterministic replica: resolves each submit on a worker thread
+    after ``service_s``; output = 2x the input row."""
+
+    def __init__(self, name="fake", service_s=0.0):
+        self.name = name
+        self.service_s = service_s
+        self.submitted = 0
+        self._alive = True
+
+    def submit(self, x):
+        self.submitted += 1
+        fut = Future()
+
+        def work():
+            if self.service_s:
+                time.sleep(self.service_s)
+            if not self._alive:
+                fut.set_exception(DeadReplicaError(self.name))
+            else:
+                fut.set_result(np.asarray(x) * 2)
+
+        threading.Thread(target=work, daemon=True).start()
+        return fut
+
+    def inflight(self):
+        return 0
+
+    def alive(self):
+        return self._alive
+
+    def stats(self):
+        return {"submitted": self.submitted}
+
+    def close(self, drain=True):
+        self._alive = False
+
+
+class DyingReplica(FakeReplica):
+    """Accepts ``die_after`` submits, then fails everything with
+    DeadReplicaError and reports dead — the clean-death path."""
+
+    def __init__(self, name="dying", die_after=3):
+        super().__init__(name)
+        self.die_after = die_after
+
+    def submit(self, x):
+        self.submitted += 1
+        if self.submitted > self.die_after:
+            self._alive = False
+        if not self._alive:
+            fut = Future()
+            fut.set_exception(DeadReplicaError(self.name))
+            return fut
+        return super().submit(x)
+
+
+class TestRouter:
+    def test_completes_and_counts(self):
+        # a small service time lets outstanding counts accumulate, so
+        # least-loaded dispatch visibly spreads the burst over both
+        # replicas (with instant fakes the first replica is always
+        # least-loaded, which is also correct — just not informative)
+        r1, r2 = FakeReplica("a", 0.01), FakeReplica("b", 0.01)
+        with Router([r1, r2], shed=False) as router:
+            futs = [router.submit(np.full((2,), i, np.float32))
+                    for i in range(20)]
+            outs = [f.result(timeout=10) for f in futs]
+        for i, o in enumerate(outs):
+            assert np.array_equal(o, np.full((2,), 2 * i, np.float32))
+        s = router.stats()
+        assert s["accepted"] == 20 and s["completed"] == 20
+        assert s["failed"] == 0 and s["shed"] == 0
+        # least-loaded over two idle fakes round-robins effectively:
+        # both replicas served traffic
+        assert r1.submitted > 0 and r2.submitted > 0
+        assert r1.submitted + r2.submitted == 20
+
+    def test_requeue_on_replica_death_zero_lost_futures(self):
+        """A dead replica fails no future that a surviving replica can
+        serve — every submit resolves, via requeue."""
+        dying = DyingReplica("dying", die_after=3)
+        healthy = FakeReplica("healthy")
+        with Router([dying, healthy], shed=False) as router:
+            futs = [router.submit(np.full((2,), i, np.float32))
+                    for i in range(30)]
+            outs = [f.result(timeout=10) for f in futs]
+        for i, o in enumerate(outs):
+            assert np.array_equal(o, np.full((2,), 2 * i, np.float32))
+        s = router.stats()
+        assert s["failed"] == 0
+        assert s["completed"] == 30
+        assert s["requeued"] >= 1
+        assert s["dead_replicas"] == 1
+
+    def test_request_errors_are_not_retried(self):
+        """A poisoned request fails identically everywhere: the router
+        must surface the error, not spin retries across replicas."""
+
+        class BadInput(FakeReplica):
+            def submit(self, x):
+                self.submitted += 1
+                fut = Future()
+                fut.set_exception(ValueError("bad row"))
+                return fut
+
+        bad = BadInput("bad")
+        with Router([bad], shed=False) as router:
+            f = router.submit(np.ones((2,), np.float32))
+            with pytest.raises(ValueError):
+                f.result(timeout=10)
+        assert bad.submitted == 1
+        assert router.stats()["failed"] == 1
+
+    def test_overload_sheds_low_priority_before_deadline_miss(self):
+        """Overload policy: high-priority requests all complete; the
+        load past capacity is shed from the LOW class before any
+        request is served past its deadline."""
+        replicas = [FakeReplica("a", service_s=0.05),
+                    FakeReplica("b", service_s=0.05)]
+        with Router(replicas, shed=True, est_ms=50.0) as router:
+            high = [router.submit(np.full((2,), i, np.float32),
+                                  priority=0, slo_ms=5000)
+                    for i in range(4)]
+            low = [router.submit(np.full((2,), 100 + i, np.float32),
+                                 priority=1, slo_ms=120)
+                   for i in range(16)]
+            done = [f.result(timeout=10) for f in high]
+            shed = served = 0
+            for f in low:
+                try:
+                    f.result(timeout=10)
+                    served += 1
+                except SheddedError:
+                    shed += 1
+        assert len(done) == 4                   # high never shed
+        assert shed > 0, "overload produced no shedding"
+        s = router.stats()
+        assert s["shed"] == shed
+        assert s["completed"] == 4 + served
+        assert s["failed"] == 0
+        assert s["accepted"] == s["completed"] + s["shed"]
+
+    def test_engine_level_shed_counts_as_shed_not_failed(self):
+        """A replica's own admission shed (max_queue) surfaces as a
+        router SHED, keeping the shed/failed taxonomy disjoint."""
+
+        class Shedding(FakeReplica):
+            def submit(self, x):
+                self.submitted += 1
+                fut = Future()
+                fut.set_exception(SheddedError("engine queue full"))
+                return fut
+
+        with Router([Shedding("s")], shed=False) as router:
+            f = router.submit(np.ones((2,), np.float32))
+            with pytest.raises(SheddedError):
+                f.result(timeout=10)
+        s = router.stats()
+        assert s["shed"] == 1 and s["failed"] == 0
+
+    def test_no_deadline_means_no_shed(self):
+        with Router([FakeReplica("a", service_s=0.02)], shed=True,
+                    est_ms=1000.0) as router:
+            futs = [router.submit(np.ones((2,), np.float32))
+                    for _ in range(10)]
+            for f in futs:
+                f.result(timeout=10)
+        assert router.stats()["shed"] == 0
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_SERVE_SLO_MS", "250")
+        assert slo_ms_default() == 250.0
+        monkeypatch.setenv("BIGDL_SERVE_SLO_MS", "junk")
+        assert slo_ms_default() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# weight store + pool + hot rollout
+# ---------------------------------------------------------------------------
+
+class TestWeightStore:
+    def test_versions_are_monotonic_and_snapshotted(self):
+        store = WeightStore()
+        buf = np.ones((2,), np.float32)
+        v1 = store.put({"w": buf}, {})
+        buf *= 7                      # mutate the source buffer
+        v2 = store.put({"w": buf}, {})
+        assert (v1, v2) == (1, 2)
+        assert store.latest() == 2
+        p1, _ = store.get(1)
+        assert np.array_equal(p1["w"], np.ones((2,)))  # decoupled copy
+
+    def test_eviction_keeps_newest(self):
+        store = WeightStore(keep=2)
+        for _ in range(5):
+            store.put({"w": np.zeros((1,))}, {})
+        assert store.versions() == [4, 5]
+        with pytest.raises(KeyError):
+            store.get(1)
+
+
+class TestReplicaPool:
+    def test_pool_matches_serial_forward(self):
+        model = _small_model()
+        ref = _oracle(model)
+        x = np.random.RandomState(0).randn(37, 4).astype(np.float32)
+        with ReplicaPool(model, n_replicas=2, max_batch=8, max_wait_ms=5,
+                         input_shape=(4,)) as pool:
+            out = pool.predict(x)
+            assert _close(out, ref(x))
+            s = pool.stats()
+        assert s["router"]["failed"] == 0
+        # both replicas actually served (least-loaded spreads the work)
+        served = [r["completed"] for r in s["replicas"] if r["alive"]]
+        assert len(served) == 2 and all(v > 0 for v in served)
+
+    def test_hot_swap_drill_zero_drops_and_atomic_flip(self):
+        """THE acceptance drill: under continuous offered load, a
+        versioned rollout across 2 replicas completes with zero
+        dropped/failed futures, every output matches exactly one
+        version's oracle (no torn weights), and every post-rollout
+        submission serves the new version."""
+        model = _small_model()
+        v1_oracle = _oracle(model)
+        p2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 2.0,
+                                    model.params())
+        v2_oracle = _oracle(model, params=p2)
+        rng = np.random.RandomState(0)
+        rows = rng.randn(240, 4).astype(np.float32)
+
+        with ReplicaPool(model, n_replicas=2, max_batch=8, max_wait_ms=1,
+                         input_shape=(4,)) as pool:
+            futs = []
+            swapped = threading.Event()
+
+            def offered_load():
+                for i, r in enumerate(rows):
+                    futs.append((r, pool.submit(r)))
+                    if i == 60:
+                        swapped.set()     # rollout fires mid-stream
+                    time.sleep(0.0005)
+
+            t = threading.Thread(target=offered_load)
+            t.start()
+            swapped.wait(timeout=30)
+            version = pool.rollout(p2, model.state())
+            t.join(timeout=60)
+            assert version == 1
+            # post-rollout traffic must serve ONLY the new version
+            tail = [(r, pool.submit(r)) for r in rows[:20]]
+
+            n_v1 = n_v2 = 0
+            for r, f in futs:
+                out = f.result(timeout=30)       # zero failed futures
+                is_v1 = _close(out, v1_oracle(r)[0])
+                is_v2 = _close(out, v2_oracle(r)[0])
+                assert is_v1 != is_v2, (
+                    "output matches neither (torn weights) or both "
+                    "(versions indistinguishable): %r" % (out,))
+                n_v1 += is_v1
+                n_v2 += is_v2
+            assert n_v1 > 0 and n_v2 > 0, (n_v1, n_v2)
+            for r, f in tail:
+                assert _close(f.result(timeout=30), v2_oracle(r)[0])
+            s = pool.stats()
+            assert s["router"]["failed"] == 0
+            assert s["router"]["shed"] == 0
+            assert all(r["failed"] == 0 for r in s["replicas"])
+            assert all(r["weights_version"] == 1 for r in s["replicas"])
+
+    def test_rollout_stage_failure_rolls_back(self):
+        model = _small_model()
+        ref = _oracle(model)
+
+        class StageFails(LocalReplica):
+            def stage_weights(self, params, state, version=None):
+                raise OSError("injected stage failure")
+
+        good = LocalReplica(ServeEngine(model, max_batch=4,
+                                        max_wait_ms=5, input_shape=(4,)),
+                            name="good")
+        bad = StageFails(ServeEngine(model, max_batch=4, max_wait_ms=5,
+                                     input_shape=(4,)), name="bad")
+        pool = ReplicaPool(replicas=[good, bad])
+        try:
+            p2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 2.0,
+                                        model.params())
+            with pytest.raises(RolloutError):
+                pool.rollout(p2, model.state())
+            # the fleet still serves v0 — nothing flipped
+            x = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+            assert _close(pool.predict(x), ref(x))
+            assert all(r.weights_version() == 0 for r in pool.replicas)
+        finally:
+            pool.close()
+
+    def test_rollout_commit_failure_reverts_committed(self):
+        model = _small_model()
+        ref = _oracle(model)
+
+        class CommitFails(LocalReplica):
+            def commit_weights(self):
+                raise OSError("injected commit failure")
+
+        a = LocalReplica(ServeEngine(model, max_batch=4, max_wait_ms=5,
+                                     input_shape=(4,)), name="a")
+        b = CommitFails(ServeEngine(model, max_batch=4, max_wait_ms=5,
+                                    input_shape=(4,)), name="b")
+        pool = ReplicaPool(replicas=[a, b])
+        try:
+            p2 = jax.tree_util.tree_map(lambda x_: np.asarray(x_) * 2.0,
+                                        model.params())
+            with pytest.raises(RolloutError):
+                pool.rollout(p2, model.state())
+            # replica a committed then REVERTED: the fleet converged
+            # back to one version with the old outputs
+            x = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+            assert _close(pool.predict(x), ref(x))
+            assert all(r.weights_version() == 0 for r in pool.replicas)
+        finally:
+            pool.close()
+
+    def test_stage_rejects_wrong_shaped_weights(self):
+        """Same tree structure, different leaf widths: the stage phase
+        must fail (and the rollout roll back) instead of committing
+        weights every later batch would explode on."""
+        model = _small_model()
+        set_seed(1)
+        wide = nn.Sequential(nn.Linear(4, 5), nn.LogSoftMax())
+        with ReplicaPool(model, n_replicas=2, max_batch=4, max_wait_ms=5,
+                         input_shape=(4,)) as pool:
+            with pytest.raises(RolloutError):
+                pool.rollout(wide.params(), wide.state())
+            ref = _oracle(model)
+            x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+            assert _close(pool.predict(x), ref(x))   # still serving v0
+            assert all(r.weights_version() == 0 for r in pool.replicas)
+
+    def test_rollback_to_stored_version(self):
+        model = _small_model()
+        v1_oracle = _oracle(model)
+        with ReplicaPool(model, n_replicas=2, max_batch=4, max_wait_ms=5,
+                         input_shape=(4,)) as pool:
+            v1 = pool.store.put_model(model)
+            p2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 2.0,
+                                        model.params())
+            v2 = pool.rollout(p2, model.state())
+            assert v2 == v1 + 1
+            x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+            assert not _close(pool.predict(x), v1_oracle(x))
+            back = pool.rollout(version=v1)       # roll BACK by version
+            assert back == v1
+            assert _close(pool.predict(x), v1_oracle(x))
+
+
+# ---------------------------------------------------------------------------
+# tensor-parallel decode
+# ---------------------------------------------------------------------------
+
+class TestTensorParallelDecode:
+    @pytest.fixture()
+    def lm(self):
+        from bigdl_tpu.models.transformer import TransformerLM
+        set_seed(1)
+        return TransformerLM(vocab_size=11, d_model=16, n_heads=2,
+                             n_layers=2, hidden=32)
+
+    @pytest.fixture()
+    def mesh(self):
+        from bigdl_tpu.parallel.mesh import hybrid_mesh
+        return hybrid_mesh(dp=1, mp=2, devices=jax.devices()[:2])
+
+    def test_tp_decode_token_parity_with_lm_decode(self, lm, mesh):
+        """The acceptance bar: TP-served decode over the mesh's
+        ``model`` axis matches single-device ``lm_decode``
+        token-for-token across staggered admissions."""
+        from bigdl_tpu.models.transformer import lm_decode
+        from bigdl_tpu.serve.decode import continuous_decode
+        seeds = [[1, 2, 3], [4, 5], [6], [7, 8, 9, 10], [2, 4]]
+        rows = continuous_decode(lm, seeds, 5, max_slots=2, n_pos=9,
+                                 sync_interval=3, mesh=mesh)
+        serial = [lm_decode(lm, s, 5, greedy=True) for s in seeds]
+        assert rows == serial
+
+    def test_tp_admission_is_compile_free(self, lm, mesh):
+        """Construction pre-compiles step/admit/retire; the serving
+        stream then builds no new jit program and no new cache entry —
+        TP keeps the zero-cold-compile property."""
+        from bigdl_tpu.serve.decode import ContinuousDecoder
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=3, mesh=mesh)
+        compiles = xcache.get().stats()["compiles"]
+        calls = []
+        real_jit = jax.jit
+        jax.jit = lambda fn, *a, **kw: (calls.append(fn),
+                                        real_jit(fn, *a, **kw))[1]
+        try:
+            futs = [dec.submit([1, 2], 4) for _ in range(5)]
+            dec.run()
+        finally:
+            jax.jit = real_jit
+        assert all(f.done() for f in futs)
+        assert not calls, "TP decode built a new jit program mid-stream"
+        assert xcache.get().stats()["compiles"] == compiles
+        assert dec.stats()["tp"] == 2
+
+    def test_tp_requires_divisible_heads(self, lm):
+        from bigdl_tpu.parallel.mesh import make_mesh
+        from bigdl_tpu.serve.decode import ContinuousDecoder
+        if len(jax.devices()) < 3:
+            pytest.skip("needs 3 devices")
+        mesh3 = make_mesh({"model": 3}, jax.devices()[:3])
+        with pytest.raises(ValueError, match="divide"):
+            ContinuousDecoder(lm, max_slots=2, n_pos=8, mesh=mesh3)
+
+
+# ---------------------------------------------------------------------------
+# bench contract (tools/bench_serve.py --replicas)
+# ---------------------------------------------------------------------------
+
+class TestBenchRouterContract:
+    """Pins the ``--replicas`` sweep's JSON row shape (the
+    test_bench_contract.py pattern: the apparatus must not bit-rot
+    between measured rounds)."""
+
+    @pytest.fixture(scope="class")
+    def bench_serve(self):
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "tools", "bench_serve.py")
+        spec = importlib.util.spec_from_file_location("bench_serve", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_router_row_keys(self, bench_serve):
+        import json
+        point = {"offered_rps": 100.0, "requests": 10, "completed": 8,
+                 "shed": 2, "wall_s": 0.1, "throughput_rps": 80.0,
+                 "shed_rate": 0.2, "p50_ms": 3.0, "p95_ms": 9.0,
+                 "p99_ms": 11.0}
+        stats = [{"name": "local0", "completed": 5, "shed": 1,
+                  "alive": True},
+                 {"name": "local1", "completed": 3, "shed": 1,
+                  "alive": True}]
+        row = bench_serve.router_row("lenet", 2, point, stats, 0.1)
+        line = json.dumps(row)                 # must serialize
+        d = json.loads(line)
+        for key in ("model", "mode", "replicas", "offered_rps",
+                    "requests", "completed", "shed", "shed_rate",
+                    "throughput_rps", "p50_ms", "p95_ms", "p99_ms",
+                    "per_replica"):
+            assert key in d, key
+        assert d["mode"] == "router" and d["replicas"] == 2
+        assert len(d["per_replica"]) == 2
+        for pr in d["per_replica"]:
+            for key in ("name", "completed", "rps", "shed", "alive"):
+                assert key in pr, key
+        assert d["per_replica"][0]["rps"] == pytest.approx(50.0)
+
+
+# ---------------------------------------------------------------------------
+# subprocess replicas (slow: each spawns its own jax runtime)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestProcessReplicas:
+    def test_process_pool_serves_and_rolls_out(self):
+        model = _small_model()
+        ref = _oracle(model)
+        x = np.random.RandomState(0).randn(24, 4).astype(np.float32)
+        with ReplicaPool(model, n_replicas=2, process=True, max_batch=8,
+                         max_wait_ms=2, input_shape=(4,)) as pool:
+            assert _close(pool.predict(x), ref(x))
+            p2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 2.0,
+                                        model.params())
+            v = pool.rollout(p2, model.state())
+            assert v == 1
+            out2 = pool.predict(x[:8])
+            assert _close(out2, _oracle(model, params=p2)(x[:8]))
+            assert all(r.weights_version() == 1 for r in pool.replicas)
+
+    @pytest.mark.chaos
+    def test_four_replica_kill_drill_zero_lost_futures(self):
+        """The chaos drill: 4 subprocess replicas, one killed
+        mid-stream by ``BIGDL_FAULTS=serve_kill@at=6`` (its 7th
+        request).  Every future resolves via requeue on the survivors
+        (zero lost), and the pool keeps serving afterwards at a sane
+        tail latency (p99 recovery: the post-kill batch completes
+        well inside the drill budget)."""
+        model = _small_model()
+        ref = _oracle(model)
+        kwargs = dict(max_batch=8, max_wait_ms=2, input_shape=(4,))
+        replicas = [ProcessReplica(model, name=f"proc{i}", **kwargs)
+                    for i in range(3)]
+        replicas.append(ProcessReplica(
+            model, name="victim",
+            env={"BIGDL_FAULTS": "serve_kill@at=6"}, **kwargs))
+        rng = np.random.RandomState(0)
+        rows = rng.randn(120, 4).astype(np.float32)
+        with ReplicaPool(replicas=replicas, shed=False) as pool:
+            futs = pool.submit_many(rows)
+            outs = [f.result(timeout=120) for f in futs]   # zero lost
+            assert _close(np.stack(outs), ref(rows))
+            s = pool.router.stats()
+            assert s["failed"] == 0
+            assert s["completed"] == 120
+            assert s["requeued"] >= 1
+            assert s["dead_replicas"] == 1
+            # p99 recovery: a full post-kill wave drains promptly on
+            # the 3 survivors
+            t0 = time.perf_counter()
+            wave = pool.submit_many(rows[:60])
+            for f in wave:
+                f.result(timeout=120)
+            assert time.perf_counter() - t0 < 60.0
+            assert pool.router.stats()["failed"] == 0

@@ -14,6 +14,12 @@ Decode (``--model transformer``): serial per-request ``lm_decode``
 versus the continuous-batching slot driver at equal token budgets,
 reported as tokens/second.
 
+Router (``--replicas N``, N > 1): the same offered-load sweep through a
+:class:`ReplicaPool` — N engine replicas behind the SLO router — with
+per-replica and aggregate rows/s plus the shed rate per point
+(``--slo-ms`` arms the deadline/shed policy; 0 = serve everything).
+The JSON row contract is pinned by ``tests/test_serve_cluster.py``.
+
 Runs on CPU (small defaults) and on a chip unchanged; emits one JSON
 line per sweep point (``bench_serve:`` prefix) plus a summary table.
 The acceptance bar — batched throughput >= 2x serial — is asserted with
@@ -119,6 +125,124 @@ def engine_point(eng, rows, rate):
             "throughput_rps": len(rows) / wall, **_quantiles(lats)}
 
 
+def router_point(pool, rows, rate, slo_ms):
+    """One router sweep point: submit at ``rate`` req/s through the
+    pool; shed futures count against the shed rate, completions against
+    throughput/latency."""
+    from bigdl_tpu.serve import SheddedError
+
+    gap = 0.0 if np.isinf(rate) else 1.0 / rate
+    done_at = [None] * len(rows)
+
+    def _stamp(i):
+        def cb(_f):
+            done_at[i] = time.perf_counter()
+        return cb
+
+    futs = []
+    t0 = time.perf_counter()
+    for i, r in enumerate(rows):
+        if gap:
+            delay = t0 + i * gap - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        t_sub = time.perf_counter()
+        f = pool.submit(r, slo_ms=slo_ms or None)
+        f.add_done_callback(_stamp(i))
+        futs.append((t_sub, f))
+    lats, shed = [], 0
+    for i, (t_sub, f) in enumerate(futs):
+        try:
+            f.result()
+        except SheddedError:
+            shed += 1
+            continue
+        # completion stamped by the done-callback (result() waiters wake
+        # before callbacks run — engine_point's spin covers the race)
+        t_spin = time.perf_counter()
+        while done_at[i] is None:
+            if time.perf_counter() - t_spin > 5.0:
+                raise RuntimeError("latency stamp missing after 5s")
+            time.sleep(0.0005)
+        lats.append(done_at[i] - t_sub)
+    wall = time.perf_counter() - t0
+    return {"offered_rps": None if np.isinf(rate) else rate,
+            "requests": len(rows), "completed": len(lats), "shed": shed,
+            "wall_s": wall, "throughput_rps": len(lats) / wall,
+            "shed_rate": shed / len(rows),
+            **(_quantiles(lats) if lats
+               else {"p50_ms": None, "p95_ms": None, "p99_ms": None})}
+
+
+def router_row(model_name, replicas, point, replica_stats,
+               wall_s) -> dict:
+    """The pinned JSON contract for one ``--replicas`` sweep point:
+    aggregate throughput/latency/shed plus a per-replica breakdown.
+    ``tests/test_serve_cluster.py`` keeps this shape honest."""
+    per_replica = [{"name": s.get("name", f"r{i}"),
+                    "completed": s.get("completed", 0),
+                    "rps": (s.get("completed", 0) / wall_s
+                            if wall_s else 0.0),
+                    "shed": s.get("shed", 0),
+                    "alive": s.get("alive", True)}
+                   for i, s in enumerate(replica_stats)]
+    return {"model": model_name, "mode": "router",
+            "replicas": replicas, **point, "per_replica": per_replica}
+
+
+def bench_router(args):
+    from bigdl_tpu.serve import ReplicaPool
+    model, shape = _build(args.model)
+    rng = np.random.RandomState(0)
+    rows = rng.rand(args.requests, *shape).astype(np.float32)
+
+    pool = ReplicaPool(model, n_replicas=args.replicas,
+                       max_batch=args.max_batch,
+                       max_wait_ms=args.max_wait_ms, input_shape=shape,
+                       slo_ms=args.slo_ms or None)
+    try:
+        pool.predict(rows[:args.max_batch])          # warm every bucket
+        prev = [r.stats() for r in pool.replicas]
+        points = []
+        for rate in args.loads:
+            t0 = time.perf_counter()
+            pt = router_point(pool, rows, rate, args.slo_ms)
+            wall = time.perf_counter() - t0
+            # per-replica deltas over this point (rate-differenced
+            # monotonic counters — the documented stats contract)
+            cur = [r.stats() for r in pool.replicas]
+            deltas = [{"name": getattr(r, "name", f"r{i}"),
+                       "completed": (c.get("completed", 0)
+                                     - p.get("completed", 0)),
+                       "shed": c.get("shed", 0) - p.get("shed", 0),
+                       "alive": r.alive()}
+                      for i, (r, p, c) in enumerate(
+                          zip(pool.replicas, prev, cur))]
+            prev = cur
+            row = router_row(args.model, args.replicas, pt, deltas, wall)
+            points.append(row)
+            print(f"bench_serve: {json.dumps(row)}")
+        rstats = pool.router.stats()
+    finally:
+        pool.close()
+
+    print(f"\n{args.model} router x{args.replicas}:")
+    for pt in points:
+        off = ("closed-loop" if pt["offered_rps"] is None
+               else f"{pt['offered_rps']:g} req/s offered")
+        per = ", ".join(f"{p['name']} {p['rps']:.0f} r/s"
+                        for p in pt["per_replica"])
+        p95 = pt["p95_ms"]
+        print(f"  {off}: {pt['throughput_rps']:.1f} req/s aggregate "
+              f"(shed {pt['shed_rate']:.1%}; "
+              f"p95 {p95:.2f} ms; {per})" if p95 is not None else
+              f"  {off}: everything shed")
+    print(f"  router: accepted {rstats['accepted']}, completed "
+          f"{rstats['completed']}, shed {rstats['shed']}, requeued "
+          f"{rstats['requeued']}")
+    return points
+
+
 def bench_scoring(args):
     from bigdl_tpu.serve import ServeEngine
     model, shape = _build(args.model)
@@ -219,6 +343,12 @@ def main():
     ap.add_argument("--decode-words", type=int, default=16)
     ap.add_argument("--decode-slots", type=int, default=4)
     ap.add_argument("--decode-sync", type=int, default=8)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="> 1 sweeps a ReplicaPool behind the SLO "
+                         "router instead of one engine")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="per-request deadline for the router sweep "
+                         "(0 = none; arms the shed policy)")
     ap.add_argument("--check", action="store_true",
                     help="fail unless batched >= 2x serial throughput")
     args = ap.parse_args()
@@ -226,6 +356,8 @@ def main():
 
     if args.model == "transformer":
         bench_decode(args)
+    elif args.replicas > 1:
+        bench_router(args)
     else:
         bench_scoring(args)
 
