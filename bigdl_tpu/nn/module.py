@@ -130,6 +130,16 @@ class Module:
         self._buffers[name] = value
         return value
 
+    def __getstate__(self):
+        # the validator/serve eval-fn cache (optim._eval_fn) holds a
+        # jitted closure: process-local by nature and unpicklable.
+        # Dropping it here keeps a model that has been validated or
+        # served in-process shippable to a subprocess replica
+        # (serve/cluster.ProcessReplica pickles the model at spawn).
+        state = dict(self.__dict__)
+        state.pop("_cached_eval_fn", None)
+        return state
+
     def set_name(self, name):
         self.name = name
         return self

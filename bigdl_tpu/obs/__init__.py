@@ -15,17 +15,33 @@ resilience layer:
   thread stacks) dumped on watchdog trips, preemption and non-finite
   aborts;
 - ``summary``: TensorBoard-compatible scalar export (the
-  ``TrainSummary``/``ValidationSummary`` parity piece), no TF dep.
+  ``TrainSummary``/``ValidationSummary`` parity piece), no TF dep;
+- ``metrics``: typed process-wide counters/gauges/fixed-bucket
+  histograms that merge EXACTLY across serve replicas and processes,
+  with Prometheus text + JSONL snapshot export (``obs/export.py`` is
+  the pull endpoint, ``tools/serve_top.py`` the terminal dashboard);
+- ``trace``: sampled per-request trace contexts for the serving stack
+  (``BIGDL_OBS_TRACE_SAMPLE``), emitted as ``trace`` events.
 
 Master switch: ``BIGDL_OBS=0`` turns the event/diagnostic machinery
 off; ``BIGDL_OBS_TAPS=0`` removes the taps from the compiled step.
 ``tools/obs_report.py`` renders a run directory into markdown.
 """
-from bigdl_tpu.obs import diagnostics, events, spans, taps  # noqa: F401
+# NOTE: ``export`` is deliberately NOT imported eagerly — it drags in
+# http.server, which every training run and subprocess replica would
+# otherwise pay at import time; its consumers (serve/cluster.py, the
+# exporter tests) import it lazily.
+from bigdl_tpu.obs import (  # noqa: F401
+    diagnostics, events, metrics, spans, taps, trace,
+)
 from bigdl_tpu.obs.diagnostics import dump_crash_bundle  # noqa: F401
 from bigdl_tpu.obs.events import (  # noqa: F401
     SCHEMA_VERSION, EventLog, read_events, validate_event,
 )
+from bigdl_tpu.obs.metrics import (  # noqa: F401
+    LATENCY_BUCKETS, Registry, parse_prometheus, render_prometheus,
+)
+from bigdl_tpu.obs.trace import Sampler, Trace  # noqa: F401
 from bigdl_tpu.obs.spans import PHASES, SpanTracker  # noqa: F401
 from bigdl_tpu.obs.summary import (  # noqa: F401
     ScalarWriter, TrainSummary, ValidationSummary, read_scalars,

@@ -87,10 +87,12 @@ def _write(path, write_fn):
 
 
 def dump_crash_bundle(reason: str, run_dir: str | None = None,
-                      extra: dict | None = None) -> str | None:
+                      extra: dict | None = None,
+                      texts: dict | None = None) -> str | None:
     """Write the bundle; returns its path (None only if even the
     directory could not be created).  Safe from signal handlers and
-    daemon threads; never raises."""
+    daemon threads; never raises.  ``texts`` maps extra filenames to
+    raw text bodies (e.g. a dead replica's ``stderr.txt`` tail)."""
     try:
         from bigdl_tpu.obs import events as events_mod
         if not events_mod.enabled():
@@ -134,5 +136,8 @@ def dump_crash_bundle(reason: str, run_dir: str | None = None,
     if extra:
         _write(os.path.join(path, "extra.json"),
                lambda f: json.dump(extra, f, indent=1, default=repr))
+    for fname, body in (texts or {}).items():
+        _write(os.path.join(path, os.path.basename(fname)),
+               lambda f, b=body: f.write(b))
     logger.error("crash bundle written: %s (%s)", path, reason)
     return path

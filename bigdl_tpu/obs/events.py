@@ -32,8 +32,9 @@ from collections import deque
 logger = logging.getLogger("bigdl_tpu.obs")
 
 #: bump when an event type gains/loses REQUIRED fields; readers accept
-#: unknown optional fields at any version
-SCHEMA_VERSION = 1
+#: unknown optional fields at any version.  v2: `serve` events grew
+#: per-kind required fields (SERVE_KINDS) and the `trace` type landed.
+SCHEMA_VERSION = 2
 
 ENV_OBS = "BIGDL_OBS"
 ENV_DIR = "BIGDL_OBS_DIR"
@@ -53,17 +54,39 @@ EVENT_TYPES = {
     # waited `seconds` for the prefetch queue at `step` (queue was empty)
     "prefetch_stall": ("step", "seconds"),
     # serving lifecycle/telemetry (serve/engine.py, serve/decode.py,
-    # serve/router.py, serve/cluster.py): kind in {start, stop, error,
-    # decode, shed, weights_commit, weights_revert, router_start,
-    # router_stop, replica_dead, rollout_begin, rollout_commit,
-    # rollout_rollback}; error events carry the failed request count +
-    # message, stop events a stats snapshot, rollout events the weight
+    # serve/router.py, serve/cluster.py): kind-specific required fields
+    # in SERVE_KINDS below; error events carry the failed request count
+    # + message, stop events a stats snapshot, rollout events the weight
     # version (the hot-swap audit trail, docs/serving.md)
     "serve": ("kind",),
+    # one sampled request's hop chain (obs/trace.py): hops is a list of
+    # [phase, perf_counter_ts] pairs, status in {ok, shed, failed}
+    "trace": ("trace_id", "status", "hops"),
     "watchdog": ("stale",),
     "preempt": ("step",),
     "abort": ("step", "reason"),
     "crash_bundle": ("reason", "path"),
+}
+
+#: per-kind REQUIRED fields for `serve` events (v2).  An unknown kind is
+#: a validation error — a silent typo'd kind would vanish from every
+#: postmortem query.  Fields here are the ones downstream tools key on
+#: (obs_report's rollout timeline needs the version, the requeue audit
+#: needs the replica name); everything else stays free-form.
+SERVE_KINDS = {
+    "start": (),
+    "stop": (),
+    "error": ("error",),
+    "decode": ("steps",),
+    "shed": (),
+    "weights_commit": ("version",),
+    "weights_revert": ("version",),
+    "router_start": ("replicas",),
+    "router_stop": (),
+    "replica_dead": ("replica",),
+    "rollout_begin": ("version",),
+    "rollout_commit": ("version",),
+    "rollout_rollback": ("version", "phase"),
 }
 
 _COMMON = ("v", "ts", "proc", "type")
@@ -91,6 +114,24 @@ def validate_event(event: dict) -> dict:
     missing = [k for k in required if k not in event]
     if missing:
         raise ValueError(f"{etype!r} event missing {missing}: {event}")
+    if etype == "serve":
+        kind = event["kind"]
+        per_kind = SERVE_KINDS.get(kind)
+        if per_kind is None:
+            raise ValueError(f"unknown serve kind {kind!r} "
+                             f"(known: {sorted(SERVE_KINDS)})")
+        missing = [k for k in per_kind if k not in event]
+        if missing:
+            raise ValueError(
+                f"serve/{kind} event missing {missing}: {event}")
+    elif etype == "trace":
+        hops = event["hops"]
+        if (not isinstance(hops, list) or not hops
+                or not all(isinstance(h, (list, tuple)) and len(h) == 2
+                           for h in hops)):
+            raise ValueError(
+                f"trace hops must be a non-empty list of "
+                f"[phase, ts] pairs: {hops!r}")
     return event
 
 
@@ -118,6 +159,7 @@ class EventLog:
         self._proc = process_index
         self._ring = deque(maxlen=max(int(ring), 1))
         self._lock = threading.Lock()
+        self._sinks = []     # extra per-event callbacks (add_sink)
         self._fh = None
         self.path = None
         if run_dir:
@@ -131,6 +173,19 @@ class EventLog:
             self._proc = _process_index()
         return self._proc
 
+    def _record(self, event: dict):
+        """Ring-append + file-write one event under the lock (the one
+        write path both :meth:`emit` and :meth:`append_foreign` share).
+        Never raises: a full disk must not kill the training loop."""
+        self._ring.append(event)
+        if self._fh is not None:
+            try:
+                self._fh.write(json.dumps(event, default=_jsonable))
+                self._fh.write("\n")
+                self._fh.flush()
+            except (OSError, ValueError) as e:
+                logger.warning("event sink write failed: %s", e)
+
     def emit(self, etype: str, **fields) -> dict:
         """Append one event (common envelope added here).  Never raises
         past the sink: a full disk must not kill the training loop."""
@@ -138,14 +193,37 @@ class EventLog:
                  "proc": self.process_index(), "type": etype}
         event.update(fields)
         with self._lock:
-            self._ring.append(event)
-            if self._fh is not None:
-                try:
-                    self._fh.write(json.dumps(event, default=_jsonable))
-                    self._fh.write("\n")
-                    self._fh.flush()
-                except (OSError, ValueError) as e:
-                    logger.warning("event sink write failed: %s", e)
+            self._record(event)
+            sinks = list(self._sinks)
+        for sink in sinks:   # outside the lock: a sink may be slow/deadlocky
+            try:
+                sink(event)
+            except Exception as e:
+                logger.warning("event sink callback failed: %s", e)
+        return event
+
+    def add_sink(self, fn):
+        """Register a per-event callback (called with the event dict
+        after ring/file write).  Subprocess replicas use this to stream
+        their events to the parent over the frame protocol
+        (serve/cluster.py) — ending the stderr/DEVNULL blackout.
+        Callback errors are swallowed: telemetry fan-out must never
+        break an emitter."""
+        with self._lock:
+            self._sinks.append(fn)
+        return fn
+
+    def append_foreign(self, event: dict, **extra) -> dict:
+        """Record an event that already carries another process's
+        envelope (a replica child's, forwarded over stdio frames) into
+        THIS log's ring and file sink.  ``extra`` fields (e.g.
+        ``replica=<name>``) are added so the merged stream stays
+        attributable; the child's own ``ts``/``proc``/``type`` are kept
+        verbatim.  Not fanned out to sinks (no forwarding loops)."""
+        event = dict(event)
+        event.update(extra)
+        with self._lock:
+            self._record(event)
         return event
 
     def ring_events(self) -> list:

@@ -1,0 +1,116 @@
+"""Pull exporter for the metrics registry (docs/observability.md
+"Serving telemetry").
+
+A :class:`MetricsExporter` is a tiny threaded HTTP endpoint over any
+zero-argument ``snapshot_fn`` returning an ``obs/metrics.py`` snapshot
+dict (usually ``ReplicaPool.merged_registry`` — the fleet view — or
+``metrics.get().snapshot`` for one process):
+
+- ``GET /metrics``  — Prometheus text exposition (version 0.0.4); what
+  a scraper or ``curl`` reads.
+- ``GET /snapshot`` — the raw snapshot as JSON (``{"ts": ...,
+  "snapshot": ...}``); what ``tools/serve_top.py`` polls, and the
+  format :func:`bigdl_tpu.obs.metrics.merge` accepts directly.
+
+``port=0`` binds an ephemeral port (tests, serve_top drills);
+``exporter.url`` is the resolved address.  The server runs on one
+daemon thread and never touches the serving hot path — cost is paid by
+the scraper, per pull.
+
+File sibling: :meth:`MetricsExporter.write_jsonl` (or
+``metrics.append_snapshot_jsonl``) appends timestamped snapshots to a
+JSONL file for offline analysis where no scraper runs.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from bigdl_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger("bigdl_tpu.obs")
+
+ENV_PORT = "BIGDL_SERVE_EXPORT_PORT"
+
+
+def export_port_default() -> int | None:
+    """``BIGDL_SERVE_EXPORT_PORT`` as an int, or None when unset/empty
+    (no exporter is auto-started)."""
+    raw = os.environ.get(ENV_PORT, "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring malformed %s=%r", ENV_PORT, raw)
+        return None
+
+
+class MetricsExporter:
+    """Serve ``snapshot_fn()`` at ``/metrics`` (Prometheus text) and
+    ``/snapshot`` (JSON).  ``close()`` (or the context manager) shuts
+    the listener down; a snapshot_fn failure answers 500 and is logged,
+    never raised into the serving process."""
+
+    def __init__(self, snapshot_fn, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.snapshot_fn = snapshot_fn
+        exporter = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):   # noqa: N802 - http.server API
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = obs_metrics.render_prometheus(
+                            exporter.snapshot_fn()).encode()
+                        ctype = "text/plain; version=0.0.4"
+                    elif self.path.split("?")[0] == "/snapshot":
+                        body = json.dumps(
+                            {"ts": time.time(),
+                             "snapshot": exporter.snapshot_fn()}).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:
+                    logger.warning("exporter snapshot failed: %s", e)
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # silence per-request stderr
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[:2]
+        self.url = f"http://{self.host}:{self.port}"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="bigdl-obs-exporter")
+        self._thread.start()
+        logger.info("metrics exporter listening at %s", self.url)
+
+    def write_jsonl(self, path: str):
+        """Append one timestamped snapshot to ``path`` (the file-based
+        export for runs nothing scrapes)."""
+        obs_metrics.append_snapshot_jsonl(path, self.snapshot_fn())
+        return path
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -29,7 +29,9 @@ Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
 (default 2), ``BIGDL_SERVE_SYNC`` (decode boundary interval, default 8),
 ``BIGDL_SERVE_REPLICAS`` (pool size, default 2), ``BIGDL_SERVE_SLO_MS``
 (default request deadline, 0 = none), ``BIGDL_SERVE_SHED`` (overload
-shedding, default on).
+shedding, default on), ``BIGDL_OBS_TRACE_SAMPLE`` (request-trace
+sample rate, default 0) and ``BIGDL_SERVE_EXPORT_PORT`` (metrics pull
+exporter — docs/observability.md "Serving telemetry").
 """
 from bigdl_tpu.serve import bucketing, xcache  # noqa: F401
 from bigdl_tpu.serve.bucketing import (  # noqa: F401

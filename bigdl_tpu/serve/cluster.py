@@ -17,7 +17,16 @@ job queue).  Here that layer is explicit and TPU-shaped:
   :class:`~bigdl_tpu.serve.router.DeadReplicaError`, which the router
   requeues onto survivors — the 4-replica chaos drill
   (``tests/test_serve_cluster.py``, ``BIGDL_FAULTS=serve_kill@...``)
-  proves zero lost futures.
+  proves zero lost futures.  The child is NOT a telemetry black hole:
+  its obs events stream to the parent's event log over the same frame
+  protocol (``op: event``), its metrics registry snapshots are pulled
+  on demand (``op: telemetry``) and merged into the fleet view, its
+  stderr is captured into a bounded ring whose tail rides
+  :class:`DeadReplicaError` messages and the crash bundle an unexpected
+  death dumps, and sampled request traces (``obs/trace.py``) cross the
+  boundary on the submit/reply frames with their hop stamps intact
+  (``CLOCK_MONOTONIC`` is host-wide, so parent+child hops stay
+  subtractable).
 - :class:`ReplicaPool` — replicas + :class:`~bigdl_tpu.serve.router.Router`
   + :class:`WeightStore`, with the two-phase rollout protocol::
 
@@ -50,6 +59,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 
 import numpy as np
@@ -62,6 +72,10 @@ from bigdl_tpu.serve.router import (DeadReplicaError, Router,
 logger = logging.getLogger("bigdl_tpu.serve")
 
 _LEN = struct.Struct(">Q")
+
+#: bounded per-replica stderr ring (lines); the tail is what a
+#: postmortem actually needs — the jax traceback right before death
+_STDERR_LINES = 256
 
 #: exception names a worker may report, mapped back to real types so
 #: router retry logic and caller except-clauses behave identically for
@@ -143,8 +157,14 @@ class LocalReplica:
         self.engine = engine
         self.name = name
 
-    def submit(self, x) -> Future:
-        return self.engine.submit(x)
+    def submit(self, x, trace=None) -> Future:
+        return self.engine.submit(x, trace=trace)
+
+    def registry_snapshot(self) -> dict | None:
+        """None: a local replica's engine instruments already live in
+        THIS process's registry — the pool's merge would double-count
+        them if we returned a copy here."""
+        return None
 
     def inflight(self) -> int:
         return self.engine.inflight()
@@ -215,24 +235,46 @@ class ProcessReplica:
         self.name = name
         self._lock = threading.Lock()
         self._wlock = threading.Lock()
-        self._futures: dict = {}
+        self._futures: dict = {}   # rid -> (future, trace-or-None)
         self._ids = iter(range(1, 1 << 62))
         self._dead = False
+        self._closing = False
+        self._stderr_ring = deque(maxlen=_STDERR_LINES)
 
         child_env = dict(os.environ)
+        # the child must NOT inherit the parent's event-log dir: its
+        # events reach the parent's log over `op: event` frames
+        # (append_foreign, attributed replica=<name>); an inherited
+        # BIGDL_OBS_DIR would make the child open the same
+        # events.p0.jsonl and double-write every event.  An explicit
+        # env={...} override below can still opt a child into its own
+        # file sink.
+        from bigdl_tpu.obs import events as obs_events
+        child_env.pop(obs_events.ENV_DIR, None)
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         child_env["PYTHONPATH"] = (repo_root + os.pathsep
                                    + child_env.get("PYTHONPATH", ""))
         if env:
             child_env.update(env)
+        # the child engine's registry series must not collide with a
+        # same-named engine in another replica once snapshots merge
+        engine_kwargs = dict(engine_kwargs)
+        engine_kwargs.setdefault("name", name)
+        # stderr CAPTURED, not discarded: the ring tail is the first
+        # thing a dead-replica postmortem needs (the old DEVNULL made
+        # every child crash an unexplained DeadReplicaError)
         self.proc = subprocess.Popen(
             [sys.executable, "-m", "bigdl_tpu.serve.cluster"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL, env=child_env)
+            stderr=subprocess.PIPE, env=child_env)
+        self._stderr_reader = threading.Thread(
+            target=self._stderr_loop, daemon=True,
+            name=f"bigdl-serve-{name}-stderr")
+        self._stderr_reader.start()
         _write_frame(self.proc.stdin,
                      {"op": "init", "model": model,
-                      "engine": dict(engine_kwargs)}, self._wlock)
+                      "engine": engine_kwargs}, self._wlock)
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True,
                                         name=f"bigdl-serve-{name}-reader")
@@ -245,7 +287,7 @@ class ProcessReplica:
         if self._dead:
             raise RuntimeError(
                 f"replica {name} died during startup (exit code "
-                f"{self.proc.poll()})")
+                f"{self.proc.poll()}){self._tail_suffix()}")
 
     # -- wire ---------------------------------------------------------------
     def _read_loop(self):
@@ -257,49 +299,120 @@ class ProcessReplica:
             if msg is None:
                 self._on_death()
                 return
-            if msg.get("op") == "ready":
+            op = msg.get("op")
+            if op == "ready":
                 self._ready.set()
                 continue
-            with self._lock:
-                fut = self._futures.pop(msg.get("id"), None)
-            if fut is None:
+            if op == "event":
+                # a child obs event forwarded over the frame protocol:
+                # land it in the PARENT's event log, attributed
+                self._forward_event(msg.get("event"))
                 continue
+            with self._lock:
+                entry = self._futures.pop(msg.get("id"), None)
+            if entry is None:
+                continue
+            fut, tr = entry
             if msg.get("ok"):
+                if tr is not None:
+                    # hops the child stamped after the wire crossing
+                    tr.extend(msg.get("hops") or ())
                 fut.set_result(msg.get("out"))
             else:
                 cls = _EXC_TYPES.get(msg.get("etype"), RuntimeError)
                 fut.set_exception(cls(msg.get("error", "replica error")))
+
+    def _stderr_loop(self):
+        try:
+            for raw in self.proc.stderr:
+                self._stderr_ring.append(
+                    raw.decode("utf-8", errors="replace").rstrip("\n"))
+        except (OSError, ValueError):  # pragma: no cover - pipe teardown
+            pass
+
+    def stderr_tail(self, n: int | None = None) -> list:
+        """Last captured stderr lines (newest last)."""
+        tail = list(self._stderr_ring)
+        return tail if n is None else tail[-n:]
+
+    def _tail_suffix(self, n: int = 8) -> str:
+        tail = self.stderr_tail(n)
+        if not tail:
+            return ""
+        return "; stderr tail:\n  " + "\n  ".join(tail)
+
+    def _dead_error(self) -> DeadReplicaError:
+        return DeadReplicaError(
+            f"replica {self.name} (pid {self.proc.pid}) died"
+            f"{self._tail_suffix()}")
+
+    def _forward_event(self, event):
+        if not isinstance(event, dict):
+            return
+        try:
+            from bigdl_tpu.obs import events as obs_events
+            log = obs_events.get()
+            if log is not None:
+                log.append_foreign(event, replica=self.name)
+        except Exception:  # pragma: no cover - telemetry must not kill IO
+            logger.warning("replica %s: event forward failed", self.name)
 
     def _on_death(self):
         with self._lock:
             if self._dead:
                 return
             self._dead = True
-            orphans = list(self._futures.values())
+            orphans = [f for f, _ in self._futures.values()]
             self._futures.clear()
         # release a constructor stuck waiting for the ready frame — a
         # child that crashes during startup must fail fast, not after
         # the full spawn timeout (__init__ re-checks _dead)
         self._ready.set()
+        # drain the stderr pipe to EOF before freezing the tail: the
+        # stdout EOF that got us here can beat the child's last stderr
+        # line by a scheduling quantum
+        if threading.current_thread() is not self._stderr_reader:
+            self._stderr_reader.join(timeout=2.0)
+        # poll only AFTER the drain: a crashing child closes stdout
+        # before it finishes dying, and a stale early poll() reading
+        # None would skip the crash bundle below for idle-replica
+        # deaths (no orphans to trip the other condition)
+        exit_code = self.proc.poll()
+        if exit_code is None and not self._closing:
+            try:
+                exit_code = self.proc.wait(timeout=2.0)
+            except Exception:  # pragma: no cover - still exiting
+                pass
+        err = self._dead_error()
         for fut in orphans:
             if not fut.done():
-                fut.set_exception(DeadReplicaError(
-                    f"replica {self.name} (pid "
-                    f"{self.proc.pid}) died"))
+                fut.set_exception(err)
+        # an UNEXPECTED death (not close()) leaves a crash bundle with
+        # the child's stderr tail — the blackout the old DEVNULL caused
+        if not self._closing and (orphans or exit_code not in (0, None)):
+            try:
+                from bigdl_tpu.obs import diagnostics
+                diagnostics.dump_crash_bundle(
+                    f"replica-{self.name}",
+                    extra={"replica": self.name, "pid": self.proc.pid,
+                           "exit_code": exit_code,
+                           "orphaned_requests": len(orphans)},
+                    texts={"stderr.txt": "\n".join(self.stderr_tail())})
+            except Exception:  # pragma: no cover - diagnostics bug
+                pass
 
     def _rpc(self, op: str, timeout: float | None = None, **fields):
         fut = self._send(op, **fields)
         return fut.result(timeout=timeout)
 
-    def _send(self, op: str, **fields) -> Future:
+    def _send(self, op: str, _trace=None, **fields) -> Future:
         rid = next(self._ids)
         fut = Future()
         with self._lock:
             if self._dead:
-                fut.set_exception(DeadReplicaError(
-                    f"replica {self.name} is dead"))
+                fut.set_exception(self._dead_error())
                 return fut
-            self._futures[rid] = fut
+            self._futures[rid] = (fut, _trace)
         try:
             _write_frame(self.proc.stdin,
                          dict(fields, op=op, id=rid), self._wlock)
@@ -308,8 +421,10 @@ class ProcessReplica:
         return fut
 
     # -- replica surface ----------------------------------------------------
-    def submit(self, x) -> Future:
-        return self._send("submit", x=np.asarray(x))
+    def submit(self, x, trace=None) -> Future:
+        return self._send(
+            "submit", _trace=trace, x=np.asarray(x),
+            trace=None if trace is None else trace.to_wire())
 
     def inflight(self) -> int:
         with self._lock:
@@ -320,6 +435,16 @@ class ProcessReplica:
 
     def stats(self) -> dict:
         return self._rpc("stats", timeout=30.0)
+
+    def telemetry(self) -> dict:
+        """``{"stats": engine.stats(), "registry": <metrics snapshot>}``
+        pulled from the child over the frame protocol."""
+        return self._rpc("telemetry", timeout=30.0)
+
+    def registry_snapshot(self) -> dict | None:
+        """The child process's metrics-registry snapshot (obs/metrics
+        wire format) for the pool's fleet merge."""
+        return self.telemetry().get("registry")
 
     def weights_version(self) -> int:
         return self._rpc("version", timeout=30.0)
@@ -338,6 +463,7 @@ class ProcessReplica:
         return self._rpc("revert", timeout=30.0)
 
     def close(self, drain: bool = True):
+        self._closing = True    # death past this point is expected
         if self.alive():
             try:
                 self._rpc("close", timeout=60.0, drain=drain)
@@ -348,6 +474,11 @@ class ProcessReplica:
         except subprocess.TimeoutExpired:
             self.proc.kill()
         self._on_death()
+        # an unexpected death dumps its crash bundle on the READER
+        # thread; close() returning means death handling (bundle
+        # included) is complete
+        if threading.current_thread() is not self._reader:
+            self._reader.join(timeout=10.0)
 
 
 # ---------------------------------------------------------------------------
@@ -369,7 +500,7 @@ class ReplicaPool:
                  process: bool = False, replicas=None,
                  slo_ms: float | None = None, shed: bool | None = None,
                  est_ms: float = 50.0, store: WeightStore | None = None,
-                 **engine_kwargs):
+                 trace_sample: float | None = None, **engine_kwargs):
         if replicas is None:
             if model is None:
                 raise ValueError("ReplicaPool needs a model or replicas")
@@ -379,14 +510,30 @@ class ReplicaPool:
                                            **engine_kwargs)
                             for i in range(n)]
             else:
+                # engine name == replica name, so registry series are
+                # attributable per replica and never collide
                 replicas = [LocalReplica(ServeEngine(model,
+                                                     name=f"local{i}",
                                                      **engine_kwargs),
                                          name=f"local{i}")
                             for i in range(n)]
         self.replicas = list(replicas)
         self.router = Router(self.replicas, slo_ms=slo_ms, shed=shed,
-                             est_ms=est_ms)
+                             est_ms=est_ms, trace_sample=trace_sample)
         self.store = store if store is not None else WeightStore()
+        self.exporter = None
+        from bigdl_tpu.obs import export as obs_export
+        port = obs_export.export_port_default()
+        if port is not None:
+            try:
+                self.start_exporter(port=port)
+            except OSError as e:
+                # e.g. a second pool in this process with a fixed
+                # BIGDL_SERVE_EXPORT_PORT: the replicas are already
+                # spawned, so a bind failure must not abort (and leak)
+                # the pool — serve without the exporter instead
+                logger.warning("exporter auto-start on port %d failed "
+                               "(%s); pool runs without one", port, e)
 
     # -- request path -------------------------------------------------------
     def submit(self, x, priority: int = 1,
@@ -470,18 +617,78 @@ class ReplicaPool:
         return version
 
     # -- telemetry / lifecycle ----------------------------------------------
+    def merged_registry(self) -> dict:
+        """One metrics snapshot covering the WHOLE fleet: this
+        process's registry (the router + every LocalReplica engine +
+        decoders + xcache) folded with each subprocess replica's
+        registry snapshot, pulled over the frame protocol.  Histograms
+        merge exactly (pinned bounds), counters/gauges per their agg —
+        the fleet p99 this returns IS the pooled p99
+        (``obs/metrics.merge``).
+
+        Scope: the in-process half is the PROCESS-LIFETIME registry
+        (Prometheus default-registry semantics), so series from earlier
+        pools or engines in this process are included; counters stay
+        monotonic across pool turnover.  Per-pool deltas come from
+        rate-differencing two snapshots, not from a fresh-at-zero
+        registry."""
+        from bigdl_tpu.obs import metrics as obs_metrics
+        snaps = [obs_metrics.get().snapshot()]
+        for r in self.replicas:
+            try:
+                snaps.append(r.registry_snapshot())
+            except Exception:  # pragma: no cover - racing a death
+                logger.warning("telemetry pull failed for replica %s",
+                               getattr(r, "name", r))
+        return obs_metrics.merge(snaps)
+
+    def prometheus(self) -> str:
+        """The merged fleet registry in Prometheus text exposition
+        format (what the exporter's ``/metrics`` serves)."""
+        from bigdl_tpu.obs import metrics as obs_metrics
+        return obs_metrics.render_prometheus(self.merged_registry())
+
+    def start_exporter(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the pull exporter over :meth:`merged_registry`
+        (``BIGDL_SERVE_EXPORT_PORT`` auto-starts one at pool
+        construction).  Returns the exporter; idempotent."""
+        if self.exporter is None:
+            from bigdl_tpu.obs import export as obs_export
+            self.exporter = obs_export.MetricsExporter(
+                self.merged_registry, port=port, host=host)
+        return self.exporter
+
     def stats(self) -> dict:
+        """Fleet snapshot: the router's counters, one entry per replica
+        (its ``engine.stats()`` view), and ``merged`` — the TRUE merge
+        of every replica's metrics registry (fleet-pooled latency
+        quantiles, summed admission counters), not a dict of dicts."""
+        from bigdl_tpu.obs import metrics as obs_metrics
         out = {"router": self.router.stats(), "replicas": []}
+        snaps = [obs_metrics.get().snapshot()]
         for r in self.replicas:
             entry = {"name": getattr(r, "name", repr(r)),
                      "alive": False}
             try:
                 entry["alive"] = r.alive()
                 if entry["alive"]:
-                    entry.update(r.stats())
+                    tele = getattr(r, "telemetry", None)
+                    if tele is not None:
+                        # ONE frame round-trip per subprocess replica:
+                        # telemetry() ships stats + registry together
+                        t = tele()
+                        entry.update(t["stats"])
+                        if t.get("registry"):
+                            snaps.append(t["registry"])
+                    else:
+                        entry.update(r.stats())
+                        snap = r.registry_snapshot()
+                        if snap:
+                            snaps.append(snap)
             except Exception:  # pragma: no cover - racing a death
                 pass
             out["replicas"].append(entry)
+        out["merged"] = obs_metrics.serving_summary(obs_metrics.merge(snaps))
         return out
 
     def drain(self, timeout: float = 60.0):
@@ -494,6 +701,9 @@ class ReplicaPool:
                 self.router.drain()
             except TimeoutError:  # pragma: no cover - shutdown path
                 pass
+        if self.exporter is not None:
+            self.exporter.close()
+            self.exporter = None
         self.router.close()
         for r in self.replicas:
             try:
@@ -537,17 +747,34 @@ def replica_main(stdin=None, stdout=None):
     init = _read_frame(stdin)
     if init is None or init.get("op") != "init":
         return 2
+    from bigdl_tpu.obs import events as obs_events
+    from bigdl_tpu.obs import metrics as obs_metrics
+    from bigdl_tpu.obs import trace as obs_trace
     from bigdl_tpu.resilience import faults
     injector = faults.get()
-    engine = ServeEngine(init["model"], **init.get("engine", {}))
     wlock = threading.Lock()
+
+    # stream THIS process's obs events to the parent as they happen —
+    # the sink is registered before the engine exists so even its
+    # `start` event crosses the boundary.  Write failures are swallowed
+    # by add_sink's contract (a dying pipe must not kill the emitter).
+    log = obs_events.get()
+    if log is not None:
+        log.add_sink(lambda ev: _write_frame(
+            stdout, {"op": "event", "event": ev}, wlock))
+
+    engine = ServeEngine(init["model"], **init.get("engine", {}))
     _write_frame(stdout, {"op": "ready", "pid": os.getpid()}, wlock)
 
-    def reply(rid, fut):
+    def reply(rid, fut, tr=None):
         try:
             out = fut.result()
-            _write_frame(stdout, {"id": rid, "ok": True, "out": out},
-                         wlock)
+            msg = {"id": rid, "ok": True, "out": out}
+            if tr is not None:
+                # only the hops stamped on THIS side of the wire; the
+                # parent extends its original context with them
+                msg["hops"] = tr.new_hops()
+            _write_frame(stdout, msg, wlock)
         except BaseException as e:
             _write_frame(stdout, {"id": rid, "ok": False,
                                   "etype": type(e).__name__,
@@ -564,14 +791,29 @@ def replica_main(stdin=None, stdout=None):
                 # Nth submitted request kills this replica mid-stream
                 if (injector is not None and injector.armed("serve_kill")
                         and injector.fires("serve_kill")):
+                    # last words on stderr: the parent's ring captures
+                    # them and the kill drill asserts the tail survives
+                    # into DeadReplicaError + the crash bundle
+                    print(f"serve_kill chaos fired: replica pid "
+                          f"{os.getpid()} exiting", file=sys.stderr,
+                          flush=True)
                     sys.stdout.flush()
                     os._exit(1)   # induced replica death (chaos drill)
-                fut = engine.submit(msg["x"])
+                tr = (obs_trace.Trace.from_wire(msg["trace"])
+                      if msg.get("trace") else None)
+                fut = engine.submit(msg["x"], trace=tr)
                 fut.add_done_callback(
-                    lambda f, r=rid: reply(r, f))
+                    lambda f, r=rid, t=tr: reply(r, f, t))
             elif op == "stats":
                 _write_frame(stdout, {"id": rid, "ok": True,
                                       "out": engine.stats()}, wlock)
+            elif op == "telemetry":
+                _write_frame(
+                    stdout,
+                    {"id": rid, "ok": True,
+                     "out": {"stats": engine.stats(),
+                             "registry": obs_metrics.get().snapshot()}},
+                    wlock)
             elif op == "version":
                 _write_frame(stdout, {"id": rid, "ok": True,
                                       "out": engine.weights_version},

@@ -41,14 +41,18 @@ under TP stays compile-free exactly like the single-chip path.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import os
+import weakref
 from collections import deque
 from concurrent.futures import Future
 
 import numpy as np
 
 logger = logging.getLogger("bigdl_tpu.serve")
+
+_DECODER_SEQ = itertools.count()
 
 ENV_SYNC = "BIGDL_SERVE_SYNC"
 DEFAULT_SYNC = 8
@@ -269,7 +273,29 @@ class ContinuousDecoder:
         self._pending: "deque[_DecodeReq]" = deque()
         self._slots: list = [None] * B
 
-        # telemetry
+        # telemetry: mirrored into the mergeable metrics registry
+        # (labelled decoder=<name>) so slot occupancy and throughput
+        # show up in the fleet exporter next to the engine numbers
+        from bigdl_tpu.obs import metrics as obs_metrics
+        self.name = f"decoder{next(_DECODER_SEQ)}"
+        reg = obs_metrics.get()
+        lab = {"decoder": self.name}
+        self._m_steps = reg.counter(
+            "decode_steps_total", "decode steps driven", **lab)
+        self._m_admitted = reg.counter(
+            "decode_admitted_total", "requests admitted into slots", **lab)
+        self._m_retired = reg.counter(
+            "decode_retired_total", "requests retired from slots", **lab)
+        self._m_syncs = reg.counter(
+            "decode_host_syncs_total", "boundary device->host fetches",
+            **lab)
+        self._m_slots = reg.gauge(
+            "decode_slots_active", "occupied KV-slab slots", **lab)
+        # directly-constructed decoders (the TP-serving entry point)
+        # may never see close() — drop the uniquely-labelled series at
+        # GC so the process registry cannot grow without bound
+        self._drop_series = weakref.finalize(
+            self, reg.drop_series, decoder=self.name)
         self.steps = 0
         self.host_syncs = 0
         self.admitted = 0
@@ -349,6 +375,7 @@ class ContinuousDecoder:
                 np.int32(len(req.seed)))
             self._slots[slot] = req
             self.admitted += 1
+            self._m_admitted.inc()
 
     def run(self):
         """Drive the slab until every submitted request has resolved.
@@ -360,9 +387,11 @@ class ContinuousDecoder:
             live = [r for r in self._slots if r is not None]
             if not live:   # pragma: no cover - defensive
                 break
+            self._m_slots.set(len(live))
             for _ in range(self.sync_interval):
                 self._run_step()
             self.steps += self.sync_interval
+            self._m_steps.inc(self.sync_interval)
             for r in live:
                 r.steps_run += self.sync_interval
             done = [r for r in live if r.steps_run >= r.steps_needed]
@@ -370,6 +399,7 @@ class ContinuousDecoder:
                 continue
             gen_host = np.asarray(self._gen)   # the boundary host sync
             self.host_syncs += 1
+            self._m_syncs.inc()
             for r in done:
                 s = len(r.seed)
                 toks = gen_host[r.slot, s - 1:s - 1 + r.n_words]
@@ -378,17 +408,33 @@ class ContinuousDecoder:
                                                np.int32(r.slot))
                 self._slots[r.slot] = None
                 self.retired += 1
+                self._m_retired.inc()
+            self._m_slots.set(sum(1 for r in self._slots
+                                  if r is not None))
         from bigdl_tpu.obs import events
         events.emit("serve", kind="decode", steps=self.steps,
                     host_syncs=self.host_syncs, admitted=self.admitted,
                     retired=self.retired, slots=self.B)
         return self
 
+    def close(self):
+        """Drop this decoder's series from the process metrics registry.
+        Decoders are labelled uniquely (``decoder=<name>``), so a
+        process that constructs many short-lived decoders (every
+        :func:`continuous_decode` call makes one) would otherwise grow
+        the registry — and every snapshot/exposition — without bound.
+        Also runs at GC for decoders nobody closes; idempotent."""
+        self._drop_series()
+
     def stats(self) -> dict:
         return {"steps": self.steps, "host_syncs": self.host_syncs,
                 "admitted": self.admitted, "retired": self.retired,
-                "slots": self.B, "n_pos": self.n_pos,
-                "sync_interval": self.sync_interval, "tp": self.tp}
+                "slots": self.B,
+                "slots_active": sum(1 for r in self._slots
+                                    if r is not None),
+                "n_pos": self.n_pos,
+                "sync_interval": self.sync_interval, "tp": self.tp,
+                "name": self.name}
 
 
 def continuous_decode(model, seed_rows, n_words, max_slots: int = 4,
@@ -405,6 +451,9 @@ def continuous_decode(model, seed_rows, n_words, max_slots: int = 4,
         n_pos = max(int(s.size) + int(n_words) - 1 for s in reqs)
     dec = ContinuousDecoder(model, max_slots=max_slots, n_pos=n_pos,
                             sync_interval=sync_interval, mesh=mesh)
-    futs = [dec.submit(s, n_words) for s in reqs]
-    dec.run()
-    return [f.result() for f in futs]
+    try:
+        futs = [dec.submit(s, n_words) for s in reqs]
+        dec.run()
+        return [f.result() for f in futs]
+    finally:
+        dec.close()   # one-shot decoder: don't leak its registry series

@@ -33,18 +33,23 @@ Weights are captured and pinned to device ONCE at engine start
 ``DTypePolicy`` (e.g. ``tensor.BF16_COMPUTE``) scopes bf16 MXU compute
 to the serving forward without touching the process default.
 
-Telemetry: per-request latency histogram (p50/p95/p99), queue depth,
-per-bucket hit counts and compile count via :meth:`stats`; ``serve``
-events (start/stop/error) in the obs stream (docs/observability.md).
+Telemetry: every counter, gauge and the fixed-bucket latency histogram
+live in the process-wide mergeable registry (``obs/metrics.py``,
+labelled ``engine=<name>``) so per-replica numbers roll up exactly
+across a fleet; :meth:`stats` is a thin view over the registry
+(p50/p95/p99, queue depth, bucket hits, compile count), and ``serve``
+events (start/stop/error) ride the obs stream (docs/observability.md).
+Sampled requests carry a trace context (``obs/trace.py``) that the
+H2D and compute stages stamp in passing.
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import queue
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future
 
 import numpy as np
@@ -61,8 +66,9 @@ DEFAULT_MAX_WAIT_MS = 2.0
 #: bounded hand-off depth between assembler -> H2D -> compute (the
 #: prefetch double-buffer: one batch in flight per stage, one queued)
 _STAGE_DEPTH = 2
-#: latency reservoir size for the percentile stats
-_LATENCY_WINDOW = 8192
+#: default engine names: unique per process so registry series never
+#: collide between replicas that share one process
+_ENGINE_SEQ = itertools.count()
 
 
 def max_batch_default() -> int:
@@ -81,12 +87,13 @@ def max_wait_ms_default() -> float:
 
 
 class _Request:
-    __slots__ = ("x", "future", "t_submit")
+    __slots__ = ("x", "future", "t_submit", "trace")
 
-    def __init__(self, x):
+    def __init__(self, x, trace=None):
         self.x = x
         self.future = Future()
         self.t_submit = time.perf_counter()
+        self.trace = trace       # obs.trace.Trace for sampled requests
 
 
 class _End:
@@ -130,10 +137,11 @@ class ServeEngine:
     def __init__(self, model, max_batch: int | None = None,
                  max_wait_ms: float | None = None, policy=None,
                  input_shape=None, input_dtype=np.float32,
-                 max_queue: int | None = None):
+                 max_queue: int | None = None, name: str | None = None):
         import jax
 
         self.model = model
+        self.name = name or f"engine{next(_ENGINE_SEQ)}"
         self.max_batch = (max_batch_default() if max_batch is None
                           else max(1, int(max_batch)))
         self.max_wait_s = (max_wait_ms_default() if max_wait_ms is None
@@ -171,22 +179,48 @@ class ServeEngine:
         self._h2d_q: "queue.Queue" = queue.Queue(maxsize=_STAGE_DEPTH)
         self._exec_q: "queue.Queue" = queue.Queue(maxsize=_STAGE_DEPTH)
 
-        # telemetry (guarded by _lock).  accepted/shed/completed/failed
-        # are MONOTONIC from construction and never reset — the router
-        # rate-differences consecutive stats() snapshots, so a reset
-        # would read as a huge negative rate.  completed+failed+inflight
-        # == accepted at every instant; shed requests are counted in
-        # none of the other three (their futures fail without entering
-        # the pipeline).
+        # telemetry: every instrument lives in the process-wide
+        # mergeable registry (obs/metrics.py) under engine=<name>, so a
+        # replica fleet's numbers roll up exactly; the attribute
+        # properties below and stats() are VIEWS over it.  The
+        # accepted/shed/completed/failed counters are MONOTONIC from
+        # construction and never reset — the router rate-differences
+        # consecutive stats() snapshots, so a reset would read as a
+        # huge negative rate.  completed+failed+inflight == accepted at
+        # every instant; shed requests are counted in none of the other
+        # three (their futures fail without entering the pipeline).
+        from bigdl_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.get()
+        lab = {"engine": self.name}
+        self._m_req = {
+            outcome: reg.counter(
+                "serve_requests_total",
+                "engine admission counters by outcome", outcome=outcome,
+                **lab)
+            for outcome in ("accepted", "shed", "completed", "failed")}
+        self._m_batches = reg.counter(
+            "serve_batches_total", "micro-batches executed", **lab)
+        self._m_compiles = reg.counter(
+            "serve_compiles_total", "bucket executables installed", **lab)
+        self._m_latency = reg.histogram(
+            "serve_latency_seconds",
+            "submit-to-resolve request latency", **lab)
+        self._m_qdepth = reg.gauge(
+            "serve_queue_depth", "requests waiting for a batch", **lab)
+        self._m_qmax = reg.gauge(
+            "serve_queue_depth_max", "queue-depth high-water mark",
+            agg="max", **lab)
+        self._m_inflight = reg.gauge(
+            "serve_inflight", "accepted, not yet resolved", **lab)
+        self._m_version = reg.gauge(
+            "serve_weights_version", "committed weight version",
+            agg="max", **lab)
+        self._m_bucket = {
+            b: reg.counter("serve_bucket_hits_total",
+                           "batches served per pow2 bucket",
+                           bucket=str(b), **lab)
+            for b in self.buckets}
         self._inflight = 0       # submitted, future not yet resolved
-        self.compiles = 0        # executables installed for this engine
-        self.accepted = 0
-        self.shed = 0
-        self.served = 0          # rows completed OK (alias: completed)
-        self.batches = 0
-        self.errors = 0          # rows failed (alias: failed)
-        self._latencies = deque(maxlen=_LATENCY_WINDOW)
-        self._bucket_hits = {b: 0 for b in self.buckets}
         self._max_queue_depth = 0
 
         if input_shape is not None:
@@ -206,6 +240,33 @@ class ServeEngine:
         self._emit("start", max_batch=self.max_batch,
                    max_wait_ms=self.max_wait_s * 1e3,
                    buckets=list(self.buckets))
+
+    # -- registry-backed counter views (monotonic; see __init__) ------------
+    @property
+    def accepted(self) -> int:
+        return int(self._m_req["accepted"].value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._m_req["shed"].value)
+
+    @property
+    def served(self) -> int:
+        """Rows completed OK (alias: completed)."""
+        return int(self._m_req["completed"].value)
+
+    @property
+    def errors(self) -> int:
+        """Rows failed (alias: failed)."""
+        return int(self._m_req["failed"].value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._m_batches.value)
+
+    @property
+    def compiles(self) -> int:
+        return int(self._m_compiles.value)
 
     # -- compilation --------------------------------------------------------
     def warmup(self, row_shape: tuple, row_dtype=np.float32):
@@ -249,7 +310,7 @@ class ServeEngine:
                 dt = time.perf_counter() - t0
                 with self._lock:
                     self._executables[b] = exe
-                    self.compiles += 1
+                self._m_compiles.inc()
                 fresh += 1
                 logger.info("serve warmup: bucket %d %s in %.3fs", b,
                             "compiled" if built else "cache hit", dt)
@@ -320,6 +381,7 @@ class ServeEngine:
             self._weights = staged
             self.weights_version = version
             self._staged = None
+        self._m_version.set(version)
         self._emit("weights_commit", version=version)
         return version
 
@@ -342,19 +404,23 @@ class ServeEngine:
             self._weights = weights
             self.weights_version = version
             self._prev_weights = None
+        self._m_version.set(version)
         self._emit("weights_revert", version=version)
         return version
 
     # -- submit side --------------------------------------------------------
-    def submit(self, x) -> Future:
+    def submit(self, x, trace=None) -> Future:
         """Queue one row (shape = model input WITHOUT the batch dim);
         returns a future resolving to that row's output array.
+        ``trace`` (an ``obs.trace.Trace``) rides the request and is
+        stamped by the H2D and compute stages — the router passes one
+        for sampled requests.
 
         A request whose payload is non-finite fails its OWN future with
         :class:`PoisonedRequestError` (the rest of its micro-batch is
         served) — stricter than the pre-engine Predictor loop, which
         forwarded NaN/Inf rows to the model silently."""
-        req = _Request(np.asarray(x))
+        req = _Request(np.asarray(x), trace=trace)
         # closed-check and enqueue under the lock: close() flips _closed
         # under the same lock, so a request can never slip into the
         # queue after close()'s final leftover drain (its future would
@@ -367,13 +433,16 @@ class ServeEngine:
             if self.max_queue is not None and depth > self.max_queue:
                 # admission shed: fail fast instead of queuing past any
                 # deadline; the future fails, the pipeline never sees it
-                self.shed += 1
+                self._m_req["shed"].inc()
                 shed = True
             else:
-                self.accepted += 1
+                self._m_req["accepted"].inc()
                 self._inflight += 1
+                self._m_inflight.set(self._inflight)
+                self._m_qdepth.set(depth)
                 if depth > self._max_queue_depth:
                     self._max_queue_depth = depth
+                    self._m_qmax.set(depth)
                 self._queue.put(req)   # unbounded put: never blocks
         if shed:
             self._emit("shed", queue_depth=self.max_queue)
@@ -465,8 +534,8 @@ class ServeEngine:
         except BaseException as e:
             self._fail(good, e)
             return
-        with self._lock:
-            self._bucket_hits[bucket] += 1
+        self._m_bucket[bucket].inc()
+        self._m_qdepth.set(self._queue.qsize())
         self._h2d_q.put((good, xs, bucket, n))
 
     def _vet(self, x):
@@ -493,6 +562,10 @@ class ServeEngine:
             except BaseException as e:
                 self._fail(reqs, e)
                 continue
+            ts = time.perf_counter()
+            for r in reqs:
+                if r.trace is not None:
+                    r.trace.stamp("h2d", ts)
             self._exec_q.put((reqs, xdev, bucket, n))
 
     def _chaos_h2d(self):
@@ -526,18 +599,28 @@ class ServeEngine:
             out = bucketing.trim(out, n)
             done = time.perf_counter()
             with self._lock:
-                self.batches += 1
-                self.served += len(reqs)
+                # completed inc'd under the SAME lock as the inflight
+                # decrement so stats() never sees the transient where
+                # completed+failed+inflight != accepted
                 self._inflight -= len(reqs)
-                for r in reqs:
-                    self._latencies.append(done - r.t_submit)
+                self._m_inflight.set(self._inflight)
+                self._m_batches.inc()
+                self._m_req["completed"].inc(len(reqs))
+            for r in reqs:
+                self._m_latency.observe(done - r.t_submit)
+                if r.trace is not None:
+                    # stamped BEFORE set_result: the router's done
+                    # callback runs on this thread and stamps complete
+                    # after, keeping the hop chain monotone
+                    r.trace.stamp("compute", done)
             for i, r in enumerate(reqs):
                 r.future.set_result(out[i])
 
     def _fail(self, reqs, exc):
         with self._lock:
-            self.errors += len(reqs)
             self._inflight -= len(reqs)
+            self._m_inflight.set(self._inflight)
+            self._m_req["failed"].inc(len(reqs))
         self._emit("error", error=f"{type(exc).__name__}: {exc}",
                    requests=len(reqs))
         for r in reqs:
@@ -550,11 +633,14 @@ class ServeEngine:
         events.emit("serve", kind=kind, **fields)
 
     def latency_quantiles(self, qs=(50, 95, 99)) -> dict:
-        with self._lock:
-            lat = np.asarray(self._latencies, np.float64)
-        if lat.size == 0:
-            return {f"p{int(q)}": None for q in qs}
-        return {f"p{int(q)}": float(np.percentile(lat, q)) for q in qs}
+        """Percentiles from the registry's fixed-bucket histogram —
+        quantized to the pinned bounds (obs/metrics.LATENCY_BUCKETS),
+        which is exactly what makes them mergeable across replicas."""
+        from bigdl_tpu.obs import metrics as obs_metrics
+        counts = self._m_latency.counts()
+        bounds = self._m_latency.bounds
+        return {f"p{int(q)}": obs_metrics.quantile(bounds, counts, q)
+                for q in qs}
 
     def inflight(self) -> int:
         """Requests accepted but not yet resolved (the router's
@@ -565,7 +651,10 @@ class ServeEngine:
     def stats(self) -> dict:
         """Snapshot: latency percentiles (seconds), queue depth, bucket
         hit counts, compile count, and the four monotonic admission
-        counters (``accepted``/``shed``/``completed``/``failed``).
+        counters (``accepted``/``shed``/``completed``/``failed``) — a
+        thin VIEW over this engine's series in the process metrics
+        registry (``obs/metrics.py``); the registry is the source of
+        truth the fleet merge and the Prometheus exporter read.
 
         Counter semantics: monotonic from engine construction, NEVER
         reset — rate-difference two snapshots to get a rate (the router
@@ -574,22 +663,32 @@ class ServeEngine:
         ``shed``.  ``served``/``errors`` are the pre-router aliases of
         completed/failed and stay for compatibility."""
         with self._lock:
-            out = {
-                "accepted": self.accepted,
-                "shed": self.shed,
-                "completed": self.served,
-                "failed": self.errors,
-                "inflight": self._inflight,
-                "served": self.served,
-                "batches": self.batches,
-                "errors": self.errors,
-                "compiles": self.compiles,
-                "weights_version": self.weights_version,
-                "queue_depth": self._queue.qsize(),
-                "max_queue_depth": self._max_queue_depth,
-                "bucket_hits": dict(self._bucket_hits),
-                "buckets": list(self.buckets),
-            }
+            # the admission counters are read under the same lock their
+            # paired inflight updates happen under, so the snapshot
+            # satisfies completed+failed+inflight == accepted exactly
+            inflight = self._inflight
+            queue_depth = self._queue.qsize()
+            max_depth = self._max_queue_depth
+            version = self.weights_version
+            accepted, shed = self.accepted, self.shed
+            completed, failed = self.served, self.errors
+        out = {
+            "accepted": accepted,
+            "shed": shed,
+            "completed": completed,
+            "failed": failed,
+            "inflight": inflight,
+            "served": completed,
+            "batches": self.batches,
+            "errors": failed,
+            "compiles": self.compiles,
+            "weights_version": version,
+            "queue_depth": queue_depth,
+            "max_queue_depth": max_depth,
+            "bucket_hits": {b: int(c.value)
+                            for b, c in self._m_bucket.items()},
+            "buckets": list(self.buckets),
+        }
         out.update(self.latency_quantiles())
         return out
 

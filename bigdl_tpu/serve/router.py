@@ -36,10 +36,19 @@ has:
 The router never touches jax: replicas are anything with the small
 ``submit/stats/inflight/alive`` surface (``serve/cluster.py`` provides
 in-process and subprocess implementations).
+
+Telemetry: the admission counters live in the mergeable metrics
+registry (``obs/metrics.py``, labelled ``router=<name>``), and the
+router is where request TRACES begin and end — admission mints a trace
+context for every sampled request (``BIGDL_OBS_TRACE_SAMPLE``,
+``obs/trace.py``), the dispatch path stamps queue/dispatch/shed/requeue
+hops, and completion emits the finished chain as one ``trace`` obs
+event.
 """
 from __future__ import annotations
 
 import heapq
+import inspect
 import itertools
 import logging
 import os
@@ -47,9 +56,12 @@ import threading
 import time
 from concurrent.futures import Future
 
+from bigdl_tpu.obs import trace as obs_trace
 from bigdl_tpu.serve.engine import SheddedError  # noqa: F401 (re-export)
 
 logger = logging.getLogger("bigdl_tpu.serve")
+
+_ROUTER_SEQ = itertools.count()
 
 ENV_REPLICAS = "BIGDL_SERVE_REPLICAS"
 ENV_SLO_MS = "BIGDL_SERVE_SLO_MS"
@@ -86,14 +98,15 @@ class DeadReplicaError(RuntimeError):
 
 class _RouterReq:
     __slots__ = ("x", "future", "priority", "deadline", "t_submit",
-                 "attempts", "queued")
+                 "attempts", "queued", "trace")
 
-    def __init__(self, x, priority, deadline):
+    def __init__(self, x, priority, deadline, trace=None):
         self.x = x
         self.future = Future()
         self.priority = int(priority)
         self.deadline = deadline          # absolute perf_counter, or None
         self.t_submit = time.perf_counter()
+        self.trace = trace                # obs.trace.Trace when sampled
         self.attempts = 0
         #: True while sitting in the admission heap — the idempotence
         #: guard for requeue-on-death (a dying replica's request can be
@@ -114,16 +127,23 @@ class Router:
 
     def __init__(self, replicas, slo_ms: float | None = None,
                  shed: bool | None = None, est_ms: float = 50.0,
-                 max_requeues: int = 3, health_interval: float = 0.2):
+                 max_requeues: int = 3, health_interval: float = 0.2,
+                 name: str | None = None,
+                 trace_sample: float | None = None):
         if not replicas:
             raise ValueError("Router needs at least one replica")
         self.replicas = list(replicas)
+        self.name = name or f"router{next(_ROUTER_SEQ)}"
         self.slo_s = (slo_ms_default() if slo_ms is None
                       else max(0.0, float(slo_ms))) / 1e3
         self.shed_enabled = shed_default() if shed is None else bool(shed)
         self.max_requeues = int(max_requeues)
         self._est_s = max(float(est_ms), 0.0) / 1e3
         self._seq = itertools.count()
+        #: request tracing: deterministic sampler, default rate from
+        #: BIGDL_OBS_TRACE_SAMPLE (0 = the hot path never stamps)
+        self._sampler = obs_trace.Sampler(rate=trace_sample)
+        self._trace_kwarg_ok: dict = {}   # id(replica) -> bool
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -133,12 +153,34 @@ class Router:
         self._dead: set = set()
         self._closed = False
 
-        # monotonic counters (stats(); never reset — see engine.stats)
-        self.accepted = 0
-        self.shed = 0
-        self.completed = 0
-        self.failed = 0
-        self.requeued = 0
+        # monotonic counters (stats(); never reset — see engine.stats),
+        # registry-backed so fleet dashboards read them merged
+        from bigdl_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.get()
+        lab = {"router": self.name}
+        self._m_req = {
+            outcome: reg.counter("router_requests_total",
+                                 "router admission counters by outcome",
+                                 outcome=outcome, **lab)
+            for outcome in ("accepted", "completed", "failed", "requeued")}
+        # sheds split into DISJOINT stages: "admission" = pre-dispatch
+        # SLO shed (the request never reached an engine, so NO engine
+        # counter saw it) vs "replica" = an engine max_queue shed
+        # bubbled up (already in that engine's serve_requests_total).
+        # Fleet roll-ups (metrics.serving_summary, serve_top) add only
+        # the admission stage on top of the engine counters — adding
+        # both would double-count replica-stage sheds.
+        self._m_shed = {
+            stage: reg.counter("router_requests_total",
+                               "router admission counters by outcome",
+                               outcome="shed", stage=stage, **lab)
+            for stage in ("admission", "replica")}
+        self._m_qdepth = reg.gauge(
+            "router_queue_depth", "admission-heap depth", **lab)
+        self._m_est = reg.gauge(
+            "router_est_ms", "EWMA service-time estimate (ms)",
+            agg="max", **lab)
+        self._m_est.set(self._est_s * 1e3)
 
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -151,6 +193,28 @@ class Router:
         self._emit("router_start", replicas=len(self.replicas),
                    slo_ms=self.slo_s * 1e3, shed=self.shed_enabled)
 
+    # -- registry-backed counter views (monotonic) --------------------------
+    @property
+    def accepted(self) -> int:
+        return int(self._m_req["accepted"].value)
+
+    @property
+    def shed(self) -> int:
+        return int(self._m_shed["admission"].value
+                   + self._m_shed["replica"].value)
+
+    @property
+    def completed(self) -> int:
+        return int(self._m_req["completed"].value)
+
+    @property
+    def failed(self) -> int:
+        return int(self._m_req["failed"].value)
+
+    @property
+    def requeued(self) -> int:
+        return int(self._m_req["requeued"].value)
+
     # -- submit -------------------------------------------------------------
     def submit(self, x, priority: int = 1,
                slo_ms: float | None = None) -> Future:
@@ -160,12 +224,16 @@ class Router:
         means no deadline (the request is never shed)."""
         slo_s = self.slo_s if slo_ms is None else max(0.0, slo_ms) / 1e3
         deadline = (time.perf_counter() + slo_s) if slo_s > 0 else None
-        req = _RouterReq(x, priority, deadline)
+        tr = self._sampler.next()
+        if tr is not None:
+            tr.stamp("admit")
+        req = _RouterReq(x, priority, deadline, trace=tr)
         with self._cv:
             if self._closed:
                 raise RuntimeError("Router is closed")
-            self.accepted += 1
+            self._m_req["accepted"].inc()
             self._push(req)
+            self._m_qdepth.set(len(self._heap))
             self._cv.notify()
         return req.future
 
@@ -198,6 +266,9 @@ class Router:
                 # visible to drain() while between heap and outstanding
                 self._dispatching += 1
                 est = self._est_s
+                self._m_qdepth.set(len(self._heap))
+            if req.trace is not None:
+                req.trace.stamp("queue")
             try:
                 self._route(req, est)
             finally:
@@ -217,10 +288,10 @@ class Router:
         # first, so overload drains budget from the LOWEST class first.
         if (self.shed_enabled and req.deadline is not None
                 and time.perf_counter() + est * (load + 1) > req.deadline):
-            with self._lock:
-                self.shed += 1
+            self._m_shed["admission"].inc()
             self._emit("shed", priority=req.priority,
                        wait_ms=(time.perf_counter() - req.t_submit) * 1e3)
+            self._finish_trace(req, "shed", hop="shed")
             req.future.set_exception(SheddedError(
                 f"projected completion past deadline (priority "
                 f"{req.priority}, backlog {load}, est "
@@ -228,8 +299,13 @@ class Router:
             return
         with self._lock:
             self._outstanding[id(replica)][id(req)] = req
+        if req.trace is not None:
+            req.trace.stamp("dispatch")
         try:
-            inner = replica.submit(req.x)
+            if req.trace is not None and self._accepts_trace(replica):
+                inner = replica.submit(req.x, trace=req.trace)
+            else:
+                inner = replica.submit(req.x)
         except Exception as e:
             with self._lock:
                 self._outstanding[id(replica)].pop(id(req), None)
@@ -237,6 +313,26 @@ class Router:
             return
         inner.add_done_callback(
             lambda f, r=replica, q=req: self._on_done(r, q, f))
+
+    def _accepts_trace(self, replica) -> bool:
+        """Whether ``replica.submit`` takes the ``trace`` kwarg
+        (replicas in this repo do; test fakes and minimal replicas may
+        not).  Decided ONCE per replica by signature inspection, never
+        by catching TypeError from the call — a submit that raises
+        TypeError mid-flight (e.g. an unpicklable payload crossing the
+        ProcessReplica frame boundary AFTER the future was registered)
+        must surface, not be silently re-submitted untraced."""
+        ok = self._trace_kwarg_ok.get(id(replica))
+        if ok is None:
+            try:
+                params = inspect.signature(replica.submit).parameters
+                ok = ("trace" in params
+                      or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                             for p in params.values()))
+            except (TypeError, ValueError):  # builtins, C callables
+                ok = False
+            self._trace_kwarg_ok[id(replica)] = ok
+        return ok
 
     def _pick(self):
         """Least-loaded live replica (outstanding count through this
@@ -268,8 +364,12 @@ class Router:
         if exc is None:
             lat = time.perf_counter() - req.t_submit
             with self._lock:
-                self.completed += 1
                 self._est_s += _EST_ALPHA * (lat - self._est_s)
+                self._m_est.set(self._est_s * 1e3)
+            self._m_req["completed"].inc()
+            self._finish_trace(req, "ok", hop="complete",
+                               replica=getattr(replica, "name", None),
+                               latency_ms=lat * 1e3)
             if not req.future.done():
                 req.future.set_result(inner.result())
         else:
@@ -283,8 +383,8 @@ class Router:
             # an engine-level admission shed (max_queue) is a SHED in
             # the router's taxonomy too, not a failure — the documented
             # counter contract keeps shed/failed disjoint
-            with self._lock:
-                self.shed += 1
+            self._m_shed["replica"].inc()
+            self._finish_trace(req, "shed", hop="shed")
             if not req.future.done():
                 req.future.set_exception(exc)
             return
@@ -300,16 +400,32 @@ class Router:
                 req.attempts += 1
                 with self._cv:
                     if self._push(req):
-                        self.requeued += 1
+                        self._m_req["requeued"].inc()
+                        if req.trace is not None:
+                            req.trace.stamp("requeue")
                         self._cv.notify()
                 return
         self._fail(req, exc)
 
     def _fail(self, req, exc):
-        with self._lock:
-            self.failed += 1
+        self._m_req["failed"].inc()
+        self._finish_trace(req, "failed",
+                           error=f"{type(exc).__name__}: {exc}")
         if not req.future.done():
             req.future.set_exception(exc)
+
+    def _finish_trace(self, req, status, hop=None, **fields):
+        """Terminal trace emission for a sampled request (no-op for the
+        unsampled 99.x%).  The trace object is detached afterwards so a
+        double-resolution path (death sweep + failing future) cannot
+        emit twice."""
+        tr, req.trace = req.trace, None
+        if tr is None:
+            return
+        if hop:
+            tr.stamp(hop)
+        tr.emit(status=status, priority=req.priority,
+                **{k: v for k, v in fields.items() if v is not None})
 
     # -- health -------------------------------------------------------------
     def _mark_dead(self, replica):
@@ -334,7 +450,9 @@ class Router:
                 req.attempts += 1
                 with self._cv:
                     if self._push(req):
-                        self.requeued += 1
+                        self._m_req["requeued"].inc()
+                        if req.trace is not None:
+                            req.trace.stamp("requeue")
                         self._cv.notify()
             else:
                 self._fail(req, DeadReplicaError(
@@ -371,19 +489,23 @@ class Router:
 
     def stats(self) -> dict:
         """Router counters (monotonic, never reset) + queue depth + the
-        current service-time estimate."""
+        current service-time estimate — a view over the metrics
+        registry, like ``engine.stats()``."""
         with self._lock:
-            return {
-                "accepted": self.accepted,
-                "shed": self.shed,
-                "completed": self.completed,
-                "failed": self.failed,
-                "requeued": self.requeued,
-                "queue_depth": len(self._heap),
-                "est_ms": self._est_s * 1e3,
-                "replicas": len(self.replicas),
-                "dead_replicas": len(self._dead),
-            }
+            queue_depth = len(self._heap)
+            est_ms = self._est_s * 1e3
+            dead = len(self._dead)
+        return {
+            "accepted": self.accepted,
+            "shed": self.shed,
+            "completed": self.completed,
+            "failed": self.failed,
+            "requeued": self.requeued,
+            "queue_depth": queue_depth,
+            "est_ms": est_ms,
+            "replicas": len(self.replicas),
+            "dead_replicas": dead,
+        }
 
     def drain(self, timeout: float = 60.0):
         """Block until every accepted request has resolved or been

@@ -107,6 +107,35 @@ def _shapes_key(args):
     return tuple(out)
 
 
+_METRIC_HANDLES = (None, -1, {})   # (registry, generation, name->Counter)
+
+
+def _note_metric(name: str):
+    """Mirror a compile/hit tick into the obs metrics registry so the
+    fleet exporter sees the process-truthful compile counter next to
+    the serving numbers.  Counter handles are cached per (registry,
+    generation) — this runs on every tracked_jit dispatch (once per
+    train step), which must not pay a registry-lock resolution each
+    time; a reset()/clear() bumps the generation and forces
+    re-registration.  Best-effort by design: the executable cache must
+    work even if the obs layer is mid-teardown."""
+    global _METRIC_HANDLES
+    try:
+        from bigdl_tpu.obs import metrics
+        reg = metrics.get()
+        cache_reg, gen, handles = _METRIC_HANDLES
+        if cache_reg is not reg or gen != reg.generation:
+            handles = {}
+            _METRIC_HANDLES = (reg, reg.generation, handles)
+        c = handles.get(name)
+        if c is None:
+            c = handles[name] = reg.counter(name,
+                                            "shared executable cache")
+        c.inc()
+    except Exception:  # pragma: no cover - obs layer unavailable
+        pass
+
+
 class ExecutableCache:
     """The process-wide registry.  Thread-safe: serve replicas warm
     concurrently with a validating training thread."""
@@ -130,6 +159,7 @@ class ExecutableCache:
             exe = self._exes.get(key)
             if exe is not None:
                 self.hits += 1
+                _note_metric("xcache_hits_total")
                 return exe, False
         # compile outside the lock: tens of seconds cold on a chip, and
         # another thread may be resolving a different bucket meanwhile
@@ -137,9 +167,11 @@ class ExecutableCache:
         with self._lock:
             if key in self._exes:   # lost a benign race: count the hit
                 self.hits += 1
+                _note_metric("xcache_hits_total")
                 return self._exes[key], False
             self._exes[key] = exe
             self.compiles += 1
+        _note_metric("xcache_compiles_total")
         return exe, True
 
     def note_jit_dispatch(self, fn_key, key_args, mesh=None) -> bool:
@@ -149,10 +181,14 @@ class ExecutableCache:
         with self._lock:
             if key in self._jit_keys:
                 self.hits += 1
-                return False
-            self._jit_keys.add(key)
-            self.compiles += 1
-            return True
+                fresh = False
+            else:
+                self._jit_keys.add(key)
+                self.compiles += 1
+                fresh = True
+        _note_metric("xcache_compiles_total" if fresh
+                     else "xcache_hits_total")
+        return fresh
 
     def stats(self) -> dict:
         with self._lock:

@@ -17,7 +17,8 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
 echo "== obs smoke 1/3: fast obs-marked tests =="
-python -m pytest tests/test_obs.py -q -m "obs and not slow" \
+python -m pytest tests/test_obs.py tests/test_obs_metrics.py -q \
+    -m "obs and not slow" \
     -p no:cacheprovider -p no:randomly
 
 RUN=$(mktemp -d)

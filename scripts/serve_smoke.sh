@@ -9,7 +9,13 @@
 #     contract, docs/serving.md) + a sane p95;
 #   - 2-replica router drill with a HOT WEIGHT SWAP mid-stream: every
 #     future resolves (zero dropped), outputs flip atomically between
-#     the two versions, the router sheds nothing.
+#     the two versions, the router sheds nothing;
+#   - traced 2-replica fleet drill (1 in-process + 1 subprocess,
+#     BIGDL_OBS_TRACE_SAMPLE=1): every completed request leaves a
+#     trace event with a complete monotone admit->complete hop chain
+#     in the PARENT event log (the subprocess's own obs events are
+#     forwarded there too), and the merged-registry Prometheus
+#     exposition parses.
 #
 #   scripts/serve_smoke.sh              # full set + drills
 #   scripts/serve_smoke.sh -k deadline  # narrow (skips the drills)
@@ -115,4 +121,71 @@ print(f"OK: 200 routed requests across 2 replicas with a mid-stream "
       f"hot swap to v{version}; zero dropped, zero shed, est "
       f"{s['est_ms']:.1f} ms")
 PY
+
+echo "== serve smoke: traced fleet drill (local + subprocess replica) =="
+OBSRUN=$(mktemp -d)
+python - "$OBSRUN" <<'PY'
+import sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.obs import metrics
+from bigdl_tpu.obs.events import read_events
+from bigdl_tpu.obs.trace import REQUEST_PHASES
+from bigdl_tpu.serve import LocalReplica, ProcessReplica, ReplicaPool, ServeEngine
+from bigdl_tpu.utils.random import set_seed
+
+obs_events.configure(sys.argv[1])
+set_seed(1)
+model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(),
+                      nn.Linear(8, 3), nn.LogSoftMax())
+kwargs = dict(max_batch=8, max_wait_ms=2, input_shape=(4,))
+local = LocalReplica(ServeEngine(model, name="local0", **kwargs),
+                     name="local0")
+proc = ProcessReplica(model, name="proc0", **kwargs)
+rows = np.random.RandomState(0).randn(60, 4).astype(np.float32)
+
+with ReplicaPool(replicas=[local, proc], shed=False,
+                 trace_sample=1.0) as pool:
+    futs = []
+    for r in rows:
+        futs.append(pool.submit(r))
+        time.sleep(0.001)
+    for f in futs:
+        f.result(timeout=120)
+    assert pool.router.stats()["failed"] == 0
+    exposition = pool.prometheus()
+    merged = pool.merged_registry()
+
+# the exposition parses and carries the merged latency histogram
+samples = metrics.parse_prometheus(exposition)
+assert any(n == "serve_latency_seconds_bucket" for n, _, _ in samples)
+agg = metrics.merged_histogram(merged, "serve_latency_seconds")
+assert agg is not None and agg[3] == 60, agg
+
+events = read_events(obs_events.get().path)
+# the subprocess replica's own events reached the PARENT log
+child = [e for e in events if e.get("replica") == "proc0"]
+assert any(e.get("kind") == "start" for e in child), "no child events"
+# every completed request left a complete monotone hop chain
+traces = [e for e in events if e["type"] == "trace"
+          and e["status"] == "ok"]
+assert len(traces) == 60, len(traces)
+for e in traces:
+    phases = [h[0] for h in e["hops"]]
+    stamps = [h[1] for h in e["hops"]]
+    it = iter(phases)
+    assert all(p in it for p in REQUEST_PHASES), phases
+    assert stamps == sorted(stamps), "hop chain not monotone"
+qs = metrics.histogram_quantiles(merged, "serve_latency_seconds")
+print(f"OK: 60 traced requests over local+subprocess replicas; "
+      f"{len(child)} child events forwarded; fleet p50 "
+      f"{qs['p50']*1e3:.1f} ms, p99 {qs['p99']*1e3:.1f} ms")
+PY
+python tools/obs_report.py "$OBSRUN" --strict -o "$OBSRUN/report.md"
+grep -q "Trace waterfall" "$OBSRUN/report.md"
+echo "OK: trace waterfall rendered ($OBSRUN/report.md)"
 echo "serve smoke: all green"

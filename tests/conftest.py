@@ -53,6 +53,11 @@ def _seed():
     xc = sys.modules.get("bigdl_tpu.serve.xcache")
     if xc is not None:
         xc.reset()
+    # same story for the obs metrics registry: engines/routers register
+    # per-name series, and counter assertions need a clean registry
+    mx = sys.modules.get("bigdl_tpu.obs.metrics")
+    if mx is not None:
+        mx.reset()
     yield
 
 

@@ -569,6 +569,53 @@ class TestReport:
         assert tool.main([obs_run_dir, "-o", out]) == 0
         assert "# obs report" in open(out).read()
 
+    def test_renders_serving_section_and_trace_waterfall(self, tmp_path):
+        """The serve event type is no longer ignored: rollout timeline,
+        shed/error counts and a per-hop waterfall for the slowest
+        sampled requests all render."""
+        from bigdl_tpu.obs.events import SCHEMA_VERSION
+        base = {"v": SCHEMA_VERSION, "proc": 0}
+        evs = [
+            dict(base, ts=1.0, type="serve", kind="start", engine="e0"),
+            dict(base, ts=2.0, type="serve", kind="rollout_begin",
+                 version=1, replicas=2),
+            dict(base, ts=2.5, type="serve", kind="rollout_commit",
+                 version=1, replicas=2),
+            dict(base, ts=2.6, type="serve", kind="rollout_rollback",
+                 version=2, phase="commit", error="OSError: boom"),
+            dict(base, ts=3.0, type="serve", kind="error",
+                 error="PoisonedRequestError: nan", requests=3),
+            dict(base, ts=3.1, type="serve", kind="shed", priority=1),
+            dict(base, ts=3.2, type="serve", kind="replica_dead",
+                 replica="proc1"),
+            dict(base, ts=4.0, type="trace", trace_id="aaaa1111",
+                 status="ok", duration_ms=30.0,
+                 hops=[["admit", 0.0], ["queue", 0.001],
+                       ["dispatch", 0.002], ["compute", 0.025],
+                       ["complete", 0.030]]),
+            dict(base, ts=4.1, type="trace", trace_id="bbbb2222",
+                 status="ok", duration_ms=5.0,
+                 hops=[["admit", 0.0], ["complete", 0.005]]),
+        ]
+        p = tmp_path / "events.p0.jsonl"
+        p.write_text("".join(json.dumps(e) + "\n" for e in evs))
+        tool = self._load_tool()
+        events, bad, bundles = tool.load_run(str(tmp_path))
+        assert not bad, bad
+        md = tool.render(events, bad, bundles, waterfall=1)
+        assert "## Serving" in md
+        assert "Rollout timeline" in md
+        assert "rollout_rollback" in md and "OSError: boom" in md
+        assert "failed requests: **3**" in md
+        assert "replica death: **proc1**" in md
+        assert "Trace waterfall (slowest 1 of 2" in md
+        assert "`aaaa1111`" in md            # the slowest one
+        assert "`bbbb2222`" not in md        # cut by waterfall=1
+        # waterfall column math: compute hop = 23 ms on the slow trace
+        assert "23.00" in md
+        md0 = tool.render(events, bad, bundles, waterfall=0)
+        assert "Trace waterfall" not in md0
+
     def test_strict_mode_counts_bad_lines(self, tmp_path):
         p = tmp_path / "events.p0.jsonl"
         good = {"v": 1, "ts": 1.0, "proc": 0, "type": "fault",

@@ -1,0 +1,569 @@
+"""Mergeable metrics registry + request tracing + export suite
+(docs/observability.md "Serving telemetry", marker ``obs``).
+
+The load-bearing contracts:
+
+- histogram MERGE EXACTNESS: per-replica histograms with the pinned
+  bucket bounds, merged by count addition, reproduce the quantiles of
+  one histogram that observed the pooled stream EXACTLY — the property
+  that makes a fleet p99 meaningful;
+- quantiles from the bucketed histogram land within one bucket width of
+  the true (numpy) percentile of the raw pooled samples;
+- the Prometheus text exposition renders and parses back (the CI
+  drill's round-trip), histograms as cumulative ``_bucket`` series;
+- serve events carry per-kind REQUIRED fields (schema v2) and trace
+  events carry well-formed hop chains;
+- the trace context round-trips the process boundary without losing or
+  duplicating hops, and the sampler is deterministic;
+- the pull exporter serves /metrics and /snapshot over HTTP;
+- ``serve_top`` computes per-engine and fleet rows from two snapshots.
+"""
+import json
+import math
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.obs import events, export, metrics, trace
+
+pytestmark = pytest.mark.obs
+
+
+# ---------------------------------------------------------------------------
+# registry basics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_counter_identity_and_monotonicity(self):
+        reg = metrics.Registry()
+        c1 = reg.counter("req_total", "x", engine="a")
+        c2 = reg.counter("req_total", "x", engine="a")
+        assert c1 is c2                     # same (name, labels)
+        c3 = reg.counter("req_total", "x", engine="b")
+        assert c3 is not c1
+        c1.inc()
+        c1.inc(4)
+        assert c1.value == 5 and c3.value == 0
+
+    def test_gauge_agg_modes(self):
+        reg = metrics.Registry()
+        g = reg.gauge("depth", "x", agg="sum")
+        g.set(3)
+        g.add(2)
+        assert g.value == 5
+        with pytest.raises(ValueError, match="agg"):
+            metrics.Gauge(agg="median")
+
+    def test_type_conflict_raises(self):
+        reg = metrics.Registry()
+        reg.counter("m", "x")
+        with pytest.raises(ValueError, match="counter"):
+            reg.gauge("m", "x")
+
+    def test_gauge_agg_conflict_raises(self):
+        reg = metrics.Registry()
+        reg.gauge("hw", "x", agg="max")
+        with pytest.raises(ValueError, match="agg"):
+            reg.gauge("hw", "x", agg="sum")
+        # same agg resolves the same family fine
+        assert reg.gauge("hw", "x", agg="max") is not None
+
+    def test_drop_series_removes_matching_labels(self):
+        reg = metrics.Registry()
+        reg.counter("decode_steps_total", "x", decoder="d0").inc()
+        reg.counter("decode_steps_total", "x", decoder="d1").inc()
+        reg.gauge("decode_slots_active", "x", decoder="d0").set(3)
+        reg.counter("other_total", "x").inc()
+        reg.drop_series(decoder="d0")
+        snap = reg.snapshot()
+        assert "decode_slots_active" not in snap          # family emptied
+        rows = snap["decode_steps_total"]["series"]
+        assert [r["labels"] for r in rows] == [{"decoder": "d1"}]
+        assert "other_total" in snap                      # untouched
+
+    def test_histogram_bounds_conflict_raises(self):
+        reg = metrics.Registry()
+        reg.histogram("lat", "x")
+        with pytest.raises(ValueError, match="bounds"):
+            reg.histogram("lat", "x", bounds=(1.0, 2.0))
+
+    def test_histogram_bucket_indexing(self):
+        h = metrics.Histogram(bounds=(1.0, 10.0, 100.0))
+        for v in (0.5, 1.0, 5.0, 10.0, 99.0, 1000.0):
+            h.observe(v)
+        # (0,1]=2  (1,10]=2  (10,100]=1  overflow=1
+        assert h.counts() == [2, 2, 1, 1]
+        counts, s, n = h.state()
+        assert n == 6 and s == pytest.approx(1115.5)
+
+    def test_process_registry_reset_keeps_instruments(self):
+        reg = metrics.get()
+        c = reg.counter("zombie_total", "x")
+        metrics.reset()
+        c.inc()                          # keeps counting, just unlisted
+        assert "zombie_total" not in reg.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# merge exactness (the satellite contract)
+# ---------------------------------------------------------------------------
+
+def _observe_all(reg_name, values):
+    reg = metrics.Registry()
+    h = reg.histogram("serve_latency_seconds", "lat", engine=reg_name)
+    for v in values:
+        h.observe(v)
+    return reg.snapshot()
+
+
+class TestHistogramMergeExactness:
+    def test_merged_equals_pooled_exactly(self):
+        """Two replicas' histograms, merged, give IDENTICAL quantiles
+        to one histogram that saw the pooled stream — at every q."""
+        rng = np.random.RandomState(0)
+        a = rng.lognormal(-5, 1.0, 400)       # ~ms-scale latencies
+        b = rng.lognormal(-4, 0.5, 300)
+        snap_a = _observe_all("a", a)
+        snap_b = _observe_all("b", b)
+        pooled = _observe_all("pooled", np.concatenate([a, b]))
+
+        merged = metrics.merge([snap_a, snap_b])
+        for q in (1, 10, 25, 50, 75, 90, 95, 99, 99.9):
+            qm = metrics.histogram_quantiles(
+                merged, "serve_latency_seconds", qs=(q,))
+            qp = metrics.histogram_quantiles(
+                pooled, "serve_latency_seconds", qs=(q,))
+            assert qm == qp, f"p{q}: merged {qm} != pooled {qp}"
+
+    def test_quantile_within_one_bucket_of_numpy(self):
+        """The bucketed quantile lands within ONE bucket width of the
+        true percentile of the raw samples (the acceptance tolerance)."""
+        rng = np.random.RandomState(1)
+        values = rng.lognormal(-5, 1.2, 2000)
+        snap = _observe_all("x", values)
+        bounds = metrics.LATENCY_BUCKETS
+        h = metrics.Histogram()             # index mapper at the bounds
+        for q in (50, 95, 99):
+            est = metrics.histogram_quantiles(
+                snap, "serve_latency_seconds", qs=(q,))[f"p{int(q)}"]
+            true = float(np.percentile(values, q))
+            assert abs(h._index(est) - h._index(true)) <= 1, (
+                f"p{q}: bucketed {est} vs true {true} off by more than "
+                f"one bucket")
+
+    def test_merge_counts_add_elementwise(self):
+        snap_a = _observe_all("a", [0.001, 0.01])
+        snap_b = _observe_all("b", [0.001, 0.1])
+        merged = metrics.merge([snap_a, snap_b], drop_labels=("engine",))
+        fam = merged["serve_latency_seconds"]
+        assert len(fam["series"]) == 1       # engine label dropped
+        row = fam["series"][0]
+        assert row["count"] == 4
+        assert sum(row["counts"]) == 4
+        assert row["sum"] == pytest.approx(0.112)
+
+    def test_merge_rejects_mismatched_bounds(self):
+        reg = metrics.Registry()
+        reg.histogram("lat", "x", bounds=(1.0, 2.0)).observe(1.5)
+        other = metrics.Registry()
+        other.histogram("lat", "x", bounds=(1.0, 4.0)).observe(1.5)
+        with pytest.raises(ValueError, match="bounds"):
+            metrics.merge([reg.snapshot(), other.snapshot()])
+
+    def test_counters_sum_and_max_gauges_max(self):
+        a, b = metrics.Registry(), metrics.Registry()
+        for reg, n, hi in ((a, 3, 7.0), (b, 5, 4.0)):
+            reg.counter("req_total", "x").inc(n)
+            reg.gauge("depth", "x").set(n)
+            reg.gauge("hiwater", "x", agg="max").set(hi)
+        m = metrics.merge([a.snapshot(), b.snapshot()])
+        assert metrics.family_total(m, "req_total") == 8
+        assert metrics.family_total(m, "depth") == 8
+        assert metrics.family_total(m, "hiwater") == 7.0
+
+    def test_merge_skips_none_snapshots(self):
+        reg = metrics.Registry()
+        reg.counter("c_total", "x").inc()
+        m = metrics.merge([None, reg.snapshot(), None])
+        assert metrics.family_total(m, "c_total") == 1
+
+    def test_serving_summary_shape(self):
+        reg = metrics.Registry()
+        for outcome, n in (("accepted", 10), ("completed", 7),
+                           ("failed", 1), ("shed", 2)):
+            reg.counter("serve_requests_total", "x", outcome=outcome,
+                        engine="e0").inc(n)
+        reg.histogram("serve_latency_seconds", "x",
+                      engine="e0").observe(0.01)
+        s = metrics.serving_summary(reg.snapshot())
+        assert s["accepted"] == 10 and s["completed"] == 7
+        assert s["failed"] == 1 and s["shed"] == 2
+        assert s["p50"] is not None
+
+    def test_serving_summary_folds_router_admission_sheds(self):
+        """A router SLO shed happens before dispatch, so no engine
+        counter sees it — the fleet shed must include the admission
+        stage but NOT the replica stage (an engine max_queue shed the
+        router re-counts; adding it would double-count)."""
+        reg = metrics.Registry()
+        reg.counter("serve_requests_total", "x", outcome="shed",
+                    engine="e0").inc(3)
+        reg.counter("router_requests_total", "x", outcome="shed",
+                    stage="admission", router="r0").inc(5)
+        reg.counter("router_requests_total", "x", outcome="shed",
+                    stage="replica", router="r0").inc(3)
+        s = metrics.serving_summary(reg.snapshot())
+        assert s["shed"] == 8   # 3 engine + 5 admission, replica-stage not re-added
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+class TestPrometheusExposition:
+    def test_round_trip(self):
+        reg = metrics.Registry()
+        reg.counter("req_total", "requests", engine="a").inc(3)
+        reg.gauge("depth", "queue depth", engine="a").set(2)
+        h = reg.histogram("lat_seconds", "latency", engine="a")
+        for v in (0.001, 0.01, 0.01, 5.0):
+            h.observe(v)
+        text = metrics.render_prometheus(reg.snapshot())
+        samples = metrics.parse_prometheus(text)
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        assert by_name["req_total"][0] == ({"engine": "a"}, 3.0)
+        assert by_name["depth"][0] == ({"engine": "a"}, 2.0)
+        # histogram: cumulative buckets ending in +Inf == count
+        buckets = by_name["lat_seconds_bucket"]
+        assert buckets[-1][0]["le"] == "+Inf"
+        assert buckets[-1][1] == 4.0
+        cum = [v for _, v in buckets]
+        assert cum == sorted(cum), "bucket series must be cumulative"
+        assert by_name["lat_seconds_count"][0][1] == 4.0
+        assert by_name["lat_seconds_sum"][0][1] == pytest.approx(5.021)
+
+    def test_help_and_type_headers(self):
+        reg = metrics.Registry()
+        reg.counter("c_total", "my help text").inc()
+        text = metrics.render_prometheus(reg.snapshot())
+        assert "# HELP c_total my help text" in text
+        assert "# TYPE c_total counter" in text
+
+    def test_label_escaping_round_trips(self):
+        reg = metrics.Registry()
+        nasty = 'eng "A"\\prod\nline2'
+        reg.counter("req_total", "requests", engine=nasty).inc(2)
+        text = metrics.render_prometheus(reg.snapshot())
+        samples = metrics.parse_prometheus(text)   # must not raise
+        assert samples == [("req_total", {"engine": nasty}, 2.0)]
+
+    def test_parse_rejects_malformed(self):
+        with pytest.raises(ValueError, match="line 2"):
+            metrics.parse_prometheus("ok_total 1\nnot a sample !!\n")
+
+    def test_jsonl_snapshot_appends(self, tmp_path):
+        reg = metrics.Registry()
+        reg.counter("c_total", "x").inc(2)
+        path = str(tmp_path / "snaps.jsonl")
+        metrics.append_snapshot_jsonl(path, reg.snapshot(), ts=1.0)
+        reg.counter("c_total", "x").inc()
+        metrics.append_snapshot_jsonl(path, reg.snapshot(), ts=2.0)
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ln["ts"] for ln in lines] == [1.0, 2.0]
+        assert metrics.family_total(lines[-1]["snapshot"],
+                                    "c_total") == 3
+
+
+# ---------------------------------------------------------------------------
+# serve event schema v2 (per-kind required fields)
+# ---------------------------------------------------------------------------
+
+def _serve_event(**fields):
+    return dict({"v": events.SCHEMA_VERSION, "ts": 0.0, "proc": 0,
+                 "type": "serve"}, **fields)
+
+
+class TestServeEventSchema:
+    @pytest.mark.parametrize("kind,required", sorted(
+        (k, v) for k, v in events.SERVE_KINDS.items() if v))
+    def test_kind_required_fields(self, kind, required):
+        filled = _serve_event(kind=kind,
+                              **{f: 1 for f in required})
+        assert events.validate_event(filled)
+        for missing in required:
+            broken = dict(filled)
+            del broken[missing]
+            with pytest.raises(ValueError, match=missing):
+                events.validate_event(broken)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown serve kind"):
+            events.validate_event(_serve_event(kind="rolout_begin",
+                                               version=1))
+
+    def test_bare_kinds_accept_extra_fields(self):
+        assert events.validate_event(
+            _serve_event(kind="start", max_batch=64, anything="goes"))
+
+    def test_trace_event_schema(self):
+        ev = {"v": events.SCHEMA_VERSION, "ts": 0.0, "proc": 0,
+              "type": "trace", "trace_id": "ab", "status": "ok",
+              "hops": [["admit", 0.0], ["complete", 0.1]]}
+        assert events.validate_event(ev)
+        for bad_hops in ([], [["admit"]], "nope", [["a", 1, 2]]):
+            with pytest.raises(ValueError, match="hops"):
+                events.validate_event(dict(ev, hops=bad_hops))
+
+
+# ---------------------------------------------------------------------------
+# trace contexts
+# ---------------------------------------------------------------------------
+
+class TestTrace:
+    def test_sampler_deterministic(self):
+        s = trace.Sampler(rate=0.25)          # every 4th
+        hits = [s.next() is not None for _ in range(12)]
+        assert hits == [True, False, False, False] * 3
+
+    def test_sampler_fractional_rates_not_snapped(self):
+        """Rates with no integer period must sample exactly their
+        fraction (the old round(1/rate) sampler turned 0.7 into EVERY
+        request and 0.4 into every 2nd)."""
+        for rate, want in ((0.7, 700), (0.4, 400)):
+            s = trace.Sampler(rate=rate)
+            assert sum(s.next() is not None
+                       for _ in range(1000)) == want
+
+    def test_sampler_off_by_default(self, monkeypatch):
+        monkeypatch.delenv(trace.ENV_SAMPLE, raising=False)
+        s = trace.Sampler()
+        assert not s.enabled
+        assert s.next() is None
+        monkeypatch.setenv(trace.ENV_SAMPLE, "junk")
+        assert trace.sample_rate() == 0.0
+        monkeypatch.setenv(trace.ENV_SAMPLE, "7")
+        assert trace.sample_rate() == 1.0     # clamped
+
+    def test_wire_round_trip_no_loss_no_duplication(self):
+        t = trace.Trace()
+        t.stamp("admit", 1.0)
+        t.stamp("dispatch", 2.0)
+        child = trace.Trace.from_wire(t.to_wire())
+        child.stamp("h2d", 3.0)
+        child.stamp("compute", 4.0)
+        assert child.new_hops() == [["h2d", 3.0], ["compute", 4.0]]
+        t.extend(child.new_hops())
+        t.stamp("complete", 5.0)
+        assert [h[0] for h in t.hops] == [
+            "admit", "dispatch", "h2d", "compute", "complete"]
+        ts = [h[1] for h in t.hops]
+        assert ts == sorted(ts)
+        assert t.duration_ms() == pytest.approx(4000.0)
+
+    def test_emit_validates(self):
+        t = trace.Trace()
+        t.stamp("admit", 1.0)
+        t.stamp("complete", 2.0)
+        ev = t.emit(status="ok", priority=1)
+        assert events.validate_event(ev)
+        assert ev["duration_ms"] == pytest.approx(1000.0)
+
+    def test_hop_deltas(self):
+        deltas = trace.hop_deltas([["admit", 1.0], ["queue", 1.5],
+                                   ["complete", 3.0]])
+        assert deltas == [("admit", 0.0), ("queue", 0.5),
+                          ("complete", 1.5)]
+
+
+# ---------------------------------------------------------------------------
+# pull exporter
+# ---------------------------------------------------------------------------
+
+class TestExporter:
+    def test_serves_metrics_and_snapshot(self):
+        reg = metrics.Registry()
+        reg.counter("req_total", "x", engine="a").inc(5)
+        with export.MetricsExporter(reg.snapshot, port=0) as ex:
+            body = urllib.request.urlopen(
+                ex.url + "/metrics", timeout=5).read().decode()
+            samples = metrics.parse_prometheus(body)
+            assert ("req_total", {"engine": "a"}, 5.0) in samples
+            rec = json.loads(urllib.request.urlopen(
+                ex.url + "/snapshot", timeout=5).read())
+            assert metrics.family_total(rec["snapshot"],
+                                        "req_total") == 5
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(ex.url + "/nope", timeout=5)
+
+    def test_export_port_env(self, monkeypatch):
+        monkeypatch.delenv(export.ENV_PORT, raising=False)
+        assert export.export_port_default() is None
+        monkeypatch.setenv(export.ENV_PORT, "1234")
+        assert export.export_port_default() == 1234
+        monkeypatch.setenv(export.ENV_PORT, "zzz")
+        assert export.export_port_default() is None
+
+    def test_write_jsonl(self, tmp_path):
+        reg = metrics.Registry()
+        reg.counter("c_total", "x").inc()
+        with export.MetricsExporter(reg.snapshot, port=0) as ex:
+            path = ex.write_jsonl(str(tmp_path / "s.jsonl"))
+        rec = json.loads(open(path).read())
+        assert metrics.family_total(rec["snapshot"], "c_total") == 1
+
+
+# ---------------------------------------------------------------------------
+# serve_top frame math
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_top():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "serve_top.py")
+    spec = importlib.util.spec_from_file_location("serve_top", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestServeTop:
+    def _snap(self, completed_a, completed_b, shed_a=0):
+        reg = metrics.Registry()
+        for eng, comp, shed in (("a", completed_a, shed_a),
+                                ("b", completed_b, 0)):
+            reg.counter("serve_requests_total", "x", outcome="completed",
+                        engine=eng).inc(comp)
+            reg.counter("serve_requests_total", "x", outcome="accepted",
+                        engine=eng).inc(comp + shed)
+            reg.counter("serve_requests_total", "x", outcome="shed",
+                        engine=eng).inc(shed)
+            h = reg.histogram("serve_latency_seconds", "x", engine=eng)
+            for _ in range(comp):
+                h.observe(0.01)
+            reg.gauge("serve_queue_depth", "x", engine=eng).set(2)
+        return reg.snapshot()
+
+    def test_frame_rows_rates_and_fleet(self, serve_top):
+        prev, cur = self._snap(10, 20), self._snap(30, 40, shed_a=10)
+        rows = serve_top.frame_rows(cur, prev, dt=2.0, budget=0.01)
+        by_name = {r["name"]: r for r in rows}
+        assert set(by_name) == {"a", "b", "fleet"}
+        assert by_name["a"]["rows_s"] == pytest.approx(10.0)
+        assert by_name["b"]["rows_s"] == pytest.approx(10.0)
+        assert by_name["fleet"]["rows_s"] == pytest.approx(20.0)
+        assert by_name["fleet"]["queue"] == 4
+        assert by_name["a"]["shed_s"] == pytest.approx(5.0)
+        assert by_name["a"]["burn"] > by_name["b"]["burn"] == 0.0
+        assert by_name["fleet"]["p50_ms"] is not None
+        text = serve_top.render(rows, "test", 2.0)
+        assert "fleet" in text and "rows/s" in text
+
+    def test_burn_counts_each_request_once(self, serve_top):
+        """failed is a subset of accepted, so the burn denominator is
+        offered = accepted + shed — an all-failed window burns at
+        failed-rate/budget, not half of it (the old acc+bad
+        double-count)."""
+        def snap(accepted, failed, shed):
+            reg = metrics.Registry()
+            for outcome, n in (("accepted", accepted),
+                               ("failed", failed), ("shed", shed),
+                               ("completed", accepted - failed)):
+                reg.counter("serve_requests_total", "x", outcome=outcome,
+                            engine="e").inc(n)
+            return reg.snapshot()
+        rows = serve_top.frame_rows(snap(100, 100, 0), snap(0, 0, 0),
+                                    dt=1.0, budget=0.01)
+        fleet = [r for r in rows if r["name"] == "fleet"][0]
+        # 100% of offered requests failed: burn = 1.0 / 0.01 = 100x
+        assert fleet["burn"] == pytest.approx(100.0)
+
+    def test_quantiles_are_windowed(self, serve_top):
+        """A live latency regression must show in the next frame — the
+        cumulative lifetime histogram would mask 100 slow requests
+        behind 1000 healthy ones for minutes to hours."""
+        def snap(slow):
+            reg = metrics.Registry()
+            h = reg.histogram("serve_latency_seconds", "x", engine="a")
+            for _ in range(1000):
+                h.observe(0.001)
+            for _ in range(slow):
+                h.observe(0.5)
+            return reg.snapshot()
+        rows = serve_top.frame_rows(snap(100), snap(0), dt=1.0)
+        fleet = [r for r in rows if r["name"] == "fleet"][0]
+        assert fleet["p50_ms"] > 100       # the window saw ONLY slow requests
+        # without a prev snapshot the lifetime histogram is all there is
+        rows = serve_top.frame_rows(snap(100), None, dt=1.0)
+        fleet = [r for r in rows if r["name"] == "fleet"][0]
+        assert fleet["p50_ms"] < 10
+
+    def test_fleet_row_includes_router_admission_sheds(self, serve_top):
+        """Router-level SLO sheds never reach an engine; the fleet
+        shed/s and burn columns must still show them (the overload
+        condition the SLO-burn column exists to surface)."""
+        def snap(admission, replica):
+            reg = metrics.Registry()
+            reg.counter("serve_requests_total", "x", outcome="completed",
+                        engine="a").inc(10)
+            reg.counter("serve_requests_total", "x", outcome="accepted",
+                        engine="a").inc(10)
+            reg.counter("router_requests_total", "x", outcome="shed",
+                        stage="admission", router="r").inc(admission)
+            reg.counter("router_requests_total", "x", outcome="shed",
+                        stage="replica", router="r").inc(replica)
+            return reg.snapshot()
+        rows = serve_top.frame_rows(snap(20, 4), snap(0, 0), dt=2.0,
+                                    budget=0.01)
+        by_name = {r["name"]: r for r in rows}
+        # replica-stage sheds are the engines' own (zero here) — only
+        # admission-stage sheds ride the fleet row
+        assert by_name["fleet"]["shed_s"] == pytest.approx(10.0)
+        assert by_name["a"]["shed_s"] == 0.0
+        assert by_name["fleet"]["burn"] > 0.0
+
+    def test_frame_rows_without_prev(self, serve_top):
+        rows = serve_top.frame_rows(self._snap(5, 5), None, dt=1.0)
+        assert all(r["rows_s"] == 0.0 for r in rows)
+
+    def test_jsonl_source(self, serve_top, tmp_path):
+        path = str(tmp_path / "snaps.jsonl")
+        metrics.append_snapshot_jsonl(path, self._snap(10, 10), ts=1.0)
+        metrics.append_snapshot_jsonl(path, self._snap(20, 30), ts=3.0)
+        ts, cur = serve_top.fetch_snapshot(path)
+        assert ts == 3.0
+        prev = serve_top.fetch_prev_jsonl(path)
+        assert prev[0] == 1.0
+        rows = serve_top.frame_rows(cur, prev[1], ts - prev[0])
+        fleet = [r for r in rows if r["name"] == "fleet"][0]
+        assert fleet["rows_s"] == pytest.approx(15.0)
+
+
+# ---------------------------------------------------------------------------
+# quantile arithmetic edge cases
+# ---------------------------------------------------------------------------
+
+class TestQuantile:
+    def test_empty_returns_none(self):
+        assert metrics.quantile((1.0, 2.0), [0, 0, 0], 50) is None
+        s = metrics.histogram_quantiles({}, "absent")
+        assert s == {"p50": None, "p95": None, "p99": None}
+
+    def test_overflow_clamps_to_last_bound(self):
+        bounds = (1.0, 2.0)
+        assert metrics.quantile(bounds, [0, 0, 5], 99) == 2.0
+
+    def test_single_bucket_interpolates(self):
+        bounds = (1.0, 2.0)
+        # 4 observations in (1, 2]: p50 = rank 2 of 4 -> halfway
+        assert metrics.quantile(bounds, [0, 4, 0], 50) == \
+            pytest.approx(1.5)
+
+    def test_inf_formatting(self):
+        assert metrics._fmt_value(math.inf) == "+Inf"
+        assert metrics._fmt_value(3.0) == "3"
+        assert metrics._fmt_value(0.25) == "0.25"

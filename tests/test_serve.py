@@ -408,6 +408,11 @@ class TestContinuousDecode:
                                  sync_interval=3)
         serial = [lm_decode(lm, s, 5, greedy=True) for s in seeds]
         assert rows == serial
+        # the one-shot decoder tore down its registry series — repeated
+        # continuous_decode calls must not grow the process registry
+        from bigdl_tpu.obs import metrics as obs_metrics
+        assert not [n for n in obs_metrics.get().snapshot()
+                    if n.startswith("decode_")]
 
     def test_admit_retire_slot_reuse(self, lm):
         dec = ContinuousDecoder(lm, max_slots=2, n_pos=8, sync_interval=4)
@@ -417,6 +422,20 @@ class TestContinuousDecode:
         assert all(f.done() for f in futs)
         first = futs[0].result()
         assert all(f.result() == first for f in futs)  # identical requests
+
+    def test_direct_decoder_series_dropped_at_gc(self, lm):
+        """A directly-constructed decoder (the TP-serving entry point;
+        nothing guarantees a close() call) must not leak its uniquely-
+        labelled registry series past its lifetime."""
+        import gc
+        from bigdl_tpu.obs import metrics as obs_metrics
+        dec = ContinuousDecoder(lm, max_slots=1, n_pos=4)
+        assert [n for n in obs_metrics.get().snapshot()
+                if n.startswith("decode_")]
+        del dec
+        gc.collect()
+        assert not [n for n in obs_metrics.get().snapshot()
+                    if n.startswith("decode_")]
 
     def test_host_sync_cadence(self, lm):
         """The driver materializes tokens only at retiring boundaries —

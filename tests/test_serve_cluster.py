@@ -683,3 +683,326 @@ class TestProcessReplicas:
                 f.result(timeout=120)
             assert time.perf_counter() - t0 < 60.0
             assert pool.router.stats()["failed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fleet telemetry: merged registries, traces, export (PR 7 tentpole)
+# ---------------------------------------------------------------------------
+
+class TestFleetTelemetry:
+    def test_engine_stats_is_registry_view(self):
+        """Back-compat satellite: engine.stats() keys are unchanged AND
+        every number is readable straight off the metrics registry —
+        the registry is the source of truth, stats() the view."""
+        from bigdl_tpu.obs import metrics
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=8, max_wait_ms=5,
+                          input_shape=(4,), name="viewtest")
+        try:
+            x = np.random.RandomState(0).randn(13, 4).astype(np.float32)
+            eng.predict(x)
+            s = eng.stats()
+            for key in ("accepted", "shed", "completed", "failed",
+                        "inflight", "served", "batches", "errors",
+                        "compiles", "weights_version", "queue_depth",
+                        "max_queue_depth", "bucket_hits", "buckets",
+                        "p50", "p95", "p99"):
+                assert key in s, key
+            snap = metrics.get().snapshot()
+            assert s["completed"] == 13 == metrics.family_total(
+                snap, "serve_requests_total", engine="viewtest",
+                outcome="completed")
+            assert s["accepted"] == metrics.family_total(
+                snap, "serve_requests_total", engine="viewtest",
+                outcome="accepted")
+            assert s["batches"] == metrics.family_total(
+                snap, "serve_batches_total", engine="viewtest")
+            assert s["compiles"] == metrics.family_total(
+                snap, "serve_compiles_total", engine="viewtest")
+            # quantiles come from the SAME fixed-bucket histogram
+            assert s["p50"] == metrics.histogram_quantiles(
+                snap, "serve_latency_seconds",
+                engine="viewtest")["p50"]
+            agg = metrics.merged_histogram(snap, "serve_latency_seconds",
+                                           engine="viewtest")
+            assert agg is not None and agg[3] == 13
+        finally:
+            eng.close()
+
+    def test_pool_merged_stats_true_merge(self):
+        """ReplicaPool.stats()['merged'] is the true registry merge:
+        fleet counters are sums over replicas and the fleet quantiles
+        come from the POOLED histogram, not a dict of per-replica
+        dicts."""
+        from bigdl_tpu.obs import metrics
+        model = _small_model()
+        x = np.random.RandomState(0).randn(40, 4).astype(np.float32)
+        with ReplicaPool(model, n_replicas=2, max_batch=8,
+                         max_wait_ms=2, input_shape=(4,)) as pool:
+            pool.predict(x)
+            s = pool.stats()
+            per_replica = sum(r["completed"] for r in s["replicas"])
+            assert s["merged"]["completed"] == per_replica == 40
+            assert s["merged"]["failed"] == 0
+            # the merged quantiles equal the pooled per-engine merge
+            merged = pool.merged_registry()
+            agg = metrics.merged_histogram(
+                merged, "serve_latency_seconds")
+            assert agg is not None and agg[3] == 40
+            assert s["merged"]["p50"] == metrics.histogram_quantiles(
+                merged, "serve_latency_seconds")["p50"]
+            # exposition renders and parses (the CI contract)
+            samples = metrics.parse_prometheus(pool.prometheus())
+            names = {n for n, _, _ in samples}
+            assert "serve_requests_total" in names
+            assert "serve_latency_seconds_bucket" in names
+
+    def test_pool_exporter_end_to_end(self):
+        import json
+        import urllib.request
+        from bigdl_tpu.obs import metrics
+        model = _small_model()
+        x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        with ReplicaPool(model, n_replicas=1, max_batch=8,
+                         max_wait_ms=2, input_shape=(4,)) as pool:
+            pool.predict(x)
+            ex = pool.start_exporter(port=0)
+            assert pool.start_exporter() is ex      # idempotent
+            body = urllib.request.urlopen(
+                ex.url + "/metrics", timeout=5).read().decode()
+            assert metrics.parse_prometheus(body)
+            rec = json.loads(urllib.request.urlopen(
+                ex.url + "/snapshot", timeout=5).read())
+            assert metrics.family_total(
+                rec["snapshot"], "serve_requests_total",
+                outcome="completed") == 8
+        assert pool.exporter is None                # closed with pool
+
+    def test_router_traces_cover_happy_path(self):
+        """Sampled requests carry a complete monotone hop chain
+        admit -> queue -> dispatch -> complete (fakes skip h2d/compute)
+        and completion emits exactly one trace event per request."""
+        from bigdl_tpu.obs import events as obs_events
+        obs_events.configure(None)      # fresh ring
+        replicas = [FakeReplica("a", 0.002), FakeReplica("b", 0.002)]
+        with Router(replicas, shed=False, trace_sample=1.0) as router:
+            futs = [router.submit(np.full((2,), i, np.float32))
+                    for i in range(12)]
+            for f in futs:
+                f.result(timeout=10)
+        traces = [e for e in obs_events.get().ring_events()
+                  if e["type"] == "trace"]
+        assert len(traces) == 12
+        for e in traces:
+            assert e["status"] == "ok"
+            phases = [h[0] for h in e["hops"]]
+            stamps = [h[1] for h in e["hops"]]
+            assert phases[0] == "admit" and phases[-1] == "complete"
+            assert "queue" in phases and "dispatch" in phases
+            assert stamps == sorted(stamps), "hop chain not monotone"
+            assert e["duration_ms"] >= 0.0
+            assert e["replica"] in ("a", "b")
+
+    def test_traced_engine_stamps_h2d_and_compute(self):
+        """Through a real engine the sampled chain covers EVERY phase
+        of REQUEST_PHASES in order."""
+        from bigdl_tpu.obs import events as obs_events
+        from bigdl_tpu.obs.trace import REQUEST_PHASES
+        obs_events.configure(None)
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=8, max_wait_ms=2,
+                          input_shape=(4,))
+        try:
+            with Router([LocalReplica(eng, name="l0")], shed=False,
+                        trace_sample=1.0) as router:
+                futs = [router.submit(
+                    np.random.RandomState(i).randn(4).astype(np.float32))
+                    for i in range(6)]
+                for f in futs:
+                    f.result(timeout=30)
+        finally:
+            eng.close()
+        traces = [e for e in obs_events.get().ring_events()
+                  if e["type"] == "trace"]
+        assert len(traces) == 6
+        for e in traces:
+            phases = [h[0] for h in e["hops"]]
+            it = iter(phases)
+            assert all(p in it for p in REQUEST_PHASES), (
+                f"hop chain {phases} does not cover {REQUEST_PHASES}")
+            stamps = [h[1] for h in e["hops"]]
+            assert stamps == sorted(stamps)
+
+    def test_shed_trace_emitted_with_shed_hop(self):
+        from bigdl_tpu.obs import events as obs_events
+        obs_events.configure(None)
+        with Router([FakeReplica("a", service_s=0.05)], shed=True,
+                    est_ms=50.0, trace_sample=1.0) as router:
+            futs = [router.submit(np.ones((2,), np.float32),
+                                  priority=1, slo_ms=60)
+                    for i in range(12)]
+            shed = 0
+            for f in futs:
+                try:
+                    f.result(timeout=10)
+                except SheddedError:
+                    shed += 1
+        assert shed > 0
+        traces = [e for e in obs_events.get().ring_events()
+                  if e["type"] == "trace"]
+        shed_traces = [e for e in traces if e["status"] == "shed"]
+        assert len(shed_traces) == shed
+        for e in shed_traces:
+            assert e["hops"][-1][0] == "shed"
+
+    def test_sampling_rate_half_traces_every_other(self):
+        from bigdl_tpu.obs import events as obs_events
+        obs_events.configure(None)
+        with Router([FakeReplica("a")], shed=False,
+                    trace_sample=0.5) as router:
+            futs = [router.submit(np.ones((2,), np.float32))
+                    for _ in range(10)]
+            for f in futs:
+                f.result(timeout=10)
+        traces = [e for e in obs_events.get().ring_events()
+                  if e["type"] == "trace"]
+        assert len(traces) == 5
+
+
+@pytest.mark.slow
+class TestProcessReplicaTelemetry:
+    def test_kill_drill_stderr_tail_and_crash_bundle(self, obs_run_dir):
+        """The DEVNULL-blackout regression: a chaos-killed child's
+        stderr tail surfaces in the DeadReplicaError message AND in a
+        crash bundle's stderr.txt (the parent's postmortem sees the
+        child's last words)."""
+        import os
+        model = _small_model()
+        rep = ProcessReplica(model, name="victim",
+                             env={"BIGDL_FAULTS": "serve_kill@at=1"},
+                             max_batch=4, max_wait_ms=2,
+                             input_shape=(4,))
+        try:
+            x = np.random.RandomState(0).randn(4).astype(np.float32)
+            rep.submit(x).result(timeout=60)       # request 1 serves
+            with pytest.raises(DeadReplicaError,
+                               match="serve_kill chaos fired"):
+                rep.submit(x).result(timeout=60)   # request 2 kills
+            deadline = time.time() + 10
+            while rep.alive() and time.time() < deadline:
+                time.sleep(0.05)
+            assert not rep.alive()
+            assert any("serve_kill chaos fired" in ln
+                       for ln in rep.stderr_tail())
+        finally:
+            rep.close()
+        bundles = [d for d in os.listdir(obs_run_dir)
+                   if d.startswith("crash-replica-victim")]
+        assert bundles, os.listdir(obs_run_dir)
+        stderr_txt = os.path.join(obs_run_dir, bundles[0], "stderr.txt")
+        assert os.path.exists(stderr_txt)
+        assert "serve_kill chaos fired" in open(stderr_txt).read()
+
+    def test_mixed_fleet_traced_drill(self, obs_run_dir, monkeypatch):
+        """THE acceptance drill: 1 in-process + 1 subprocess replica
+        under load with sampling at 1.0 —
+
+        1. the parent event log contains the subprocess replica's own
+           obs events (forwarded over the frame protocol, attributed);
+        2. every sampled request's trace covers admit -> complete with
+           monotone hop timestamps;
+        3. the merged Prometheus histogram's quantiles match the pooled
+           client-observed latencies within one bucket width."""
+        import json
+        import urllib.request
+        from bigdl_tpu.obs import events as obs_events
+        from bigdl_tpu.obs import metrics
+        from bigdl_tpu.obs.events import read_events
+        from bigdl_tpu.obs.trace import REQUEST_PHASES
+
+        model = _small_model()
+        ref = _oracle(model)
+        # simulate production: BIGDL_OBS_DIR set in the ENVIRONMENT
+        # (not just configured programmatically).  The child must NOT
+        # inherit it — frame forwarding is the delivery path — or every
+        # child event would land in the parent's JSONL twice
+        monkeypatch.setenv(obs_events.ENV_DIR, obs_run_dir)
+        # max_wait 20 ms pins the latency floor well above the frame
+        # transport + callback-dispatch overhead the child engine's
+        # histogram cannot see (client-side only), so the one-bucket
+        # quantile comparison below is deterministic: ~1 ms of noise on
+        # a >=20 ms base never crosses a 1.78x log-bucket edge
+        kwargs = dict(max_batch=8, max_wait_ms=20, input_shape=(4,))
+        local = LocalReplica(ServeEngine(model, name="local0", **kwargs),
+                             name="local0")
+        proc = ProcessReplica(model, name="proc0", **kwargs)
+        rng = np.random.RandomState(0)
+        rows = rng.randn(80, 4).astype(np.float32)
+
+        lats = []
+        lat_lock = threading.Lock()
+        with ReplicaPool(replicas=[local, proc], shed=False,
+                         trace_sample=1.0) as pool:
+            futs = []
+            for r in rows:
+                t0 = time.perf_counter()
+
+                def _done(f, t0=t0):
+                    with lat_lock:
+                        lats.append(time.perf_counter() - t0)
+
+                f = pool.submit(r)
+                f.add_done_callback(_done)
+                futs.append(f)
+                time.sleep(0.001)
+            outs = [f.result(timeout=120) for f in futs]
+            assert _close(np.stack(outs), ref(rows))
+
+            s = pool.stats()
+            assert s["router"]["failed"] == 0
+            served = {r["name"]: r.get("completed", 0)
+                      for r in s["replicas"]}
+            assert served["local0"] > 0 and served["proc0"] > 0, served
+
+            # (3) merged exposition: quantiles vs pooled client
+            # latencies within one bucket width
+            merged = pool.merged_registry()
+            samples = metrics.parse_prometheus(
+                metrics.render_prometheus(merged))
+            assert samples
+            agg = metrics.merged_histogram(merged,
+                                           "serve_latency_seconds")
+            assert agg is not None and agg[3] == 80
+            mapper = metrics.Histogram()       # pinned-bounds indexer
+            for q in (50, 95, 99):
+                est = metrics.quantile(agg[0], agg[1], q)
+                true = float(np.percentile(lats, q))
+                assert abs(mapper._index(est)
+                           - mapper._index(true)) <= 1, (
+                    f"p{q}: merged {est * 1e3:.2f} ms vs client "
+                    f"{true * 1e3:.2f} ms — off by more than one "
+                    f"bucket")
+
+        # (1) parent log carries the child's events, attributed
+        events = read_events(obs_events.get().path)
+        child_events = [e for e in events
+                        if e.get("replica") == "proc0"
+                        and e["type"] == "serve"]
+        starts = [e for e in child_events if e["kind"] == "start"]
+        assert len(starts) == 1, (
+            "the subprocess replica's serve start event must reach the "
+            "parent log exactly once (0 = forwarding broken, 2 = child "
+            f"inherited {obs_events.ENV_DIR} and double-wrote): "
+            f"{len(starts)}")
+        assert any(e["kind"] == "stop" for e in child_events)
+
+        # (2) every sampled request: complete monotone hop chain
+        traces = [e for e in events if e["type"] == "trace"]
+        ok = [e for e in traces if e["status"] == "ok"]
+        assert len(ok) == 80, (len(ok), len(traces))
+        for e in ok:
+            phases = [h[0] for h in e["hops"]]
+            stamps = [h[1] for h in e["hops"]]
+            it = iter(phases)
+            assert all(p in it for p in REQUEST_PHASES), phases
+            assert stamps == sorted(stamps), "hops not monotone"

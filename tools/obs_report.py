@@ -8,7 +8,10 @@ RUN_DIR is a ``BIGDL_OBS_DIR`` directory: every ``events.p*.jsonl`` in
 it is loaded (one per process), crash bundles (``crash-*/``) are
 listed.  The report covers: run configuration, the throughput/loss
 trajectory (bucketed), tap trends, phase breakdown, skip/straggler
-summary, fault/watchdog/preemption timeline, crash bundles.
+summary, fault/watchdog/preemption timeline, the serving section
+(rollout timeline, shed/error/replica-death counts, decode summary,
+and a per-hop latency waterfall for the slowest traced requests —
+``--waterfall N``), crash bundles.
 
 Lines that fail schema validation are counted and quoted, not fatal —
 a postmortem tool that dies on the interesting input is useless.
@@ -74,7 +77,88 @@ def _trajectory(steps, n_buckets=8):
     return rows
 
 
-def render(events, bad, bundles, title="obs run report") -> str:
+def _serving_section(events, waterfall=5):
+    """Markdown lines for the ``serve`` + ``trace`` event types (empty
+    when the run never served)."""
+    from bigdl_tpu.obs.trace import hop_deltas
+
+    serves = _by_type(events, "serve")
+    traces = _by_type(events, "trace")
+    if not serves and not traces:
+        return []
+    out = ["## Serving", ""]
+
+    kinds = {}
+    for e in serves:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    out.append("- serve events: " + ", ".join(
+        f"{k}={n}" for k, n in sorted(kinds.items())))
+    errors = [e for e in serves if e["kind"] == "error"]
+    if errors:
+        failed = sum(int(e.get("requests", 1)) for e in errors)
+        out.append(f"- failed requests: **{failed}** across "
+                   f"{len(errors)} error event(s); last: "
+                   f"`{errors[-1].get('error', '?')}`")
+    sheds = kinds.get("shed", 0)
+    if sheds:
+        out.append(f"- shed events: **{sheds}**")
+    deaths = [e for e in serves if e["kind"] == "replica_dead"]
+    for e in deaths:
+        out.append(f"- replica death: **{e.get('replica', '?')}** "
+                   f"(p{e['proc']})")
+    out.append("")
+
+    rollouts = [e for e in serves if e["kind"].startswith("rollout_")
+                or e["kind"] in ("weights_commit", "weights_revert")]
+    if rollouts:
+        t0 = rollouts[0]["ts"]
+        out += ["### Rollout timeline", "",
+                "| t (s) | event | version | detail |", "|---|---|---|---|"]
+        for e in rollouts:
+            detail = ", ".join(
+                f"{k}={_fmt(v)}" for k, v in sorted(e.items())
+                if k not in ("v", "ts", "proc", "type", "kind", "version"))
+            out.append(f"| {e['ts'] - t0:+.3f} | {e['kind']} | "
+                       f"{e.get('version', '-')} | {detail or '-'} |")
+        out.append("")
+
+    decodes = [e for e in serves if e["kind"] == "decode"]
+    if decodes:
+        steps = sum(int(e["steps"]) for e in decodes)
+        retired = sum(int(e.get("retired", 0)) for e in decodes)
+        syncs = sum(int(e.get("host_syncs", 0)) for e in decodes)
+        out.append(f"- decode: {len(decodes)} run(s), {steps} steps, "
+                   f"{retired} requests retired, {syncs} host syncs")
+        out.append("")
+
+    if traces and waterfall > 0:
+        ok = sum(1 for e in traces if e.get("status") == "ok")
+        out.append(f"### Trace waterfall (slowest {waterfall} of "
+                   f"{len(traces)} sampled; {ok} ok)")
+        out.append("")
+        slowest = sorted(traces, key=lambda e: e.get("duration_ms", 0.0),
+                         reverse=True)[:waterfall]
+        phases = []
+        for e in slowest:       # union of hop names, first-seen order
+            for ph, _ in hop_deltas(e["hops"]):
+                if ph not in phases:
+                    phases.append(ph)
+        out.append("| trace | status | total ms | "
+                   + " | ".join(phases) + " |")
+        out.append("|---|---|---|" + "---|" * len(phases))
+        for e in slowest:
+            cells = {ph: 0.0 for ph in phases}
+            for ph, dt in hop_deltas(e["hops"]):
+                cells[ph] = cells.get(ph, 0.0) + dt * 1e3
+            row = " | ".join(f"{cells[ph]:.2f}" for ph in phases)
+            out.append(f"| `{e['trace_id'][:8]}` | {e['status']} | "
+                       f"{e.get('duration_ms', 0.0):.2f} | {row} |")
+        out.append("")
+    return out
+
+
+def render(events, bad, bundles, title="obs run report",
+           waterfall=5) -> str:
     out = [f"# {title}", ""]
     procs = sorted({e["proc"] for e in events})
     steps = _by_type(events, "step")
@@ -133,6 +217,8 @@ def render(events, bad, bundles, title="obs run report") -> str:
                        f"{_fmt(e['value'])}")
         out.append("")
 
+    out.extend(_serving_section(events, waterfall))
+
     incidents = [e for e in events if e["type"] in
                  ("fault", "watchdog", "preempt", "abort", "crash_bundle")]
     if incidents:
@@ -166,10 +252,14 @@ def main(argv=None) -> int:
                     "(default: stdout)")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 if any event line fails validation")
+    ap.add_argument("--waterfall", type=int, default=5,
+                    help="trace waterfall: slowest N sampled requests "
+                    "(default 5; 0 disables)")
     args = ap.parse_args(argv)
     events, bad, bundles = load_run(args.path)
     md = render(events, bad, bundles,
-                title=f"obs report: {os.path.basename(args.path.rstrip('/'))}")
+                title=f"obs report: {os.path.basename(args.path.rstrip('/'))}",
+                waterfall=args.waterfall)
     if args.output:
         with open(args.output, "w") as f:
             f.write(md + "\n")

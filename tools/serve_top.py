@@ -1,0 +1,206 @@
+"""Live terminal dashboard for a serving fleet (docs/observability.md
+"Serving telemetry").
+
+Polls a metrics exporter's ``/snapshot`` endpoint
+(``bigdl_tpu/obs/export.py`` — start one with
+``ReplicaPool.start_exporter()`` or ``BIGDL_SERVE_EXPORT_PORT``) and
+renders, per engine and fleet-wide:
+
+    rows/s   queue   inflt   shed/s   p50/p95/p99 (ms)   SLO burn
+
+Rates are differences between consecutive snapshots (the counters are
+monotonic, so the math survives engine restarts landing mid-window as a
+one-frame glitch, not corruption).  Quantiles come from the merged
+fixed-bucket histograms — the fleet row's p99 is the TRUE pooled p99,
+not an average of per-replica p99s — and are WINDOWED the same way the
+rates are (bucket counts difference just like counters), so a latency
+regression shows in the next frame instead of being averaged away
+under a long healthy history; an idle window falls back to the
+lifetime histogram (last known latency beats a blank column).
+
+SLO burn rate: (shed+failed)/offered over the window — offered =
+accepted+shed, so every request counts exactly once (failed is a
+subset of accepted) — divided by the error budget (``--budget``,
+default 0.01 = a 99% success objective).  1.0 means the budget is
+being consumed exactly as fast as it accrues, >1 means the fleet is
+eating into reserves.
+
+Usage:
+    python tools/serve_top.py http://127.0.0.1:9090 [--interval 1]
+    python tools/serve_top.py snapshots.jsonl --once   # offline replay
+
+``--once`` prints a single frame and exits (CI smoke; for a JSONL file
+the last two snapshots give the rates).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bigdl_tpu.obs import metrics  # noqa: E402
+
+
+def fetch_snapshot(source: str):
+    """``(ts, snapshot)`` from an exporter URL or the LAST line of a
+    snapshots JSONL file."""
+    if source.startswith("http://") or source.startswith("https://"):
+        with urllib.request.urlopen(source.rstrip("/") + "/snapshot",
+                                    timeout=5) as resp:
+            rec = json.loads(resp.read())
+        return float(rec["ts"]), rec["snapshot"]
+    with open(source) as f:
+        lines = [ln for ln in f if ln.strip()]
+    if not lines:
+        raise ValueError(f"no snapshots in {source}")
+    rec = json.loads(lines[-1])
+    return float(rec["ts"]), rec["snapshot"]
+
+
+def fetch_prev_jsonl(source: str):
+    """Second-to-last snapshot of a JSONL file (rates for --once)."""
+    with open(source) as f:
+        lines = [ln for ln in f if ln.strip()]
+    if len(lines) < 2:
+        return None
+    rec = json.loads(lines[-2])
+    return float(rec["ts"]), rec["snapshot"]
+
+
+def engines_in(snapshot: dict) -> list:
+    """Engine label values present in the admission-counter family."""
+    fam = snapshot.get("serve_requests_total", {"series": []})
+    return sorted({row["labels"]["engine"] for row in fam["series"]
+                   if "engine" in row["labels"]})
+
+
+def _rate(cur, prev, dt, name, **match):
+    if prev is None or dt <= 0:
+        return 0.0
+    d = (metrics.family_total(cur, name, **match)
+         - metrics.family_total(prev, name, **match))
+    return max(d, 0.0) / dt
+
+
+def _window_quantiles(cur, prev, name, **match):
+    """p50/p95/p99 of the observations that landed BETWEEN the two
+    snapshots: bucket counts are monotonic per series, so the window's
+    histogram is the element-wise count difference (clamped at 0 to
+    absorb a restart mid-window, like ``_rate``).  Falls back to the
+    lifetime histogram when there is no prev snapshot or the window
+    saw no observations."""
+    lifetime = metrics.histogram_quantiles(cur, name, **match)
+    agg_cur = metrics.merged_histogram(cur, name, **match)
+    agg_prev = metrics.merged_histogram(prev, name, **match) \
+        if prev is not None else None
+    if agg_cur is None or agg_prev is None \
+            or list(agg_prev[0]) != list(agg_cur[0]):
+        return lifetime
+    bounds, counts_cur = agg_cur[0], agg_cur[1]
+    counts = [max(a - b, 0) for a, b in zip(counts_cur, agg_prev[1])]
+    if sum(counts) == 0:
+        return lifetime
+    return {f"p{q}": metrics.quantile(bounds, counts, q)
+            for q in (50, 95, 99)}
+
+
+def frame_rows(cur: dict, prev: dict | None, dt: float,
+               budget: float = 0.01) -> list:
+    """One dict per engine plus a trailing ``fleet`` row; pure function
+    of two snapshots (testable offline)."""
+    rows = []
+    scopes = [({"engine": e}, e) for e in engines_in(cur)]
+    scopes.append(({}, "fleet"))
+    for match, label in scopes:
+        qs = _window_quantiles(cur, prev, "serve_latency_seconds",
+                               **match)
+        comp = _rate(cur, prev, dt, "serve_requests_total",
+                     outcome="completed", **match)
+        acc = _rate(cur, prev, dt, "serve_requests_total",
+                    outcome="accepted", **match)
+        shed = _rate(cur, prev, dt, "serve_requests_total",
+                     outcome="shed", **match)
+        if not match:
+            # fleet row: router admission-stage sheds never reached an
+            # engine (replica-stage sheds are already in the engine
+            # counters), so the SLO-overload scenario this column
+            # exists for shows up here and in the burn rate
+            shed += _rate(cur, prev, dt, "router_requests_total",
+                          outcome="shed", stage="admission")
+        failed = _rate(cur, prev, dt, "serve_requests_total",
+                       outcome="failed", **match)
+        # failed is a SUBSET of accepted (completed+failed+inflight ==
+        # accepted); only shed lives outside it — so the offered total
+        # is accepted+shed and each request counts once in the burn
+        bad, offered = shed + failed, acc + shed
+        rows.append({
+            "name": label,
+            "rows_s": comp,
+            "queue": int(metrics.family_total(cur, "serve_queue_depth",
+                                              **match)),
+            "inflight": int(metrics.family_total(cur, "serve_inflight",
+                                                 **match)),
+            "shed_s": shed,
+            "p50_ms": None if qs["p50"] is None else qs["p50"] * 1e3,
+            "p95_ms": None if qs["p95"] is None else qs["p95"] * 1e3,
+            "p99_ms": None if qs["p99"] is None else qs["p99"] * 1e3,
+            "burn": (bad / offered / budget) if offered > 0 else 0.0,
+        })
+    return rows
+
+
+def _ms(v):
+    return "-" if v is None else f"{v:8.2f}"
+
+
+def render(rows: list, source: str, dt: float) -> str:
+    out = [f"serve_top — {source}  (window {dt:.1f}s)", "",
+           f"{'engine':<12} {'rows/s':>8} {'queue':>6} {'inflt':>6} "
+           f"{'shed/s':>7} {'p50 ms':>8} {'p95 ms':>8} {'p99 ms':>8} "
+           f"{'burn':>6}"]
+    for r in rows:
+        marker = "*" if r["name"] == "fleet" else " "
+        out.append(
+            f"{marker}{r['name']:<11} {r['rows_s']:8.1f} {r['queue']:6d} "
+            f"{r['inflight']:6d} {r['shed_s']:7.1f} {_ms(r['p50_ms'])} "
+            f"{_ms(r['p95_ms'])} {_ms(r['p99_ms'])} {r['burn']:6.2f}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("source", help="exporter base URL (http://host:port) "
+                    "or a snapshots JSONL file")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval seconds (default 1)")
+    ap.add_argument("--budget", type=float, default=0.01,
+                    help="SLO error budget fraction (default 0.01)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    args = ap.parse_args(argv)
+
+    prev = None
+    if args.once and not args.source.startswith("http"):
+        prev = fetch_prev_jsonl(args.source)
+    while True:
+        ts, cur = fetch_snapshot(args.source)
+        dt = (ts - prev[0]) if prev else args.interval
+        rows = frame_rows(cur, prev[1] if prev else None, dt,
+                          budget=args.budget)
+        frame = render(rows, args.source, dt)
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        prev = (ts, cur)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
