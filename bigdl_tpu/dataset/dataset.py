@@ -115,12 +115,41 @@ class ShardedDataSet(AbstractDataSet):
         data = list(data)
         self._global_size = len(data)
         self._shard = data[self.shard_index::self.n_shards]
+        # elastic runs keep the FULL record list so recovery can
+        # re-partition it when the process world shrinks (reshard) —
+        # without it, a dead process takes its records' only owner with
+        # it.  Fail-fast runs (the default) drop the other shards as
+        # before: N-times resident memory is a price only recovery pays.
+        from bigdl_tpu.resilience import elastic
+        self._data = data if elastic.enabled() else None
 
     def size(self):
         return self._global_size
 
     def shard_size(self):
         return len(self._shard)
+
+    def reshard(self, n_shards: int = None, shard_index: int = None):
+        """Re-partition over a changed process world (elastic recovery,
+        docs/resilience.md): defaults re-read the LIVE jax topology, so
+        after a re-form each survivor picks up its new strided shard of
+        the ORIGINAL record order — every record keeps exactly one owner
+        and the global size is unchanged.  In-place shuffles of the old
+        shard are discarded by design: the recovery protocol rewinds the
+        RNG stream to its anchor, so iteration order is re-derived from
+        the restored stream, not inherited from a half-dead epoch."""
+        import jax
+        if self._data is None:
+            raise RuntimeError(
+                "ShardedDataSet.reshard needs the full record list, "
+                "which is only retained under BIGDL_ELASTIC=1 (set the "
+                "flag before constructing the dataset)")
+        self.n_shards = (n_shards if n_shards is not None
+                         else jax.process_count())
+        self.shard_index = (shard_index if shard_index is not None
+                            else jax.process_index())
+        self._shard = self._data[self.shard_index::self.n_shards]
+        return self
 
     def shuffle(self):
         RNG.shuffle(self._shard)

@@ -85,14 +85,27 @@ class SampleToBatch(Transformer):
     default path.
     """
 
-    def __init__(self, batch_size: int, feature_padding=None, label_padding=None,
-                 fixed_length: int = None, drop_last: bool = False,
-                 reuse_buffers: int = 0):
+    def __init__(self, batch_size: int = None, feature_padding=None,
+                 label_padding=None, fixed_length: int = None,
+                 drop_last: bool = False, reuse_buffers: int = 0,
+                 global_batch_size: int = None):
         if reuse_buffers and reuse_buffers < 2:
             raise ValueError(
                 f"reuse_buffers needs a ring of >= 2 slots, got "
                 f"{reuse_buffers} (the consumer still holds the previous "
                 "batch while the next is assembled)")
+        if (batch_size is None) == (global_batch_size is None):
+            raise ValueError("pass exactly one of batch_size (per-process)"
+                             " or global_batch_size (divided over the live"
+                             " process world)")
+        # global_batch_size is the reference's Utils.getBatchSize contract
+        # (global batch ÷ node count, Utils.scala:26-48) resolved at
+        # ITERATION time from the live jax topology instead of once at
+        # construction — so an elastic re-form (docs/resilience.md) that
+        # shrinks the world automatically grows each survivor's local
+        # batch and the GLOBAL batch stays fixed.
+        self.global_batch_size = (int(global_batch_size)
+                                  if global_batch_size is not None else None)
         self.batch_size = batch_size
         self.feature_padding = feature_padding
         self.label_padding = label_padding
@@ -110,19 +123,23 @@ class SampleToBatch(Transformer):
             return None
         if self._ring is None:
             f0, l0 = np.asarray(feats[0]), np.asarray(labels[0])
+            # global mode: batch_size is None; size the ring from the
+            # batch being assembled (== the resolved local batch)
+            rows = (self.batch_size if self.batch_size is not None
+                    else len(feats))
             # padded sides have data-dependent dim 1 unless pinned
             if self.feature_padding is not None:
                 if self.fixed_length is None:
                     return None
-                fshape = (self.batch_size, self.fixed_length) + f0.shape[1:]
+                fshape = (rows, self.fixed_length) + f0.shape[1:]
             else:
-                fshape = (self.batch_size,) + f0.shape
+                fshape = (rows,) + f0.shape
             if self.label_padding is not None:
                 if self.fixed_length is None:
                     return None
-                lshape = (self.batch_size, self.fixed_length) + l0.shape[1:]
+                lshape = (rows, self.fixed_length) + l0.shape[1:]
             else:
-                lshape = (self.batch_size,) + l0.shape
+                lshape = (rows,) + l0.shape
             self._ring = [
                 (np.empty(fshape, f0.dtype), np.empty(lshape, l0.dtype))
                 for _ in range(self.reuse_buffers)]
@@ -178,11 +195,23 @@ class SampleToBatch(Transformer):
             labels = np.stack(labels)
         return MiniBatch(feats, labels)
 
+    def _local_batch(self) -> int:
+        if self.global_batch_size is None:
+            return self.batch_size
+        import jax
+        from bigdl_tpu.dataset.dataset import get_batch_size
+        return get_batch_size(self.global_batch_size, jax.process_count())
+
     def __call__(self, iterator):
+        batch = self._local_batch()
+        if self.reuse_buffers and self.global_batch_size is not None \
+                and self._ring is not None \
+                and self._ring[0][0].shape[0] != batch:
+            self._ring = None  # world changed: old slots have stale rows
         buf = []
         for s in iterator:
             buf.append(s)
-            if len(buf) == self.batch_size:
+            if len(buf) == batch:
                 yield self._assemble(buf)
                 buf = []
         if buf and not self.drop_last:

@@ -63,6 +63,10 @@ EVENT_TYPES = {
     # [phase, perf_counter_ts] pairs, status in {ok, shed, failed}
     "trace": ("trace_id", "status", "hops"),
     "watchdog": ("stale",),
+    # elastic recovery lifecycle (resilience/elastic.py): kind-specific
+    # required fields in RECOVER_KINDS below — the trip→quiesce→reform→
+    # reshard→resume chain is the recovery timeline obs_report renders
+    "recover": ("kind",),
     "preempt": ("step",),
     "abort": ("step", "reason"),
     "crash_bundle": ("reason", "path"),
@@ -87,6 +91,22 @@ SERVE_KINDS = {
     "rollout_begin": ("version",),
     "rollout_commit": ("version",),
     "rollout_rollback": ("version", "phase"),
+}
+
+#: per-kind REQUIRED fields for `recover` events (schema v2, same
+#: contract as SERVE_KINDS): an unknown kind is a validation error.
+#: world sizes ride the reform/reshard/resume kinds so a postmortem can
+#: read the membership change without correlating other streams;
+#: `resume` carries the recovery pause (seconds from trip to the first
+#: post-reform dispatch) — the number the bounded-pause acceptance
+#: drill asserts on.
+RECOVER_KINDS = {
+    "trip": ("stale",),
+    "quiesce": ("step",),
+    "reform": ("world_before", "world_after"),
+    "reshard": ("world_after",),
+    "resume": ("step", "world_before", "world_after", "pause_s"),
+    "abort": ("reason",),
 }
 
 _COMMON = ("v", "ts", "proc", "type")
@@ -124,6 +144,16 @@ def validate_event(event: dict) -> dict:
         if missing:
             raise ValueError(
                 f"serve/{kind} event missing {missing}: {event}")
+    elif etype == "recover":
+        kind = event["kind"]
+        per_kind = RECOVER_KINDS.get(kind)
+        if per_kind is None:
+            raise ValueError(f"unknown recover kind {kind!r} "
+                             f"(known: {sorted(RECOVER_KINDS)})")
+        missing = [k for k in per_kind if k not in event]
+        if missing:
+            raise ValueError(
+                f"recover/{kind} event missing {missing}: {event}")
     elif etype == "trace":
         hops = event["hops"]
         if (not isinstance(hops, list) or not hops

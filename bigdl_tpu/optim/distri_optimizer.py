@@ -33,6 +33,7 @@ Multi-host: each process feeds its local batch shard;
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 import jax
@@ -43,6 +44,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.nn.module import Context
+from bigdl_tpu.obs import events as obs_events
 from bigdl_tpu.optim.local_optimizer import (LocalOptimizer,
                                              _HostSyncWindow, _PendingStep,
                                              _finite_all,
@@ -55,6 +57,18 @@ from bigdl_tpu.utils.random import RNG
 from bigdl_tpu.utils.table import T
 
 logger = logging.getLogger("bigdl_tpu.optim")
+
+
+def _put_host(arr, sharding):
+    """Host array → device array under ``sharding``, multi-host-safe:
+    every process holds the FULL host copy (replicated state, or a
+    checkpoint/anchor restore) and contributes its addressable slices —
+    the one placement primitive this jax supports for arbitrary
+    cross-process shardings."""
+    import jax as _jax
+    arr = np.asarray(arr)
+    return _jax.make_array_from_callback(arr.shape, sharding,
+                                         lambda idx: arr[idx])
 
 
 class DistriOptimizer(LocalOptimizer):
@@ -305,12 +319,17 @@ class DistriOptimizer(LocalOptimizer):
             # is not picklable)
             opt_state = jax.tree_util.tree_map(
                 self._pipe_plan._gather_stacked, opt_state)
-        # params are replicated, so exactly one process writes — the
-        # reference gathers slices to the driver and saves once
-        # (getModel + File.save, DistriOptimizer.scala:320-342); writing
-        # from every host would race on a shared checkpoint path.
-        if jax.process_index() != 0:
-            return
+            # params are replicated post-unpack, so exactly one process
+            # writes — the reference gathers slices to the driver and
+            # saves once (getModel + File.save, DistriOptimizer.scala:
+            # 320-342); writing from every host would race on a shared
+            # checkpoint path.
+            if jax.process_index() != 0:
+                return
+        # non-pipeline: the base decides per snapshot — replicated state
+        # writes from process 0 only; zero1 state sharded across
+        # processes writes one shard file per process
+        # (resilience/checkpoint.py, docs/resilience.md)
         super()._maybe_checkpoint(params, net_state, opt_state, state,
                                   force=True, neval_label=neval_label)
 
@@ -335,8 +354,10 @@ class DistriOptimizer(LocalOptimizer):
                     "ignoring it")
             return False
         from jax.experimental import multihost_utils
-        flags = np.asarray(multihost_utils.process_allgather(
-            np.asarray(1.0 if Engine.preempted() else 0.0, np.float32)))
+        flags = self._guarded(lambda: np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray(1.0 if Engine.preempted() else 0.0,
+                           np.float32))))
         return bool(flags.max() > 0)
 
     def _expert_param_specs(self, params):
@@ -718,15 +739,52 @@ class DistriOptimizer(LocalOptimizer):
         of the flat parameter vector (the reference's per-partition
         optimMethod state, AllReduceParameter.scala:162-235) — init it
         flat; everything else defers to the base builder."""
-        if ((self.gradient_compression or self._straggler is not None)
-                and self.zero1 and self._resume_opt_state is None):
+        z1c = ((self.gradient_compression or self._straggler is not None)
+               and self.zero1)
+        if z1c and self._resume_opt_state is None:
             state = self.optim_method.init_state(
                 jnp.zeros((self._z1c_flat,), jnp.float32))
             return jax.tree_util.tree_map(
                 lambda v: jax.device_put(
                     v, NamedSharding(self.mesh, self._z1c_leaf_spec(v))),
                 state)
+        if z1c and self._resume_opt_state is not None:
+            return self._adapt_z1c_state(self._resume_opt_state)
+        if self.zero1 and self._resume_opt_state is not None:
+            # world-size-agnostic restore: the snapshot holds the FULL
+            # logical tree (load_latest_checkpoint reassembles shards);
+            # partition it over THIS mesh's data axis — which may differ
+            # from the saving run's (dp=4 checkpoint, dp=3 restore)
+            from bigdl_tpu.parallel.sharding import zero1_rule
+            rule = zero1_rule(self.mesh, "data")
+            if self.tensor_parallel and "model" in self.mesh.axis_names:
+                from bigdl_tpu.parallel.sharding import zero1_tp_rule
+                rule = zero1_tp_rule(self.mesh, "data", "model")
+            return jax.tree_util.tree_map(
+                lambda v: _put_host(np.asarray(v), rule(np.asarray(v))),
+                self._resume_opt_state)
         return super()._initial_opt_state(params)
+
+    def _adapt_z1c_state(self, host_state):
+        """Restore flat compressed-ZeRO-1 optimizer state saved at ANY
+        world size: the stored flat mirrors carry the saving run's
+        padding (flat param count rounded up to ITS data-axis size), so
+        leaves are trimmed to the model's true flat length and re-padded
+        for this mesh before sharding.  Scalar leaves (step counters)
+        pass through."""
+        from jax.flatten_util import ravel_pytree
+        total = int(ravel_pytree(self.model.params())[0].size)
+
+        def adapt(v):
+            arr = np.asarray(v)
+            if arr.ndim >= 1 and arr.shape[0] >= total:
+                arr = np.pad(arr[:total],
+                             [(0, self._z1c_flat - total)]
+                             + [(0, 0)] * (arr.ndim - 1))
+            return _put_host(
+                arr, NamedSharding(self.mesh, self._z1c_leaf_spec(arr)))
+
+        return jax.tree_util.tree_map(adapt, host_state)
 
     def _state_trees(self):
         # used only to derive sharding specs: opt_state as abstract
@@ -909,7 +967,275 @@ class DistriOptimizer(LocalOptimizer):
             return jax.process_count()
         return 1
 
+    # -- elastic recovery (resilience/elastic.py, docs/resilience.md) ------
+
+    def _elastic_session(self):
+        """Arm recover-in-place for this run, or return None (and train
+        with the historical fail-fast contract).  Armed only when every
+        parameter bit is redundant across the surviving processes — pure
+        data-parallel layouts (plain DP, zero1, gradient compression):
+        pipeline/tensor/expert/sequence parallelism shard params across
+        processes, so a dead peer takes the only copy of its slice."""
+        from bigdl_tpu.resilience import elastic
+        if not elastic.enabled() or jax.process_count() == 1:
+            return None
+        rt = elastic.runtime()
+        if not rt.armed:
+            logger.warning(
+                "BIGDL_ELASTIC=1 but the job was not brought up through "
+                "the elastic runtime (Engine.init_distributed with the "
+                "flag set, or resilience.elastic.initialize): recover-in-"
+                "place disabled — the stock runtime's heartbeat defaults "
+                "abort survivors before any re-form could run")
+            return None
+        mode = None
+        if self.pipeline_stages is not None:
+            mode = "pipeline_stages"
+        elif self.tensor_parallel:
+            mode = "tensor_parallel"
+        elif self.expert_parallel:
+            mode = "expert_parallel"
+        elif self.sequence_parallel:
+            mode = "sequence_parallel"
+        elif self._straggler is not None:
+            mode = "straggler dropping"
+        if mode is not None:
+            logger.warning(
+                "BIGDL_ELASTIC=1 ignored: %s is keyed to the original "
+                "process world (params or policy state are not redundant "
+                "across survivors); this run keeps the fail-fast "
+                "watchdog contract", mode)
+            return None
+        try:
+            cadence = max(1, int(os.environ.get("BIGDL_ELASTIC_ANCHOR",
+                                                "1")))
+        except ValueError:
+            cadence = 1
+        return {"keeper": elastic.AnchorKeeper(), "gather": None,
+                "cadence": cadence}
+
+    def _elastic_gather_fn(self):
+        """The anchor gather: one dispatch producing fresh REPLICATED
+        copies of (params, net_state, opt_state) — zero1 shards all-
+        gather back to full leaves, so every survivor holds a complete
+        host snapshot after the background D2H (the redundancy recovery
+        reshards from)."""
+        rep = NamedSharding(self.mesh, P())
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        return jax.jit(lambda p, s, o: (copy(p), copy(s), copy(o)),
+                       out_shardings=(rep, rep, rep))
+
+    def _elastic_offer(self, params, net_state, opt_state, state, count):
+        """Enqueue a consistent anchor snapshot (async: the collective
+        dispatches here, the D2H lands on the keeper's thread)."""
+        es = self._elastic
+        if es["gather"] is None:
+            es["gather"] = self._elastic_gather_fn()
+        pipeline = self._train_pipeline
+        snap = T()
+        snap.update(state)
+        payload = {"state": snap, "neval": int(state["neval"]),
+                   "epoch": int(state["epoch"]), "count": int(count),
+                   "rng": (pipeline.rng_snapshot() if pipeline is not None
+                           else RNG.snapshot())}
+        # abandonable: on sync-dispatch backends the gather collective
+        # runs right here, and a dead peer must not wedge the loop
+        trees = self._guarded(
+            lambda: es["gather"](params, net_state, opt_state))
+        es["keeper"].offer(trees, payload)
+
+    def _guarded(self, fn):
+        """Host-blocking work (window flush, validation, checkpoint,
+        preemption merge) runs abandonably while elastic is armed: a
+        collective with a dead peer hangs forever on this backend, and
+        the loop must reach its recovery point instead."""
+        if self._elastic is None:
+            return fn()
+        from bigdl_tpu.resilience import elastic
+        return elastic.guarded_sync(fn)
+
+    def _flush_window(self, state, monitor, reason):
+        if self._elastic is not None:
+            from bigdl_tpu.resilience import elastic
+            if elastic.tripped() is not None:
+                # the pending scalars ride collectives the dead peer will
+                # never join; PARK them (freeing a doomed buffer blocks
+                # in the PJRT destructor) — the anchor is the resume truth
+                if self._window is not None and self._window.pending:
+                    elastic.runtime().leaked.append(
+                        list(self._window.pending))
+                    self._window.pending.clear()
+                if reason == "exception":
+                    return
+                elastic.check()
+            return self._guarded(
+                lambda: super(DistriOptimizer, self)._flush_window(
+                    state, monitor, reason))
+        return super()._flush_window(state, monitor, reason)
+
+    def _elastic_recover(self, trip):
+        """The recovery protocol between two ``_optimize_run`` attempts:
+        quiesce (the unwind already abandoned in-flight work), re-form
+        the fleet at the reduced world size, restore the training state
+        from the newest complete host anchor, re-partition the dataset,
+        and hand back to the loop — which rebuilds mesh-keyed
+        executables through the (reset) xcache registry on re-entry.
+        Raises ``ReformAbort`` when recovery is impossible; the caller
+        falls back to the fail-fast exit."""
+        from bigdl_tpu.resilience import elastic
+        es = self._elastic
+        started = time.monotonic() - (elastic.trip_age() or 0.0)
+        obs_events.emit("recover", kind="quiesce",
+                        step=int(self.state["neval"]),
+                        stale=sorted(trip.stale))
+        anchor = es["keeper"].latest()
+        world_before = int(elastic.runtime().world or jax.process_count())
+        elastic.reform(trip.stale)   # ReformAbort propagates to caller
+        world_after = jax.process_count()
+        self.mesh = data_parallel_mesh()
+        Engine.init()                # refresh node/core counts
+        # training state: the anchor is the last consistent step
+        self.model.load_params(anchor.params)
+        self.model.load_state(anchor.net_state)
+        self._resume_opt_state = anchor.opt_state
+        self.state.update(anchor.state)
+        self.state["neval"] = anchor.neval
+        self.state["epoch"] = anchor.epoch
+        RNG.restore(anchor.rng)
+        self._elastic_resume_count = anchor.count
+        self._reshard_dataset()
+        # executables, device copies and writer threads are keyed to the
+        # abandoned runtime; drop them (xcache was reset in the reform)
+        self._ckpt_copy_fn = None
+        self._ckpt_writer = None
+        self._lr_scales_arg = None
+        # the old keeper's drain thread may be wedged on a doomed gather
+        # (and its queue holds doomed buffers) — park it with the rest of
+        # the old runtime and seed a fresh one from the anchor on host
+        elastic.runtime().leaked.append((es["keeper"], es["gather"]))
+        keeper = elastic.AnchorKeeper()
+        keeper.capture_sync(
+            (anchor.params, anchor.net_state, anchor.opt_state),
+            {"state": anchor.state, "neval": anchor.neval,
+             "epoch": anchor.epoch, "count": anchor.count,
+             "rng": anchor.rng})
+        es["keeper"] = keeper
+        es["gather"] = None
+        obs_events.emit("recover", kind="reshard", step=int(anchor.neval),
+                        world_after=int(world_after))
+        pause = time.monotonic() - started
+        obs_events.emit("recover", kind="resume", step=int(anchor.neval),
+                        world_before=int(world_before),
+                        world_after=int(world_after),
+                        pause_s=round(pause, 4))
+        logger.warning(
+            "elastic: resuming from in-memory anchor at neval=%d epoch=%d "
+            "(world %d -> %d, recovery pause %.2fs, no checkpoint read)",
+            anchor.neval, anchor.epoch, world_before, world_after, pause)
+
+    def _reshard_dataset(self):
+        """Walk the dataset chain and re-key every world-size-dependent
+        stage to the LIVE topology: ShardedDataSet strided shards and
+        ``SampleToBatch(global_batch_size=...)`` local batches.  A
+        global batch that does not divide the re-formed world is a
+        recovery failure HERE (uniform exit 43), not a raw unwind at
+        the first post-recovery iteration."""
+        from bigdl_tpu.resilience import elastic
+
+        def check_batch(t):
+            gbs = getattr(t, "global_batch_size", None)
+            if gbs and gbs % jax.process_count():
+                raise elastic.ReformAbort(
+                    f"global batch {gbs} cannot be divided over the "
+                    f"re-formed world of {jax.process_count()} "
+                    "process(es)")
+            for sub in getattr(t, "transformers", None) or ():
+                check_batch(sub)
+
+        for root in (self.dataset, self.validation_dataset):
+            ds = root
+            while ds is not None:
+                if hasattr(ds, "reshard"):
+                    ds.reshard()
+                t = getattr(ds, "transformer", None)
+                if t is not None:
+                    check_batch(t)
+                ds = getattr(ds, "base", None)
+
+    def _elastic_fail(self, abort):
+        """Recovery was impossible: honor the historical fail-fast
+        contract — same crash bundle and exit code as the watchdog's
+        default policy, so operators see ONE failure shape."""
+        from bigdl_tpu.resilience import elastic
+        from bigdl_tpu.resilience.watchdog import EXIT_CODE
+        logger.error("elastic: recover-in-place impossible (%s) — "
+                     "falling back to the fail-fast exit %d",
+                     abort, EXIT_CODE)
+        try:
+            obs_events.emit("recover", kind="abort", reason=str(abort))
+            from bigdl_tpu.obs import diagnostics
+            import threading
+            t = threading.Thread(
+                target=lambda: diagnostics.dump_crash_bundle(
+                    "elastic-abort", extra={"reason": str(abort)}),
+                daemon=True, name="bigdl-elastic-postmortem")
+            t.start()
+            t.join(timeout=3.0)
+        except Exception:
+            logger.exception("elastic abort crash bundle failed")
+        if elastic.runtime().orig_index == 0:
+            # this process hosts the coordination service: linger so the
+            # other survivors' exit-43 lands before the socket close
+            # SIGABRTs them mid-unwind (the watchdog's grace, one knob)
+            dog = elastic.runtime().watchdog
+            time.sleep(dog.coordinator_grace if dog is not None else 2.0)
+        os._exit(EXIT_CODE)
+
     def optimize(self):
+        from bigdl_tpu.resilience import elastic
+        self._elastic = self._elastic_session()
+        self._elastic_resume_count = None
+        if self._elastic is None:
+            return self._optimize_run()
+        try:
+            while True:
+                try:
+                    return self._optimize_run()
+                except Exception as err:
+                    if isinstance(err, elastic.PeerLossRecovery):
+                        trip = err
+                    else:
+                        # a dead peer surfaces as an immediate collective
+                        # error (gloo TCP reset) well before the heartbeat
+                        # timeout — park for the watchdog's verdict; only
+                        # a confirmed peer death converts into recovery
+                        logger.warning(
+                            "elastic: training raised %s: %s — awaiting "
+                            "the watchdog's verdict before treating it as "
+                            "peer loss", type(err).__name__, err)
+                        trip = elastic.await_trip()
+                        if trip is None:
+                            raise
+                    # the unwound traceback's frames reference buffers
+                    # whose defining computation involves the dead peer;
+                    # FREEING such a buffer blocks forever in the PJRT
+                    # destructor (awaiting the definition event) — park
+                    # the whole traceback with the rest of the doomed
+                    # runtime instead of letting it die here
+                    elastic.runtime().leaked.append(err)
+                try:
+                    self._elastic_recover(trip)
+                except Exception as abort:
+                    # ANY recovery failure — quorum/timeout aborts or an
+                    # unexpected error after the new world formed — takes
+                    # the uniform fail-fast exit; a raw unwind here would
+                    # strand the other survivors in the re-formed
+                    # collectives with an arbitrary exit code
+                    self._elastic_fail(abort)
+        finally:
+            self._elastic = None
+
+    def _optimize_run(self):
         state = self.state
         state.get_or_update("epoch", 1)
         state.get_or_update("neval", 1)
@@ -927,9 +1253,16 @@ class DistriOptimizer(LocalOptimizer):
             net_state = jax.device_put(self._pipe_plan.pack_state(net_state),
                                        pipe_s)
         opt_state = self._initial_opt_state(params)
+        self._resume_opt_state = None   # consumed; never reuse a stale tree
         monitor = self._start_obs_run()
 
         count = 0
+        if self._elastic is not None and self._elastic_resume_count:
+            # post-recovery re-entry: continue the interrupted epoch's
+            # record count from the anchor (docs/resilience.md: the epoch
+            # TAIL re-reads from the re-sharded stream)
+            count = int(self._elastic_resume_count)
+        self._elastic_resume_count = None
         epoch_size = self.dataset.size()
         n_disp = self.iters_per_dispatch
         straggler = self._straggler
@@ -944,8 +1277,16 @@ class DistriOptimizer(LocalOptimizer):
             1 if straggler is not None else self._sync_cadence())
         wall_start = time.perf_counter()
 
+        if self._elastic is not None:
+            from bigdl_tpu.resilience import elastic as elastic_mod
+            # generation-0 anchor: a peer death before the first step's
+            # snapshot must still find a complete resume point
+            self._elastic_offer(params, net_state, opt_state, state, count)
+
         try:
             while not self.end_when(state):
+                if self._elastic is not None:
+                    elastic_mod.check()   # raises PeerLossRecovery on trip
                 neval0 = int(state["neval"])
                 epoch0 = int(state["epoch"])
                 self._window.arm()
@@ -1011,6 +1352,13 @@ class DistriOptimizer(LocalOptimizer):
                         # step.  The HOST array rides the window so the
                         # cadence-1 flush does not transfer a second time.
                         loss = np.asarray(loss)
+                    elif self._elastic is not None:
+                        # on backends that execute collectives on the
+                        # dispatching thread (multi-process CPU), a step
+                        # whose peer died would wedge the loop right here
+                        # — run it abandonably
+                        (params, net_state, opt_state, loss, finite,
+                         taps) = self._guarded(lambda: step_fn(*step_args))
                     else:
                         (params, net_state, opt_state, loss, finite,
                          taps) = step_fn(*step_args)
@@ -1047,6 +1395,12 @@ class DistriOptimizer(LocalOptimizer):
                 rolled = count >= epoch_size
                 count, data_iter = self._advance_epochs(
                     state, count, epoch_size, n_disp, data_iter, pipeline)
+                if self._elastic is not None and \
+                        neval0 % self._elastic["cadence"] == 0:
+                    # consistent post-step snapshot (post-rollover: the
+                    # epoch's shuffle draw is already in the RNG payload)
+                    self._elastic_offer(params, net_state, opt_state,
+                                        state, count)
                 if self._window.due() or rolled:
                     self._flush_window(state, monitor,
                                        "epoch" if rolled else "cadence")
@@ -1059,12 +1413,12 @@ class DistriOptimizer(LocalOptimizer):
                     self._flush_window(state, monitor,
                                        "preempt" if preempt else "trigger")
                 if ne_val is not None:
-                    self._maybe_validate(params, net_state, state,
-                                         force=True)
+                    self._guarded(lambda: self._maybe_validate(
+                        params, net_state, state, force=True))
                 if ne_ck is not None:
-                    self._maybe_checkpoint(params, net_state, opt_state,
-                                           state, force=True,
-                                           neval_label=ne_ck)
+                    self._guarded(lambda: self._maybe_checkpoint(
+                        params, net_state, opt_state, state, force=True,
+                        neval_label=ne_ck))
                 if preempt:
                     self._checkpoint_and_stop(params, net_state, opt_state,
                                               state)
@@ -1081,6 +1435,14 @@ class DistriOptimizer(LocalOptimizer):
             if pipeline is not None:
                 pipeline.close()
             self._train_pipeline = None
+            if self._ckpt_writer is not None:
+                if self._elastic is None:
+                    self._flush_ckpt_writer("run end")
+                elif elastic_mod.tripped() is None:
+                    # a possibly-doomed unwind: bound the wait — if this
+                    # turns into a recovery, _elastic_recover drops the
+                    # writer (its thread may be wedged on dead arrays)
+                    self._flush_ckpt_writer("elastic unwind", timeout=5.0)
 
         # gather (replicated -> host) and write back, ref getModel :475-499
         if self._pipe_plan is not None:

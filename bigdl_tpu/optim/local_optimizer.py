@@ -182,6 +182,13 @@ class LocalOptimizer:
         # cadence window, both set up per optimize() run
         self._train_pipeline = None
         self._window = None
+        # async/sharded checkpointing (resilience/checkpoint.py): lazy
+        # writer thread + the non-donated device-copy jit it feeds from
+        self._ckpt_writer = None
+        self._ckpt_copy_fn = None
+        # elastic recovery session (resilience/elastic.py) — armed by the
+        # DistriOptimizer loop when BIGDL_ELASTIC=1 on a multi-process run
+        self._elastic = None
 
     def set_taps(self, enabled: bool | None = None,
                  cadence: int | None = None):
@@ -633,6 +640,9 @@ class LocalOptimizer:
             if pipeline is not None:
                 pipeline.close()
             self._train_pipeline = None
+            # leaving optimize() with snapshots still in flight would
+            # let the process exit before they are durable
+            self._flush_ckpt_writer("run end")
 
         self.model.load_params(params)
         self.model.load_state(net_state)
@@ -732,6 +742,9 @@ class LocalOptimizer:
         if self.checkpoint_path:
             self._maybe_checkpoint(params, net_state, opt_state, state,
                                    force=True)
+            # the eviction deadline is real: the final snapshot must
+            # be on disk before the exit, async mode or not
+            self._flush_ckpt_writer("preemption checkpoint-and-stop")
         # the exit is clean, but the bundle records WHERE the notice
         # landed (docs/observability.md: preemption postmortems)
         from bigdl_tpu.obs import diagnostics
@@ -897,6 +910,24 @@ class LocalOptimizer:
                           or not self.checkpoint_trigger(state)):
             return
         neval = state["neval"] if neval_label is None else neval_label
+        from bigdl_tpu.resilience import checkpoint as ckpt_mod
+        # the classic (synchronous, whole-tree) path cannot express
+        # optimizer state sharded ACROSS processes — those leaves are not
+        # addressable from one writer — so zero1 multi-host snapshots ride
+        # the sharded writer even with the async flag off
+        sharded = jax.process_count() > 1 and any(
+            ckpt_mod.is_cross_process_sharded(l)
+            for l in jax.tree_util.tree_leaves(opt_state))
+        if ckpt_mod.async_enabled() or sharded:
+            with self.spans.span("checkpoint"):
+                self._emit_checkpoint(params, net_state, opt_state, state,
+                                      neval,
+                                      asynchronous=ckpt_mod.async_enabled())
+            return
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # replicated state, shared checkpoint dir: exactly one writer
+            # (the reference's driver-side getModel + File.save)
+            return
         with self.spans.span("checkpoint"):
             # load host copies: loading the live pytree would leave the
             # module referencing buffers the next (donating) step deletes
@@ -919,8 +950,108 @@ class LocalOptimizer:
             File.save({"state": state, "opt_state": opt_state,
                        "neval": neval, "rng": rng_snap},
                       f"{self.checkpoint_path}/state.{neval}")
+            keep = ckpt_mod.keep_count()
+            if keep:
+                from bigdl_tpu.optim.optimizer import prune_checkpoints
+                prune_checkpoints(self.checkpoint_path, keep,
+                                  just_written=neval)
         obs_events.emit("checkpoint", step=int(neval),
                         path=f"{self.checkpoint_path}/model.{neval}")
+
+    def _flush_ckpt_writer(self, context: str, timeout: float = 120.0):
+        """Drain the async checkpoint writer, LOUDLY: a flush that times
+        out at a preemption/run-end epilogue means the newest snapshot
+        may be missing at resume — that must be in the log, not silently
+        indistinguishable from success."""
+        if self._ckpt_writer is None:
+            return True
+        ok = self._ckpt_writer.flush(timeout=timeout)
+        if not ok:
+            logger.error(
+                "async checkpoint writer did not drain within %.0fs at "
+                "%s — the newest snapshot may be missing or partial on "
+                "resume (the CRC scan will fall back past it)",
+                timeout, context)
+        return ok
+
+    def _ckpt_copy(self, params, net_state, opt_state):
+        """Fresh (never-donated) device copies of the carried state in one
+        dispatch, shardings preserved — what makes handing the trees to a
+        background writer safe against the next step's donation."""
+        if self._ckpt_copy_fn is None:
+            copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+            self._ckpt_copy_fn = jax.jit(
+                lambda p, s, o: (copy(p), copy(s), copy(o)))
+        return self._ckpt_copy_fn(params, net_state, opt_state)
+
+    def _emit_checkpoint(self, params, net_state, opt_state, state, neval,
+                         asynchronous: bool):
+        """The sharded/async snapshot builder (docs/resilience.md "Async
+        checkpoints").  Device trees are copied on this thread (cheap,
+        on-device); the device→host materialization and every byte of
+        pickling/IO happen on the writer thread when ``asynchronous`` —
+        the loop's checkpoint-step cost collapses to one copy dispatch +
+        an enqueue.  Optimizer-state leaves sharded across processes
+        become one ``state.N.shard<r>of<n>`` file (+ CRC sidecar) per
+        process; ``load_latest_checkpoint`` reassembles the full tree,
+        making the snapshot world-size-agnostic."""
+        from bigdl_tpu.resilience import checkpoint as ckpt_mod
+        from bigdl_tpu.utils.file import _pickle_architecture
+
+        params_c, net_c, opt_c = self._ckpt_copy(params, net_state,
+                                                 opt_state)
+        marked, slices = ckpt_mod.split_sharded_state(opt_c)
+        nproc = jax.process_count()
+        rank = jax.process_index()
+        sharded = bool(slices) and nproc > 1
+        pipeline = self._train_pipeline
+        rng_snap = (pipeline.rng_snapshot() if pipeline is not None
+                    else RNG.snapshot())
+        files = []
+        if sharded:
+            files.append((ckpt_mod.shard_file(self.checkpoint_path, neval,
+                                              rank, nproc),
+                          {"rank": int(rank), "world": int(nproc),
+                           "slices": slices}))
+        meta = {}
+        if rank == 0:
+            state_copy = T()
+            state_copy.update(state)
+            blob = {"state": state_copy,
+                    "opt_state": marked if sharded else opt_c,
+                    "neval": neval, "rng": rng_snap}
+            if sharded:
+                blob["opt_shards"] = int(nproc)
+            files.append((f"{self.checkpoint_path}/model.{neval}",
+                          {"format": "bigdl_tpu.module.v2",
+                           "cls": type(self.model).__name__,
+                           "architecture": _pickle_architecture(self.model),
+                           "params": params_c, "state": net_c}))
+            files.append((f"{self.checkpoint_path}/state.{neval}", blob))
+            meta = {"event_path": f"{self.checkpoint_path}/model.{neval}",
+                    "step": int(neval),
+                    "shards": int(nproc) if sharded else 0,
+                    "keep": ckpt_mod.keep_count() or None,
+                    "ckpt_dir": self.checkpoint_path}
+        if not files:
+            return
+        if asynchronous:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = ckpt_mod.AsyncCheckpointWriter()
+            self._ckpt_writer.submit(files, meta)
+            return
+        # sharded-but-sync (zero1 multi-host with BIGDL_CKPT_ASYNC=0):
+        # write inline, same files, same sidecars
+        for path, blob in files:
+            File.save(blob, path)
+        if meta:
+            obs_events.emit("checkpoint", step=int(neval),
+                            path=meta["event_path"],
+                            shards=meta["shards"])
+            if meta.get("keep"):
+                from bigdl_tpu.optim.optimizer import prune_checkpoints
+                prune_checkpoints(self.checkpoint_path, meta["keep"],
+                                  just_written=meta.get("step"))
 
 
 def _model_fingerprint(model):

@@ -4,6 +4,8 @@ LocalDataSet vs DistributedDataSet.
 """
 from __future__ import annotations
 
+import os
+
 from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, LocalDataSet, ShardedDataSet, TransformedDataSet,
 )
@@ -83,6 +85,15 @@ def load_latest_checkpoint(path, restore_rng: bool = False):
     interval of retraining, never the run.  Each candidate is read once
     (no separate verify pre-pass: checkpoints can be multi-GB).
 
+    Sharded snapshots (``blob["opt_shards"] == n``, written by the async
+    sharded path — ``resilience/checkpoint.py``) additionally load their
+    ``state.N.shard<r>of<n>`` files and reassemble the FULL optimizer
+    state, so the returned blob is world-size-agnostic: a checkpoint
+    taken at dp=4 restores at dp=3 or dp=1 (the restoring optimizer
+    re-partitions over its own mesh).  A corrupt or missing shard fails
+    the whole snapshot (optimizer state must be complete or absent,
+    never silently partial) and the scan falls back to an older pair.
+
     Returns ``(module, state_blob, neval)`` or ``None`` when no valid
     snapshot exists (caller starts fresh).  ``restore_rng=True`` also
     restores the host RNG stream snapshotted into the payload
@@ -100,6 +111,14 @@ def load_latest_checkpoint(path, restore_rng: bool = False):
         try:
             module = File.load_module(mp)
             blob = File.load(sp)
+            n_shards = int(blob.get("opt_shards") or 0)
+            if n_shards:
+                from bigdl_tpu.resilience.checkpoint import (
+                    assemble_sharded_state, shard_file)
+                shards = [File.load(shard_file(path, neval, r, n_shards))
+                          for r in range(n_shards)]
+                blob["opt_state"] = assemble_sharded_state(
+                    blob["opt_state"], shards)
         except File.ChecksumError as e:
             logger.warning("resume: snapshot %d under %s is corrupt or "
                            "partial (%s) — skipping to an older one",
@@ -115,6 +134,139 @@ def load_latest_checkpoint(path, restore_rng: bool = False):
         logger.info("resume: loaded snapshot %d from %s", neval, path)
         return module, blob, neval
     return None
+
+
+def snapshot_files(path, neval):
+    """Every file belonging to snapshot ``neval`` under ``path`` (model,
+    state, shard files, CRC sidecars) — the unit retention deletes."""
+    from bigdl_tpu.utils import fs
+    try:
+        names = fs.listdir(path)
+    except (FileNotFoundError, OSError):
+        return []
+    prefixes = (f"model.{neval}", f"state.{neval}")
+    out = []
+    for f in names:
+        stem = f[:-len(".crc32")] if f.endswith(".crc32") else f
+        if stem in prefixes or stem.startswith(f"state.{neval}.shard"):
+            out.append(f)
+    return out
+
+
+def shard_set_complete(path, neval, names=None) -> bool:
+    """True when snapshot ``neval``'s shard files form a complete set.
+    The expected count is parsed from the ``shard<r>of<n>`` names (the
+    same writer emits its own shard before ``state.N``, so a sharded
+    snapshot with a ``state.N`` always has at least one shard file to
+    read ``n`` from) — no payload unpickling.  A snapshot with no shard
+    files is trivially complete (whole-tree path)."""
+    from bigdl_tpu.utils import fs
+    if names is None:
+        try:
+            names = fs.listdir(path)
+        except (FileNotFoundError, OSError):
+            return False
+    prefix = f"state.{neval}.shard"
+    shards = [f for f in names
+              if f.startswith(prefix) and not f.endswith(".crc32")]
+    if not shards:
+        return True
+    try:
+        n = int(shards[0].rsplit("of", 1)[1])
+    except (IndexError, ValueError):
+        return False
+    want = {f"{prefix}{r}of{n}" for r in range(n)}
+    return want <= set(names)
+
+
+def snapshot_valid(path, neval) -> bool:
+    """CRC-verify every file of snapshot ``neval`` (model, state, and
+    any shard files) without unpickling the payloads twice.  A sharded
+    snapshot missing any of its shard files (a rank died before its
+    write landed) is invalid — it can never reassemble."""
+    from bigdl_tpu.utils import file as File
+    from bigdl_tpu.utils import fs
+    files = [f for f in snapshot_files(path, neval)
+             if not f.endswith(File.CRC_SUFFIX)]
+    if not files:
+        return False
+    if not shard_set_complete(path, neval):
+        return False
+    return all(File.verify(fs.join(path, f)) for f in files)
+
+
+def prune_checkpoints(path, keep: int, just_written=None):
+    """Keep-last-``keep`` retention over the ``model.N``/``state.N``
+    snapshot pairs (shard files and CRC sidecars ride along with their
+    label).  The newest CRC-VALID snapshot is always retained even when
+    it falls outside the keep window — a corrupt latest snapshot must
+    never leave the directory with nothing to resume from.
+    ``just_written``: the label the caller wrote (and checksummed)
+    moments ago — when it is the newest, the full read-back CRC scan is
+    skipped (retention after every snapshot must not double the
+    checkpoint I/O).  Deletion failures are logged, not raised
+    (retention is housekeeping; the training run matters more)."""
+    import logging
+
+    from bigdl_tpu.utils import fs
+    logger = logging.getLogger("bigdl_tpu.optim")
+    keep = int(keep or 0)
+    if keep <= 0:
+        return []
+    labels = list_checkpoints(path)   # newest first
+    if not labels:
+        return []
+    victims = []
+    if len(labels) > keep:
+        # just_written vouches only for THIS rank's files — a sharded
+        # snapshot still needs every other rank's shard on disk before
+        # it can anchor retention (a rank killed mid-write must not let
+        # the last complete snapshot be pruned)
+        if just_written is not None and \
+                int(just_written) == labels[0] and \
+                shard_set_complete(path, labels[0]):
+            newest_valid = labels[0]
+        else:
+            newest_valid = next(
+                (n for n in labels if snapshot_valid(path, n)), None)
+        victims = [n for n in labels[keep:] if n != newest_valid]
+    # orphan sweep: shard files whose model/state pair is already gone
+    # (a failed delete in an earlier prune, or a lagging rank's async
+    # writer landing after the pair was pruned) never reappear in
+    # list_checkpoints, so without this they would leak forever.  Only
+    # labels OLDER than the newest pair qualify — a shard landing ahead
+    # of its still-in-flight state.N must not be swept.
+    try:
+        names = fs.listdir(path)
+    except (FileNotFoundError, OSError):
+        names = []
+    known = set(labels)
+    for f in names:
+        stem = f[:-len(".crc32")] if f.endswith(".crc32") else f
+        if stem.startswith("state.") and ".shard" in stem:
+            lab = stem[len("state."):stem.index(".shard")]
+            if lab.isdigit() and int(lab) not in known \
+                    and int(lab) < labels[0] and int(lab) not in victims:
+                victims.append(int(lab))
+    removed = []
+    for n in victims:
+        for f in snapshot_files(path, n):
+            full = fs.join(path, f)
+            try:
+                if fs.is_url(full):  # pragma: no cover - object stores
+                    fsys, p = fs._fs(full)
+                    fsys.rm(p)
+                else:
+                    os.remove(full)
+                removed.append(f)
+            except OSError as e:
+                logger.warning("checkpoint retention: could not remove "
+                               "%s: %s", full, e)
+    if removed:
+        logger.info("checkpoint retention: pruned %d file(s) beyond the "
+                    "newest %d snapshot(s) under %s",
+                    len(removed), keep, path)
+    return removed
 
 
 def save_model(model, path, overwrite: bool = False):

@@ -4,7 +4,14 @@ exercises (docs/resilience.md).
 - ``faults``: ``FaultInjector`` — reproducible chaos keyed by
   ``(step, process_index, site)``, configured via ``BIGDL_FAULTS``.
 - ``watchdog``: heartbeat/timeout peer-death detector for multi-host
-  runs (fail fast out of a dead collective).
+  runs (fail fast out of a dead collective, or hand the trip to the
+  elastic layer under ``on_peer_death="recover"``).
+- ``elastic``: recover-in-place on peer loss — survivors re-form the
+  mesh at the reduced world size and continue from an in-memory anchor
+  (``BIGDL_ELASTIC=1``).
+- ``checkpoint``: asynchronous sharded checkpointing (one CRC-sidecar
+  file per shard, written off the training thread;
+  ``BIGDL_CKPT_ASYNC``/``BIGDL_CKPT_KEEP``).
 
 The defenses themselves live where the work happens: checksummed atomic
 checkpoints in ``utils/fs.py``/``utils/file.py``, the non-finite-grad
@@ -17,3 +24,8 @@ from bigdl_tpu.resilience.faults import (  # noqa: F401
     parse_faults,
 )
 from bigdl_tpu.resilience.watchdog import Watchdog, EXIT_CODE  # noqa: F401
+from bigdl_tpu.resilience import checkpoint  # noqa: F401
+from bigdl_tpu.resilience import elastic  # noqa: F401
+from bigdl_tpu.resilience.elastic import (  # noqa: F401
+    PeerLossRecovery, ReformAbort,
+)

@@ -1,0 +1,269 @@
+"""Asynchronous sharded checkpointing (docs/resilience.md "Async
+checkpoints").
+
+The synchronous checkpoint path blocks the training loop for a full
+device→host gather plus a pickle+write of every byte — at production
+cadence that tax is why operators stretch checkpoint intervals, which
+in turn is why restarts are expensive.  This module moves the whole
+thing off the critical path, the prefetch double-buffer pattern in
+reverse:
+
+- the training loop makes cheap ON-DEVICE copies of the carried state
+  (new buffers, so the next step's donation can never invalidate them)
+  and enqueues them here with the host-side payload;
+- one background writer thread materializes device→host
+  (``File.save``'s numpy conversion), writes ``model.N``/``state.N``
+  with their CRC sidecars exactly like the sync path, and emits the
+  ``checkpoint`` obs event when the snapshot is durable.
+
+ZeRO-1 optimizer state that is sharded ACROSS processes (a multi-host
+data axis) cannot be gathered by one writer — ``np.asarray`` on a
+non-addressable array is an error, and shipping every slice to process
+0 would serialize the fleet through one host's NIC.  Instead each
+process writes its own slices as one shard file + CRC sidecar
+(``state.N.shard<r>of<n>``); ``state.N`` keeps the tree structure with
+:class:`ShardRef` placeholders and records the shard count, and
+``optim.load_latest_checkpoint`` reassembles the full logical tree at
+load time.  Because the reassembled tree is the FULL state (slices
+concatenated back along their original axis), a checkpoint taken at
+dp=4 restores at dp=3 or dp=1 — the restoring optimizer re-partitions
+over its own mesh (world-size-agnostic restore).
+
+Retention: ``BIGDL_CKPT_KEEP=N`` prunes to the newest N snapshots after
+each successful write — but never the newest CRC-valid one, so a
+corrupt latest snapshot cannot leave the directory resume-empty
+(``optim.optimizer.prune_checkpoints``).
+
+Knobs: ``BIGDL_CKPT_ASYNC=1`` (default off: the sync path is the
+historical behavior), ``BIGDL_CKPT_KEEP`` (default 0 = unlimited).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.resilience")
+
+ENV_ASYNC = "BIGDL_CKPT_ASYNC"
+ENV_KEEP = "BIGDL_CKPT_KEEP"
+
+
+def async_enabled() -> bool:
+    return os.environ.get(ENV_ASYNC, "0").strip() == "1"
+
+
+def keep_count() -> int:
+    """Keep-last-N retention (0 = unlimited)."""
+    try:
+        return max(0, int(os.environ.get(ENV_KEEP, "0")))
+    except ValueError:
+        return 0
+
+
+class ShardRef:
+    """Placeholder leaf in a checkpoint's ``opt_state`` tree: the real
+    array lives split across the snapshot's shard files, keyed by this
+    path.  Deliberately tiny and version-tolerant (plain attrs)."""
+
+    def __init__(self, path: str, shape, dtype: str):
+        self.path = path
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = str(dtype)
+
+    def __repr__(self):
+        return f"ShardRef({self.path}, {self.shape}, {self.dtype})"
+
+
+def shard_file(ckpt_path: str, neval: int, rank: int, n: int) -> str:
+    from bigdl_tpu.utils import fs
+    return fs.join(str(ckpt_path), f"state.{neval}.shard{rank}of{n}")
+
+
+def _leaf_key(key_path) -> str:
+    import jax
+    return jax.tree_util.keystr(key_path)
+
+
+def is_cross_process_sharded(leaf) -> bool:
+    """True when ``np.asarray(leaf)`` would fail on this process: a jax
+    array whose shards span processes without full replication."""
+    if not hasattr(leaf, "sharding"):
+        return False
+    try:
+        if leaf.is_fully_addressable or leaf.is_fully_replicated:
+            return False
+        return True
+    except AttributeError:  # plain numpy / scalars
+        return False
+
+
+def split_sharded_state(opt_state):
+    """Split a live optimizer-state tree into (tree with ShardRef
+    placeholders, this process's slices).
+
+    ``slices`` maps leaf path -> list of ``(spec, device_block)``
+    covering this process's addressable shards of that leaf, where
+    ``spec`` is a per-dim ``((start, stop), ...)`` tuple — NOT assumed
+    dim-0: ``zero1_tp_rule`` shards TP'd leaves over dim 1
+    (``P(model, data)``), and the spec must round-trip any layout.
+    Blocks stay ON DEVICE here; the writer thread materializes them."""
+    import jax
+
+    slices = {}
+
+    def visit(key_path, leaf):
+        if not is_cross_process_sharded(leaf):
+            return leaf
+        key = _leaf_key(key_path)
+        # one entry per distinct index range (replicated-within-process
+        # shards would duplicate data)
+        seen = {}
+        for s in leaf.addressable_shards:
+            spec = tuple(
+                (0 if sl.start is None else int(sl.start),
+                 int(dim) if sl.stop is None else int(sl.stop))
+                for sl, dim in zip(s.index, leaf.shape))
+            seen.setdefault(spec, s.data)
+        slices[key] = sorted(seen.items())
+        return ShardRef(key, leaf.shape, leaf.dtype)
+
+    marked = jax.tree_util.tree_map_with_path(visit, opt_state)
+    return marked, slices
+
+
+def assemble_sharded_state(blob_opt_state, shard_blobs):
+    """Inverse of :func:`split_sharded_state` at load time: replace each
+    :class:`ShardRef` with every shard file's blocks written back into
+    their index ranges.  Raises ValueError when any element is missing
+    — an incomplete shard set must fail the snapshot, not silently
+    zero-fill optimizer state."""
+    import jax
+
+    merged = {}
+    for sb in shard_blobs:
+        for key, blocks in sb["slices"].items():
+            merged.setdefault(key, []).extend(
+                (tuple(tuple(int(v) for v in d) for d in spec),
+                 np.asarray(b)) for spec, b in blocks)
+
+    def visit(leaf):
+        if not isinstance(leaf, ShardRef):
+            return leaf
+        blocks = merged.get(leaf.path)
+        if not blocks:
+            raise ValueError(f"checkpoint shard data missing for "
+                             f"{leaf.path}")
+        full = np.empty(leaf.shape, leaf.dtype)
+        covered = np.zeros(leaf.shape, dtype=bool)
+        for spec, b in sorted({s: b for s, b in blocks}.items()):
+            idx = tuple(slice(a, z) for a, z in spec)
+            if full[idx].shape != b.shape:
+                raise ValueError(
+                    f"checkpoint shard block for {leaf.path} at {spec} "
+                    f"has shape {b.shape}, expected {full[idx].shape}")
+            full[idx] = b
+            covered[idx] = True
+        if not covered.all():
+            raise ValueError(
+                f"checkpoint shards for {leaf.path} cover only "
+                f"{int(covered.sum())}/{covered.size} elements "
+                "(incomplete shard set)")
+        return full
+
+    return jax.tree_util.tree_map(
+        visit, blob_opt_state,
+        is_leaf=lambda l: isinstance(l, ShardRef))
+
+
+class AsyncCheckpointWriter:
+    """One background writer; jobs are whole snapshots and execute in
+    submission order (a snapshot must never interleave with the next).
+
+    ``submit`` enqueues ``(files, meta)`` where ``files`` is an ordered
+    list of ``(path, blob)`` pairs saved via ``File.save`` (CRC sidecar
+    per file — every shard gets its own) and ``meta`` drives the
+    post-write bookkeeping (obs event, retention pruning).  Blobs may
+    contain device arrays; the D2H happens on this thread.  A write
+    failure is logged and the job dropped — the training loop must
+    never die for a checkpoint (the resume scan skips the partial
+    snapshot by CRC)."""
+
+    def __init__(self, name: str = "bigdl-ckpt-writer"):
+        self._q = queue.Queue()
+        # outstanding-job counter under one lock (an Event toggled from
+        # two threads has a submit-vs-drain race that could let flush()
+        # return before the final snapshot is durable — the preemption
+        # epilogue's one job)
+        self._cond = threading.Condition()
+        self._outstanding = 0
+        self._stop = False
+        self.written = 0
+        self.failed = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def submit(self, files, meta=None):
+        with self._cond:
+            self._outstanding += 1
+        self._q.put((list(files), dict(meta or {})))
+
+    def _drain(self):
+        from bigdl_tpu.utils import file as File
+        while True:
+            try:
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop:
+                    return
+                continue
+            files, meta = item
+            t0 = time.perf_counter()
+            try:
+                for path, blob in files:
+                    File.save(blob, path)
+                self.written += 1
+                if meta.get("event_path") is not None:
+                    from bigdl_tpu.obs import events as obs_events
+                    obs_events.emit(
+                        "checkpoint", step=int(meta.get("step", 0)),
+                        path=meta["event_path"], mode="async",
+                        shards=int(meta.get("shards", 0)),
+                        write_s=round(time.perf_counter() - t0, 4))
+                keep = meta.get("keep")
+                if keep:
+                    from bigdl_tpu.optim.optimizer import prune_checkpoints
+                    prune_checkpoints(meta["ckpt_dir"], keep,
+                                      just_written=meta.get("step"))
+            except Exception as e:
+                self.failed += 1
+                logger.warning("async checkpoint write failed (%s); the "
+                               "resume scan will skip the partial "
+                               "snapshot: %s",
+                               files[0][0] if files else "?", e)
+            finally:
+                with self._cond:
+                    self._outstanding -= 1
+                    self._cond.notify_all()
+
+    def flush(self, timeout: float = 120.0) -> bool:
+        """Block until every submitted snapshot is durable (preemption
+        epilogue, run end).  Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._outstanding > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=left)
+        return True
+
+    def close(self, timeout: float = 120.0):
+        ok = self.flush(timeout=timeout)
+        self._stop = True
+        return ok

@@ -1,0 +1,693 @@
+"""Elastic training: recover-in-place on peer loss (docs/resilience.md
+"Elastic training").
+
+The watchdog's historical answer to a dead peer is exit 43: survivors
+fail fast out of the dead collective and an operator restarts the whole
+fleet from the last checkpoint.  On a multi-tenant preemptible pod that
+turns every eviction into a full job restart.  This module is the other
+answer: the survivors *re-form* — quiesce in-flight dispatch, abandon
+the poisoned runtime, re-initialize jax distributed among themselves at
+the reduced world size, reshard parameters and ZeRO-1 optimizer state
+from an in-memory host anchor, and continue from the last consistent
+step.  Checkpoint restore becomes the fallback, not the first response.
+
+Protocol (one *generation* per recovery, files under the shared
+watchdog/heartbeat directory)::
+
+    trip      watchdog monitor thread sees a silent peer; with the
+              ``recover`` policy it records the trip here instead of
+              exiting.  The training loop notices at its next host-side
+              boundary (dispatch is async, so the loop thread is never
+              wedged inside the dead collective itself; blocking host
+              syncs run on an abandonable helper thread).
+    join      every survivor writes  rf.<gen>.join.<orig>
+    plan      the surviving ORIGINAL process 0 waits for the join set to
+              settle, checks the quorum floor, picks a fresh coordinator
+              port and publishes  rf.<gen>.plan  (survivor list = new
+              rank order).
+    reform    all survivors abandon the old runtime (see below), then
+              bring up jax distributed at the new world size.
+    reshard   the optimizer re-partitions the anchor state over the new
+              ``data`` axis and rebuilds its executables.
+    resume    training continues from the anchor step.
+
+Why the old runtime is LEAKED, not shut down — three hard facts of this
+jaxlib (0.4.36, measured by the probes that shaped this module):
+
+- a gloo collective whose peer died HANGS forever (no TCP-reset error),
+  so any in-flight train step is unjoinable and the PJRT client that
+  owns its thread can never be destructed;
+- ``jax.distributed.shutdown`` runs a coordination-service shutdown
+  barrier that the dead peer can never join — the client aborts the
+  whole process (``client.h:80``);
+- destroying the coordination *service* while any old client's
+  error-polling RPC is still connected aborts every such process, and a
+  custom ``missed_heartbeat_callback`` crashes in pybind before it can
+  be called.
+
+So recovery drops every Python reference (jit caches, backends, the
+distributed client) and parks the old coordination service on the
+original process 0 for the rest of the process lifetime.  Heartbeats at
+elastic bring-up are stretched to *never* fire (the file watchdog is
+the failure detector), which keeps the leaked stack inert.  The cost is
+one idle port + a few idle threads per recovery; the benefit is that
+peer loss costs a bounded pause instead of the job.
+
+What still exits (the fail-fast contract survives where recovery is
+impossible — the table in docs/resilience.md):
+
+- the ORIGINAL process 0 dies: it hosts the coordination service; the
+  survivors' error-polling RPC aborts them within milliseconds on this
+  jaxlib, before any protocol could run;
+- survivors below the quorum floor (``BIGDL_ELASTIC_QUORUM``, default
+  2);
+- the reform protocol times out (join/plan/connect), or this process
+  is itself declared dead in the published plan;
+- non-pure-DP meshes (pipeline/tensor/expert/sequence parallel shard
+  *parameters* across processes — a dead peer takes its only copy).
+
+Knobs: ``BIGDL_ELASTIC=1`` arms recovery (with ``Watchdog(
+on_peer_death="recover")``), ``BIGDL_ELASTIC_QUORUM`` the minimum
+survivor count, ``BIGDL_ELASTIC_HOST`` the host part of the re-formed
+coordinator address (default the original coordinator's host, else
+localhost).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import socket
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.resilience")
+
+ENV_ELASTIC = "BIGDL_ELASTIC"
+ENV_QUORUM = "BIGDL_ELASTIC_QUORUM"
+ENV_HOST = "BIGDL_ELASTIC_HOST"
+
+#: heartbeat windows for the elastic bring-up: long enough that the
+#: coordination service never declares a task dead on its own (the file
+#: watchdog is the failure detector) and the leaked post-recovery stack
+#: stays silent for the rest of the process lifetime.
+_CLIENT_HEARTBEAT_S = 86400
+_SERVICE_HEARTBEAT_S = 10
+_SERVICE_MAX_MISSING = 1000000
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_ELASTIC, "0").strip() == "1"
+
+
+def quorum() -> int:
+    try:
+        return max(1, int(os.environ.get(ENV_QUORUM, "2")))
+    except ValueError:
+        return 2
+
+
+class PeerLossRecovery(Exception):
+    """Control-flow signal: a peer died and the recover policy is armed —
+    unwind to the training loop's recovery point.  Carries the watchdog's
+    stale view.  Constructing one marks the trip CONSUMED (a recovery
+    owner exists), which is what stands down the watchdog's
+    unconsumed-trip fallback to exit 43."""
+
+    def __init__(self, stale):
+        super().__init__(f"peer loss: stale={sorted(stale)}")
+        self.stale = frozenset(stale)
+        _RT.recovering = True
+
+
+class ReformAbort(RuntimeError):
+    """Recovery is impossible (quorum, timeout, not in plan, dead
+    coordinator): fall back to the fail-fast exit."""
+
+
+# -- module state -----------------------------------------------------------
+
+class _Runtime:
+    """This process's elastic bring-up bookkeeping across generations."""
+
+    def __init__(self):
+        self.armed = False
+        self.generation = 0
+        self.orig_index = None     # process index at generation 0 (stable id)
+        self.n_orig = None
+        self.rank = None           # current rank
+        self.world = None          # current world size
+        self.survivors = None      # current membership as orig indices
+        self.reform_dir = None     # shared dir for join/plan files
+        self.coordinator_host = "localhost"
+        self.leaked = []           # old services/clients parked forever
+        self.watchdog = None
+        self.recovered = False
+        self.recovering = False    # a PeerLossRecovery owner exists
+        self._trip = None          # frozenset of stale orig indices
+        self._trip_mono = None     # monotonic clock at the FIRST trip
+        self._lock = threading.Lock()
+
+
+_RT = _Runtime()
+
+
+def runtime() -> _Runtime:
+    return _RT
+
+
+def reset():
+    """Forget all elastic state (tests)."""
+    global _RT, _SYNC_WORKER
+    _RT = _Runtime()
+    _SYNC_WORKER = None
+
+
+def note_trip(stale):
+    """Record a watchdog trip under the recover policy.  Called from the
+    watchdog monitor thread; the training loop polls :func:`tripped`."""
+    with _RT._lock:
+        if _RT._trip is None:
+            _RT._trip = frozenset(int(s) for s in stale)
+            _RT._trip_mono = time.monotonic()
+        else:
+            _RT._trip = _RT._trip | frozenset(int(s) for s in stale)
+    from bigdl_tpu.obs import events as obs_events
+    obs_events.emit("recover", kind="trip", stale=sorted(_RT._trip),
+                    generation=_RT.generation)
+    logger.error("elastic: peer(s) %s dead — recovery pending (the loop "
+                 "re-forms at its next host boundary)", sorted(_RT._trip))
+
+
+def tripped():
+    """The pending stale set (frozenset of orig indices), or None."""
+    return _RT._trip
+
+
+def trip_age() -> float | None:
+    """Seconds since the first pending trip was recorded, or None — the
+    recovery-pause clock the ``resume`` obs event reports."""
+    t = _RT._trip_mono
+    return None if t is None else time.monotonic() - t
+
+
+def clear_trip():
+    with _RT._lock:
+        _RT._trip = None
+        _RT._trip_mono = None
+        _RT.recovering = False
+
+
+def check():
+    """Raise :class:`PeerLossRecovery` if a trip is pending — the one
+    probe the training loop calls at host-side boundaries."""
+    t = _RT._trip
+    if t is not None:
+        raise PeerLossRecovery(t)
+
+
+def await_trip(timeout: float | None = None):
+    """Wait for the watchdog to confirm a peer death; returns the
+    :class:`PeerLossRecovery` to raise, or None if no trip lands within
+    ``timeout``.
+
+    The error-conversion net under the training loop: a dead peer can
+    surface as an immediate collective error (gloo TCP reset) long
+    before the heartbeat timeout expires — the loop catches the error,
+    parks here for the watchdog's verdict, and recovers if the verdict
+    is peer death (any other error re-raises untouched).  Default
+    timeout: the watchdog's timeout plus margin."""
+    if timeout is None:
+        dog = _RT.watchdog
+        timeout = (dog.timeout + 3.0 * dog.interval + 2.0
+                   if dog is not None else 10.0)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        t = _RT._trip
+        if t is not None:
+            return PeerLossRecovery(t)
+        time.sleep(0.05)
+    return None
+
+
+# -- distributed bring-up ---------------------------------------------------
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int, reform_dir: str | None = None):
+    """Elastic replacement for ``jax.distributed.initialize``.
+
+    Builds the coordination service (process 0) and client directly so
+    it can pass the options plain ``initialize`` hides: heartbeat
+    windows stretched to never fire, and ``shutdown_on_destruction=
+    False`` so dropping the client never runs the (un-joinable)
+    shutdown barrier.  Idempotent per generation; must be used INSTEAD
+    of ``jax.distributed.initialize`` for a run that wants recovery —
+    the stock bring-up's heartbeat/error-polling defaults abort
+    survivors ~100s after a peer dies, before or during any recovery.
+    """
+    from jax._src import distributed as jdist
+    from jax._src.lib import xla_extension as xe
+
+    gs = jdist.global_state
+    if process_id == 0:
+        bind = "[::]:" + coordinator_address.rsplit(":", 1)[1]
+        gs.service = xe.get_distributed_runtime_service(
+            bind, num_processes,
+            heartbeat_interval=_SERVICE_HEARTBEAT_S,
+            max_missing_heartbeats=_SERVICE_MAX_MISSING)
+    client = xe.get_distributed_runtime_client(
+        coordinator_address, process_id, init_timeout=120,
+        heartbeat_interval=_CLIENT_HEARTBEAT_S, max_missing_heartbeats=10,
+        shutdown_on_destruction=False, use_compression=True)
+    client.connect()
+    gs.client = client
+    gs.coordinator_address = coordinator_address
+    gs.process_id = process_id
+    gs.num_processes = num_processes
+
+    rt = _RT
+    rt.armed = True
+    if rt.orig_index is None:
+        rt.orig_index = int(process_id)
+        rt.n_orig = int(num_processes)
+        rt.survivors = list(range(num_processes))
+    rt.rank = int(process_id)
+    rt.world = int(num_processes)
+    rt.coordinator_host = coordinator_address.rsplit(":", 1)[0]
+    if reform_dir is not None:
+        rt.reform_dir = reform_dir
+        os.makedirs(reform_dir, exist_ok=True)
+    return client
+
+
+def _free_port(host: str) -> int:
+    s = socket.socket()
+    s.bind((host if host not in ("", "[::]") else "localhost", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- reform protocol (files under the shared heartbeat dir) ----------------
+
+def _join_path(d, gen, orig):
+    return os.path.join(d, f"rf.{gen}.join.{orig}")
+
+
+def _plan_path(d, gen):
+    return os.path.join(d, f"rf.{gen}.plan")
+
+
+def _write_atomic(path, data: bytes):
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def publish_plan(reform_dir: str, gen: int, stale, orig_index: int,
+                 n_orig: int, live_probe=None, settle: float = 1.0,
+                 timeout: float = 60.0, host: str | None = None,
+                 min_survivors: int | None = None) -> dict:
+    """Coordinator side of the reform handshake (original process 0).
+
+    Waits for the join-file set to settle (no new joiner for ``settle``
+    seconds), intersects it with the heartbeat view (``live_probe() ->
+    stale list``), enforces the quorum floor and publishes the plan:
+    ``{"gen", "survivors": [orig...], "addr": "host:port"}``.  Survivor
+    order IS the new rank order.  Testable without jax: pure files +
+    callbacks."""
+    min_survivors = quorum() if min_survivors is None else min_survivors
+    host = host or os.environ.get(ENV_HOST, "").strip() or "localhost"
+    deadline = time.time() + timeout
+    stale = set(int(s) for s in stale)
+    joined = set()
+    last_change = time.time()
+    while True:
+        now = time.time()
+        cur = {o for o in range(n_orig)
+               if o not in stale and os.path.exists(
+                   _join_path(reform_dir, gen, o))}
+        if cur != joined:
+            joined = cur
+            last_change = now
+        expected = set(range(n_orig)) - stale
+        if joined and (joined == expected or now - last_change >= settle):
+            break
+        if now > deadline:
+            raise _abort_plan(
+                reform_dir, gen,
+                f"reform gen {gen}: join set never settled "
+                f"(joined={sorted(joined)}, stale={sorted(stale)})")
+        time.sleep(0.05)
+    if live_probe is not None:
+        joined -= set(int(s) for s in live_probe())
+    if orig_index not in joined:
+        raise _abort_plan(
+            reform_dir, gen,
+            f"reform gen {gen}: coordinator {orig_index} not in its own "
+            "join set")
+    survivors = sorted(joined)
+    if len(survivors) < min_survivors:
+        raise _abort_plan(
+            reform_dir, gen,
+            f"reform gen {gen}: {len(survivors)} survivor(s) "
+            f"{survivors} below the quorum floor {min_survivors}")
+    plan = {"gen": gen, "survivors": survivors,
+            "addr": "%s:%d" % (host, _free_port(host))}
+    _write_atomic(_plan_path(reform_dir, gen),
+                  json.dumps(plan).encode())
+    return plan
+
+
+def _abort_plan(reform_dir: str, gen: int, reason: str) -> ReformAbort:
+    """Publish the coordinator's abort verdict as the plan, so the other
+    survivors abort PROMPTLY instead of burning their await timeout (and
+    being SIGABRTed mid-wait when the first aborter's exit closes the
+    old coordination-service socket).  Returns the exception to raise."""
+    try:
+        _write_atomic(_plan_path(reform_dir, gen),
+                      json.dumps({"gen": gen, "abort": reason}).encode())
+    except OSError:  # pragma: no cover - the abort still stands
+        pass
+    return ReformAbort(reason)
+
+
+def await_plan(reform_dir: str, gen: int, timeout: float = 90.0) -> dict:
+    """Non-coordinator side: poll for the published plan.  A plan
+    carrying an ``abort`` verdict raises :class:`ReformAbort`."""
+    deadline = time.time() + timeout
+    path = _plan_path(reform_dir, gen)
+    while time.time() < deadline:
+        if os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    plan = json.loads(f.read())
+            except (OSError, ValueError):
+                plan = None  # racing the atomic rename; retry
+            if plan is not None:
+                if "abort" in plan:
+                    raise ReformAbort(plan["abort"])
+                return plan
+        time.sleep(0.05)
+    raise ReformAbort(f"reform gen {gen}: no plan within {timeout:.0f}s "
+                      "(coordinator dead or partitioned)")
+
+
+def _abandon_runtime():
+    """Drop every Python reference into the old jax runtime and leak
+    what cannot die (module docstring: why).  After this, jax.devices()
+    lazily builds a fresh CPU/TPU client against the NEW distributed
+    state on next touch."""
+    import gc
+
+    import jax
+    from jax._src import distributed as jdist
+    from jax.extend import backend as jax_backend
+
+    gs = jdist.global_state
+    jax.clear_caches()
+    jax_backend.clear_backends()
+    # the executable registry holds old-backend executables; drop them so
+    # the rebuilt step re-registers against the new mesh cleanly
+    try:
+        from bigdl_tpu.serve import xcache
+        xcache.reset()
+    except Exception:  # pragma: no cover - serve layer absent
+        pass
+    if gs.client is not None:
+        _RT.leaked.append(gs.client)   # undestructible: hung collective
+        gs.client = None
+    if gs.service is not None:
+        # destroying the service aborts every leaked client's polling
+        # RPC (probe-verified) — park it for the process lifetime
+        _RT.leaked.append(gs.service)
+        gs.service = None
+    gs.coordinator_address = None
+    gc.collect()
+
+
+def reform(stale, settle: float = 1.0, timeout: float = 90.0) -> dict:
+    """Run the full membership handshake + runtime swap for this
+    process.  Returns the plan.  Raises :class:`ReformAbort` when
+    recovery is impossible (callers fall back to exit 43)."""
+    rt = _RT
+    if not rt.armed or rt.reform_dir is None:
+        raise ReformAbort("elastic runtime not armed (bring the job up "
+                          "with resilience.elastic.initialize)")
+    stale = set(int(s) for s in stale)
+    if 0 in stale and rt.orig_index != 0:
+        # the coordination service died with original process 0; on this
+        # jaxlib the leaked clients abort within ms of the socket close —
+        # don't pretend a handshake could win that race
+        raise ReformAbort("original process 0 (coordination service) is "
+                          "dead: recover-in-place is impossible")
+    gen = rt.generation + 1
+    _write_atomic(_join_path(rt.reform_dir, gen, rt.orig_index), b"1")
+    dog = rt.watchdog
+    if rt.orig_index == 0:
+        plan = publish_plan(
+            rt.reform_dir, gen, stale, rt.orig_index, rt.n_orig,
+            live_probe=(dog.stale_peers if dog is not None else None),
+            settle=settle, timeout=timeout,
+            host=os.environ.get(ENV_HOST, "").strip()
+            or rt.coordinator_host)
+    else:
+        plan = await_plan(rt.reform_dir, gen, timeout=timeout)
+    survivors = [int(s) for s in plan["survivors"]]
+    if rt.orig_index not in survivors:
+        raise ReformAbort(f"reform gen {gen}: this process "
+                          f"({rt.orig_index}) is not in the published "
+                          f"plan {survivors}")
+    if len(survivors) < quorum():
+        raise ReformAbort(f"reform gen {gen}: plan {survivors} below "
+                          f"quorum {quorum()}")
+
+    world_before = rt.world
+    _abandon_runtime()
+    new_rank = survivors.index(rt.orig_index)
+    initialize(plan["addr"], len(survivors), new_rank,
+               reform_dir=rt.reform_dir)
+    rt.generation = gen
+    rt.survivors = survivors
+    rt.recovered = True
+    if dog is not None:
+        dog.rebind(peers=survivors)
+    clear_trip()
+    from bigdl_tpu.obs import events as obs_events
+    obs_events.emit("recover", kind="reform", generation=gen,
+                    world_before=int(world_before),
+                    world_after=len(survivors),
+                    survivors=survivors, addr=plan["addr"])
+    logger.warning("elastic: re-formed at generation %d — world %d -> %d "
+                   "(survivors %s, coordinator %s, this process rank %d)",
+                   gen, world_before, len(survivors), survivors,
+                   plan["addr"], new_rank)
+    return plan
+
+
+# -- host anchor ------------------------------------------------------------
+
+class Anchor:
+    """One consistent host-side training snapshot: full numpy trees plus
+    the loop bookkeeping needed to continue from exactly this step."""
+
+    __slots__ = ("params", "net_state", "opt_state", "state", "neval",
+                 "epoch", "count", "rng", "seq")
+
+    def __init__(self, params, net_state, opt_state, state, neval, epoch,
+                 count, rng, seq):
+        self.params = params
+        self.net_state = net_state
+        self.opt_state = opt_state
+        self.state = state
+        self.neval = neval
+        self.epoch = epoch
+        self.count = count
+        self.rng = rng
+        self.seq = seq
+
+
+class AnchorKeeper:
+    """Background snapshot-to-host of the training state (the prefetch
+    double-buffer pattern in reverse: the loop enqueues freshly-gathered
+    device trees; one transfer thread materializes them to numpy).
+
+    The loop hands in REPLICATED, NON-DONATED device trees (the gather
+    jit produces new arrays), so the next step's donation can never
+    invalidate an in-flight transfer.  If a peer dies mid-gather the
+    transfer thread blocks forever on the doomed arrays — it is a
+    daemon, the keeper just keeps serving the last COMPLETE anchor."""
+
+    def __init__(self):
+        self._q = queue.Queue(maxsize=1)
+        self._lock = threading.Lock()
+        self._anchor = None
+        self._seq = 0
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="bigdl-elastic-anchor")
+        self._thread.start()
+
+    def offer(self, device_trees, payload: dict):
+        """Enqueue a gathered snapshot; drops the previous pending one
+        (latest wins — an anchor is only useful if it is the newest
+        complete state)."""
+        self._seq += 1
+        item = (self._seq, device_trees, payload)
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:  # pragma: no cover - single producer
+                pass
+
+    def _drain(self):
+        import jax
+        while True:
+            seq, trees, payload = self._q.get()
+            try:
+                host = jax.tree_util.tree_map(np.asarray, trees)
+            except Exception as e:
+                # doomed gather (peer died mid-window): keep the previous
+                # complete anchor; this thread survives for the next one
+                logger.warning("elastic anchor transfer failed: %s", e)
+                continue
+            params, net_state, opt_state = host
+            anchor = Anchor(params, net_state, opt_state,
+                            payload["state"], payload["neval"],
+                            payload["epoch"], payload["count"],
+                            payload["rng"], seq)
+            with self._lock:
+                if self._anchor is None or seq > self._anchor.seq:
+                    self._anchor = anchor
+
+    def capture_sync(self, host_trees, payload: dict):
+        """Synchronous anchor install from already-host trees (the
+        generation-0 snapshot before the loop starts)."""
+        self._seq += 1
+        params, net_state, opt_state = host_trees
+        with self._lock:
+            self._anchor = Anchor(params, net_state, opt_state,
+                                  payload["state"], payload["neval"],
+                                  payload["epoch"], payload["count"],
+                                  payload["rng"], self._seq)
+
+    def latest(self, grace: float = 2.0) -> Anchor:
+        """The newest complete anchor, giving an in-flight transfer a
+        short grace to land (it usually has: D2H is fast next to a
+        watchdog timeout)."""
+        target = self._seq
+        deadline = time.time() + grace
+        while time.time() < deadline:
+            with self._lock:
+                a = self._anchor
+            if a is not None and a.seq >= target:
+                return a
+            time.sleep(0.05)
+        with self._lock:
+            if self._anchor is None:
+                raise ReformAbort("no complete anchor (peer died before "
+                                  "the first snapshot landed)")
+            return self._anchor
+
+
+class _GuardedWorker:
+    """One long-lived helper thread serving :func:`guarded_sync` calls
+    in order — the guarded path sits on the per-step hot path when
+    elastic is armed, and a thread spawn per call would be thousands of
+    short-lived threads per run.  A worker abandoned mid-call (its fn
+    wedged in a dead collective) is replaced, never reused."""
+
+    def __init__(self):
+        self._req = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="bigdl-elastic-sync")
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            fn, box, done = self._req.get()
+            try:
+                box.append(("ok", fn()))
+            except BaseException as e:  # surfaced on the caller
+                box.append(("err", e))
+            finally:
+                done.set()
+
+    def submit(self, fn):
+        box = []
+        done = threading.Event()
+        self._req.put((fn, box, done))
+        return box, done
+
+
+_SYNC_WORKER = None
+
+
+def guarded_sync(fn, poll: float = 0.2):
+    """Run a potentially-blocking device→host sync on an abandonable
+    helper thread, polling the trip flag.  A doomed sync (collective
+    with a dead peer hangs forever on this backend) would otherwise wedge
+    the training loop past any recovery; here the loop abandons the
+    helper (daemon; its buffers die with the old runtime) and raises
+    :class:`PeerLossRecovery`."""
+    global _SYNC_WORKER
+    if _RT._trip is not None:
+        raise PeerLossRecovery(_RT._trip)
+    if _SYNC_WORKER is None:
+        _SYNC_WORKER = _GuardedWorker()
+    box, done = _SYNC_WORKER.submit(fn)
+    while not done.wait(timeout=poll):
+        if _RT._trip is not None:
+            # the worker is wedged inside fn (or about to be abandoned
+            # with work queued) — poison it; the next call gets a fresh
+            # one and this thread parks with the doomed runtime
+            _SYNC_WORKER = None
+            raise PeerLossRecovery(_RT._trip)
+    kind, val = box[0]
+    if kind == "err":
+        raise val
+    return val
+
+
+# -- ordered job exit -------------------------------------------------------
+
+def finalize(exit_code: int = 0, timeout: float = 60.0):
+    """Ordered end-of-job exit for a RECOVERED fleet; a no-op (returns)
+    when no recovery ever happened.
+
+    The original process 0 hosts the leaked pre-recovery coordination
+    service; its exit closes that socket and aborts any other survivor
+    still running (the leaked clients' error-polling RPC).  So the
+    non-coordinators exit first (``os._exit`` — the leaked runtime's
+    threads make a clean interpreter teardown unreliable), each leaving
+    an exit marker; the coordinator waits for the markers, then exits.
+    """
+    rt = _RT
+    if not rt.recovered:
+        return
+    import sys
+    sys.stdout.flush()
+    sys.stderr.flush()
+    d = rt.reform_dir
+    me = rt.orig_index
+    if me == 0:
+        deadline = time.time() + timeout
+        others = [o for o in (rt.survivors or []) if o != 0]
+        while time.time() < deadline:
+            if all(os.path.exists(os.path.join(d, f"exit.{o}"))
+                   for o in others):
+                break
+            time.sleep(0.05)
+        os._exit(exit_code)
+    else:
+        _write_atomic(os.path.join(d, f"exit.{me}"), b"1")
+        os._exit(exit_code)

@@ -35,24 +35,72 @@ EXIT_CODE = 43
 class Watchdog:
     def __init__(self, directory: str, process_index: int, n_processes: int,
                  interval: float = 0.5, timeout: float = 10.0,
-                 on_stale=None):
+                 on_stale=None, on_peer_death: str = "exit"):
+        """``on_peer_death`` picks the policy when a peer goes silent:
+
+        - ``"exit"`` (default, the historical contract): log loudly and
+          ``os._exit(EXIT_CODE)`` — survivors fail fast out of the dead
+          collective and a restart resumes from the last checkpoint.
+        - ``"recover"``: record the trip with the elastic layer
+          (``resilience/elastic.py``) and KEEP RUNNING — the training
+          loop re-forms the fleet at the reduced world size at its next
+          host-side boundary.  The heartbeat thread keeps beating so the
+          other survivors' monitors don't read *this* process as dead
+          mid-recovery.
+
+        An explicit ``on_stale`` callable overrides either policy (the
+        historical escape hatch, unchanged)."""
         if timeout <= interval:
             raise ValueError(
                 f"timeout ({timeout}) must exceed the heartbeat interval "
                 f"({interval}) or every process looks stale")
+        if on_peer_death not in ("exit", "recover"):
+            raise ValueError(
+                f"on_peer_death must be 'exit' or 'recover', got "
+                f"{on_peer_death!r}")
         self.dir = directory
         self.process_index = int(process_index)
         self.n_processes = int(n_processes)
         self.interval = float(interval)
         self.timeout = float(timeout)
-        self.on_stale = on_stale or self._default_on_stale
+        #: extra seconds process 0 lingers before its fail-fast exit so
+        #: the other survivors' exit-43 lands before the coordination-
+        #: service socket closes (see _default_on_stale)
+        self.coordinator_grace = 2.0
+        #: how long the recover policy waits for a recovery owner to
+        #: consume the trip before downgrading to the fail-fast exit
+        #: (see _recover_on_stale)
+        self.trip_fallback = max(30.0, 4 * self.timeout)
+        self.on_peer_death = on_peer_death
+        # recover keeps the monitor/heartbeat threads alive through the
+        # re-form (an explicit flag: bound-method identity is useless)
+        self._policy_recover = False
+        if on_stale is not None:
+            self.on_stale = on_stale
+        elif on_peer_death == "recover":
+            self.on_stale = self._recover_on_stale
+            self._policy_recover = True
+        else:
+            self.on_stale = self._default_on_stale
         self._stop = threading.Event()
         self._threads = []
+        #: orig indices this monitor watches (None = all < n_processes);
+        #: rebind() narrows it to the survivors after a recovery
+        self._peers = None
         # peers get a grace period from watchdog start until their first
         # beat: process bring-up (jax.distributed handshake, first
         # compile) must not read as death
         self._started_at = None
         os.makedirs(directory, exist_ok=True)
+        if on_peer_death == "recover":
+            # the heartbeat dir doubles as the reform-protocol dir: every
+            # process can already reach it, and join/plan files sit next
+            # to the heartbeats they are decided from
+            from bigdl_tpu.resilience import elastic
+            rt = elastic.runtime()
+            rt.watchdog = self
+            if rt.reform_dir is None:
+                rt.reform_dir = directory
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -93,6 +141,21 @@ class Watchdog:
             except OSError as e:  # transient FS hiccup: keep beating
                 logger.warning("watchdog heartbeat write failed: %s", e)
 
+    def rebind(self, peers=None, n_processes: int | None = None):
+        """Re-key the monitor after an elastic re-form: watch only the
+        surviving ORIGINAL indices (heartbeat files keep their original
+        names — a process's identity never changes, only the membership).
+        Restarts the threads with a fresh bring-up grace."""
+        was_running = bool(self._threads)
+        self.stop()
+        if n_processes is not None:
+            self.n_processes = int(n_processes)
+        self._peers = None if peers is None else [int(p) for p in peers]
+        self._stop = threading.Event()
+        if was_running:
+            self.start()
+        return self
+
     # -- monitor side ------------------------------------------------------
     def stale_peers(self, now: float | None = None):
         """Process indices whose heartbeat is older than ``timeout``
@@ -102,7 +165,9 @@ class Watchdog:
         # can be stale yet
         started = self._started_at if self._started_at is not None else now
         stale = []
-        for i in range(self.n_processes):
+        peers = (self._peers if self._peers is not None
+                 else range(self.n_processes))
+        for i in peers:
             if i == self.process_index:
                 continue
             try:
@@ -118,9 +183,73 @@ class Watchdog:
         while not self._stop.wait(self.interval):
             stale = self.stale_peers()
             if stale:
-                self._stop.set()
+                if not self._policy_recover:
+                    # exit/custom policy: one shot, stop both threads
+                    # (the default exits the process anyway)
+                    self._stop.set()
                 self.on_stale(stale)
                 return
+
+    def _recover_on_stale(self, stale):
+        """The ``recover`` policy: hand the trip to the elastic layer and
+        keep beating — this process is alive and about to re-form; going
+        heartbeat-silent here would cascade false deaths through the
+        other survivors' monitors.  The monitor thread then watches for
+        CONSUMPTION: if no recovery owner claims the trip within a
+        bounded window (no elastic session armed — wrong bring-up,
+        non-pure-DP mesh — or the loop is wedged beyond the guarded
+        probes), the policy downgrades to the fail-fast exit rather
+        than converting peer death into an unbounded fleet hang."""
+        logger.error(
+            "watchdog: process(es) %s silent > %.1fs — peer death; "
+            "recover policy armed, deferring to elastic re-form instead "
+            "of exiting %d", stale, self.timeout, EXIT_CODE)
+        from bigdl_tpu.resilience import elastic
+        from bigdl_tpu.obs import events
+        events.emit("watchdog", stale=list(stale), timeout=self.timeout,
+                    process_index=self.process_index, policy="recover")
+        elastic.note_trip(stale)
+        deadline = time.time() + self.trip_fallback
+        while time.time() < deadline:
+            if self._stop.is_set():
+                return
+            rt = elastic.runtime()
+            if rt.recovering or elastic.tripped() is None:
+                return   # a recovery owner has the process's fate now
+            time.sleep(self.interval)
+        logger.error(
+            "watchdog: recover policy armed but NO recovery owner "
+            "consumed the trip within %.0fs (elastic session not armed, "
+            "or the loop is wedged) — falling back to the fail-fast "
+            "exit %d", self.trip_fallback, EXIT_CODE)
+        self._default_on_stale(stale)
+
+    def arbitrate(self, error, timeout: float | None = None):
+        """Hand a training-loop error to the watchdog's verdict (exit
+        policy).  A dead peer can surface as an IMMEDIATE collective
+        error (TCP reset) long before the heartbeat timeout; if the
+        erroring process unwound on its own it would die with an
+        arbitrary exit code — or worse, be SIGABRTed by the runtime's
+        error-poll when the first survivor's exit closes the
+        coordination service.  Parking here lets the monitor thread
+        deliver the uniform contract: confirmed peer death exits
+        ``EXIT_CODE`` (this call never returns), anything else re-raises
+        ``error`` after the verdict window."""
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self.timeout + 3 * self.interval + 2)
+        logger.warning(
+            "watchdog: training raised %s: %s — holding for the peer-"
+            "death verdict before unwinding", type(error).__name__, error)
+        while time.time() < deadline:
+            if self.stale_peers():
+                # confirmed: the monitor thread exits the process (crash
+                # bundle included) — give it room, then exit directly as
+                # the fallback
+                time.sleep(self.coordinator_grace + 2 * self.interval
+                           + 3.5)
+                os._exit(EXIT_CODE)
+            time.sleep(self.interval)
+        raise error
 
     def _default_on_stale(self, stale):
         logger.error(
@@ -152,6 +281,14 @@ class Watchdog:
                              name="bigdl-watchdog-postmortem")
         t.start()
         t.join(timeout=3.0)
+        if self.process_index == 0 and self.n_processes > 1:
+            # process 0 usually hosts the coordination service; its exit
+            # closes that socket and the runtime's error-poll SIGABRTs
+            # any survivor still unwinding — before it could deliver the
+            # contract's EXIT_CODE.  A short grace lets the peers' own
+            # fail-fast exits land first (still bounded: fail fast means
+            # seconds, not hangs).
+            time.sleep(self.coordinator_grace)
         # os._exit, not sys.exit: the main thread is likely blocked inside
         # a dead collective and would never unwind a SystemExit
         os._exit(EXIT_CODE)

@@ -83,12 +83,34 @@ class _Engine:
         """Multi-host bring-up: one JAX process per TPU VM host (the Spark
         executor role, SURVEY.md §2.9/§3.1).  Wraps
         ``jax.distributed.initialize``; with no args, reads the standard
-        TPU metadata (works out of the box on Cloud TPU pods)."""
+        TPU metadata (works out of the box on Cloud TPU pods).
+
+        With ``BIGDL_ELASTIC=1`` (and explicit coordinates) the bring-up
+        routes through ``resilience.elastic.initialize`` instead: same
+        coordination service, but with heartbeat windows stretched so the
+        runtime never self-terminates on a dead peer — the file watchdog
+        is the failure detector, and the training loop re-forms the fleet
+        (docs/resilience.md "Elastic training")."""
         kwargs = {}
         if coordinator_address is not None:
             kwargs = dict(coordinator_address=coordinator_address,
                           num_processes=num_processes, process_id=process_id)
-        jax.distributed.initialize(**kwargs)
+        from bigdl_tpu.resilience import elastic
+        if elastic.enabled():
+            if coordinator_address is None:
+                # silently falling through to the stock bring-up would
+                # leave the flag a no-op discovered only at the first
+                # peer death — fail at init, where it is fixable
+                raise ValueError(
+                    "BIGDL_ELASTIC=1 requires explicit coordinates "
+                    "(coordinator_address/num_processes/process_id): "
+                    "the elastic bring-up builds the coordination "
+                    "service itself and cannot ride the TPU-metadata "
+                    "auto-init — pass the coordinates or unset the flag")
+            elastic.initialize(coordinator_address, num_processes,
+                               process_id)
+        else:
+            jax.distributed.initialize(**kwargs)
         return self.init()
 
     def _ensure_init(self):

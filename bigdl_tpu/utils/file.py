@@ -69,8 +69,12 @@ def verify(path: str) -> bool:
 
 
 def _to_numpy(tree):
+    # the "has a shape -> materialize" duck test must not swallow
+    # non-array leaves that merely DESCRIBE a shape (the sharded
+    # checkpoint writer's ShardRef placeholders) into 0-d object arrays
     return jax.tree_util.tree_map(
-        lambda x: np.asarray(x) if hasattr(x, "shape") else x, tree)
+        lambda x: np.asarray(x)
+        if isinstance(x, (np.ndarray, jax.Array)) else x, tree)
 
 
 def _to_jax(tree):

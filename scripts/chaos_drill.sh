@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Full chaos matrix: every injected-fault resilience test, INCLUDING the
 # multi-process drills the tier-1 run skips (watchdog peer-death, SIGTERM
-# preemption barrier across 4 processes).
+# preemption barrier across 4 processes, and the elastic
+# kill -> recover-in-place -> converge drill).
 #
 #   scripts/chaos_drill.sh            # full matrix
 #   scripts/chaos_drill.sh -k ckpt    # usual pytest filters pass through
@@ -10,12 +11,17 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== chaos drill: fast injected-fault smokes =="
-JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
-    -m "chaos and not slow" -p no:cacheprovider "$@"
+echo "== chaos drill: fast injected-fault + elastic smokes =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py \
+    tests/test_elastic.py -q \
+    -m "(chaos or elastic) and not slow" -p no:cacheprovider "$@"
 
 echo "== chaos drill: multi-process fault drills (slow) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
     -m "chaos and slow" -p no:cacheprovider "$@"
+
+echo "== chaos drill: 4-proc kill -> recover -> converge (elastic, slow) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_multiprocess.py -q \
+    -m "elastic and slow" -p no:cacheprovider "$@"
 
 echo "chaos drill: all green"

@@ -27,6 +27,19 @@ in the dead collective.
 process — the merged stop flag is a collective).
 ``--preempt-at N``: this worker SIGTERMs itself once neval reaches N.
 
+Elastic drills (tests/test_multiprocess.py, docs/resilience.md):
+``--elastic``: recover-in-place mode — the launcher must export
+``BIGDL_ELASTIC=1`` (so Engine.init_distributed routes through the
+elastic bring-up) and pass ``--watchdog DIR`` (the heartbeat dir doubles
+as the reform dir); the watchdog runs the ``recover`` policy, training
+uses a 24-sample dataset with ``SampleToBatch(global_batch_size=24)``
+(full-batch at ANY world size, so a post-recovery trajectory is oracle-
+comparable) and zero1 so optimizer state is genuinely sharded across
+processes.  The JSON adds ``recovered``/``generation``/``world``/
+``ckpt_loads`` and survivors exit through ``elastic.finalize`` (ordered:
+the leaked pre-recovery coordination service on process 0 must outlive
+every other survivor).
+
 Observability drills (tests/test_obs.py):
 ``--obs DIR``: enable the structured event log (JSONL per process under
 DIR, docs/observability.md).  Process 0 additionally renders the
@@ -59,6 +72,9 @@ def main():
     preempt = "--preempt" in argv
     if preempt:
         argv.remove("--preempt")
+    elastic_mode = "--elastic" in argv
+    if elastic_mode:
+        argv.remove("--elastic")
     preempt_at = None
     if "--preempt-at" in argv:
         i = argv.index("--preempt-at")
@@ -117,8 +133,9 @@ def main():
     watchdog = None
     if watchdog_dir:
         from bigdl_tpu.resilience import Watchdog
-        watchdog = Watchdog(watchdog_dir, pid, nproc,
-                            interval=0.3, timeout=6.0).start()
+        watchdog = Watchdog(
+            watchdog_dir, pid, nproc, interval=0.3, timeout=6.0,
+            on_peer_death="recover" if elastic_mode else "exit").start()
     if faults_spec:
         from bigdl_tpu.resilience import faults as _faults
         _faults.configure(faults_spec, process_index=pid)
@@ -190,6 +207,68 @@ def main():
         print(json.dumps(out))
         return
 
+    if elastic_mode:
+        # elastic drill: 24 records (divisible by 4- and 3-process
+        # worlds), global-batch SampleToBatch (full batch at any world
+        # size -> trajectory comparable to a smaller-world oracle),
+        # zero1 (optimizer state genuinely sharded across processes, so
+        # recovery must reshard it) and momentum (stale velocity would
+        # visibly diverge)
+        from bigdl_tpu.resilience import elastic
+        import bigdl_tpu.optim.optimizer as optmod
+        ckpt_loads = []
+        orig_load = optmod.load_latest_checkpoint
+
+        def counted_load(*a, **k):
+            # the happy recovery path must never read a checkpoint
+            ckpt_loads.append(1)
+            return orig_load(*a, **k)
+
+        optmod.load_latest_checkpoint = counted_load
+        n_e = 24
+        rng_e = np.random.RandomState(0)
+        w_e = rng_e.randn(d, classes) * 2
+        xs_e = rng_e.randn(n_e, d).astype(np.float32)
+        ys_e = (xs_e @ w_e).argmax(1) + 1.0
+        set_seed(5)
+        samples_e = [Sample(x, np.asarray([y]))
+                     for x, y in zip(xs_e, ys_e)]
+        ds_e = (DataSet.array(samples_e, distributed=(nproc > 1))
+                >> SampleToBatch(global_batch_size=n_e))
+        # hidden width 24: divisible by BOTH the 8-device (4-proc) and
+        # 6-device (3-proc) data axes, so zero1 state stays genuinely
+        # cross-process sharded before AND after the re-form (the shard
+        # writer keeps writing shard files at the reduced world)
+        model_e = nn.Sequential(nn.Linear(d, 24), nn.Tanh(),
+                                nn.Linear(24, classes), nn.LogSoftMax())
+        opt = DistriOptimizer(model_e, ds_e, nn.ClassNLLCriterion(),
+                              zero1=(nproc > 1))
+        opt.set_state(T(learningRate=0.5, momentum=0.9))
+        opt.set_end_when(max_iteration(6))
+        if ckpt_dir:
+            opt.set_checkpoint(ckpt_dir, several_iteration(2))
+        opt.optimize()
+        if watchdog is not None:
+            watchdog.stop()
+        psum = float(sum(np.abs(np.asarray(p)).sum()
+                         for p in jax.tree_util.tree_leaves(
+                             model_e.params())))
+        out = {"process_id": pid, "losses": [float(opt.state["loss"])],
+               "psum": psum, "final_neval": int(opt.state["neval"]),
+               "recovered": bool(elastic.runtime().recovered),
+               "generation": int(elastic.runtime().generation),
+               "world": int(jax.process_count()),
+               "ckpt_loads": len(ckpt_loads)}
+        if ckpt_dir:
+            out["ckpt_files"] = sorted(_os.listdir(ckpt_dir))
+        print(json.dumps(out))
+        sys.stdout.flush()
+        # ordered exit: after a recovery the pre-recovery coordination
+        # service (leaked on process 0) must outlive every other
+        # survivor's exit; a no-op when nothing ever tripped
+        elastic.finalize(0)
+        return
+
     model = nn.Sequential(nn.Linear(d, 8), nn.Tanh(),
                           nn.Linear(8, classes), nn.LogSoftMax())
 
@@ -254,7 +333,16 @@ def main():
     if ckpt_dir and not resume:
         opt.set_checkpoint(ckpt_dir, several_iteration(3))
 
-    opt.optimize()
+    try:
+        opt.optimize()
+    except Exception as e:
+        if watchdog is not None:
+            # a dead peer can surface as an immediate collective error
+            # (TCP reset) before the heartbeat timeout: hold for the
+            # watchdog's verdict so survivors deliver the uniform
+            # exit-43 contract instead of an arbitrary unwind
+            watchdog.arbitrate(e)
+        raise
     if watchdog is not None:
         # training survived; peers exit at slightly different times from
         # here on, which must not read as peer death

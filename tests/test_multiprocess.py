@@ -28,13 +28,16 @@ def free_port():
     return port
 
 
-def spawn_workers(nproc, port, ckpt_dir=None, per_proc_args=None):
+def spawn_workers(nproc, port, ckpt_dir=None, per_proc_args=None,
+                  extra_env=None):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # worker pins cpu via jax.config
     # the worker script lives in tests/helpers/, so its sys.path[0] is NOT
     # the repo root — make bigdl_tpu importable without a pip install
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
     extra = [str(ckpt_dir)] if ckpt_dir else []
     return [subprocess.Popen(
         [sys.executable, WORKER, str(i), str(nproc), str(port)] + extra
@@ -43,11 +46,19 @@ def spawn_workers(nproc, port, ckpt_dir=None, per_proc_args=None):
         for i in range(nproc)]
 
 
-def run_workers(nproc, port, ckpt_dir=None, per_proc_args=None):
-    procs = spawn_workers(nproc, port, ckpt_dir, per_proc_args)
+def run_workers(nproc, port, ckpt_dir=None, per_proc_args=None,
+                extra_env=None, expect_dead=()):
+    """``expect_dead``: process ids allowed (required) to die non-zero —
+    the chaos drills' victims; their stdout is not parsed."""
+    procs = spawn_workers(nproc, port, ckpt_dir, per_proc_args, extra_env)
     outs = []
-    for p in procs:
+    for i, p in enumerate(procs):
         out, err = p.communicate(timeout=600)
+        if i in expect_dead:
+            assert p.returncode != 0, \
+                f"victim worker {i} should have died, exited 0:\n{out}"
+            outs.append(None)
+            continue
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         jlines = [l for l in out.splitlines() if l.startswith("{")]
         assert jlines, f"no JSON line in worker stdout:\n{out}\n{err[-1500:]}"
@@ -234,3 +245,152 @@ def test_mid_training_failure_restart_resumes_to_same_result(tmp_path):
     for r in resumed:
         assert r["losses"] == pytest.approx(oracle[0]["losses"], rel=1e-4)
         assert r["psum"] == pytest.approx(oracle[0]["psum"], rel=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Elastic training: kill -> recover-in-place -> converge (ISSUE 8,
+# docs/resilience.md "Elastic training")
+# ---------------------------------------------------------------------------
+
+def _elastic_args(nproc, hb, obs=None, faults=None):
+    args = ["--elastic", "--watchdog", str(hb)]
+    if obs:
+        args += ["--obs", str(obs)]
+    if faults:
+        args += ["--faults", faults]
+    return {i: list(args) for i in range(nproc)}
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.elastic
+def test_four_process_kill_recover_converge(tmp_path):
+    """The acceptance drill: a mid-run ``proc_kill`` under
+    ``BIGDL_ELASTIC=1`` costs a bounded recovery pause, not the job.
+
+    4 processes train zero1 full-batch; process 2 is killed at step 3.
+    The 3 survivors must re-form the mesh, reshard the zero1 optimizer
+    state from the in-memory anchor (NO checkpoint read — asserted via
+    the worker's load counter), finish with exit 0, and land on the
+    trajectory of a 3-process-from-start oracle (full batch at any
+    world size => identical math).  Async sharded checkpoints ride
+    along: every shard written before AND after the re-form must
+    CRC-validate and reassemble."""
+    import sys as _sys
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bigdl_tpu.optim import load_latest_checkpoint
+    from bigdl_tpu.resilience.checkpoint import ShardRef
+    from bigdl_tpu.utils import file as File
+    import jax as _jax
+
+    hb = tmp_path / "hb"
+    obs = tmp_path / "obs"
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    env = {"BIGDL_ELASTIC": "1", "BIGDL_CKPT_ASYNC": "1"}
+    outs = run_workers(
+        4, free_port(), ckpt_dir=ck,
+        per_proc_args=_elastic_args(4, hb, obs=obs,
+                                    faults="proc_kill@at=3,proc=2"),
+        extra_env=env, expect_dead=(2,))
+    survivors = [o for o in outs if o is not None]
+    assert len(survivors) == 3
+
+    for s in survivors:
+        # recovered in place at the reduced world, from memory
+        assert s["recovered"] is True
+        assert s["generation"] == 1
+        assert s["world"] == 3
+        assert s["ckpt_loads"] == 0, "happy path must not read checkpoints"
+        assert s["final_neval"] == 7   # all 6 steps delivered
+    # survivors agree exactly (replicated params after the re-form)
+    for s in survivors[1:]:
+        assert s["losses"] == pytest.approx(survivors[0]["losses"],
+                                            rel=1e-5)
+        assert s["psum"] == pytest.approx(survivors[0]["psum"], rel=1e-5)
+
+    # the dp=3-from-start oracle (same data, same global batch)
+    oracle = run_workers(3, free_port(),
+                         per_proc_args=_elastic_args(3, tmp_path / "hb2"),
+                         extra_env=env)
+    assert oracle[0]["recovered"] is False
+    assert survivors[0]["losses"] == pytest.approx(oracle[0]["losses"],
+                                                   rel=1e-3)
+    assert survivors[0]["psum"] == pytest.approx(oracle[0]["psum"],
+                                                 rel=1e-3)
+
+    # recovery timeline in the obs stream: every survivor resumed with
+    # a bounded pause and the 4 -> 3 membership change on record
+    import glob as _glob
+    events = []
+    for f in _glob.glob(str(obs / "events.p*.jsonl")):
+        with open(f) as fh:
+            events += [json.loads(l) for l in fh if l.strip()]
+    resumes = [e for e in events if e["type"] == "recover"
+               and e["kind"] == "resume"]
+    assert len(resumes) == 3
+    for e in resumes:
+        assert e["world_before"] == 4 and e["world_after"] == 3
+        assert 0 < e["pause_s"] < 120
+    assert any(e["type"] == "recover" and e["kind"] == "trip"
+               for e in events)
+
+    # async sharded checkpoints: every shard CRC-validates, and the
+    # newest snapshot (written at the REDUCED world) reassembles
+    shard_files = [f for f in os.listdir(ck) if ".shard" in f
+                   and not f.endswith(".crc32")]
+    assert shard_files, "zero1 multi-host run wrote no shard files"
+    for f in shard_files:
+        assert File.verify(str(ck / f)), f"shard {f} failed CRC"
+    got = load_latest_checkpoint(str(ck))
+    assert got is not None
+    module, blob, neval = got
+    assert int(blob.get("opt_shards") or 0) == 3   # post-recovery world
+    for leaf in _jax.tree_util.tree_leaves(blob["opt_state"]):
+        assert not isinstance(leaf, ShardRef)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.elastic
+def test_elastic_flag_off_keeps_exit_43(tmp_path):
+    """Back-compat regression: the same kill WITHOUT the elastic flag
+    keeps the historical fail-fast contract — survivors exit 43."""
+    from bigdl_tpu.resilience.watchdog import EXIT_CODE
+
+    hb = tmp_path / "hb"
+    procs = spawn_workers(
+        4, free_port(),
+        per_proc_args={i: ["--watchdog", str(hb), "--faults",
+                           "proc_kill@at=3,proc=2"] for i in range(4)})
+    assert procs[2].wait(timeout=600) == 1
+    for i in (0, 1, 3):
+        out, err = procs[i].communicate(timeout=600)
+        assert procs[i].returncode == EXIT_CODE, \
+            f"worker {i} exited {procs[i].returncode}, want {EXIT_CODE}"
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.elastic
+def test_quorum_floor_falls_back_to_exit_43(tmp_path):
+    """Two dead peers out of 4 with BIGDL_ELASTIC_QUORUM=3: the
+    survivors cannot meet the floor and must fall back to the fail-fast
+    exit (the "what still exits" table, docs/resilience.md)."""
+    from bigdl_tpu.resilience.watchdog import EXIT_CODE
+
+    hb = tmp_path / "hb"
+    env = {"BIGDL_ELASTIC": "1", "BIGDL_ELASTIC_QUORUM": "3"}
+    procs = spawn_workers(
+        4, free_port(),
+        per_proc_args=_elastic_args(
+            4, hb, faults="proc_kill@at=3,proc=2;proc_kill@at=3,proc=3"),
+        extra_env=env)
+    assert procs[2].wait(timeout=600) == 1
+    assert procs[3].wait(timeout=600) == 1
+    for i in (0, 1):
+        out, err = procs[i].communicate(timeout=600)
+        assert procs[i].returncode == EXIT_CODE, \
+            f"worker {i} exited {procs[i].returncode}, want {EXIT_CODE}" \
+            f"\n{err[-2000:]}"

@@ -8,7 +8,8 @@ RUN_DIR is a ``BIGDL_OBS_DIR`` directory: every ``events.p*.jsonl`` in
 it is loaded (one per process), crash bundles (``crash-*/``) are
 listed.  The report covers: run configuration, the throughput/loss
 trajectory (bucketed), tap trends, phase breakdown, skip/straggler
-summary, fault/watchdog/preemption timeline, the serving section
+summary, fault/watchdog/preemption timeline, the elastic recovery
+timeline (``recover`` events), the serving section
 (rollout timeline, shed/error/replica-death counts, decode summary,
 and a per-hop latency waterfall for the slowest traced requests —
 ``--waterfall N``), crash bundles.
@@ -157,6 +158,36 @@ def _serving_section(events, waterfall=5):
     return out
 
 
+def _recovery_section(events):
+    """Markdown lines for the ``recover`` event type (elastic training,
+    docs/resilience.md): the trip→quiesce→reform→reshard→resume chain
+    per process, plus the membership change and the recovery pause."""
+    recovers = _by_type(events, "recover")
+    if not recovers:
+        return []
+    out = ["## Recovery timeline (elastic)", ""]
+    resumes = [e for e in recovers if e["kind"] == "resume"]
+    aborts = [e for e in recovers if e["kind"] == "abort"]
+    for e in resumes:
+        out.append(f"- p{e['proc']} recovered: world "
+                   f"**{e['world_before']} → {e['world_after']}**, "
+                   f"resumed at step {e['step']} after a "
+                   f"**{e['pause_s']:.2f}s** pause")
+    for e in aborts:
+        out.append(f"- p{e['proc']} recovery ABORTED: "
+                   f"`{e.get('reason', '?')}` (fail-fast exit)")
+    out += ["", "| t (s) | proc | kind | detail |", "|---|---|---|---|"]
+    t0 = recovers[0]["ts"]
+    for e in recovers:
+        detail = ", ".join(
+            f"{k}={_fmt(v)}" for k, v in sorted(e.items())
+            if k not in ("v", "ts", "proc", "type", "kind"))
+        out.append(f"| {e['ts'] - t0:+.3f} | p{e['proc']} | {e['kind']} | "
+                   f"{detail or '-'} |")
+    out.append("")
+    return out
+
+
 def render(events, bad, bundles, title="obs run report",
            waterfall=5) -> str:
     out = [f"# {title}", ""]
@@ -218,6 +249,7 @@ def render(events, bad, bundles, title="obs run report",
         out.append("")
 
     out.extend(_serving_section(events, waterfall))
+    out.extend(_recovery_section(events))
 
     incidents = [e for e in events if e["type"] in
                  ("fault", "watchdog", "preempt", "abort", "crash_bundle")]
