@@ -160,11 +160,100 @@ def _lm_handles(model):
                       n_heads, hd, ln_f, eps_f, head, vocab)
 
 
-def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None):
+def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
+                       tp_axis=None):
+    """Paged multi-position forward: token ids (B, S) at per-row
+    positions ``i`` (B, S) against block-paged KV pools.
+
+    ``pages`` is ``(page_table, page_size)``: the pools in ``caches``
+    are shaped (layers, n_pages, page_size, H, hd) and ``page_table``
+    (B, P) maps each row's logical page ``t // page_size`` to a pool
+    page, so a row's attention span is the gathered view
+    ``pool[layer][page_table[b]]`` — (P * page_size) positions in
+    logical order.  The window's K/V scatter runs BEFORE the gather, so
+    window position j attends window positions j' <= j and the
+    committed past through one causal mask (``t <= i[b, j]``): this is
+    both the speculative-verify batch step (S = k+1 drafted positions
+    judged in one pass) and, at S = 1, the paged continuous-decode
+    step.
+
+    ``valid`` (B, S) gates the scatter: invalid positions — a frozen
+    row, or window positions past the row's page allocation — are
+    routed out of bounds, where XLA DROPS the update.  That gate is a
+    correctness contract, not hygiene: pages can outlive their request
+    through the prefix cache (serve/prefix.py), so a stale write from a
+    finished row would corrupt K/V another request later trusts.
+
+    ``tp_axis`` has `_lm_forward_one`'s Megatron semantics: handles
+    carry LOCAL shards, the pools shard on their head dim, one psum
+    merges each branch's output projection."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    h_ = handles
+    ptab, page_size = pages
+    kpool, vpool = caches
+    bsz, S = tok.shape
+    n_pool_pages = int(kpool.shape[1])
+    n_view = int(ptab.shape[1]) * int(page_size)
+    rows = jnp.arange(bsz)[:, None]                      # (B, 1)
+    scale = 1.0 / np.sqrt(h_.hd)
+    if valid is None:
+        valid = jnp.ones(tok.shape, bool)
+    # scatter coordinates: logical page -> physical pool page; invalid
+    # positions target page id n_pool_pages (out of bounds -> dropped)
+    phys = jnp.where(valid, ptab[rows, i // page_size], n_pool_pages)
+    off = i % page_size
+    mask = (jnp.arange(n_view)[None, None, None, :]
+            <= i[:, None, :, None])                      # (B, 1, S, T)
+
+    def layernorm(x, p, eps):
+        mean = x.mean(axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + eps)
+        return (x - mean) * inv * p["~"]["weight"] + p["~"]["bias"]
+
+    def merge(partial):
+        return (partial if tp_axis is None
+                else jax.lax.psum(partial, tp_axis))
+
+    x = h_.emb["weight"].T[tok] + h_.emb["bias"] + pe[i]   # (B, S, d)
+    for li, (ln1, m, ln2, lin1, lin2) in enumerate(h_.blocks):
+        a = layernorm(x, ln1, h_.block_eps[li][0])
+        q = (a @ m["wq"] + m["bq"]).reshape(bsz, S, h_.n_heads, h_.hd)
+        k = (a @ m["wk"] + m["bk"]).reshape(bsz, S, h_.n_heads, h_.hd)
+        v = (a @ m["wv"] + m["bv"]).reshape(bsz, S, h_.n_heads, h_.hd)
+        kpool = kpool.at[li, phys, off].set(k)
+        vpool = vpool.at[li, phys, off].set(v)
+        kview = kpool[li][ptab].reshape(bsz, n_view, h_.n_heads, h_.hd)
+        vview = vpool[li][ptab].reshape(bsz, n_view, h_.n_heads, h_.hd)
+        s = jnp.einsum("bshd,bthd->bhst", q, kview) * scale
+        s = jnp.where(mask, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhst,bthd->bshd", p,
+                       vview).reshape(bsz, S, h_.n_heads * h_.hd)
+        x = x + merge(o @ m["wo"]) + m["bo"]
+        a2 = layernorm(x, ln2, h_.block_eps[li][1])
+        h = jax.nn.relu(a2 @ lin1["weight"].T + lin1["bias"])
+        x = x + merge(h @ lin2["weight"].T) + lin2["bias"]
+    xf = ((x - x.mean(axis=-1, keepdims=True))
+          * jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + h_.eps_f)
+          * h_.ln_f["weight"] + h_.ln_f["bias"])
+    logp = jax.nn.log_softmax(xf @ h_.head["weight"].T + h_.head["bias"])
+    return logp, (kpool, vpool)
+
+
+def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None,
+                    pages=None, valid=None):
     """One decode position for all rows: token ids (B,) at position i
     with per-layer KV caches (layers, B, n_pos, H, hd) -> (log-probs
     (B, vocab), updated caches).  The shared inner body of lm_decode,
     lm_beam_search and the continuous-batching decoder.
+
+    ``pages=(page_table, page_size)`` switches the cache layout to the
+    block-paged pools of :func:`_lm_forward_window` (gather/scatter
+    through the slot→page table, ``valid`` gating the write) — the same
+    math at that row's position, storage indirected through pages.
 
     ``i`` is either a scalar position (every row at the same step — the
     lock-step scans here) or a per-row (B,) vector (``serve/decode.py``
@@ -186,6 +275,13 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    if pages is not None:
+        v = None if valid is None else valid[:, None]
+        logp, caches = _lm_forward_window(
+            tok[:, None], i[:, None], caches, handles, pe, pages,
+            valid=v, tp_axis=tp_axis)
+        return logp[:, 0], caches
 
     h_ = handles
     emb, blocks, block_eps = h_.emb, h_.blocks, h_.block_eps

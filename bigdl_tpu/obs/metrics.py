@@ -47,6 +47,14 @@ import threading
 #: +Inf overflow).
 LATENCY_BUCKETS = tuple(1e-4 * 10 ** (i / 4) for i in range(28))
 
+#: pinned bucket bounds for speculative-decode acceptance lengths
+#: (serve/decode.py): integers 0..32, one bucket per exact length so the
+#: merged histogram reconstructs the full distribution and the fleet
+#: acceptance mean/quantiles are exact, not interpolated.  Pinned at
+#: module scope for the same reason as LATENCY_BUCKETS — replicas can
+#: only merge identical bounds.
+SPEC_ACCEPT_BUCKETS = tuple(float(i) for i in range(33))
+
 
 class Counter:
     """Monotonic counter.  ``inc`` only; merge = sum."""
@@ -116,12 +124,21 @@ class Histogram:
         return bisect.bisect_left(self.bounds, v)
 
     def observe(self, v):
+        self.observe_n(v, 1)
+
+    def observe_n(self, v, n: int):
+        """``n`` observations of ``v`` in one bucket update — the bulk
+        path for device-accumulated counts (the speculative decoder
+        fetches a per-length acceptance vector once per sync boundary,
+        not one observation per window)."""
+        if n <= 0:
+            return
         v = float(v)
         i = self._index(v)
         with self._lock:
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
+            self._counts[i] += n
+            self._sum += v * n
+            self._count += n
 
     def counts(self) -> list:
         with self._lock:

@@ -18,6 +18,11 @@ counterpart, reusing the training stack's pipeline idioms:
   continuous batching over the ``TransformerLM`` KV-cache step, with
   admissions/retirements at step boundaries, cadenced host syncs, and
   optional tensor-parallel serving over a mesh ``model`` axis;
+- :mod:`bigdl_tpu.serve.paging` / :mod:`bigdl_tpu.serve.prefix` — the
+  block-paged KV pool behind the decoder (:class:`PagePool` refcounted
+  page allocation; concurrency scales with pooled tokens, not slab
+  width) and token-hash prefix caching (:class:`PrefixCache` — shared
+  system prompts map cached pages and skip their prefill);
 - :mod:`bigdl_tpu.serve.router` — :class:`Router`: SLO admission in
   front of N replicas (priority classes, deadlines, shed-on-overload,
   least-loaded dispatch, requeue-on-replica-death);
@@ -27,6 +32,11 @@ counterpart, reusing the training stack's pipeline idioms:
 
 Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
 (default 2), ``BIGDL_SERVE_SYNC`` (decode boundary interval, default 8),
+``BIGDL_SERVE_PAGED`` (block-paged KV decode, default on),
+``BIGDL_SERVE_PAGE_SIZE`` (tokens per KV page, default 16),
+``BIGDL_SERVE_PAGES`` (pool size in pages, default slab-equivalent),
+``BIGDL_SERVE_PREFIX_CACHE`` (prefix page reuse, default on),
+``BIGDL_SERVE_SPEC_K`` (self-speculative draft length, default 0 = off),
 ``BIGDL_SERVE_REPLICAS`` (pool size, default 2), ``BIGDL_SERVE_SLO_MS``
 (default request deadline, 0 = none), ``BIGDL_SERVE_SHED`` (overload
 shedding, default on), ``BIGDL_OBS_TRACE_SAMPLE`` (request-trace
@@ -46,6 +56,10 @@ from bigdl_tpu.serve.decode import (  # noqa: F401
 from bigdl_tpu.serve.engine import (  # noqa: F401
     PoisonedRequestError, ServeEngine, SheddedError,
 )
+from bigdl_tpu.serve.paging import (  # noqa: F401
+    PagePool, RequestTooLongError,
+)
+from bigdl_tpu.serve.prefix import PrefixCache  # noqa: F401
 from bigdl_tpu.serve.router import (  # noqa: F401
     DeadReplicaError, Router,
 )
@@ -55,5 +69,6 @@ __all__ = [
     "trim", "valid_mask", "ServeEngine", "PoisonedRequestError",
     "SheddedError", "ContinuousDecoder", "continuous_decode", "Router",
     "DeadReplicaError", "ReplicaPool", "LocalReplica", "ProcessReplica",
-    "WeightStore", "RolloutError",
+    "WeightStore", "RolloutError", "PagePool", "PrefixCache",
+    "RequestTooLongError",
 ]

@@ -5,8 +5,7 @@
 starts together, ends together, and a new request waits for the whole
 batch to finish.  A serving decoder cannot run lock-step — requests
 arrive whenever they arrive and finish at their own lengths.  This
-driver keeps a fixed (B, n_pos) KV-cache slab on device and treats its
-B rows as **slots**:
+driver treats the rows of a fixed-width device batch as **slots**:
 
 - each slot independently consumes its own seed and generates its own
   continuation (per-row positions — ``_lm_forward_one`` scatters the
@@ -17,23 +16,49 @@ B rows as **slots**:
   argument — admission never recompiles);
 - the host syncs only every ``sync_interval`` steps (the
   ``BIGDL_OBS_TAPS_CADENCE``-style boundary, env ``BIGDL_SERVE_SYNC``):
-  generated tokens feed back device-side, completion steps are known
-  arithmetically on the host, and the generated-token slab is
-  materialized once per boundary that retires anything — never per
+  generated tokens feed back device-side, and the generated-token slab
+  is materialized once per boundary that retires anything — never per
   token.
+
+**Paged KV (default, env ``BIGDL_SERVE_PAGED``)**: KV storage is a
+block-paged pool — ``(layers, n_pages, page_size, heads, hd)`` plus a
+per-slot slot→page table carried as traced state — instead of the PR-5
+``(B, n_pos)`` slab.  Admit/retire allocate and free fixed-size pages
+(``serve/paging.py``), so a short request holds only the pages its own
+length needs and live concurrency scales with TOTAL POOLED TOKENS, not
+slab width: ``max_slots`` can exceed ``pool_tokens / n_pos`` by far
+when traffic skews short.  On top of the pool:
+
+- **prefix caching** (``serve/prefix.py``, env
+  ``BIGDL_SERVE_PREFIX_CACHE``): a retiring request donates the full
+  pages inside its seed to a token-hash chain cache; a new request
+  whose seed matches maps those pages read-only into its own table and
+  starts at the (page-aligned) divergence point, skipping that much
+  prefill.  Hits/misses and reused pages ride the metrics registry.
+- **self-speculative decode** (env ``BIGDL_SERVE_SPEC_K``): the model
+  drafts ``k`` tokens per step with a SHALLOW pass over its own first
+  ``draft_layers`` blocks (same weights — no second model), then ONE
+  batched verify pass over the ``k+1``-token window accepts the longest
+  prefix whose drafted tokens match the full model's greedy argmax.
+  Committed tokens are exactly the non-speculative greedy stream for
+  every ``k`` (the acceptance rule only ever commits argmax-consistent
+  tokens), and seed consumption rides the same window — chunked
+  prefill for free.  The draft+verify pair is ONE fused program with a
+  fixed ``k+1`` window, pre-warmed through the shared executable cache
+  at construction, so acceptance-length variance never compiles.
 
 Greedy decoding only (the serial oracle is ``lm_decode(greedy=True)``;
 sampling needs per-slot key streams, which would change the draw order
 vs the serial scan and break the bit-parity contract).
 
-**Tensor-parallel serving** (``mesh=``): a model whose KV slab + weights
+**Tensor-parallel serving** (``mesh=``): a model whose KV pool + weights
 outgrow one chip's HBM serves by sharding the decode step over the
 mesh's ``model`` axis (``parallel/mesh.hybrid_mesh``) with
 ``parallel/compat.shard_map`` — Megatron-style: attention heads and the
-FFN hidden dim split across shards (wq/wk/wv columns + KV-cache head
-dim; lin1 rows), each branch's output projection psum-merges once, and
-everything else (embeddings, LayerNorms, the LM head) replicates.  The
-per-head math is untouched, so TP decode is token-identical to the
+FFN hidden dim split across shards (wq/wk/wv columns + the KV pool's
+head dim; lin1 rows), each branch's output projection psum-merges once,
+and everything else (embeddings, LayerNorms, the LM head) replicates.
+The per-head math is untouched, so TP decode is token-identical to the
 single-device driver — the parity contract ``tests/test_serve_cluster.py``
 asserts.  The step/admit/retire programs are warmed at construction
 through the shared executable cache (``serve/xcache.py``), so admission
@@ -50,19 +75,32 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from bigdl_tpu.serve.paging import PagePool, RequestTooLongError
+from bigdl_tpu.serve.prefix import PrefixCache
+
 logger = logging.getLogger("bigdl_tpu.serve")
 
 _DECODER_SEQ = itertools.count()
 
 ENV_SYNC = "BIGDL_SERVE_SYNC"
 DEFAULT_SYNC = 8
+ENV_PAGED = "BIGDL_SERVE_PAGED"
+ENV_PAGE_SIZE = "BIGDL_SERVE_PAGE_SIZE"
+DEFAULT_PAGE_SIZE = 16
+ENV_PAGES = "BIGDL_SERVE_PAGES"
+ENV_PREFIX = "BIGDL_SERVE_PREFIX_CACHE"
+ENV_SPEC_K = "BIGDL_SERVE_SPEC_K"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
 
 
 def sync_interval_default() -> int:
-    try:
-        return max(1, int(os.environ.get(ENV_SYNC, DEFAULT_SYNC)))
-    except ValueError:
-        return DEFAULT_SYNC
+    return max(1, _env_int(ENV_SYNC, DEFAULT_SYNC))
 
 
 def _tp_weight_specs(handles, ax: str):
@@ -100,7 +138,7 @@ def _tp_weight_specs(handles, ax: str):
 
 class _DecodeReq:
     __slots__ = ("seed", "n_words", "future", "slot", "steps_needed",
-                 "steps_run")
+                 "steps_run", "start_pos", "pages")
 
     def __init__(self, seed, n_words):
         self.seed = [int(t) for t in seed]
@@ -110,24 +148,42 @@ class _DecodeReq:
         # positions fed through = n_seed + n_words - 1 (lm_decode's n_pos)
         self.steps_needed = len(self.seed) + self.n_words - 1
         self.steps_run = 0
+        self.start_pos = 0       # > 0 on a prefix-cache hit
+        self.pages = []          # pool page ids, logical order (paged)
 
 
 class ContinuousDecoder:
-    """Fixed-slab continuous-batching decoder for one ``TransformerLM``.
+    """Continuous-batching decoder for one ``TransformerLM``.
 
-    ``max_slots`` is the device batch width B; ``n_pos`` the slab's
+    ``max_slots`` is the device batch width B; ``n_pos`` the per-request
     position capacity — a request needs ``len(seed) + n_words - 1 <=
-    n_pos``.  :meth:`submit` queues a request (future of the full token
-    row, seed included, matching ``lm_decode``'s return); :meth:`run`
-    drives admitted slots until queue and slots drain.
+    n_pos``, and one that does not fit fails ITS OWN future with
+    :class:`RequestTooLongError` at submit time.  :meth:`submit` queues
+    a request (future of the full token row, seed included, matching
+    ``lm_decode``'s return); :meth:`run` drives admitted slots until
+    queue and slots drain.
+
+    ``paged`` (default from ``BIGDL_SERVE_PAGED``, on) stores KV in a
+    block-paged pool of ``n_pages`` × ``page_size`` tokens instead of a
+    ``(B, n_pos)`` slab; ``n_pages`` defaults to the slab-equivalent
+    ``ceil(n_pos / page_size) * max_slots``.  ``prefix_cache`` enables
+    token-hash prefix page reuse, ``spec_k`` > 0 self-speculative
+    decode with a ``draft_layers``-deep draft pass (default: half the
+    blocks) — both paged-only.
     """
 
     def __init__(self, model, max_slots: int = 4, n_pos: int = 64,
-                 sync_interval: int | None = None, mesh=None):
+                 sync_interval: int | None = None, mesh=None,
+                 paged: bool | None = None, page_size: int | None = None,
+                 n_pages: int | None = None,
+                 prefix_cache: bool | None = None,
+                 spec_k: int | None = None,
+                 draft_layers: int | None = None):
         import jax
         import jax.numpy as jnp
 
         from bigdl_tpu.models.transformer import (_lm_forward_one,
+                                                  _lm_forward_window,
                                                   _lm_handles)
         from bigdl_tpu.optim.local_optimizer import _model_fingerprint
         from bigdl_tpu.serve import xcache
@@ -138,11 +194,38 @@ class ContinuousDecoder:
         self.sync_interval = (sync_interval_default()
                               if sync_interval is None
                               else max(1, int(sync_interval)))
+        self.paged = bool(_env_int(ENV_PAGED, 1)) if paged is None \
+            else bool(paged)
+        self.page_size = max(1, _env_int(ENV_PAGE_SIZE, DEFAULT_PAGE_SIZE)
+                             if page_size is None else int(page_size))
+        self.pages_per_slot = -(-self.n_pos // self.page_size)
+        if n_pages is None:
+            n_pages = _env_int(ENV_PAGES, 0) \
+                or self.pages_per_slot * self.B
+        self.spec_k = max(0, _env_int(ENV_SPEC_K, 0) if spec_k is None
+                          else int(spec_k))
+        use_prefix = bool(_env_int(ENV_PREFIX, 1)) \
+            if prefix_cache is None else bool(prefix_cache)
+        if not self.paged and (self.spec_k or prefix_cache):
+            raise ValueError("speculative decode and prefix caching "
+                             "need the paged KV pool (paged=True)")
+
         handles = _lm_handles(model)
         self._vocab = handles.vocab
-        pe = jnp.asarray(model.modules[1].table(self.n_pos))
-        B, n_pos = self.B, self.n_pos
+        B, n_pos, ps = self.B, self.n_pos, self.page_size
         L, H, hd = handles.n_layers, handles.n_heads, handles.hd
+        self.draft_layers = (max(1, L // 2) if draft_layers is None
+                             else min(L, max(1, int(draft_layers))))
+        Ld, k = self.draft_layers, self.spec_k
+        if self.paged:
+            self._pool = PagePool(int(n_pages), ps)
+            self._prefix = PrefixCache(self._pool) if use_prefix else None
+            n_view = self.pages_per_slot * ps
+        else:
+            self._pool = self._prefix = None
+            n_view = n_pos
+        self._n_view = n_view
+        pe = jnp.asarray(model.modules[1].table(n_view))
 
         self.mesh = mesh
         self.tp = (int(mesh.shape["model"])
@@ -150,8 +233,9 @@ class ContinuousDecoder:
                    else 1)
         fp = _model_fingerprint(model)
 
-        def step_body(local_handles, kc, vc, pos, prev, active, seeds,
-                      seed_len, gen, tp_axis=None):
+        # ---- step bodies --------------------------------------------------
+        def slab_step_body(local_handles, kc, vc, pos, prev, active,
+                           seeds, seed_len, gen, tp_axis=None):
             rows = jnp.arange(B)
             live = active & (pos < n_pos)
             wp = jnp.clip(pos, 0, n_pos - 1)
@@ -166,11 +250,104 @@ class ContinuousDecoder:
             pos = jnp.where(live, pos + 1, pos)
             return kc, vc, pos, prev, gen
 
+        def paged_step_body(local_handles, kpool, vpool, ptab, pos, prev,
+                            active, seeds, seed_len, cap, gen,
+                            tp_axis=None):
+            rows = jnp.arange(B)
+            live = active & (pos < cap)
+            wp = jnp.clip(pos, 0, cap - 1)
+            tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
+            logp, (kpool, vpool) = _lm_forward_one(
+                tok.astype(jnp.int32), wp, (kpool, vpool), local_handles,
+                n_view, pe, tp_axis=tp_axis, pages=(ptab, ps), valid=live)
+            nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+            # frozen rows route their token write out of bounds (dropped)
+            gen = gen.at[rows, jnp.where(live, wp, n_view)].set(nxt)
+            prev = jnp.where(live, nxt, prev)
+            pos = jnp.where(live, pos + 1, pos)
+            return kpool, vpool, pos, prev, gen
+
+        def spec_step_body(local_full, local_draft, kpool, vpool, ptab,
+                           pos, prev, active, seeds, seed_len, cap, gen,
+                           acc_hist, tp_axis=None):
+            rows = jnp.arange(B)
+            live = active & (pos < cap)
+            # -- draft k tokens with the shallow pass (window position 0
+            # is the normal step token; seed positions stay forced)
+            wp0 = jnp.clip(pos, 0, cap - 1)
+            t0 = jnp.where(pos < seed_len,
+                           seeds[rows, wp0], prev).astype(jnp.int32)
+            toks, d_tok, d_pos = [t0], t0, pos
+            for _ in range(k):
+                d_valid = live & (d_pos < cap)
+                dlogp, (kpool, vpool) = _lm_forward_one(
+                    d_tok, jnp.clip(d_pos, 0, cap - 1), (kpool, vpool),
+                    local_draft, n_view, pe, tp_axis=tp_axis,
+                    pages=(ptab, ps), valid=d_valid)
+                d_arg = jnp.argmax(dlogp, axis=-1).astype(jnp.int32)
+                d_pos = d_pos + 1
+                d_tok = jnp.where(
+                    d_pos < seed_len,
+                    seeds[rows, jnp.clip(d_pos, 0, n_view - 1)], d_arg)
+                toks.append(d_tok)
+            W = jnp.stack(toks, axis=1)                     # (B, k+1)
+            p_idx = pos[:, None] + jnp.arange(k + 1)[None, :]
+            valid = live[:, None] & (p_idx < cap[:, None])
+            wp = jnp.clip(p_idx, 0, n_view - 1)
+            # -- ONE batched verify pass with the full model (overwrites
+            # the draft's shallow K/V at the same positions)
+            logp, (kpool, vpool) = _lm_forward_window(
+                W, wp, (kpool, vpool), local_full, pe, (ptab, ps),
+                valid=valid, tp_axis=tp_axis)
+            g = jnp.argmax(logp, axis=-1).astype(jnp.int32)  # (B, k+1)
+            # -- longest accepted prefix: drafted token j+1 survives iff
+            # it equals the verify argmax at position j (seed-forced
+            # positions always survive), so the committed stream is
+            # EXACTLY the non-speculative greedy stream
+            forced = p_idx[:, 1:] < seed_len[:, None]
+            # valid-masked so a chance match at a garbage position past
+            # the slot's page capacity cannot extend the run (it could
+            # never commit — consumed caps at cap - pos — but it would
+            # inflate the acceptance telemetry)
+            match = valid[:, 1:] & (forced | (W[:, 1:] == g[:, :k]))
+            acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+            consumed = jnp.where(live,
+                                 jnp.minimum(acc + 1, cap - pos), 0)
+            commit = jnp.arange(k + 1)[None, :] < consumed[:, None]
+            gen = gen.at[rows[:, None],
+                         jnp.where(commit, wp, n_view)].set(g)
+            prev = jnp.where(consumed > 0,
+                             g[rows, jnp.clip(consumed - 1, 0, k)], prev)
+            # acceptance telemetry covers PURE decode windows only —
+            # every drafted position past the seed.  Seed-forced
+            # (chunked-prefill) windows "accept" by construction and
+            # would skew the histogram toward k no matter how bad the
+            # draft actually is.
+            rec = live & (p_idx[:, 1] >= seed_len)
+            pos = pos + consumed
+            acc_hist = acc_hist + jnp.where(
+                rec[:, None],
+                jax.nn.one_hot(acc, k + 1, dtype=jnp.int32), 0
+            ).sum(axis=0)
+            return kpool, vpool, pos, prev, gen, acc_hist
+
+        def _draft_of(local):
+            return local._replace(blocks=local.blocks[:Ld],
+                                  block_eps=handles.block_eps[:Ld],
+                                  n_layers=Ld)
+
+        # ---- program assembly (single-chip or TP shard_map) ---------------
+        pool_shape = ((L, self._pool.n_pages, ps, H, hd) if self.paged
+                      else (L, B, n_pos, H, hd))
+        kind = "spec" if k else ("paged" if self.paged else "slab")
+        key_tail = ((ps, self.pages_per_slot, self._pool.n_pages, k, Ld)
+                    if self.paged else ())
+
         if self.tp > 1:
             # Megatron head/hidden sharding over the mesh's "model"
             # axis: the step body runs inside shard_map on LOCAL weight
             # shards (passed as an argument pytree — constants cannot
-            # shard), with the KV caches split on their head dim.
+            # shard), with the KV pools split on their head dim.
             if H % self.tp:
                 raise ValueError(
                     f"tensor parallelism {self.tp} must divide "
@@ -196,49 +373,87 @@ class ContinuousDecoder:
                  "ln_f": handles.ln_f, "head": handles.head},
                 jax.tree_util.tree_map(
                     lambda sp: NamedSharding(mesh, sp), wspec))
-            cache = P(None, None, None, ax)
+            cache = P(None, None, None, ax)   # head dim, slab and pool
             rep = P()
             H_local = H // self.tp
 
-            def step_tp(W, kc, vc, pos, prev, active, seeds, seed_len,
-                        gen):
-                local = handles._replace(
+            def _local(W):
+                return handles._replace(
                     mods=None, emb=W["emb"], blocks=W["blocks"],
                     ln_f=W["ln_f"], head=W["head"], n_heads=H_local)
-                return step_body(local, kc, vc, pos, prev, active,
-                                 seeds, seed_len, gen, tp_axis=ax)
+
+            if k:
+                def step_tp(W, *st):
+                    local = _local(W)
+                    return spec_step_body(local, _draft_of(local), *st,
+                                          tp_axis=ax)
+                n_rep_in, n_rep_out = 9, 4
+            elif self.paged:
+                def step_tp(W, *st):
+                    return paged_step_body(_local(W), *st, tp_axis=ax)
+                n_rep_in, n_rep_out = 8, 3
+            else:
+                def step_tp(W, *st):
+                    return slab_step_body(_local(W), *st, tp_axis=ax)
+                n_rep_in, n_rep_out = 6, 3
 
             sharded = compat.shard_map(
                 step_tp, mesh=mesh,
-                in_specs=(wspec, cache, cache, rep, rep, rep, rep, rep,
-                          rep),
-                out_specs=(cache, cache, rep, rep, rep))
+                in_specs=(wspec, cache, cache) + (rep,) * n_rep_in,
+                out_specs=(cache, cache) + (rep,) * n_rep_out)
             self._step = xcache.tracked_jit(
-                sharded, ("decode_step", fp, B, n_pos, "tp%d" % self.tp),
+                sharded,
+                ("decode_step_" + kind, fp, B, n_pos) + key_tail
+                + ("tp%d" % self.tp,),
                 mesh=mesh)
         else:
             self._W = None
 
-            def step(kc, vc, pos, prev, active, seeds, seed_len, gen):
-                return step_body(handles, kc, vc, pos, prev, active,
-                                 seeds, seed_len, gen)
+            if k:
+                def step(*st):
+                    return spec_step_body(handles, _draft_of(handles),
+                                          *st)
+            elif self.paged:
+                def step(*st):
+                    return paged_step_body(handles, *st)
+            else:
+                def step(*st):
+                    return slab_step_body(handles, *st)
 
             self._step = xcache.tracked_jit(
-                step, ("decode_step", fp, B, n_pos))
+                step, ("decode_step_" + kind, fp, B, n_pos) + key_tail)
 
-        def admit(kc, vc, pos, active, seeds, seed_len, gen, slot,
-                  seed_row, s_len):
-            kc = kc.at[:, slot].set(0.0)
-            vc = vc.at[:, slot].set(0.0)
-            pos = pos.at[slot].set(0)
-            active = active.at[slot].set(True)
-            seeds = seeds.at[slot].set(seed_row)
-            seed_len = seed_len.at[slot].set(s_len)
-            gen = gen.at[slot].set(0)
-            return kc, vc, pos, active, seeds, seed_len, gen
+        if self.paged:
+            def admit(ptab, pos, active, seeds, seed_len, cap, gen, slot,
+                      ptab_row, start, seed_row, s_len, capv):
+                ptab = ptab.at[slot].set(ptab_row)
+                pos = pos.at[slot].set(start)
+                active = active.at[slot].set(True)
+                seeds = seeds.at[slot].set(seed_row)
+                seed_len = seed_len.at[slot].set(s_len)
+                cap = cap.at[slot].set(capv)
+                gen = gen.at[slot].set(0)
+                return ptab, pos, active, seeds, seed_len, cap, gen
 
-        def retire(active, slot):
-            return active.at[slot].set(False)
+            def retire(ptab, active, slot):
+                # frozen rows' K/V writes are valid-gated out, so the
+                # table reset is hygiene: freed pages stop being
+                # gathered into this slot's (masked) attention view
+                return ptab.at[slot].set(0), active.at[slot].set(False)
+        else:
+            def admit(kc, vc, pos, active, seeds, seed_len, gen, slot,
+                      seed_row, s_len):
+                kc = kc.at[:, slot].set(0.0)
+                vc = vc.at[:, slot].set(0.0)
+                pos = pos.at[slot].set(0)
+                active = active.at[slot].set(True)
+                seeds = seeds.at[slot].set(seed_row)
+                seed_len = seed_len.at[slot].set(s_len)
+                gen = gen.at[slot].set(0)
+                return kc, vc, pos, active, seeds, seed_len, gen
+
+            def retire(active, slot):
+                return active.at[slot].set(False)
 
         if self.tp > 1:
             # admit/retire ride the SAME shard_map layout as the step:
@@ -247,28 +462,45 @@ class ContinuousDecoder:
             # a silent recompile per (program, sharding) combination
             from bigdl_tpu.parallel import compat
             cache, rep = P(None, None, None, "model"), P()
-            admit = compat.shard_map(
-                admit, mesh=mesh,
-                in_specs=(cache, cache, rep, rep, rep, rep, rep, rep,
-                          rep, rep),
-                out_specs=(cache, cache, rep, rep, rep, rep, rep))
-            retire = compat.shard_map(retire, mesh=mesh,
-                                      in_specs=(rep, rep),
-                                      out_specs=rep)
+            if self.paged:
+                admit = compat.shard_map(
+                    admit, mesh=mesh, in_specs=(rep,) * 13,
+                    out_specs=(rep,) * 7)
+                retire = compat.shard_map(
+                    retire, mesh=mesh, in_specs=(rep,) * 3,
+                    out_specs=(rep, rep))
+            else:
+                admit = compat.shard_map(
+                    admit, mesh=mesh,
+                    in_specs=(cache, cache) + (rep,) * 8,
+                    out_specs=(cache, cache) + (rep,) * 5)
+                retire = compat.shard_map(retire, mesh=mesh,
+                                          in_specs=(rep, rep),
+                                          out_specs=rep)
         self._admit_fn = xcache.tracked_jit(
-            admit, ("decode_admit", fp, B, n_pos), mesh=mesh)
+            admit, ("decode_admit_" + kind, fp, B, n_pos) + key_tail,
+            mesh=mesh)
         self._retire_fn = xcache.tracked_jit(
-            retire, ("decode_retire", fp, B), mesh=mesh)
+            retire, ("decode_retire_" + kind, fp, B) + key_tail,
+            mesh=mesh)
 
         z = jnp.zeros
-        self._kc = z((L, B, n_pos, H, hd), jnp.float32)
-        self._vc = z((L, B, n_pos, H, hd), jnp.float32)
+        self._kc = z(pool_shape, jnp.float32)
+        self._vc = z(pool_shape, jnp.float32)
         self._pos = z((B,), jnp.int32)
         self._prev = z((B,), jnp.int32)
         self._active = z((B,), bool)
-        self._seeds = z((B, n_pos), jnp.int32)
+        self._seeds = z((B, n_view), jnp.int32)
         self._seed_len = z((B,), jnp.int32)
-        self._gen = z((B, n_pos), jnp.int32)
+        self._gen = z((B, n_view), jnp.int32)
+        if self.paged:
+            self._ptab = z((B, self.pages_per_slot), jnp.int32)
+            # capacity starts at one page so clips/masks stay in range
+            # for never-admitted slots; admit sets the real value
+            self._cap = jnp.full((B,), ps, jnp.int32)
+        if k:
+            self._acc_hist = z((k + 1,), jnp.int32)
+            self._acc_seen = np.zeros((k + 1,), np.int64)
 
         self._pending: "deque[_DecodeReq]" = deque()
         self._slots: list = [None] * B
@@ -290,7 +522,29 @@ class ContinuousDecoder:
             "decode_host_syncs_total", "boundary device->host fetches",
             **lab)
         self._m_slots = reg.gauge(
-            "decode_slots_active", "occupied KV-slab slots", **lab)
+            "decode_slots_active", "occupied decode slots", **lab)
+        self._m_slots_hwm = reg.gauge(
+            "decode_slots_hwm", "live-request high-water mark",
+            agg="max", **lab)
+        if self.paged:
+            self._m_pages = reg.gauge(
+                "decode_pages_in_use", "allocated KV pool pages", **lab)
+            reg.gauge("decode_pages_total", "KV pool size in pages",
+                      **lab).set(self._pool.n_pages)
+            self._m_pfx_hit = reg.counter(
+                "decode_prefix_hits_total",
+                "requests admitted with >=1 cached prefix page", **lab)
+            self._m_pfx_miss = reg.counter(
+                "decode_prefix_misses_total",
+                "requests admitted with no cached prefix page", **lab)
+            self._m_pfx_pages = reg.counter(
+                "decode_prefix_pages_total",
+                "prefill pages served from the prefix cache", **lab)
+        if k:
+            self._m_accept = reg.histogram(
+                "decode_spec_accept_len",
+                "accepted draft tokens per speculative window",
+                bounds=obs_metrics.SPEC_ACCEPT_BUCKETS, **lab)
         # directly-constructed decoders (the TP-serving entry point)
         # may never see close() — drop the uniquely-labelled series at
         # GC so the process registry cannot grow without bound
@@ -300,16 +554,58 @@ class ContinuousDecoder:
         self.host_syncs = 0
         self.admitted = 0
         self.retired = 0
+        self.live_hwm = 0
+        self.spec_windows = 0
+        self.spec_accepted = 0
 
         self._warm()
 
+    # -- compiled-program drivers -------------------------------------------
     def _run_step(self):
-        args = (self._kc, self._vc, self._pos, self._prev, self._active,
-                self._seeds, self._seed_len, self._gen)
+        if self.paged:
+            args = (self._kc, self._vc, self._ptab, self._pos,
+                    self._prev, self._active, self._seeds,
+                    self._seed_len, self._cap, self._gen)
+        else:
+            args = (self._kc, self._vc, self._pos, self._prev,
+                    self._active, self._seeds, self._seed_len, self._gen)
+        if self.spec_k:
+            args = args + (self._acc_hist,)
         if self._W is not None:
             args = (self._W,) + args
-        (self._kc, self._vc, self._pos, self._prev,
-         self._gen) = self._step(*args)
+        out = self._step(*args)
+        if self.spec_k:
+            (self._kc, self._vc, self._pos, self._prev, self._gen,
+             self._acc_hist) = out
+        else:
+            (self._kc, self._vc, self._pos, self._prev, self._gen) = out
+
+    def _apply_admit(self, slot, req):
+        seed_row = np.zeros((self._n_view,), np.int32)
+        seed_row[:len(req.seed)] = req.seed
+        if self.paged:
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            row[:len(req.pages)] = req.pages
+            (self._ptab, self._pos, self._active, self._seeds,
+             self._seed_len, self._cap, self._gen) = self._admit_fn(
+                self._ptab, self._pos, self._active, self._seeds,
+                self._seed_len, self._cap, self._gen, np.int32(slot),
+                row, np.int32(req.start_pos), seed_row,
+                np.int32(len(req.seed)),
+                np.int32(len(req.pages) * self.page_size))
+        else:
+            (self._kc, self._vc, self._pos, self._active, self._seeds,
+             self._seed_len, self._gen) = self._admit_fn(
+                self._kc, self._vc, self._pos, self._active, self._seeds,
+                self._seed_len, self._gen, np.int32(slot), seed_row,
+                np.int32(len(req.seed)))
+
+    def _apply_retire(self, slot):
+        if self.paged:
+            self._ptab, self._active = self._retire_fn(
+                self._ptab, self._active, np.int32(slot))
+        else:
+            self._active = self._retire_fn(self._active, np.int32(slot))
 
     def _warm(self):
         """Pre-compile the step/admit/retire programs at construction so
@@ -317,140 +613,267 @@ class ContinuousDecoder:
         zero-cold-compile property, docs/serving.md).
 
         The warm pass cycles the REAL state machine once — step on the
-        fresh slab, admit into slot 0, step on the admit outputs, retire,
-        step again — keeping each program's outputs as the live state, so
-        every (shape, sharding) combination the serving loop will feed
-        each program is compiled here and not mid-stream (jit caches per
-        input sharding; under TP the shard_map step and the plain-jit
-        admit/retire produce differently-placed carries).  The slot-0
-        garbage this writes is erased by ``admit``'s per-slot reset
-        before any real request serves."""
-        import numpy as np
-
+        fresh state, admit into slot 0, step on the admit outputs,
+        retire, step again — keeping each program's outputs as the live
+        state, so every (shape, sharding) combination the serving loop
+        will feed each program is compiled here and not mid-stream (jit
+        caches per input sharding; under TP the shard_map step and the
+        admit/retire programs produce differently-placed carries).  The
+        warm admission maps slot 0 at pool page 0 with a one-page
+        capacity; whatever K/V it writes there is overwritten
+        position-by-position by the page's next real owner before any
+        masked-in read."""
+        warm = _DecodeReq([0], 1)
+        warm.pages = [0] if self.paged else []
         self._run_step()
         for _ in range(2):
             # twice: the first admission's carries are the fresh
-            # host-placed slab, every later admission's are program
+            # host-placed state, every later admission's are program
             # outputs — both placement combinations must compile now
-            (self._kc, self._vc, self._pos, self._active, self._seeds,
-             self._seed_len, self._gen) = self._admit_fn(
-                self._kc, self._vc, self._pos, self._active, self._seeds,
-                self._seed_len, self._gen, np.int32(0),
-                np.zeros((self.n_pos,), np.int32), np.int32(0))
+            self._apply_admit(0, warm)
         self._run_step()
-        self._active = self._retire_fn(self._active, np.int32(0))
+        self._apply_retire(0)
         self._run_step()
+        if self.spec_k:
+            # the warm pass ran live speculative windows; exclude them
+            # from the acceptance histogram — they judged garbage
+            self._acc_seen = np.asarray(self._acc_hist, np.int64)
 
     # -- submit -------------------------------------------------------------
     def submit(self, seed_ids, n_words: int) -> Future:
         """Queue one request; the future resolves to the full token row
         (seed + ``n_words`` generated ids), exactly ``lm_decode``'s
-        greedy output for the same seed."""
+        greedy output for the same seed.  A request that cannot ever
+        fit fails ONLY its own future with :class:`RequestTooLongError`
+        — other submitted requests are untouched."""
         seed = np.asarray(seed_ids, np.int32)
         if seed.ndim != 1 or seed.size == 0:
             raise ValueError("seed_ids must be one flat non-empty id row")
         if n_words < 1:
             raise ValueError("n_words must be >= 1")
         req = _DecodeReq(seed.tolist(), n_words)
-        if req.steps_needed > self.n_pos:
-            raise ValueError(
-                f"request needs {req.steps_needed} positions but the "
-                f"slab holds n_pos={self.n_pos}")
+        too_long = req.steps_needed > self.n_pos
+        if self.paged and not too_long:
+            too_long = (-(-req.steps_needed // self.page_size)
+                        > self._pool.n_pages)
+        if too_long:
+            req.future.set_exception(RequestTooLongError(
+                f"request needs {req.steps_needed} positions "
+                f"(len(seed)={len(req.seed)} + n_words={req.n_words} - 1)"
+                f" but this decoder holds n_pos={self.n_pos}"
+                + (f" across {self._pool.n_pages} pages of "
+                   f"{self.page_size}" if self.paged else "")
+                + "; raise n_pos/the pool or split the request"))
+            return req.future
         self._pending.append(req)
         return req.future
 
     # -- drive --------------------------------------------------------------
+    def _alloc_pages(self, n):
+        """``n`` fresh pool pages, evicting cache-only prefix pages on
+        demand (one LRU scan per attempt); None when the pool cannot
+        satisfy the request yet."""
+        short = n - self._pool.free_count
+        if short > 0 and (self._prefix is None
+                          or self._prefix.evict(short) < short):
+            return None
+        return [self._pool.alloc_one() for _ in range(n)]
+
+    def _try_admit_paged(self, req) -> bool:
+        shared = (self._prefix.match(req.seed)
+                  if self._prefix is not None else [])
+        total = -(-req.steps_needed // self.page_size)
+        fresh = self._alloc_pages(total - len(shared))
+        if fresh is None:
+            for pid in shared:
+                self._pool.release(pid)
+            return False
+        req.pages = shared + fresh
+        req.start_pos = len(shared) * self.page_size
+        if self._prefix is not None:
+            self._prefix.note_request(len(shared))
+            (self._m_pfx_hit if shared else self._m_pfx_miss).inc()
+            if shared:
+                self._m_pfx_pages.inc(len(shared))
+        return True
+
     def _admit_waiting(self):
         for slot in range(self.B):
             if self._slots[slot] is not None or not self._pending:
                 continue
-            req = self._pending.popleft()
+            req = self._pending[0]
+            if self.paged and not self._try_admit_paged(req):
+                break   # head-of-line: wait for retirements to free pages
+            self._pending.popleft()
             req.slot = slot
-            seed_row = np.zeros((self.n_pos,), np.int32)
-            seed_row[:len(req.seed)] = req.seed
-            (self._kc, self._vc, self._pos, self._active, self._seeds,
-             self._seed_len, self._gen) = self._admit_fn(
-                self._kc, self._vc, self._pos, self._active, self._seeds,
-                self._seed_len, self._gen, np.int32(slot), seed_row,
-                np.int32(len(req.seed)))
+            self._apply_admit(slot, req)
             self._slots[slot] = req
             self.admitted += 1
             self._m_admitted.inc()
+        if self.paged:
+            self._m_pages.set(self._pool.in_use)
+
+    def _retire_req(self, req):
+        self._apply_retire(req.slot)
+        if self.paged:
+            donate = 0
+            if self._prefix is not None:
+                # donate the full pages inside the seed: their K/V is a
+                # pure function of the seed prefix, so the next request
+                # sharing it skips that much prefill (ownership moves to
+                # the cache — no copy; already-shared pages just drop
+                # this slot's reference)
+                donate = min(len(req.seed) // self.page_size,
+                             len(req.pages))
+                self._prefix.insert(req.seed, req.pages[:donate])
+            for pid in req.pages[donate:]:
+                self._pool.release(pid)
+            self._m_pages.set(self._pool.in_use)
+        self._slots[req.slot] = None
+        self.retired += 1
+        self._m_retired.inc()
+
+    def _drain_accept_hist(self):
+        """Fold the device-accumulated acceptance-length vector into the
+        registry histogram (bulk bucket adds — one tiny fetch per
+        boundary, never one observation per window)."""
+        cur = np.asarray(self._acc_hist, np.int64)
+        delta = cur - self._acc_seen
+        self._acc_seen = cur
+        for a, n in enumerate(delta):
+            n = int(n)
+            if n > 0:
+                self._m_accept.observe_n(float(a), n)
+                self.spec_windows += n
+                self.spec_accepted += n * a
 
     def run(self):
-        """Drive the slab until every submitted request has resolved.
+        """Drive the decoder until every submitted request has resolved.
         Admissions and retirements happen only at ``sync_interval``
         step boundaries; the only device->host reads are one
-        generated-slab fetch per boundary that retires a request."""
+        generated-slab fetch per boundary that retires a request (plus,
+        under speculative decode, one (B,)-int position fetch per
+        boundary — acceptance lengths make completion data-dependent)."""
+        spec = self.spec_k > 0
         while self._pending or any(r is not None for r in self._slots):
             self._admit_waiting()
             live = [r for r in self._slots if r is not None]
             if not live:   # pragma: no cover - defensive
+                # submit() guarantees every queued request can fit an
+                # empty pool, so an empty slab with work pending is a
+                # bug — fail the futures loudly instead of dropping them
+                for req in self._pending:
+                    req.future.set_exception(RuntimeError(
+                        "decoder stalled with no admissible request"))
+                self._pending.clear()
                 break
+            self.live_hwm = max(self.live_hwm, len(live))
             self._m_slots.set(len(live))
+            self._m_slots_hwm.set(self.live_hwm)
             for _ in range(self.sync_interval):
                 self._run_step()
             self.steps += self.sync_interval
             self._m_steps.inc(self.sync_interval)
-            for r in live:
-                r.steps_run += self.sync_interval
-            done = [r for r in live if r.steps_run >= r.steps_needed]
+            if spec:
+                pos_host = np.asarray(self._pos)
+                self.host_syncs += 1
+                self._m_syncs.inc()
+                self._drain_accept_hist()
+                done = [r for r in live
+                        if int(pos_host[r.slot]) >= r.steps_needed]
+            else:
+                for r in live:
+                    r.steps_run += self.sync_interval
+                done = [r for r in live
+                        if r.start_pos + r.steps_run >= r.steps_needed]
             if not done:
                 continue
             gen_host = np.asarray(self._gen)   # the boundary host sync
-            self.host_syncs += 1
-            self._m_syncs.inc()
+            if not spec:
+                self.host_syncs += 1
+                self._m_syncs.inc()
             for r in done:
                 s = len(r.seed)
                 toks = gen_host[r.slot, s - 1:s - 1 + r.n_words]
                 r.future.set_result(r.seed + [int(t) for t in toks])
-                self._active = self._retire_fn(self._active,
-                                               np.int32(r.slot))
-                self._slots[r.slot] = None
-                self.retired += 1
-                self._m_retired.inc()
+                self._retire_req(r)
             self._m_slots.set(sum(1 for r in self._slots
                                   if r is not None))
         from bigdl_tpu.obs import events
+        extra = {}
+        if self.paged:
+            ps = self._pool.stats()
+            extra.update(paged=True, page_size=self.page_size,
+                         pages=ps["pages"], pages_hwm=ps["in_use_hwm"],
+                         live_hwm=self.live_hwm)
+            if self._prefix is not None:
+                extra.update(prefix_hits=self._prefix.hits,
+                             prefix_misses=self._prefix.misses,
+                             prefix_pages=self._prefix.pages_reused)
+        if self.spec_k:
+            extra.update(spec_k=self.spec_k,
+                         spec_windows=self.spec_windows,
+                         accept_mean=(self.spec_accepted
+                                      / max(1, self.spec_windows)))
         events.emit("serve", kind="decode", steps=self.steps,
                     host_syncs=self.host_syncs, admitted=self.admitted,
-                    retired=self.retired, slots=self.B)
+                    retired=self.retired, slots=self.B, **extra)
         return self
 
     def close(self):
-        """Drop this decoder's series from the process metrics registry.
-        Decoders are labelled uniquely (``decoder=<name>``), so a
-        process that constructs many short-lived decoders (every
+        """Drop this decoder's series from the process metrics registry
+        and release the prefix cache's page holds.  Decoders are
+        labelled uniquely (``decoder=<name>``), so a process that
+        constructs many short-lived decoders (every
         :func:`continuous_decode` call makes one) would otherwise grow
         the registry — and every snapshot/exposition — without bound.
-        Also runs at GC for decoders nobody closes; idempotent."""
+        The series drop also runs at GC for decoders nobody closes;
+        idempotent."""
+        if self._prefix is not None:
+            self._prefix.drop_all()
         self._drop_series()
 
     def stats(self) -> dict:
-        return {"steps": self.steps, "host_syncs": self.host_syncs,
-                "admitted": self.admitted, "retired": self.retired,
-                "slots": self.B,
-                "slots_active": sum(1 for r in self._slots
-                                    if r is not None),
-                "n_pos": self.n_pos,
-                "sync_interval": self.sync_interval, "tp": self.tp,
-                "name": self.name}
+        out = {"steps": self.steps, "host_syncs": self.host_syncs,
+               "admitted": self.admitted, "retired": self.retired,
+               "slots": self.B,
+               "slots_active": sum(1 for r in self._slots
+                                   if r is not None),
+               "live_hwm": self.live_hwm,
+               "n_pos": self.n_pos, "paged": self.paged,
+               "sync_interval": self.sync_interval, "tp": self.tp,
+               "name": self.name}
+        if self.paged:
+            out["pool"] = self._pool.stats()
+            if self._prefix is not None:
+                out["prefix"] = self._prefix.stats()
+        if self.spec_k:
+            out.update(spec_k=self.spec_k,
+                       spec_windows=self.spec_windows,
+                       spec_accepted=self.spec_accepted,
+                       accept_mean=(self.spec_accepted
+                                    / max(1, self.spec_windows)))
+        return out
 
 
 def continuous_decode(model, seed_rows, n_words, max_slots: int = 4,
                       n_pos: int | None = None,
-                      sync_interval: int | None = None, mesh=None):
-    """Convenience one-shot: decode every seed row with a shared slab.
+                      sync_interval: int | None = None, mesh=None,
+                      **decoder_kwargs):
+    """Convenience one-shot: decode every seed row with a shared decoder.
 
     ``n_pos`` defaults to the largest request's need, so a mixed set of
     seed lengths shares one compiled step.  ``mesh`` (with a ``model``
-    axis) serves tensor-parallel.  Returns the extended rows in
+    axis) serves tensor-parallel; extra keyword arguments (``paged``,
+    ``page_size``, ``n_pages``, ``prefix_cache``, ``spec_k``, ...) pass
+    through to :class:`ContinuousDecoder`.  Returns the extended rows in
     submission order (``lm_decode`` greedy semantics per row)."""
     reqs = [np.asarray(s, np.int32) for s in seed_rows]
     if n_pos is None:
         n_pos = max(int(s.size) + int(n_words) - 1 for s in reqs)
     dec = ContinuousDecoder(model, max_slots=max_slots, n_pos=n_pos,
-                            sync_interval=sync_interval, mesh=mesh)
+                            sync_interval=sync_interval, mesh=mesh,
+                            **decoder_kwargs)
     try:
         futs = [dec.submit(s, n_words) for s in reqs]
         dec.run()

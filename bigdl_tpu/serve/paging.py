@@ -1,0 +1,100 @@
+"""Block-paged KV-cache allocation for the continuous decoder
+(docs/serving.md "Paged KV + speculative decode").
+
+The PR-5 decoder reserved one fixed ``(B, n_pos)`` KV slab row per slot:
+a 6-token request held the same HBM as a 64-token one, and concurrency
+was hard-capped at the slab width B.  This module is the vLLM
+PagedAttention idea applied to that slab: KV storage becomes a
+``(n_pages, page_size, ...)`` pool, every request holds only the
+fixed-size pages its own length needs, and a per-slot slot→page table
+(traced state — admission never recompiles) maps logical positions to
+pool pages.  Concurrency then scales with **total pooled tokens**, not
+slab width.
+
+:class:`PagePool` is the host-side allocator — pure bookkeeping, no
+device arrays.  The device pool lives in the decoder; page ids handed
+out here index its page dimension.  Pages are refcounted because the
+prefix cache (``serve/prefix.py``) shares read-only pages across
+requests: a shared page is released only when the last holder lets go.
+
+:class:`RequestTooLongError` is the submit-time verdict for a request
+whose ``n_seed + n_words - 1`` exceeds the decoder's position capacity.
+It fails ONLY that request's future — the old behaviour silently held
+the row at the slab edge (``pos`` clipped to ``n_pos - 1``), burning
+steps while generating garbage tokens.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+
+class RequestTooLongError(ValueError):
+    """A decode request needs more positions than the decoder can ever
+    hold (``len(seed) + n_words - 1 > n_pos``, or more pages than the
+    whole pool).  Set on the request's OWN future at submit time; other
+    requests are untouched."""
+
+
+class PagePool:
+    """Refcounted free-list allocator over ``n_pages`` fixed-size pages.
+
+    Page ids are ``0 .. n_pages - 1`` — indices into the decoder's
+    device pool arrays.  ``alloc_one`` hands out a page at refcount 1;
+    :meth:`retain` / :meth:`release` move shared (prefix-cache) pages
+    between holders; a page returns to the free list when its last
+    reference drops.  Host-side only: nothing here touches jax.
+    """
+
+    def __init__(self, n_pages: int, page_size: int):
+        if n_pages < 1 or page_size < 1:
+            raise ValueError(
+                f"PagePool needs n_pages >= 1 and page_size >= 1, got "
+                f"{n_pages}/{page_size}")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._free: "deque[int]" = deque(range(self.n_pages))
+        self._ref: dict = {}          # page id -> refcount
+        self.in_use_hwm = 0           # high-water mark of allocated pages
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def alloc_one(self) -> int:
+        """One free page at refcount 1; raises when the pool is empty
+        (callers check ``free_count`` / evict first)."""
+        if not self._free:
+            raise RuntimeError("page pool exhausted")
+        pid = self._free.popleft()
+        self._ref[pid] = 1
+        if self.in_use > self.in_use_hwm:
+            self.in_use_hwm = self.in_use
+        return pid
+
+    def retain(self, pid: int):
+        """One more holder of an allocated page (a prefix-cache hit
+        mapping a shared page into a new slot's table)."""
+        self._ref[pid] += 1
+
+    def release(self, pid: int):
+        """Drop one reference; the page frees when nobody holds it."""
+        n = self._ref[pid] - 1
+        if n < 0:  # pragma: no cover - double-release guard
+            raise RuntimeError(f"page {pid} released below zero")
+        if n == 0:
+            del self._ref[pid]
+            self._free.append(pid)
+        else:
+            self._ref[pid] = n
+
+    def refcount(self, pid: int) -> int:
+        return self._ref.get(pid, 0)
+
+    def stats(self) -> dict:
+        return {"pages": self.n_pages, "page_size": self.page_size,
+                "in_use": self.in_use, "free": self.free_count,
+                "in_use_hwm": self.in_use_hwm}

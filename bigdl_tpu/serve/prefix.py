@@ -1,0 +1,156 @@
+"""Token-hash prefix caching over the paged KV pool
+(docs/serving.md "Paged KV + speculative decode").
+
+Requests that share a leading prompt — the fleet's system-prompt
+pattern — recompute identical K/V for identical prefixes: causal
+attention makes the K/V at position ``p`` a pure function of tokens
+``0..p``.  With block-paged KV (``serve/paging.py``) that redundancy is
+a page-granular cache: a retiring request DONATES the full pages whose
+positions lie entirely inside its seed to this cache (ownership
+transfer, no copy — the pages already hold the right values), and a new
+request whose seed matches a cached chain maps those pages into its own
+page table read-only and starts decoding at the divergence point,
+skipping that much prefill outright.
+
+Keys are the vLLM-style per-page hash chain: page ``j``'s key digests
+tokens ``0 .. (j+1)*page_size`` — the whole prefix through that page,
+not the page's tokens alone — so two prompts share page ``j`` only when
+they agree on EVERYTHING before it.  Divergence is therefore
+page-aligned, which is what makes sharing copy-free: a partial page is
+never shared, so the first page a request writes is always its own
+("copy-on-write" degenerates to "allocate-fresh-at-the-aligned
+boundary").
+
+A matched request still re-feeds at least its last seed position — the
+first generated token comes from the logits there — so a match is
+capped at ``len(seed) - 1`` positions.
+
+Eviction is LRU over chain entries whose page nobody else holds
+(refcount 1 = cache-only); the decoder evicts on demand when an
+admission cannot find free pages.  Evicting a mid-chain entry strands
+its descendants unreachable — they stop being refreshed and drain out
+of the same LRU sweep, so reclamation is eventual, not leaked.
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+import numpy as np
+
+
+def _chain_keys(seed, n_pages: int, page_size: int):
+    """Yield page ``j``'s chain key for ``j = 0 .. n_pages - 1``:
+    ``digest(parent_key || tokens of page j)``, an incremental digest
+    over the whole prefix through page ``j`` (O(tokens) for the whole
+    chain, not O(tokens²) as rehashing each prefix from scratch would
+    be) — two prompts share a key only when they agree on everything
+    before it."""
+    toks = np.asarray(seed[:n_pages * page_size], np.int32)
+    key = b""
+    for j in range(n_pages):
+        h = hashlib.sha1(key)
+        h.update(toks[j * page_size:(j + 1) * page_size].tobytes())
+        key = h.digest()
+        yield key
+
+
+class PrefixCache:
+    """Chain-hash → page-id map over one :class:`~bigdl_tpu.serve.paging.PagePool`.
+
+    The cache owns one reference on every page it holds; :meth:`match`
+    retains matched pages for the requesting slot (the caller releases
+    them at retire through :meth:`insert`'s duplicate path or
+    ``pool.release``)."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()
+        self.hits = 0          # requests that matched >= 1 page
+        self.misses = 0        # requests that matched none
+        self.pages_reused = 0  # total pages served from the cache
+        self.inserted = 0      # pages donated into the cache
+        self.evicted = 0       # pages evicted back to the pool
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def match(self, seed) -> list:
+        """Longest cached chain of full pages agreeing with ``seed``,
+        capped at ``len(seed) - 1`` positions (the last seed position
+        must be re-fed to produce the first generated token).  Returns
+        the page ids in logical order, each RETAINED for the caller.
+        Does NOT touch the hit/miss counters — an admission attempt can
+        fail allocation after matching and retry later; the decoder
+        calls :meth:`note_request` once per request actually admitted."""
+        ps = self.pool.page_size
+        max_pages = max(0, (len(seed) - 1) // ps)
+        pids = []
+        for key in _chain_keys(seed, max_pages, ps):
+            pid = self._entries.get(key)
+            if pid is None:
+                break
+            self._entries.move_to_end(key)
+            pids.append(pid)
+        for pid in pids:
+            self.pool.retain(pid)
+        return pids
+
+    def note_request(self, matched_pages: int):
+        """Count one admitted request against the hit/miss ledger."""
+        if matched_pages > 0:
+            self.hits += 1
+            self.pages_reused += matched_pages
+        else:
+            self.misses += 1
+
+    def insert(self, seed, pids):
+        """Donate a retiring request's leading pages: ``pids[j]`` must
+        hold the K/V of positions ``j*ps .. (j+1)*ps - 1`` computed
+        under ``seed``.  Ownership of each page transfers to the cache
+        (the caller's reference is consumed); when the chain key is
+        already cached — including the pages this very request matched
+        at admit — the caller's reference is simply released."""
+        ps = self.pool.page_size
+        for key, pid in zip(_chain_keys(seed, len(pids), ps), pids):
+            have = self._entries.get(key)
+            if have is not None:
+                self._entries.move_to_end(key)
+                self.pool.release(pid)
+            else:
+                self._entries[key] = pid
+                self.inserted += 1
+
+    def evict(self, n: int) -> int:
+        """Free up to ``n`` least-recently-used cache-only pages
+        (refcount 1 — shared pages some live slot still maps are
+        skipped) in ONE scan; returns the number freed.  One scan per
+        allocation attempt keeps admission under cache pressure linear
+        in the cache size, not entries x pages."""
+        freed = 0
+        for key in list(self._entries):
+            if freed >= n:
+                break
+            pid = self._entries[key]
+            if self.pool.refcount(pid) == 1:
+                del self._entries[key]
+                self.pool.release(pid)
+                self.evicted += 1
+                freed += 1
+        return freed
+
+    def evict_one(self) -> bool:
+        """Free the single LRU cache-only page; False when nothing is
+        evictable."""
+        return self.evict(1) == 1
+
+    def drop_all(self):
+        """Release every cache-held page (decoder teardown)."""
+        while self._entries:
+            _, pid = self._entries.popitem(last=False)
+            self.pool.release(pid)
+
+    def stats(self) -> dict:
+        return {"entries": len(self._entries), "hits": self.hits,
+                "misses": self.misses, "pages_reused": self.pages_reused,
+                "inserted": self.inserted, "evicted": self.evicted}

@@ -15,7 +15,13 @@
 #     trace event with a complete monotone admit->complete hop chain
 #     in the PARENT event log (the subprocess's own obs events are
 #     forwarded there too), and the merged-registry Prometheus
-#     exposition parses.
+#     exposition parses;
+#   - paged + speculative decode drill: a mixed-length request stream
+#     (half sharing a system prompt) through the block-paged KV pool
+#     with draft-k self-speculation — ZERO cold compiles after
+#     construction (xcache compile counter + jit trap), prefix
+#     hit-rate > 0 on the shared-prompt wave, every token equal to
+#     serial lm_decode.
 #
 #   scripts/serve_smoke.sh              # full set + drills
 #   scripts/serve_smoke.sh -k deadline  # narrow (skips the drills)
@@ -71,6 +77,68 @@ assert p95 is not None and p95 < 5.0, f"p95 {p95}s out of bounds"
 print(f"OK: 200 requests, zero cold compiles after warmup "
       f"({warm_compiles} buckets), p95 {p95*1e3:.1f} ms, "
       f"bucket hits {stats['bucket_hits']}")
+PY
+
+echo "== serve smoke: paged + speculative decode drill =="
+python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.serve import xcache
+from bigdl_tpu.serve.decode import ContinuousDecoder
+from bigdl_tpu.utils.random import set_seed
+
+set_seed(1)
+model = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                      hidden=64)
+rng = np.random.RandomState(0)
+SYS = [7, 3, 9, 1, 5, 2, 8, 4]                 # 2 full pages at ps=4
+reqs = []
+for i in range(24):                             # mixed-length stream
+    if i % 2:
+        reqs.append(SYS + rng.randint(1, 64, 1 + i % 3).tolist())
+    else:
+        reqs.append(rng.randint(1, 64, 2 + i % 5).tolist())
+n_words = 6
+oracle = [lm_decode(model, s, n_words) for s in reqs]
+
+dec = ContinuousDecoder(model, max_slots=6, n_pos=24, sync_interval=2,
+                        page_size=4, prefix_cache=True, spec_k=3)
+warm_compiles = xcache.get().stats()["compiles"]
+calls, real_jit = [], jax.jit
+jax.jit = lambda fn, *a, **kw: (calls.append(fn),
+                                real_jit(fn, *a, **kw))[1]
+try:
+    # two waves: the first populates the prefix cache, the second hits
+    futs = [dec.submit(s, n_words) for s in reqs[:12]]
+    dec.run()
+    futs += [dec.submit(s, n_words) for s in reqs[12:]]
+    dec.run()
+finally:
+    jax.jit = real_jit
+
+rows = [f.result(timeout=60) for f in futs]
+assert rows == oracle, "paged/speculative decode lost token parity"
+assert not calls, "decode built a new jit program mid-stream"
+assert xcache.get().stats()["compiles"] == warm_compiles, \
+    "cold compile after warmup on the speculative stream"
+st = dec.stats()
+pfx = st["prefix"]
+assert pfx["hits"] > 0, f"no prefix hits on shared-prompt wave: {pfx}"
+snap = obs_metrics.get().snapshot()
+assert obs_metrics.family_total(snap, "decode_pages_total") > 0
+fam = snap["decode_spec_accept_len"]["series"][0]
+assert fam["count"] == st["spec_windows"] > 0
+dec.close()
+hit_rate = pfx["hits"] / (pfx["hits"] + pfx["misses"])
+print(f"OK: 24 mixed-length paged+spec requests, zero cold compiles "
+      f"after {warm_compiles}-program warmup, prefix hit-rate "
+      f"{hit_rate:.0%} ({pfx['pages_reused']} pages reused), spec "
+      f"accept mean {st['accept_mean']:.2f}/{st['spec_k']}, "
+      f"pool hwm {st['pool']['in_use_hwm']}/{st['pool']['pages']} pages")
 PY
 
 echo "== serve smoke: 2-replica router drill + hot weight swap =="

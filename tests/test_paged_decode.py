@@ -1,0 +1,499 @@
+"""Paged KV-cache, prefix reuse, and self-speculative decode
+(docs/serving.md "Paged KV + speculative decode", marker ``serve``).
+
+The tentpole contracts:
+
+- paged greedy decode is token-for-token equal to serial ``lm_decode``
+  across page sizes — including a page size that does NOT divide
+  ``n_pos`` — page-pool exhaustion/queuing, and tensor parallelism;
+- a prefix-cache hit (shared system prompt) produces exactly the
+  cold-prefill output while skipping page-aligned prefill work;
+- self-speculative decode commits exactly the non-speculative greedy
+  stream for EVERY draft length k, with zero cold compiles after
+  construction (the fixed k+1 verify window is one pre-warmed program);
+- concurrency scales with pooled tokens: a paged decoder holds more
+  live requests than the slab bound ``pool_tokens / n_pos``;
+- a too-long request fails ONLY its own future with
+  ``RequestTooLongError`` at submit time (the old driver silently
+  clipped its position at the slab edge);
+- page-pool occupancy, prefix hit/miss and the acceptance-length
+  histogram land on the pinned-bucket metrics registry (fleet-mergeable,
+  PR-7 semantics) and render in ``tools/serve_top.py``.
+"""
+import importlib.util
+import os
+
+import jax
+import pytest
+
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.serve import (PagePool, PrefixCache, RequestTooLongError,
+                             continuous_decode, xcache)
+from bigdl_tpu.serve.decode import ContinuousDecoder
+from bigdl_tpu.utils.random import set_seed
+
+pytestmark = pytest.mark.serve
+
+
+def _tool(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def lm():
+    set_seed(1)
+    return TransformerLM(vocab_size=11, d_model=16, n_heads=2,
+                         n_layers=2, hidden=32)
+
+
+SEEDS = [[1, 2, 3], [4, 5], [6], [7, 8, 9, 10], [2, 4]]
+
+
+@pytest.fixture()
+def serial(lm):
+    return [lm_decode(lm, s, 5, greedy=True) for s in SEEDS]
+
+
+class TestPagePool:
+    def test_alloc_release_refcount(self):
+        pool = PagePool(4, 8)
+        a, b = pool.alloc_one(), pool.alloc_one()
+        assert pool.in_use == 2 and pool.free_count == 2
+        pool.retain(a)
+        pool.release(a)
+        assert pool.in_use == 2          # still held once
+        pool.release(a)
+        pool.release(b)
+        assert pool.in_use == 0 and pool.free_count == 4
+        assert pool.in_use_hwm == 2
+
+    def test_exhaustion_raises(self):
+        pool = PagePool(1, 4)
+        pool.alloc_one()
+        with pytest.raises(RuntimeError):
+            pool.alloc_one()
+
+    def test_freed_pages_recycle(self):
+        pool = PagePool(2, 4)
+        a = pool.alloc_one()
+        pool.release(a)
+        b, c = pool.alloc_one(), pool.alloc_one()
+        assert {b, c} == {0, 1}
+        assert pool.stats()["in_use"] == 2
+
+
+class TestPrefixCache:
+    def test_match_capped_below_full_seed(self):
+        """A match never covers the whole seed — the last seed position
+        must be re-fed to produce the first generated token."""
+        pool = PagePool(8, 2)
+        cache = PrefixCache(pool)
+        pages = [pool.alloc_one() for _ in range(3)]
+        seed = [5, 6, 7, 8, 9, 10]           # 3 full pages of 2
+        cache.insert(seed, pages)
+        assert cache.match(list(seed)) == pages[:2]   # (6-1)//2 = 2
+        assert cache.match(seed + [3]) == pages       # now 3 fit
+        # divergence mid-chain: only the agreeing prefix matches
+        assert cache.match([5, 6, 0, 1, 2, 3]) == pages[:1]
+        assert cache.match([9, 9, 9, 9]) == []
+
+    def test_insert_duplicate_releases_donor_page(self):
+        pool = PagePool(8, 2)
+        cache = PrefixCache(pool)
+        first = [pool.alloc_one()]
+        cache.insert([1, 2, 3], first)
+        dup = [pool.alloc_one()]
+        cache.insert([1, 2, 9], dup)          # same first-page chain
+        assert pool.refcount(dup[0]) == 0     # freed, cache kept `first`
+        assert pool.refcount(first[0]) == 1
+
+    def test_evict_skips_shared_pages(self):
+        pool = PagePool(8, 2)
+        cache = PrefixCache(pool)
+        cache.insert([1, 2, 3], [pool.alloc_one()])
+        held = cache.match([1, 2, 9])          # a "slot" now shares it
+        assert len(held) == 1
+        assert not cache.evict_one()           # refcount 2: not evictable
+        pool.release(held[0])
+        assert cache.evict_one()               # cache-only now
+        assert pool.in_use == 0
+
+
+class TestPagedParity:
+    @pytest.mark.parametrize("page_size", [2, 4, 16])
+    def test_token_parity_across_page_sizes(self, lm, serial, page_size):
+        """Staggered admissions through the paged pool decode
+        token-for-token what the serial lock-step scan produces —
+        page_size 4 does not divide n_pos=9 (padded view, masked)."""
+        rows = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=3, page_size=page_size)
+        assert rows == serial
+
+    def test_slab_mode_regression(self, lm, serial):
+        rows = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=3, paged=False)
+        assert rows == serial
+
+    def test_parity_under_pool_pressure(self, lm, serial):
+        """A pool too small for every request at once queues admissions
+        (head-of-line waits for retirements) without changing a single
+        token."""
+        dec = ContinuousDecoder(lm, max_slots=4, n_pos=9, sync_interval=2,
+                                page_size=4, n_pages=4,
+                                prefix_cache=False)
+        futs = [dec.submit(s, 5) for s in SEEDS]
+        dec.run()
+        assert [f.result() for f in futs] == serial
+        assert dec.stats()["pool"]["in_use"] == 0    # all pages returned
+        dec.close()
+
+    def test_concurrency_scales_past_slab_bound(self, lm):
+        """The density story: with the SAME pooled tokens a slab of
+        n_pos-wide rows holds, the paged decoder runs MORE live
+        requests when traffic skews short."""
+        n_pos, ps = 24, 4
+        slab_slots = 2                        # slab: 2 rows x 24 tokens
+        pool_pages = slab_slots * (n_pos // ps)
+        dec = ContinuousDecoder(lm, max_slots=8, n_pos=n_pos,
+                                sync_interval=2, page_size=ps,
+                                n_pages=pool_pages, prefix_cache=False)
+        futs = [dec.submit([1 + i % 9], 4) for i in range(8)]
+        dec.run()
+        st = dec.stats()
+        assert st["live_hwm"] > slab_slots, st
+        assert st["pool"]["in_use_hwm"] <= pool_pages
+        for f, s in zip(futs, range(8)):
+            assert f.result() == lm_decode(lm, [1 + s % 9], 4,
+                                           greedy=True)
+        dec.close()
+
+
+class TestPrefixReuse:
+    SYS = [7, 3, 9, 1]                        # page-aligned at ps=2
+
+    def test_prefix_hit_matches_cold_prefill(self, lm):
+        """Second-wave requests sharing the system prompt map cached
+        pages (skipping that prefill) and still decode exactly the
+        cold-path tokens."""
+        waves = [self.SYS + [2], self.SYS + [5], self.SYS + [8, 6],
+                 [4, 5, 6]]
+        oracle = {tuple(s): lm_decode(lm, s, 4, greedy=True)
+                  for s in waves}
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=10,
+                                sync_interval=2, page_size=2,
+                                prefix_cache=True)
+        f = dec.submit(waves[0], 4)
+        dec.run()
+        assert f.result() == oracle[tuple(waves[0])]
+        assert dec.stats()["prefix"]["hits"] == 0     # cold wave
+        futs = [dec.submit(s, 4) for s in waves[1:]]
+        dec.run()
+        for s, f in zip(waves[1:], futs):
+            assert f.result() == oracle[tuple(s)]
+        st = dec.stats()["prefix"]
+        assert st["hits"] >= 2 and st["pages_reused"] >= 4, st
+        assert dec.stats()["pool"]["in_use"] == len(dec._prefix._entries)
+        dec.close()
+
+    def test_prefix_hits_skip_prefill_steps(self, lm):
+        """A full-page hit starts the slot AT the divergence point: the
+        second identical-prefix request runs measurably fewer steps."""
+        seed = self.SYS + self.SYS + [2]      # 8 shared + 1 own token
+        dec = ContinuousDecoder(lm, max_slots=1, n_pos=16,
+                                sync_interval=1, page_size=4,
+                                prefix_cache=True)
+        dec.submit(seed, 4)
+        dec.run()
+        cold_steps = dec.steps
+        dec.submit(seed, 4)
+        dec.run()
+        assert dec.steps - cold_steps <= cold_steps - 8 + 1, (
+            "prefix hit did not skip the shared-prefix steps")
+        assert dec.stats()["prefix"]["pages_reused"] == 2
+        dec.close()
+
+    def test_eviction_reclaims_cache_pages_under_pressure(self, lm):
+        """When an admission wants pages the free list cannot supply,
+        cache-only prefix pages evict LRU on demand — the pool never
+        wedges on its own cache."""
+        dec = ContinuousDecoder(lm, max_slots=1, n_pos=8,
+                                sync_interval=2, page_size=2, n_pages=4,
+                                prefix_cache=True)
+        a, b = [1, 2, 3, 4], [5, 6, 7, 8]
+        fa = dec.submit(a, 4)
+        dec.run()                 # donates a's 2 seed pages to the cache
+        fb = dec.submit(b, 4)     # needs all 4 pages -> evicts them
+        dec.run()
+        assert fa.result() == lm_decode(lm, a, 4, greedy=True)
+        assert fb.result() == lm_decode(lm, b, 4, greedy=True)
+        assert dec.stats()["prefix"]["evicted"] >= 1
+        dec.close()
+
+    def test_prefix_disabled_never_hits(self, lm):
+        dec = ContinuousDecoder(lm, max_slots=1, n_pos=10,
+                                sync_interval=2, page_size=2,
+                                prefix_cache=False)
+        for _ in range(2):
+            dec.submit(self.SYS + [2], 4)
+            dec.run()
+        assert "prefix" not in dec.stats()
+        assert dec.stats()["pool"]["in_use"] == 0
+        dec.close()
+
+
+class TestSpeculativeDecode:
+    @pytest.mark.parametrize("k", [1, 2, 3, 5])
+    def test_output_identical_to_greedy_for_every_k(self, lm, serial, k):
+        """The acceptance rule only ever commits verify-argmax-
+        consistent tokens, so ANY draft quality yields the exact
+        non-speculative greedy stream."""
+        rows = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=2, page_size=4, spec_k=k)
+        assert rows == serial
+
+    def test_spec_with_prefix_reuse(self, lm):
+        sys_p = [7, 3, 9, 1]
+        seeds = [sys_p + [2], sys_p + [5]]
+        oracle = [lm_decode(lm, s, 4, greedy=True) for s in seeds]
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=10,
+                                sync_interval=2, page_size=2,
+                                prefix_cache=True, spec_k=2)
+        f0 = dec.submit(seeds[0], 4)
+        dec.run()
+        futs = [dec.submit(s, 4) for s in seeds]
+        dec.run()
+        assert f0.result() == oracle[0]
+        assert [f.result() for f in futs] == oracle
+        assert dec.stats()["prefix"]["hits"] >= 2
+        assert dec.stats()["spec_windows"] > 0
+        dec.close()
+
+    def test_acceptance_histogram_on_pinned_buckets(self, lm):
+        from bigdl_tpu.obs import metrics as obs_metrics
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4, spec_k=3)
+        futs = [dec.submit(s, 5) for s in SEEDS]
+        dec.run()
+        assert all(f.done() for f in futs)
+        snap = obs_metrics.get().snapshot()
+        fam = snap["decode_spec_accept_len"]
+        assert fam["bounds"] == list(obs_metrics.SPEC_ACCEPT_BUCKETS)
+        row = fam["series"][0]
+        assert row["count"] == dec.spec_windows > 0
+        # mean acceptance within [0, k]; row["sum"] is total accepted
+        assert 0.0 <= row["sum"] / row["count"] <= 3.0
+        assert row["sum"] == dec.spec_accepted
+        dec.close()
+
+    def test_warm_windows_excluded_from_acceptance(self, lm):
+        """The construction warm pass runs live speculative windows on
+        garbage state; they must not count as observations (they would
+        skew accept_mean low on every decoder construction)."""
+        import numpy as np
+        from bigdl_tpu.obs import metrics as obs_metrics
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4, spec_k=2)
+        assert int(np.asarray(dec._acc_hist).sum()) > 0   # warm ran
+        assert dec.spec_windows == 0
+        snap = obs_metrics.get().snapshot()
+        assert snap["decode_spec_accept_len"]["series"][0]["count"] == 0
+        f = dec.submit([1, 2], 4)
+        dec.run()
+        assert f.done() and dec.spec_windows > 0
+        snap = obs_metrics.get().snapshot()
+        assert snap["decode_spec_accept_len"]["series"][0]["count"] \
+            == dec.spec_windows
+        dec.close()
+
+    def test_spec_stream_is_compile_free_after_construction(self, lm):
+        """The mixed-length speculative stream — variable acceptance
+        lengths, staggered admits — builds no new jit program and no
+        new executable-cache entry: the k+1 verify window is ONE
+        pre-warmed shape."""
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4, spec_k=2)
+        compiles = xcache.get().stats()["compiles"]
+        calls = []
+        real_jit = jax.jit
+        jax.jit = lambda fn, *a, **kw: (calls.append(fn),
+                                        real_jit(fn, *a, **kw))[1]
+        try:
+            futs = [dec.submit(s, 5) for s in SEEDS]
+            dec.run()
+        finally:
+            jax.jit = real_jit
+        assert all(f.done() for f in futs)
+        assert not calls, "speculative decode built a jit mid-stream"
+        assert xcache.get().stats()["compiles"] == compiles
+        dec.close()
+
+    def test_spec_requires_paged(self, lm):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousDecoder(lm, max_slots=1, n_pos=8, paged=False,
+                              spec_k=2)
+
+
+class TestRequestTooLong:
+    def test_fails_only_its_own_future(self, lm):
+        """Regression for the silent-clip bug: the oversized request
+        fails at submit with a typed error; every other request decodes
+        to parity as if it was never submitted."""
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=7,
+                                sync_interval=2, page_size=4)
+        ok1 = dec.submit([1, 2, 3], 5)        # exactly n_pos
+        bad = dec.submit([1, 2, 3, 4], 5)     # needs 8 > 7
+        ok2 = dec.submit([4, 5], 4)
+        assert isinstance(bad.exception(), RequestTooLongError)
+        assert "8 positions" in str(bad.exception())
+        dec.run()
+        assert ok1.result() == lm_decode(lm, [1, 2, 3], 5, greedy=True)
+        assert ok2.result() == lm_decode(lm, [4, 5], 4, greedy=True)
+        assert dec.admitted == dec.retired == 2
+        dec.close()
+
+    def test_pool_bound_checked_at_submit(self, lm):
+        """Paged decoders also reject a request needing more pages than
+        the WHOLE pool — it could never be admitted."""
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=12,
+                                sync_interval=2, page_size=4, n_pages=2,
+                                prefix_cache=False)
+        f = dec.submit([1, 2, 3, 4, 5, 6, 7, 8], 5)   # 12 pos = 3 pages
+        assert isinstance(f.exception(), RequestTooLongError)
+        ok = dec.submit([1, 2, 3], 5)                 # 7 pos = 2 pages
+        dec.run()
+        assert ok.result() == lm_decode(lm, [1, 2, 3], 5, greedy=True)
+        dec.close()
+
+    def test_slab_mode_same_contract(self, lm):
+        dec = ContinuousDecoder(lm, max_slots=1, n_pos=4, paged=False)
+        f = dec.submit([1, 2, 3], 3)
+        assert isinstance(f.exception(), RequestTooLongError)
+        dec.close()
+
+
+class TestDecodeTelemetry:
+    def test_occupancy_and_prefix_series(self, lm):
+        from bigdl_tpu.obs import metrics as obs_metrics
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=True)
+        futs = [dec.submit(s, 5) for s in SEEDS]
+        dec.run()
+        assert all(f.done() for f in futs)
+        snap = obs_metrics.get().snapshot()
+        lab = {"decoder": dec.name}
+        total = obs_metrics.family_total
+        assert total(snap, "decode_pages_total", **lab) == \
+            dec._pool.n_pages
+        # pages still allocated == what the prefix cache retains
+        assert total(snap, "decode_pages_in_use", **lab) == \
+            dec.stats()["pool"]["in_use"]
+        hits = total(snap, "decode_prefix_hits_total", **lab)
+        misses = total(snap, "decode_prefix_misses_total", **lab)
+        assert hits + misses == 5
+        assert total(snap, "decode_slots_hwm", **lab) == dec.live_hwm > 0
+        dec.close()
+        snap = obs_metrics.get().snapshot()
+        assert not [n for n in snap if n.startswith("decode_")]
+
+    def test_decode_event_carries_paging_fields(self, lm):
+        from bigdl_tpu.obs import events
+        log = events.configure(None)
+        try:
+            dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                    sync_interval=2, page_size=4,
+                                    spec_k=2)
+            futs = [dec.submit(s, 5) for s in SEEDS]
+            dec.run()
+            assert all(f.done() for f in futs)
+            dec.close()
+            ev = [e for e in log.ring_events()
+                  if e["type"] == "serve" and e.get("kind") == "decode"]
+            assert ev and ev[-1]["paged"] and ev[-1]["page_size"] == 4
+            assert ev[-1]["spec_k"] == 2
+            assert 0.0 <= ev[-1]["accept_mean"] <= 2.0
+            events.validate_event(ev[-1])
+        finally:
+            events.reset()
+
+    def test_serve_top_renders_decode_section(self, lm):
+        """The dashboard shows pool occupancy, prefix hit-rate and the
+        acceptance quantiles from a registry snapshot."""
+        from bigdl_tpu.obs import metrics as obs_metrics
+        serve_top = _tool("serve_top")
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4, spec_k=2,
+                                prefix_cache=True)
+        futs = [dec.submit(s, 5) for s in SEEDS]
+        dec.run()
+        assert all(f.done() for f in futs)
+        snap = obs_metrics.get().snapshot()
+        line = serve_top.decode_line(snap, None, 1.0)
+        assert line is not None
+        assert "pages" in line and "prefix" in line and "accept" in line
+        dec.close()
+        assert serve_top.decode_line({}, None, 1.0) is None
+
+
+class TestBenchDecodeSweepContract:
+    """Pins the ``--decode-sweep`` JSON row shape (the
+    TestBenchRouterContract pattern: the apparatus must not bit-rot
+    between measured rounds)."""
+
+    def test_decode_sweep_row_keys(self):
+        import json
+        bench = _tool("bench_serve")
+        stats = {"slots": 8, "live_hwm": 6, "paged": True,
+                 "pool": {"pages": 24, "page_size": 4, "in_use": 0,
+                          "free": 24, "in_use_hwm": 18},
+                 "prefix": {"hits": 3, "misses": 5, "pages_reused": 6,
+                            "entries": 4, "inserted": 6, "evicted": 0},
+                 "spec_k": 3, "spec_windows": 40, "spec_accepted": 70,
+                 "accept_mean": 1.75}
+        row = bench.decode_sweep_row("paged", 8, 120, 0.5, stats, 9)
+        d = json.loads(json.dumps(row))        # must serialize
+        for key in ("model", "mode", "impl", "offered", "tokens",
+                    "wall_s", "tok_per_s", "tok_per_s_per_slot",
+                    "live_max", "slots", "pool_tokens", "spec_k",
+                    "accept_mean", "prefix_hits", "compiles"):
+            assert key in d, key
+        assert d["mode"] == "decode_sweep" and d["impl"] == "paged"
+        assert d["tok_per_s"] == pytest.approx(240.0)
+        assert d["live_max"] == 6
+        assert d["tok_per_s_per_slot"] == pytest.approx(40.0)
+        assert d["pool_tokens"] == 96
+
+    def test_decode_sweep_row_slab(self):
+        bench = _tool("bench_serve")
+        stats = {"slots": 4, "live_hwm": 4, "paged": False}
+        row = bench.decode_sweep_row("slab", 8, 120, 0.5, stats, 3)
+        assert row["impl"] == "slab" and row["pool_tokens"] is None
+        assert row["spec_k"] == 0 and row["prefix_hits"] == 0
+
+
+class TestTensorParallelPaged:
+    @pytest.fixture()
+    def mesh(self):
+        from bigdl_tpu.parallel.mesh import hybrid_mesh
+        return hybrid_mesh(dp=1, mp=2, devices=jax.devices()[:2])
+
+    def test_tp_spec_paged_token_parity(self, lm, serial, mesh):
+        """The full stack at once: paged pool sharded on its head dim,
+        speculative window inside shard_map — still token-identical to
+        single-device ``lm_decode``."""
+        rows = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=3, mesh=mesh,
+                                 page_size=4, spec_k=2)
+        assert rows == serial
+
+    def test_tp_slab_mode_regression(self, lm, serial, mesh):
+        """The legacy slab keeps its TP parity too (the default-on
+        paged pool took over the main TP tests)."""
+        rows = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=3, mesh=mesh, paged=False)
+        assert rows == serial

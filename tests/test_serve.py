@@ -451,13 +451,15 @@ class TestContinuousDecode:
         assert dec.host_syncs <= math.ceil(dec.steps / 4)
 
     def test_request_validation(self, lm):
+        from bigdl_tpu.serve import RequestTooLongError
         dec = ContinuousDecoder(lm, max_slots=1, n_pos=4)
         with pytest.raises(ValueError):
             dec.submit([], 3)
         with pytest.raises(ValueError):
             dec.submit([1, 2], 0)
-        with pytest.raises(ValueError):
-            dec.submit([1, 2, 3], 3)      # needs 5 positions > n_pos
+        # a too-long request fails ONLY its own future, at submit time
+        f = dec.submit([1, 2, 3], 3)      # needs 5 positions > n_pos
+        assert isinstance(f.exception(), RequestTooLongError)
 
 
 class TestPredictorRegression:

@@ -14,6 +14,20 @@ Decode (``--model transformer``): serial per-request ``lm_decode``
 versus the continuous-batching slot driver at equal token budgets,
 reported as tokens/second.
 
+Decode sweep (``--decode-sweep``): the paged-KV concurrency-scaling
+story (docs/serving.md "Paged KV + speculative decode").  At a FIXED
+pooled-token budget — exactly the HBM a ``--decode-slots``-wide slab of
+``--decode-npos`` rows holds — the sweep offers increasing concurrency
+and reports tokens/sec/slot for the legacy slab (live requests capped
+at the slab width) against the paged pool (live requests capped only by
+pooled tokens), asserts paged output token-for-token equal to serial
+``lm_decode``, and finishes with a mixed-length SPECULATIVE stream
+(``--spec-k``) audited for zero cold compiles after warmup through the
+shared executable-cache counter.  One JSON row per point (contract
+pinned by ``tests/test_paged_decode.py``); ``--check`` enforces the
+acceptance bar: more live requests than the slab bound, parity, zero
+cold compiles.
+
 Router (``--replicas N``, N > 1): the same offered-load sweep through a
 :class:`ReplicaPool` — N engine replicas behind the SLO router — with
 per-replica and aggregate rows/s plus the shed rate per point
@@ -330,6 +344,112 @@ def bench_decode(args):
     return serial_wall / cont_wall
 
 
+def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
+                     compiles) -> dict:
+    """The pinned JSON contract for one ``--decode-sweep`` point:
+    throughput per live slot plus the paging/prefix/speculation
+    counters that explain it.  ``tests/test_paged_decode.py`` keeps
+    this shape honest."""
+    live = dec_stats.get("live_hwm") or dec_stats["slots"]
+    pool = dec_stats.get("pool") or {}
+    prefix = dec_stats.get("prefix") or {}
+    rate = tokens / wall_s if wall_s else 0.0
+    return {"model": "transformer", "mode": "decode_sweep", "impl": impl,
+            "offered": offered, "tokens": tokens, "wall_s": wall_s,
+            "tok_per_s": rate,
+            "tok_per_s_per_slot": rate / max(1, live),
+            "live_max": live, "slots": dec_stats["slots"],
+            "pool_tokens": (pool["pages"] * pool["page_size"]
+                            if pool else None),
+            "spec_k": dec_stats.get("spec_k", 0),
+            "accept_mean": dec_stats.get("accept_mean"),
+            "prefix_hits": prefix.get("hits", 0),
+            "compiles": compiles}
+
+
+def bench_decode_sweep(args):
+    from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+    from bigdl_tpu.serve import xcache
+    from bigdl_tpu.serve.decode import ContinuousDecoder
+    from bigdl_tpu.utils.random import set_seed
+    set_seed(1)
+    model = TransformerLM(vocab_size=128, d_model=64, n_heads=4,
+                          n_layers=2, hidden=128)
+    rng = np.random.RandomState(0)
+    n_words, ps = args.decode_words, args.page_size
+    seeds = [rng.randint(1, 128, rng.randint(2, 6)).tolist()
+             for _ in range(args.requests)]
+    n_pos = max(args.decode_npos,
+                max(len(s) for s in seeds) + n_words - 1)
+    slab_slots = args.decode_slots
+    # the FIXED HBM budget both implementations get: what the slab holds
+    pool_pages = slab_slots * (-(-n_pos // ps))
+    toks = len(seeds) * n_words
+
+    # serial oracle (and scan warmup per distinct seed length)
+    for length in {len(s) for s in seeds}:
+        lm_decode(model, [1] * length, n_words)
+    oracle = [lm_decode(model, s, n_words) for s in seeds]
+
+    def run_point(impl, offered, **kw):
+        dec = ContinuousDecoder(model, n_pos=n_pos,
+                                sync_interval=args.decode_sync, **kw)
+        c0 = xcache.get().stats()["compiles"]
+        t0 = time.perf_counter()
+        futs = [dec.submit(s, n_words) for s in seeds]
+        dec.run()
+        wall = time.perf_counter() - t0
+        parity = [f.result() for f in futs] == oracle
+        row = decode_sweep_row(impl, offered, toks, wall, dec.stats(),
+                               xcache.get().stats()["compiles"] - c0)
+        row["parity"] = parity
+        dec.close()
+        print(f"bench_serve: {json.dumps(row)}")
+        return row
+
+    points = [run_point("slab", slab_slots, max_slots=slab_slots,
+                        paged=False)]
+    for offered in (slab_slots, 2 * slab_slots, 4 * slab_slots):
+        points.append(run_point(
+            "paged", offered, max_slots=offered, page_size=ps,
+            n_pages=pool_pages, prefix_cache=False))
+    spec = run_point("paged+spec", 2 * slab_slots,
+                     max_slots=2 * slab_slots, page_size=ps,
+                     n_pages=pool_pages, prefix_cache=True,
+                     spec_k=args.spec_k)
+    points.append(spec)
+
+    slab = points[0]
+    print(f"\ntransformer decode sweep (pool {pool_pages} pages x {ps} "
+          f"tokens = slab {slab_slots} x {n_pos}):")
+    for pt in points:
+        print(f"  {pt['impl']:<10} offered {pt['offered']:>3}: "
+              f"{pt['live_max']:>3} live max, "
+              f"{pt['tok_per_s']:8.1f} tok/s "
+              f"({pt['tok_per_s_per_slot']:.1f}/slot), "
+              f"parity {'OK' if pt['parity'] else 'FAIL'}, "
+              f"cold compiles {pt['compiles']}"
+              + (f", accept mean {pt['accept_mean']:.2f}"
+                 if pt["spec_k"] else ""))
+    scaled = [p for p in points if p["impl"] == "paged"
+              and p["offered"] > slab_slots]
+    best_live = max(p["live_max"] for p in scaled)
+    print(f"  live-concurrency: slab bound {slab['live_max']}, paged "
+          f"reaches {best_live} on the same pooled tokens")
+    if args.check:
+        if not all(p["parity"] for p in points):
+            raise SystemExit("decode sweep lost token parity")
+        if best_live <= slab["live_max"]:
+            raise SystemExit(
+                f"paged concurrency {best_live} did not scale past the "
+                f"slab bound {slab['live_max']}")
+        if spec["compiles"]:
+            raise SystemExit(
+                f"speculative stream hit {spec['compiles']} cold "
+                f"compiles after warmup")
+    return points
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--model", default="lenet",
@@ -343,6 +463,17 @@ def main():
     ap.add_argument("--decode-words", type=int, default=16)
     ap.add_argument("--decode-slots", type=int, default=4)
     ap.add_argument("--decode-sync", type=int, default=8)
+    ap.add_argument("--decode-sweep", action="store_true",
+                    help="paged-vs-slab concurrency-scaling sweep at a "
+                         "fixed pooled-token budget, plus a zero-cold-"
+                         "compile speculative stream")
+    ap.add_argument("--decode-npos", type=int, default=48,
+                    help="per-request position capacity for the sweep "
+                         "(slab rows reserve ALL of it)")
+    ap.add_argument("--page-size", type=int, default=8,
+                    help="KV page size (tokens) for the sweep")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft length for the speculative sweep point")
     ap.add_argument("--replicas", type=int, default=1,
                     help="> 1 sweeps a ReplicaPool behind the SLO "
                          "router instead of one engine")
@@ -354,7 +485,9 @@ def main():
     args = ap.parse_args()
     args.loads = [float(tok) for tok in str(args.loads).split(",") if tok]
 
-    if args.model == "transformer":
+    if args.decode_sweep:
+        bench_decode_sweep(args)
+    elif args.model == "transformer":
         bench_decode(args)
     elif args.replicas > 1:
         bench_router(args)

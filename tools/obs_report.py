@@ -130,6 +130,27 @@ def _serving_section(events, waterfall=5):
         syncs = sum(int(e.get("host_syncs", 0)) for e in decodes)
         out.append(f"- decode: {len(decodes)} run(s), {steps} steps, "
                    f"{retired} requests retired, {syncs} host syncs")
+        paged = [e for e in decodes if e.get("paged")]
+        if paged:
+            hits = sum(int(e.get("prefix_hits", 0)) for e in paged)
+            misses = sum(int(e.get("prefix_misses", 0)) for e in paged)
+            hwm = max(int(e.get("pages_hwm", 0)) for e in paged)
+            live = max(int(e.get("live_hwm", 0)) for e in paged)
+            line = (f"- paged KV: {len(paged)} run(s), page-pool hwm "
+                    f"{hwm} pages, live-request hwm {live}")
+            if hits + misses:
+                line += (f", prefix hit-rate {hits / (hits + misses):.0%}"
+                         f" ({hits}/{hits + misses})")
+            out.append(line)
+        specs = [e for e in decodes if e.get("spec_k")]
+        if specs:
+            wins = sum(int(e.get("spec_windows", 0)) for e in specs)
+            acc = sum(float(e.get("accept_mean", 0.0))
+                      * int(e.get("spec_windows", 0)) for e in specs)
+            ks = sorted({int(e["spec_k"]) for e in specs})
+            out.append(f"- speculative: k={ks}, {wins} verify windows, "
+                       f"mean accepted "
+                       f"{acc / wins if wins else 0.0:.2f} drafts")
         out.append("")
 
     if traces and waterfall > 0:

@@ -8,6 +8,11 @@ renders, per engine and fleet-wide:
 
     rows/s   queue   inflt   shed/s   p50/p95/p99 (ms)   SLO burn
 
+plus, when a paged continuous decoder is exporting, one trailing
+``decode:`` line with KV page-pool occupancy, the prefix-cache
+hit-rate and the speculative acceptance p50 (docs/serving.md "Paged
+KV + speculative decode").
+
 Rates are differences between consecutive snapshots (the counters are
 monotonic, so the math survives engine restarts landing mid-window as a
 one-frame glitch, not corruption).  Quantiles come from the merged
@@ -154,11 +159,39 @@ def frame_rows(cur: dict, prev: dict | None, dt: float,
     return rows
 
 
+def decode_line(cur: dict, prev: dict | None, dt: float) -> str | None:
+    """One trailing line of continuous-decode telemetry when a paged
+    decoder is exporting: KV page-pool occupancy (current gauges),
+    prefix-cache hit-rate and speculative acceptance p50 — the latter
+    two WINDOWED like the engine rates (lifetime fallback when the
+    window saw no admissions/windows).  None when no decoder series are
+    present."""
+    if "decode_pages_total" not in cur:
+        return None
+    total = metrics.family_total(cur, "decode_pages_total")
+    in_use = metrics.family_total(cur, "decode_pages_in_use")
+    occ = in_use / total if total else 0.0
+    h = _rate(cur, prev, dt, "decode_prefix_hits_total") * dt
+    m = _rate(cur, prev, dt, "decode_prefix_misses_total") * dt
+    if h + m == 0:          # idle window: last known hit-rate
+        h = metrics.family_total(cur, "decode_prefix_hits_total")
+        m = metrics.family_total(cur, "decode_prefix_misses_total")
+    hit_rate = h / (h + m) if (h + m) else None
+    accept = _window_quantiles(cur, prev,
+                               "decode_spec_accept_len").get("p50")
+    return (f"decode: pages {int(in_use)}/{int(total)} ({occ:.0%})   "
+            f"prefix hit "
+            + (f"{hit_rate:.0%}" if hit_rate is not None else "-")
+            + "   spec accept p50 "
+            + (f"{accept:.1f}" if accept is not None else "-"))
+
+
 def _ms(v):
     return "-" if v is None else f"{v:8.2f}"
 
 
-def render(rows: list, source: str, dt: float) -> str:
+def render(rows: list, source: str, dt: float,
+           decode: str | None = None) -> str:
     out = [f"serve_top — {source}  (window {dt:.1f}s)", "",
            f"{'engine':<12} {'rows/s':>8} {'queue':>6} {'inflt':>6} "
            f"{'shed/s':>7} {'p50 ms':>8} {'p95 ms':>8} {'p99 ms':>8} "
@@ -169,6 +202,8 @@ def render(rows: list, source: str, dt: float) -> str:
             f"{marker}{r['name']:<11} {r['rows_s']:8.1f} {r['queue']:6d} "
             f"{r['inflight']:6d} {r['shed_s']:7.1f} {_ms(r['p50_ms'])} "
             f"{_ms(r['p95_ms'])} {_ms(r['p99_ms'])} {r['burn']:6.2f}")
+    if decode:
+        out += ["", decode]
     return "\n".join(out)
 
 
@@ -192,7 +227,9 @@ def main(argv=None) -> int:
         dt = (ts - prev[0]) if prev else args.interval
         rows = frame_rows(cur, prev[1] if prev else None, dt,
                           budget=args.budget)
-        frame = render(rows, args.source, dt)
+        frame = render(rows, args.source, dt,
+                       decode=decode_line(cur, prev[1] if prev else None,
+                                          dt))
         if args.once:
             print(frame)
             return 0
