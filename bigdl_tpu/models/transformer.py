@@ -184,16 +184,32 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
     through the prefix cache (serve/prefix.py), so a stale write from a
     finished row would corrupt K/V another request later trusts.
 
+    ``caches`` of FOUR arrays — ``(kpool, vpool, kscale, vscale)`` —
+    selects int8 KV storage (``BIGDL_SERVE_KV_QUANT``, docs/serving.md
+    "Quantized serving"): the pools are int8 and the scale arrays
+    ``(layers, n_pages, page_size, H)`` carry one float scale per
+    written head-row, pool-indexed exactly like the values (so prefix
+    page donation ships scales with pages).  The scatter quantizes
+    (``quant/kv.py``: per-head amax/127), the page-gathered attention
+    view dequantizes; scales ride the SAME ``phys`` coordinates, so
+    invalid lanes drop both writes together.
+
     ``tp_axis`` has `_lm_forward_one`'s Megatron semantics: handles
-    carry LOCAL shards, the pools shard on their head dim, one psum
-    merges each branch's output projection."""
+    carry LOCAL shards, the pools (and scale arrays) shard on their
+    head dim, one psum merges each branch's output projection."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from bigdl_tpu.quant import kv as kvq
+
     h_ = handles
     ptab, page_size = pages
-    kpool, vpool = caches
+    quantized = len(caches) == 4
+    if quantized:
+        kpool, vpool, kscale, vscale = caches
+    else:
+        kpool, vpool = caches
     bsz, S = tok.shape
     n_pool_pages = int(kpool.shape[1])
     n_view = int(ptab.shape[1]) * int(page_size)
@@ -223,10 +239,26 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
         q = (a @ m["wq"] + m["bq"]).reshape(bsz, S, h_.n_heads, h_.hd)
         k = (a @ m["wk"] + m["bk"]).reshape(bsz, S, h_.n_heads, h_.hd)
         v = (a @ m["wv"] + m["bv"]).reshape(bsz, S, h_.n_heads, h_.hd)
-        kpool = kpool.at[li, phys, off].set(k)
-        vpool = vpool.at[li, phys, off].set(v)
-        kview = kpool[li][ptab].reshape(bsz, n_view, h_.n_heads, h_.hd)
-        vview = vpool[li][ptab].reshape(bsz, n_view, h_.n_heads, h_.hd)
+        if quantized:
+            qk, sk = kvq.quantize_rows(k)
+            qv, sv = kvq.quantize_rows(v)
+            kpool = kpool.at[li, phys, off].set(qk)
+            vpool = vpool.at[li, phys, off].set(qv)
+            kscale = kscale.at[li, phys, off].set(sk)
+            vscale = vscale.at[li, phys, off].set(sv)
+            kview = kvq.dequantize_view(kpool[li][ptab],
+                                        kscale[li][ptab])
+            vview = kvq.dequantize_view(vpool[li][ptab],
+                                        vscale[li][ptab])
+            kview = kview.reshape(bsz, n_view, h_.n_heads, h_.hd)
+            vview = vview.reshape(bsz, n_view, h_.n_heads, h_.hd)
+        else:
+            kpool = kpool.at[li, phys, off].set(k)
+            vpool = vpool.at[li, phys, off].set(v)
+            kview = kpool[li][ptab].reshape(bsz, n_view, h_.n_heads,
+                                            h_.hd)
+            vview = vpool[li][ptab].reshape(bsz, n_view, h_.n_heads,
+                                            h_.hd)
         s = jnp.einsum("bshd,bthd->bhst", q, kview) * scale
         s = jnp.where(mask, s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)
@@ -240,6 +272,8 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
           * jax.lax.rsqrt(x.var(axis=-1, keepdims=True) + h_.eps_f)
           * h_.ln_f["weight"] + h_.ln_f["bias"])
     logp = jax.nn.log_softmax(xf @ h_.head["weight"].T + h_.head["bias"])
+    if quantized:
+        return logp, (kpool, vpool, kscale, vscale)
     return logp, (kpool, vpool)
 
 
@@ -253,7 +287,10 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None,
     ``pages=(page_table, page_size)`` switches the cache layout to the
     block-paged pools of :func:`_lm_forward_window` (gather/scatter
     through the slot→page table, ``valid`` gating the write) — the same
-    math at that row's position, storage indirected through pages.
+    math at that row's position, storage indirected through pages.  A
+    four-array ``caches`` tuple (int8 pools + per-page-row scales,
+    ``BIGDL_SERVE_KV_QUANT``) passes through opaquely to the window's
+    quantized storage path.
 
     ``i`` is either a scalar position (every row at the same step — the
     lock-step scans here) or a per-row (B,) vector (``serve/decode.py``

@@ -32,6 +32,13 @@ class MultiHeadSelfAttention(TensorModule):
     mask (identically in both execution paths).
     """
 
+    #: quantized-serving declaration (bigdl_tpu/quant/weights.py): the
+    #: projections multiply as x @ W, so the OUTPUT channels are the
+    #: columns (axis 1) and inputs the rows (axis 0) — the transpose of
+    #: Linear's layout.  Biases stay fp32.
+    quant_spec = {"wq": (1, 0), "wk": (1, 0), "wv": (1, 0),
+                  "wo": (1, 0)}
+
     def __init__(self, d_model: int, n_heads: int, causal: bool = False):
         super().__init__()
         if d_model % n_heads:

@@ -224,6 +224,10 @@ class SpatialConvolution(TensorModule):
     initMethod).
     """
 
+    #: quantized-serving declaration (bigdl_tpu/quant/weights.py):
+    #: weight is (O, C/group, kh, kw) — per-output-plane scales
+    quant_spec = {"weight": (0, 1)}
+
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
                  pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
@@ -296,6 +300,9 @@ class SpatialShareConvolution(SpatialConvolution):
 
 class SpatialDilatedConvolution(TensorModule):
     """Atrous convolution (ref SpatialDilatedConvolution.scala, 561 LoC)."""
+
+    #: weight is (O, C, kh, kw) — see SpatialConvolution.quant_spec
+    quant_spec = {"weight": (0, 1)}
 
     def __init__(self, n_input_plane: int, n_output_plane: int,
                  kw: int, kh: int, dw: int = 1, dh: int = 1,

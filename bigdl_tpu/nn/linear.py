@@ -29,6 +29,11 @@ def _dot(a, b):
 class Linear(TensorModule):
     """y = x W^T + b (ref Linear.scala:~40, gemm path :103-136)."""
 
+    #: quantized-serving declaration (bigdl_tpu/quant/weights.py):
+    #: param name -> (output-channel axis, input-channel axis) of the
+    #: leaf.  weight is (out, in).
+    quant_spec = {"weight": (0, 1)}
+
     def __init__(self, input_size: int, output_size: int, with_bias: bool = True,
                  init_method: str = init_.Default):
         super().__init__()

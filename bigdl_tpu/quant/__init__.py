@@ -1,0 +1,101 @@
+"""Quantized serving: reduced-precision weights and KV pages
+(docs/serving.md "Quantized serving").
+
+The serving stack's only reduced-precision path used to be the
+``DTypePolicy`` bf16 compute scope (``serve/engine.py``); this package
+adds the density levers that actually shrink HBM:
+
+- :mod:`bigdl_tpu.quant.weights` — per-channel symmetric int8 (and,
+  where the installed XLA supports the dtype, fp8 ``e4m3``) weight
+  quantization of Linear / conv / attention-projection weights, with an
+  optional activation-aware calibration pass (LLM.int8() / AWQ-style
+  clip search).  Serving executables take ``(qweights, scales)`` as
+  ARGUMENTS and dequantize on the fly inside the compiled forward, so
+  the quantized path rides the same ``serve/xcache.py`` AOT keys as
+  full precision — with the quant recipe folded into the function key
+  so the two never collide.
+- :mod:`bigdl_tpu.quant.calibrate` — the calibration pass: run a
+  calibration split through the model (the ``optim.validate`` loop's
+  iteration idiom, eagerly, with activation taps installed on the
+  quantizable layers) and collect per-input-channel amax; the same
+  sweep returns the fp32 baseline metrics the accuracy budget is
+  declared against.
+- :mod:`bigdl_tpu.quant.kv` — int8 KV page storage for the block-paged
+  decode pool (``serve/decode.py``): per-page-row, per-head scales
+  carried as parallel pool-indexed traced arrays, quantize-on-scatter /
+  dequantize-on-gather inside ``models/transformer._lm_forward_window``.
+  Because scales are indexed by PHYSICAL page id, prefix-cache page
+  donation (``serve/prefix.py``) ships them with the pages for free.
+
+Adoption is gated like kernels (docs/performance.md adoption rule):
+``BIGDL_SERVE_QUANT`` / ``BIGDL_SERVE_KV_QUANT`` default **off**, the
+calibration+accuracy harness ``tools/quant_check.py`` pins top1/top5
+within the declared budget below, and the spec-decode acceptance-length
+histogram (``decode_spec_accept_len``) is the LM-quality canary — a
+quantized draft that tanks acceptance shows up immediately.
+"""
+from __future__ import annotations
+
+import os
+
+#: weight-quantization mode for serving engines: off | int8 | fp8
+ENV_QUANT = "BIGDL_SERVE_QUANT"
+#: KV-page quantization mode for the paged decoder: off | int8
+ENV_KV_QUANT = "BIGDL_SERVE_KV_QUANT"
+
+#: the declared accuracy budget (tools/quant_check.py, the acceptance
+#: gate in docs/serving.md): quantized top1/top5 on the real_data.py
+#: harness must be within this of the fp32 baseline
+WEIGHT_TOP1_BUDGET = 0.02
+WEIGHT_TOP5_BUDGET = 0.02
+#: greedy-decode drift budget for int8 KV pages: the fraction of
+#: generated tokens allowed to diverge from the fp-KV stream on the
+#: bench model (tools/bench_serve.py --decode-sweep --check)
+KV_TOKEN_DRIFT_BUDGET = 0.10
+
+
+def normalize_mode(raw, allowed: tuple, what: str) -> str:
+    """ONE normalizer for every quant-mode knob (env vars and the
+    ``ServeEngine(quant=)`` / ``ContinuousDecoder(kv_quant=)`` kwargs):
+    off-ish spellings collapse to ``"off"``, anything else must be in
+    ``allowed``.  ``what`` names the knob in the error."""
+    raw = str(raw).strip().lower()
+    if raw in ("", "0", "off", "none"):
+        return "off"
+    if raw in allowed:
+        return raw
+    raise ValueError(
+        f"{what}={raw!r} is not a known quantization mode "
+        f"(expected one of {('off',) + allowed})")
+
+
+def _mode(env: str, allowed: tuple) -> str:
+    return normalize_mode(os.environ.get(env, ""), allowed, env)
+
+
+def weight_mode_default() -> str:
+    """``BIGDL_SERVE_QUANT`` resolved to off/int8/fp8 (default off)."""
+    from bigdl_tpu.quant.weights import ON_MODES
+    return _mode(ENV_QUANT, ON_MODES)
+
+
+def kv_mode_default() -> str:
+    """``BIGDL_SERVE_KV_QUANT`` resolved to off/int8 (default off)."""
+    from bigdl_tpu.quant.kv import ON_MODES
+    return _mode(ENV_KV_QUANT, ON_MODES)
+
+
+from bigdl_tpu.quant.weights import (  # noqa: E402,F401
+    UnsupportedQuantError, WeightQuantizer, dequantize_params,
+    quantize_channelwise, supports_fp8,
+)
+from bigdl_tpu.quant.calibrate import Calibration, collect  # noqa: E402,F401
+from bigdl_tpu.quant import kv  # noqa: E402,F401
+
+__all__ = [
+    "ENV_QUANT", "ENV_KV_QUANT", "normalize_mode",
+    "weight_mode_default", "kv_mode_default",
+    "WEIGHT_TOP1_BUDGET", "WEIGHT_TOP5_BUDGET", "KV_TOKEN_DRIFT_BUDGET",
+    "WeightQuantizer", "UnsupportedQuantError", "quantize_channelwise",
+    "dequantize_params", "supports_fp8", "Calibration", "collect", "kv",
+]

@@ -1,0 +1,164 @@
+"""Activation calibration for weight quantization (docs/serving.md
+"Quantized serving").
+
+Per-channel min-max quantization treats every weight column alike; the
+activation-aware recipe (AWQ, Lin et al. 2023) observes that error only
+matters where activations actually flow, so the clip search in
+``quant/weights.py`` weights each channel's quantization error by the
+amax of the activations feeding it.  This module collects those amax
+vectors: :func:`collect` drives a calibration split through the model
+with taps installed on the quantizable layer classes and records, per
+module, the per-INPUT-channel ``max |x|`` across every batch.
+
+The sweep is the ``optim.validate`` loop's iteration idiom — same
+``dataset.data(train=False)`` batches, same ValidationMethod algebra —
+run EAGERLY (taps are host-side recorders; under jit they would see
+tracers and record nothing).  ``methods=`` optionally computes fp32
+validation results over the same batches (``Calibration.baseline``)
+for callers whose calibration split IS their eval split;
+``tools/quant_check.py`` anchors its budget on the full-set
+``validate`` pass instead and skips it.
+
+Taps are class-level ``_forward`` wrappers installed for the duration
+of the sweep only (a context manager restores the originals even on
+error) and keyed by module INSTANCE, then resolved to params-tree
+paths, so the result lines up with ``quant_leaf_specs``'s addressing.
+For :class:`~bigdl_tpu.nn.attention.MultiHeadSelfAttention` the block
+input's amax stands in for all four projections (``wo``'s true input is
+the attention output; same width, and the approximation only steers a
+clip search).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+
+class Calibration:
+    """Result of one calibration sweep: ``amax`` maps a module's
+    params-tree path (child-name segments) to its per-input-channel
+    activation amax vector; ``baseline`` holds the fp32 validation
+    results computed in the same pass (``[(method, result)]`` or [])."""
+
+    def __init__(self, amax: dict, n_batches: int, n_records: int,
+                 baseline=None):
+        self.amax = amax
+        self.n_batches = n_batches
+        self.n_records = n_records
+        self.baseline = baseline or []
+
+    def __len__(self):
+        return len(self.amax)
+
+
+def _tapped_classes():
+    from bigdl_tpu.nn.attention import MultiHeadSelfAttention
+    from bigdl_tpu.nn.conv import (SpatialConvolution,
+                                   SpatialDilatedConvolution)
+    from bigdl_tpu.nn.linear import Linear
+    # class -> input-channel axis of the recorded activation (negative
+    # axes count from the end; conv activations are NCHW)
+    return {Linear: -1, SpatialConvolution: 1,
+            SpatialDilatedConvolution: 1, MultiHeadSelfAttention: -1}
+
+
+@contextlib.contextmanager
+def _activation_taps(sink: dict):
+    """Patch the quantizable layer classes' ``_forward`` to record each
+    eager call's per-input-channel amax into ``sink[id(module)]``
+    (max-merged across batches).  Traced calls pass through untouched —
+    a concurrent jit cannot corrupt the sink with tracers."""
+    import jax
+
+    classes = _tapped_classes()
+    originals = {}
+
+    def wrap(cls, orig, ch_axis):
+        def fwd(self, P, x, S, ctx):
+            if not isinstance(x, jax.core.Tracer):
+                try:
+                    arr = np.asarray(x)
+                    ax = ch_axis % arr.ndim
+                    red = tuple(i for i in range(arr.ndim) if i != ax)
+                    amax = np.max(np.abs(arr), axis=red)
+                    prev = sink.get(id(self))
+                    sink[id(self)] = (amax if prev is None
+                                      else np.maximum(prev, amax))
+                except Exception:
+                    pass   # a table input or exotic shape: skip the tap
+            return orig(self, P, x, S, ctx)
+        return fwd
+
+    try:
+        for cls, ch_axis in classes.items():
+            originals[cls] = cls._forward
+            cls._forward = wrap(cls, originals[cls], ch_axis)
+        yield
+    finally:
+        for cls, orig in originals.items():
+            cls._forward = orig
+
+
+def _module_paths(model) -> dict:
+    """id(module) -> params-tree path (child-name segments)."""
+    out = {}
+
+    def walk(mod, path):
+        out[id(mod)] = path
+        for name, child in mod._modules.items():
+            walk(child, path + (name,))
+
+    walk(model, ())
+    return out
+
+
+def collect(model, dataset, methods=None, max_batches: int = 8,
+            params=None, state=None) -> Calibration:
+    """Run up to ``max_batches`` of ``dataset``'s eval split through
+    ``model`` eagerly with activation taps installed; returns the
+    :class:`Calibration` (per-module input-channel amax + the fp32
+    baseline results for ``methods``, validate-style)."""
+    import jax
+
+    from bigdl_tpu.nn.module import Context
+
+    params = model.params() if params is None else params
+    state = model.state() if state is None else state
+    methods = list(methods or [])
+    sink: dict = {}
+    totals = [None] * len(methods)
+    n_batches = n_records = 0
+    ctx = Context(training=False, key=jax.random.PRNGKey(0))
+    with _activation_taps(sink):
+        for batch in dataset.data(train=False):
+            data = np.asarray(batch.data)
+            out, _ = model.apply(params, data, state, ctx)
+            for i, m in enumerate(methods):
+                r = m(out, batch.labels)
+                totals[i] = r if totals[i] is None else totals[i] + r
+            n_batches += 1
+            n_records += int(data.shape[0])
+            if n_batches >= max_batches:
+                break
+    if not n_batches:
+        raise ValueError("calibration split yielded no batches")
+    paths = _module_paths(model)
+    amax = {paths[mid]: v for mid, v in sink.items() if mid in paths}
+
+    # calibration telemetry: gauges next to the serving numbers so a
+    # fleet operator can see what the quantized replicas were tuned on
+    # (docs/observability.md "Quantized serving" rows)
+    try:
+        from bigdl_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.get()
+        reg.gauge("quant_calib_batches",
+                  "batches in the last calibration sweep").set(n_batches)
+        reg.gauge("quant_calib_records",
+                  "records in the last calibration sweep").set(n_records)
+        reg.gauge("quant_calib_layers",
+                  "layers with collected activation amax").set(len(amax))
+    except Exception:   # pragma: no cover - obs layer unavailable
+        pass
+    return Calibration(amax, n_batches, n_records,
+                       baseline=list(zip(methods, totals)))

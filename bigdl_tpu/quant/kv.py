@@ -1,0 +1,95 @@
+"""int8 KV-page storage for the block-paged decode pool
+(docs/serving.md "Quantized serving"; the pool itself is
+``serve/paging.py`` + ``models/transformer._lm_forward_window``).
+
+The paged KV pools are ``(layers, n_pages, page_size, H, hd)`` float32;
+at serving batch sizes they ARE the HBM budget, so int8 storage roughly
+quadruples pooled tokens at equal bytes — which is live concurrency,
+because the paged decoder admits by pooled tokens (``--decode-sweep``).
+
+Scheme: **per-page-row, per-head scales** — one float32 scale per
+``(layer, page, in-page position, head)`` covering that row's ``hd``
+values, stored in parallel ``(layers, n_pages, page_size, H)`` pool
+arrays carried as traced state next to the pools themselves.  Finer
+than one scale per page on purpose, for three load-bearing properties:
+
+- a scatter never touches neighbouring rows, so there is no
+  requantize-the-page step and no scale coupling between requests that
+  share a page read-only (prefix cache);
+- scales are indexed by PHYSICAL page id exactly like the values, so
+  prefix-cache page donation (``serve/prefix.py``) ships the scales
+  with the pages — a prefix hit dequantizes to bit-identical K/V and
+  the hit-vs-cold output equality contract survives quantization;
+- speculative decode stays EXACTLY identical to the non-speculative
+  quantized stream for every draft length: rejected draft positions
+  are overwritten value+scale by the next verify window, and a page's
+  committed rows never change representation afterwards (a per-page
+  running amax would let a rejected draft outlier permanently coarsen
+  the page — ``tests/test_quant.py`` pins the identity).
+
+Per-head (not per-``(H, hd)`` row) because under tensor parallelism the
+scale arrays shard on their head dim with the SAME PartitionSpec as the
+pools — each shard quantizes its local heads with zero cross-shard
+communication.
+
+Write: ``q = clip(round(k / s), ±127)`` with ``s = max|k|_hd / 127``;
+read: the page-gathered attention view multiplies the gathered scale
+rows back in.  Worst-case error is ``amax/254`` per head-row.  The
+quantize/dequantize helpers here are traced inside the decode step
+(``_lm_forward_window``); everything stays jnp.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+QMAX = 127.0
+EPS = 1e-8
+#: modes the paged decoder accepts — THE source of truth for
+#: ``kv_mode_default()`` and ``ContinuousDecoder(kv_quant=)``
+#: validation (fp8 KV is not offered: e4m3 has ~2 decimal digits —
+#: attention logits visibly drift — and the int8 path already caps
+#: storage at 1 byte/value)
+MODES = ("off", "int8")
+#: MODES minus "off": what normalize_mode() accepts beyond off-ish
+ON_MODES = tuple(m for m in MODES if m != "off")
+
+scale_dtype = np.float32
+storage_dtype = np.int8
+
+
+def quantize_rows(x):
+    """Quantize ``(..., H, hd)`` K/V rows per head: returns
+    ``(q int8 (..., H, hd), scales f32 (..., H))``.  Traced (jnp) —
+    this runs inside the compiled decode step on every scatter."""
+    import jax.numpy as jnp
+
+    amax = jnp.max(jnp.abs(x), axis=-1)
+    s = jnp.maximum(amax, EPS) / QMAX
+    q = jnp.clip(jnp.round(x / s[..., None]), -QMAX, QMAX)
+    return q.astype(jnp.int8), s.astype(jnp.float32)
+
+
+def dequantize_view(q, s):
+    """Dequantize a gathered view: ``q`` int8 ``(..., H, hd)`` with
+    scales ``(..., H)`` back to float32."""
+    import jax.numpy as jnp
+
+    return q.astype(jnp.float32) * s[..., None]
+
+
+def scale_shape(pool_shape) -> tuple:
+    """Scale-array shape for a ``(L, n_pages, page_size, H, hd)`` pool:
+    the same pool minus the ``hd`` dim."""
+    return tuple(pool_shape[:-1])
+
+
+def bytes_per_token(n_layers: int, n_heads: int, head_dim: int,
+                    mode: str = "off") -> int:
+    """KV bytes one pooled token costs across all layers (K and V,
+    scales included) — the ``decode_kv_bytes_per_token`` gauge and the
+    equal-HBM pool sizing in ``tools/bench_serve.py --decode-sweep``."""
+    if mode == "int8":
+        per_layer = 2 * (n_heads * head_dim * 1 + n_heads * 4)
+    else:
+        per_layer = 2 * n_heads * head_dim * 4
+    return n_layers * per_layer

@@ -1,0 +1,300 @@
+"""Per-channel weight quantization for serving (docs/serving.md
+"Quantized serving").
+
+The scheme is symmetric per-OUTPUT-channel quantization (LLM.int8(),
+Dettmers et al. 2022): each output channel ``c`` of a weight stores
+``q = round(W_c / s_c)`` in int8 with one float scale ``s_c =
+amax_c / 127``, so the worst-case round-trip error is ``amax_c / 254``
+per channel — the bound ``tests/test_quant.py`` pins.  With a
+calibration (``quant/calibrate.py``) the scale comes from an
+activation-aware clip search (AWQ-flavored, Lin et al. 2023): per
+output channel, pick the clip ratio minimizing the ACTIVATION-WEIGHTED
+quantization error, so channels whose inputs run hot keep precision
+where it matters and channels feeding dead inputs may clip outliers.
+
+fp8 ``e4m3`` is the same recipe with the mantissa doing the rounding
+(scale maps amax to the format's ±448 range).  It is CAPABILITY-GATED:
+:func:`supports_fp8` probes the installed XLA once (the jax/jaxlib
+span this framework runs on includes versions without fp8 lowering on
+every backend), and :class:`WeightQuantizer` raises
+:class:`UnsupportedQuantError` with a clear "unsupported on this XLA"
+message instead of failing somewhere inside a trace.
+
+Serving integration: quantized weights are executable ARGUMENTS, never
+constants — :func:`quantized_eval_fn` builds the jitted forward
+``fwd(qpack, state, x)`` that dequantizes on the fly (one fused
+``int8 -> f32 * scale`` per weight, which XLA folds into the consumer
+matmul's prologue) and wraps it in ``xcache.ShapedCallable`` with the
+quant recipe folded into the function key, so quantized and
+full-precision replicas of one architecture ride the same shared
+executable cache without ever colliding (``serve/xcache.py``).
+
+Which leaves quantize is declared by the layers themselves: module
+classes carry a ``quant_spec`` mapping param name -> (out_axis,
+in_axis) (``nn/linear.py``, ``nn/conv.py``, ``nn/attention.py``), and
+:func:`quant_leaf_specs` walks the module tree in step with the params
+tree — biases, LayerNorm gains, BN statistics and everything else stay
+fp32.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+#: weight-quantization modes — THE source of truth for
+#: ``weight_mode_default()``, ``ServeEngine(quant=)`` validation and
+#: :class:`WeightQuantizer` (the kv.MODES pattern)
+MODES = ("off", "int8", "fp8")
+ON_MODES = tuple(m for m in MODES if m != "off")
+
+INT8_QMAX = 127.0
+FP8_MAX = 448.0          # float8_e4m3fn finite max
+#: clip ratios searched by the activation-aware calibration pass
+CLIP_RATIOS = (1.0, 0.95, 0.9, 0.85, 0.8, 0.7, 0.6, 0.5)
+
+_FP8_SUPPORT = None      # capability probe result, cached per process
+
+
+class UnsupportedQuantError(RuntimeError):
+    """The requested quantization mode is not available on this
+    toolchain (e.g. fp8 on an XLA without ``float8_e4m3fn`` lowering).
+    Raised at construction — never from inside a trace."""
+
+
+def supports_fp8() -> bool:
+    """True when the installed jax/XLA can store and convert
+    ``float8_e4m3fn`` on the current backend.  Probed ONCE with a tiny
+    round-trip (the capability-gate idiom: a feature is used only after
+    this process proved it works, never inferred from version strings).
+    """
+    global _FP8_SUPPORT
+    if _FP8_SUPPORT is None:
+        try:
+            import jax.numpy as jnp
+            x = jnp.asarray(np.full((2,), 1.5, np.float32),
+                            jnp.float8_e4m3fn)
+            _FP8_SUPPORT = bool(
+                np.allclose(np.asarray(x.astype(jnp.float32)), 1.5))
+        except Exception:
+            _FP8_SUPPORT = False
+    return _FP8_SUPPORT
+
+
+def _fp8_dtype():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def is_quantized_leaf(leaf) -> bool:
+    """True for leaves holding quantized storage (int8 or fp8)."""
+    dt = np.dtype(getattr(leaf, "dtype", np.float32))
+    return dt == np.int8 or "float8" in dt.name
+
+
+def _search_clip(w, amax, out_axis, red, act_amax, in_axis, mode):
+    """Per-output-channel clip ratio minimizing the activation-weighted
+    quantization error ``sum(|W - dq(W)| * act_amax)`` over
+    :data:`CLIP_RATIOS`.  Returns ratios shaped like ``amax``."""
+    if act_amax is None:
+        return np.ones_like(amax)
+    act = np.asarray(act_amax, np.float32).reshape(-1)
+    if act.size != w.shape[in_axis]:
+        # grouped conv or a shape the taps did not see: fall back to
+        # plain min-max rather than mis-broadcasting the weights
+        return np.ones_like(amax)
+    shp = [1] * w.ndim
+    shp[in_axis] = -1
+    a = act.reshape(shp)
+    best_err = None
+    best = np.ones_like(amax)
+    for r in CLIP_RATIOS:
+        clip = amax * r
+        if mode == "int8":
+            s = clip / INT8_QMAX
+            dq = np.clip(np.rint(w / s), -INT8_QMAX, INT8_QMAX) * s
+        else:
+            s = clip / FP8_MAX
+            dq = np.clip(w / s, -FP8_MAX, FP8_MAX).astype(
+                _fp8_dtype()).astype(np.float32) * s
+        err = np.sum(np.abs(w - dq) * a, axis=red, keepdims=True)
+        if best_err is None:
+            best_err, best = err, np.full_like(amax, r)
+        else:
+            take = err < best_err
+            best_err = np.where(take, err, best_err)
+            best = np.where(take, r, best)
+    return best
+
+
+def quantize_channelwise(w, out_axis: int, mode: str = "int8",
+                         act_amax=None, in_axis: int | None = None):
+    """Quantize one weight leaf per output channel; returns
+    ``(q, scale)`` with ``scale`` keep-dims shaped so ``q.astype(f32) *
+    scale`` broadcasts back to ``w``'s shape.  ``act_amax`` (a vector
+    over the input-channel axis) arms the activation-aware clip search.
+    """
+    w = np.asarray(w, np.float32)
+    red = tuple(i for i in range(w.ndim) if i != out_axis)
+    amax = np.maximum(np.max(np.abs(w), axis=red, keepdims=True), 1e-12)
+    if act_amax is not None and in_axis is not None:
+        amax = amax * _search_clip(w, amax, out_axis, red, act_amax,
+                                   in_axis, mode)
+    if mode == "int8":
+        scale = amax / INT8_QMAX
+        q = np.clip(np.rint(w / scale), -INT8_QMAX,
+                    INT8_QMAX).astype(np.int8)
+    elif mode == "fp8":
+        if not supports_fp8():
+            raise UnsupportedQuantError(
+                "fp8 (e4m3) is unsupported on this XLA — the "
+                "supports_fp8() capability probe failed; serve int8 or "
+                "full precision instead")
+        scale = amax / FP8_MAX
+        q = np.clip(w / scale, -FP8_MAX, FP8_MAX).astype(_fp8_dtype())
+    else:
+        raise ValueError(f"unknown quantization mode {mode!r}")
+    return q, scale.astype(np.float32)
+
+
+def quant_leaf_specs(model):
+    """Walk the module tree in step with the params-tree layout and
+    yield ``(path, (out_axis, in_axis))`` for every quantizable leaf,
+    where ``path`` indexes ``model.params()`` (child name segments,
+    then ``("~", leaf_name)``).  Layers opt in by declaring
+    ``quant_spec`` (``nn/linear.py`` / ``nn/conv.py`` /
+    ``nn/attention.py``)."""
+    out = []
+
+    def walk(mod, path):
+        spec = getattr(type(mod), "quant_spec", None)
+        if spec:
+            for name, axes in spec.items():
+                if name in mod._params:
+                    out.append((path + ("~", name), tuple(axes)))
+        for cname, child in mod._modules.items():
+            walk(child, path + (cname,))
+
+    walk(model, ())
+    return out
+
+
+_KEEP = object()
+
+
+def _tree_substitute(tree, updates, default=_KEEP):
+    """Copy a nested-dict params tree, substituting ``updates[path]``
+    where present; elsewhere keep the original leaf (the quantized
+    tree) or place ``default`` (the scale tree's unit scales) — see
+    :meth:`WeightQuantizer.quantize`."""
+    def rec(node, path):
+        if isinstance(node, dict):
+            return {k: rec(v, path + (k,)) for k, v in node.items()}
+        if path in updates:
+            return updates[path]
+        return node if default is _KEEP else default
+    return rec(tree, ())
+
+
+def dequantize_params(qpack):
+    """Rebuild the fp32 params tree from ``{"q": ..., "scale": ...}``.
+    Runs under jit (the serving forward's prologue — XLA fuses the cast
+    and the per-channel multiply into the consumer) and eagerly (the
+    accuracy harness evaluates the EXACT values the engine serves)."""
+    import jax
+    import jax.numpy as jnp
+
+    def dq(q, s):
+        if is_quantized_leaf(q):
+            return q.astype(jnp.float32) * s
+        return q
+
+    return jax.tree_util.tree_map(dq, qpack["q"], qpack["scale"])
+
+
+class WeightQuantizer:
+    """One model's quantization recipe: which leaves, which mode, which
+    calibration.  :meth:`quantize` maps a full-precision params tree to
+    the ``{"q", "scale"}`` pack the serving executables take as
+    arguments — the engine calls it once at capture and again for every
+    staged rollout, so a hot weight swap re-quantizes with the SAME
+    recipe (``serve/engine.py``)."""
+
+    def __init__(self, model, mode: str, calibration=None):
+        if mode not in ON_MODES:
+            raise ValueError(f"unknown quantization mode {mode!r}")
+        if mode == "fp8" and not supports_fp8():
+            raise UnsupportedQuantError(
+                "fp8 (e4m3) weights are unsupported on this XLA — the "
+                "supports_fp8() capability probe failed (serve "
+                "BIGDL_SERVE_QUANT=int8 instead)")
+        self.model = model
+        self.mode = mode
+        self.calibration = calibration
+        self.leaves = quant_leaf_specs(model)
+        if not self.leaves:
+            raise ValueError(
+                "model has no quantizable leaves (no module declares a "
+                "quant_spec) — nothing to serve quantized")
+        #: folded into the serving fn_key (serve/xcache.py): quantized
+        #: and full-precision executables of one architecture must
+        #: never resolve to the same cache entry
+        self.recipe_key = (mode,
+                           "calib" if calibration is not None else
+                           "minmax", len(self.leaves))
+
+    def _act_amax(self, path):
+        if self.calibration is None:
+            return None
+        return self.calibration.amax.get(path[:-2])
+
+    def quantize(self, params):
+        """Full-precision params tree -> ``{"q": tree, "scale": tree}``.
+        Both trees share the ORIGINAL tree structure (non-quantized
+        leaf positions hold the fp leaf / a unit scale), so the
+        engine's staged-rollout structure checks keep working
+        unchanged."""
+        q_up, s_up = {}, {}
+
+        def leaf_at(tree, path):
+            for k in path:
+                tree = tree[k]
+            return tree
+
+        for path, (out_ax, in_ax) in self.leaves:
+            w = leaf_at(params, path)
+            q, s = quantize_channelwise(
+                w, out_ax, self.mode, act_amax=self._act_amax(path),
+                in_axis=in_ax)
+            q_up[path], s_up[path] = q, s
+        return {"q": _tree_substitute(params, q_up),
+                "scale": _tree_substitute(params, s_up,
+                                          default=np.float32(1.0))}
+
+    def stats(self) -> dict:
+        return {"mode": self.mode, "leaves": len(self.leaves),
+                "calibrated": self.calibration is not None}
+
+
+def quantized_eval_fn(model, quantizer: WeightQuantizer):
+    """The quantized counterpart of ``optim.local_optimizer._eval_fn``:
+    a jitted ``fwd(qpack, state, x)`` that dequantizes INSIDE the
+    compiled forward (weights stay int8/fp8 in HBM; the executable
+    takes ``(qweights, scales)`` as arguments, so rollouts never
+    recompile) routed through the shared executable cache under a
+    fn_key extended with the quant recipe."""
+    import jax
+
+    from bigdl_tpu.nn.module import Context
+    from bigdl_tpu.optim.local_optimizer import _model_fingerprint
+    from bigdl_tpu.serve import xcache
+
+    fp = _model_fingerprint(model)
+
+    @jax.jit
+    def fwd(qpack, s, x):
+        p = dequantize_params(qpack)
+        out, _ = model.apply(p, x, s, Context(training=False,
+                                              key=jax.random.PRNGKey(0)))
+        return out
+
+    return xcache.ShapedCallable(
+        fwd, fn_key=("eval_quant", quantizer.recipe_key, fp))

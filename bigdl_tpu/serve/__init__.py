@@ -30,6 +30,13 @@ counterpart, reusing the training stack's pipeline idioms:
   :class:`WeightStore`: in-process or subprocess replica fleets with
   two-phase (stage → atomic flip, rollback on failure) weight rollout.
 
+Quantized serving (``bigdl_tpu/quant``, docs/serving.md "Quantized
+serving"): ``BIGDL_SERVE_QUANT`` serves per-channel int8/fp8 weights
+through the ServeEngine (dequant-on-the-fly, quant recipe in the xcache
+key) and ``BIGDL_SERVE_KV_QUANT`` stores the paged decode pool as int8
+with per-page-row scales — both default off, gated by the
+``tools/quant_check.py`` accuracy budget.
+
 Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
 (default 2), ``BIGDL_SERVE_SYNC`` (decode boundary interval, default 8),
 ``BIGDL_SERVE_PAGED`` (block-paged KV decode, default on),
@@ -37,6 +44,8 @@ Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
 ``BIGDL_SERVE_PAGES`` (pool size in pages, default slab-equivalent),
 ``BIGDL_SERVE_PREFIX_CACHE`` (prefix page reuse, default on),
 ``BIGDL_SERVE_SPEC_K`` (self-speculative draft length, default 0 = off),
+``BIGDL_SERVE_QUANT`` (weight quantization: off/int8/fp8, default off),
+``BIGDL_SERVE_KV_QUANT`` (int8 KV pages, default off),
 ``BIGDL_SERVE_REPLICAS`` (pool size, default 2), ``BIGDL_SERVE_SLO_MS``
 (default request deadline, 0 = none), ``BIGDL_SERVE_SHED`` (overload
 shedding, default on), ``BIGDL_OBS_TRACE_SAMPLE`` (request-trace
@@ -54,7 +63,8 @@ from bigdl_tpu.serve.decode import (  # noqa: F401
     ContinuousDecoder, continuous_decode,
 )
 from bigdl_tpu.serve.engine import (  # noqa: F401
-    PoisonedRequestError, ServeEngine, SheddedError,
+    DTypePolicyDriftError, PoisonedRequestError, ServeEngine,
+    SheddedError,
 )
 from bigdl_tpu.serve.paging import (  # noqa: F401
     PagePool, RequestTooLongError,
@@ -67,6 +77,7 @@ from bigdl_tpu.serve.router import (  # noqa: F401
 __all__ = [
     "bucketing", "xcache", "bucket_sizes", "bucket_for", "pad_rows",
     "trim", "valid_mask", "ServeEngine", "PoisonedRequestError",
+    "DTypePolicyDriftError",
     "SheddedError", "ContinuousDecoder", "continuous_decode", "Router",
     "DeadReplicaError", "ReplicaPool", "LocalReplica", "ProcessReplica",
     "WeightStore", "RolloutError", "PagePool", "PrefixCache",

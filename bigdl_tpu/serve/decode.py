@@ -35,6 +35,18 @@ when traffic skews short.  On top of the pool:
   whose seed matches maps those pages read-only into its own table and
   starts at the (page-aligned) divergence point, skipping that much
   prefill.  Hits/misses and reused pages ride the metrics registry.
+- **int8 KV pages** (env ``BIGDL_SERVE_KV_QUANT``, docs/serving.md
+  "Quantized serving"): the pools store int8 with per-page-row,
+  per-head scales in parallel ``(layers, n_pages, page_size, H)``
+  traced arrays (``quant/kv.py``) — the scatter quantizes, the
+  page-gathered attention view dequantizes, and because scales are
+  pool-indexed like the values, prefix page donation ships them with
+  the pages.  ~3-4x pooled tokens at equal HBM (scales included),
+  which is live concurrency; greedy output may drift from the fp-KV
+  stream within
+  the declared budget (``bigdl_tpu.quant.KV_TOKEN_DRIFT_BUDGET``),
+  while speculative decode stays EXACTLY identical to the
+  non-speculative quantized stream for every k.
 - **self-speculative decode** (env ``BIGDL_SERVE_SPEC_K``): the model
   drafts ``k`` tokens per step with a SHALLOW pass over its own first
   ``draft_layers`` blocks (same weights — no second model), then ONE
@@ -169,7 +181,9 @@ class ContinuousDecoder:
     ``ceil(n_pos / page_size) * max_slots``.  ``prefix_cache`` enables
     token-hash prefix page reuse, ``spec_k`` > 0 self-speculative
     decode with a ``draft_layers``-deep draft pass (default: half the
-    blocks) — both paged-only.
+    blocks), and ``kv_quant="int8"`` (default from
+    ``BIGDL_SERVE_KV_QUANT``) int8 KV pages with per-page-row scales —
+    all paged-only.
     """
 
     def __init__(self, model, max_slots: int = 4, n_pos: int = 64,
@@ -178,7 +192,8 @@ class ContinuousDecoder:
                  n_pages: int | None = None,
                  prefix_cache: bool | None = None,
                  spec_k: int | None = None,
-                 draft_layers: int | None = None):
+                 draft_layers: int | None = None,
+                 kv_quant: str | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -186,6 +201,8 @@ class ContinuousDecoder:
                                                   _lm_forward_window,
                                                   _lm_handles)
         from bigdl_tpu.optim.local_optimizer import _model_fingerprint
+        from bigdl_tpu.quant import kv as kvq
+        from bigdl_tpu.quant import kv_mode_default, normalize_mode
         from bigdl_tpu.serve import xcache
 
         self.model = model
@@ -206,9 +223,19 @@ class ContinuousDecoder:
                           else int(spec_k))
         use_prefix = bool(_env_int(ENV_PREFIX, 1)) \
             if prefix_cache is None else bool(prefix_cache)
-        if not self.paged and (self.spec_k or prefix_cache):
-            raise ValueError("speculative decode and prefix caching "
-                             "need the paged KV pool (paged=True)")
+        if kv_quant is None:
+            # the env opts the PAGED pool in; a slab decoder (A/B
+            # baseline) under the same env quietly serves fp — only an
+            # explicit kv_quant= on a slab decoder is a hard error
+            self.kv_quant = kv_mode_default() if self.paged else "off"
+        else:
+            self.kv_quant = normalize_mode(kv_quant, kvq.ON_MODES,
+                                           "kv_quant")
+        if not self.paged and (self.spec_k or prefix_cache
+                               or self.kv_quant != "off"):
+            raise ValueError("speculative decode, prefix caching and "
+                             "KV quantization need the paged KV pool "
+                             "(paged=True)")
 
         handles = _lm_handles(model)
         self._vocab = handles.vocab
@@ -234,40 +261,45 @@ class ContinuousDecoder:
         fp = _model_fingerprint(model)
 
         # ---- step bodies --------------------------------------------------
-        def slab_step_body(local_handles, kc, vc, pos, prev, active,
+        # ``caches`` is the KV-storage pytree threaded through every
+        # program: (k, v) pools, or (k, v, kscale, vscale) under int8
+        # KV quantization (the scale arrays are traced state exactly
+        # like the pools — serve/decode carries them, quant/kv.py and
+        # _lm_forward_window do the math)
+        def slab_step_body(local_handles, caches, pos, prev, active,
                            seeds, seed_len, gen, tp_axis=None):
             rows = jnp.arange(B)
             live = active & (pos < n_pos)
             wp = jnp.clip(pos, 0, n_pos - 1)
             tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
-            logp, (kc, vc) = _lm_forward_one(
-                tok.astype(jnp.int32), wp, (kc, vc), local_handles,
+            logp, caches = _lm_forward_one(
+                tok.astype(jnp.int32), wp, caches, local_handles,
                 n_pos, pe, tp_axis=tp_axis)
             nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
             # parked/finished slots must not advance or write tokens
             gen = gen.at[rows, wp].set(jnp.where(live, nxt, gen[rows, wp]))
             prev = jnp.where(live, nxt, prev)
             pos = jnp.where(live, pos + 1, pos)
-            return kc, vc, pos, prev, gen
+            return caches, pos, prev, gen
 
-        def paged_step_body(local_handles, kpool, vpool, ptab, pos, prev,
+        def paged_step_body(local_handles, caches, ptab, pos, prev,
                             active, seeds, seed_len, cap, gen,
                             tp_axis=None):
             rows = jnp.arange(B)
             live = active & (pos < cap)
             wp = jnp.clip(pos, 0, cap - 1)
             tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
-            logp, (kpool, vpool) = _lm_forward_one(
-                tok.astype(jnp.int32), wp, (kpool, vpool), local_handles,
+            logp, caches = _lm_forward_one(
+                tok.astype(jnp.int32), wp, caches, local_handles,
                 n_view, pe, tp_axis=tp_axis, pages=(ptab, ps), valid=live)
             nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
             # frozen rows route their token write out of bounds (dropped)
             gen = gen.at[rows, jnp.where(live, wp, n_view)].set(nxt)
             prev = jnp.where(live, nxt, prev)
             pos = jnp.where(live, pos + 1, pos)
-            return kpool, vpool, pos, prev, gen
+            return caches, pos, prev, gen
 
-        def spec_step_body(local_full, local_draft, kpool, vpool, ptab,
+        def spec_step_body(local_full, local_draft, caches, ptab,
                            pos, prev, active, seeds, seed_len, cap, gen,
                            acc_hist, tp_axis=None):
             rows = jnp.arange(B)
@@ -280,8 +312,8 @@ class ContinuousDecoder:
             toks, d_tok, d_pos = [t0], t0, pos
             for _ in range(k):
                 d_valid = live & (d_pos < cap)
-                dlogp, (kpool, vpool) = _lm_forward_one(
-                    d_tok, jnp.clip(d_pos, 0, cap - 1), (kpool, vpool),
+                dlogp, caches = _lm_forward_one(
+                    d_tok, jnp.clip(d_pos, 0, cap - 1), caches,
                     local_draft, n_view, pe, tp_axis=tp_axis,
                     pages=(ptab, ps), valid=d_valid)
                 d_arg = jnp.argmax(dlogp, axis=-1).astype(jnp.int32)
@@ -296,8 +328,8 @@ class ContinuousDecoder:
             wp = jnp.clip(p_idx, 0, n_view - 1)
             # -- ONE batched verify pass with the full model (overwrites
             # the draft's shallow K/V at the same positions)
-            logp, (kpool, vpool) = _lm_forward_window(
-                W, wp, (kpool, vpool), local_full, pe, (ptab, ps),
+            logp, caches = _lm_forward_window(
+                W, wp, caches, local_full, pe, (ptab, ps),
                 valid=valid, tp_axis=tp_axis)
             g = jnp.argmax(logp, axis=-1).astype(jnp.int32)  # (B, k+1)
             # -- longest accepted prefix: drafted token j+1 survives iff
@@ -329,7 +361,7 @@ class ContinuousDecoder:
                 rec[:, None],
                 jax.nn.one_hot(acc, k + 1, dtype=jnp.int32), 0
             ).sum(axis=0)
-            return kpool, vpool, pos, prev, gen, acc_hist
+            return caches, pos, prev, gen, acc_hist
 
         def _draft_of(local):
             return local._replace(blocks=local.blocks[:Ld],
@@ -339,8 +371,12 @@ class ContinuousDecoder:
         # ---- program assembly (single-chip or TP shard_map) ---------------
         pool_shape = ((L, self._pool.n_pages, ps, H, hd) if self.paged
                       else (L, B, n_pos, H, hd))
+        #: arrays in the KV-storage pytree: (k, v) pools, plus the two
+        #: per-page-row scale arrays under int8 KV quantization
+        n_caches = 4 if self.kv_quant == "int8" else 2
         kind = "spec" if k else ("paged" if self.paged else "slab")
-        key_tail = ((ps, self.pages_per_slot, self._pool.n_pages, k, Ld)
+        key_tail = ((ps, self.pages_per_slot, self._pool.n_pages, k, Ld,
+                     self.kv_quant)
                     if self.paged else ())
 
         if self.tp > 1:
@@ -373,7 +409,12 @@ class ContinuousDecoder:
                  "ln_f": handles.ln_f, "head": handles.head},
                 jax.tree_util.tree_map(
                     lambda sp: NamedSharding(mesh, sp), wspec))
-            cache = P(None, None, None, ax)   # head dim, slab and pool
+            # head dim: the pools shard their H axis (dim 3 of both the
+            # 5-d value pools AND the 4-d per-page-row scale arrays —
+            # scales are per-head exactly so they shard with zero
+            # cross-shard traffic, quant/kv.py)
+            cache = P(None, None, None, ax)
+            cspec = (cache,) * n_caches
             rep = P()
             H_local = H // self.tp
 
@@ -399,8 +440,8 @@ class ContinuousDecoder:
 
             sharded = compat.shard_map(
                 step_tp, mesh=mesh,
-                in_specs=(wspec, cache, cache) + (rep,) * n_rep_in,
-                out_specs=(cache, cache) + (rep,) * n_rep_out)
+                in_specs=(wspec, cspec) + (rep,) * n_rep_in,
+                out_specs=(cspec,) + (rep,) * n_rep_out)
             self._step = xcache.tracked_jit(
                 sharded,
                 ("decode_step_" + kind, fp, B, n_pos) + key_tail
@@ -441,8 +482,9 @@ class ContinuousDecoder:
                 # gathered into this slot's (masked) attention view
                 return ptab.at[slot].set(0), active.at[slot].set(False)
         else:
-            def admit(kc, vc, pos, active, seeds, seed_len, gen, slot,
+            def admit(caches, pos, active, seeds, seed_len, gen, slot,
                       seed_row, s_len):
+                kc, vc = caches
                 kc = kc.at[:, slot].set(0.0)
                 vc = vc.at[:, slot].set(0.0)
                 pos = pos.at[slot].set(0)
@@ -450,7 +492,7 @@ class ContinuousDecoder:
                 seeds = seeds.at[slot].set(seed_row)
                 seed_len = seed_len.at[slot].set(s_len)
                 gen = gen.at[slot].set(0)
-                return kc, vc, pos, active, seeds, seed_len, gen
+                return (kc, vc), pos, active, seeds, seed_len, gen
 
             def retire(active, slot):
                 return active.at[slot].set(False)
@@ -472,8 +514,8 @@ class ContinuousDecoder:
             else:
                 admit = compat.shard_map(
                     admit, mesh=mesh,
-                    in_specs=(cache, cache) + (rep,) * 8,
-                    out_specs=(cache, cache) + (rep,) * 5)
+                    in_specs=((cache, cache),) + (rep,) * 8,
+                    out_specs=((cache, cache),) + (rep,) * 5)
                 retire = compat.shard_map(retire, mesh=mesh,
                                           in_specs=(rep, rep),
                                           out_specs=rep)
@@ -485,8 +527,19 @@ class ContinuousDecoder:
             mesh=mesh)
 
         z = jnp.zeros
-        self._kc = z(pool_shape, jnp.float32)
-        self._vc = z(pool_shape, jnp.float32)
+        if self.kv_quant == "int8":
+            # int8 pools + per-page-row per-head scale arrays; a fresh
+            # page's stale rows are never read before their overwrite
+            # (same masked-read argument as the fp pool), so zero-init
+            # scales are only ever paired with zero-init values
+            sshape = kvq.scale_shape(pool_shape)
+            self._caches = (z(pool_shape, jnp.int8),
+                            z(pool_shape, jnp.int8),
+                            z(sshape, jnp.float32),
+                            z(sshape, jnp.float32))
+        else:
+            self._caches = (z(pool_shape, jnp.float32),
+                            z(pool_shape, jnp.float32))
         self._pos = z((B,), jnp.int32)
         self._prev = z((B,), jnp.int32)
         self._active = z((B,), bool)
@@ -501,6 +554,10 @@ class ContinuousDecoder:
         if k:
             self._acc_hist = z((k + 1,), jnp.int32)
             self._acc_seen = np.zeros((k + 1,), np.int64)
+            # host-side copy of the acceptance-length counts (warm pass
+            # excluded) — stats()/bench read p50 from here without
+            # touching the registry
+            self._accept_counts = np.zeros((k + 1,), np.int64)
 
         self._pending: "deque[_DecodeReq]" = deque()
         self._slots: list = [None] * B
@@ -526,6 +583,14 @@ class ContinuousDecoder:
         self._m_slots_hwm = reg.gauge(
             "decode_slots_hwm", "live-request high-water mark",
             agg="max", **lab)
+        #: KV bytes one pooled token costs across all layers (scales
+        #: included under int8 KV quant) — the density lever the
+        #: quantized pool pulls (docs/observability.md)
+        self.kv_bytes_per_token = kvq.bytes_per_token(
+            L, H, hd, self.kv_quant)
+        reg.gauge("decode_kv_bytes_per_token",
+                  "KV bytes per pooled token incl. scales",
+                  **lab).set(self.kv_bytes_per_token)
         if self.paged:
             self._m_pages = reg.gauge(
                 "decode_pages_in_use", "allocated KV pool pages", **lab)
@@ -563,11 +628,11 @@ class ContinuousDecoder:
     # -- compiled-program drivers -------------------------------------------
     def _run_step(self):
         if self.paged:
-            args = (self._kc, self._vc, self._ptab, self._pos,
+            args = (self._caches, self._ptab, self._pos,
                     self._prev, self._active, self._seeds,
                     self._seed_len, self._cap, self._gen)
         else:
-            args = (self._kc, self._vc, self._pos, self._prev,
+            args = (self._caches, self._pos, self._prev,
                     self._active, self._seeds, self._seed_len, self._gen)
         if self.spec_k:
             args = args + (self._acc_hist,)
@@ -575,10 +640,10 @@ class ContinuousDecoder:
             args = (self._W,) + args
         out = self._step(*args)
         if self.spec_k:
-            (self._kc, self._vc, self._pos, self._prev, self._gen,
+            (self._caches, self._pos, self._prev, self._gen,
              self._acc_hist) = out
         else:
-            (self._kc, self._vc, self._pos, self._prev, self._gen) = out
+            (self._caches, self._pos, self._prev, self._gen) = out
 
     def _apply_admit(self, slot, req):
         seed_row = np.zeros((self._n_view,), np.int32)
@@ -594,9 +659,9 @@ class ContinuousDecoder:
                 np.int32(len(req.seed)),
                 np.int32(len(req.pages) * self.page_size))
         else:
-            (self._kc, self._vc, self._pos, self._active, self._seeds,
+            (self._caches, self._pos, self._active, self._seeds,
              self._seed_len, self._gen) = self._admit_fn(
-                self._kc, self._vc, self._pos, self._active, self._seeds,
+                self._caches, self._pos, self._active, self._seeds,
                 self._seed_len, self._gen, np.int32(slot), seed_row,
                 np.int32(len(req.seed)))
 
@@ -744,6 +809,7 @@ class ContinuousDecoder:
             n = int(n)
             if n > 0:
                 self._m_accept.observe_n(float(a), n)
+                self._accept_counts[a] += n
                 self.spec_windows += n
                 self.spec_accepted += n * a
 
@@ -810,6 +876,9 @@ class ContinuousDecoder:
                 extra.update(prefix_hits=self._prefix.hits,
                              prefix_misses=self._prefix.misses,
                              prefix_pages=self._prefix.pages_reused)
+        if self.kv_quant != "off":
+            extra.update(kv_quant=self.kv_quant,
+                         kv_bytes_per_token=self.kv_bytes_per_token)
         if self.spec_k:
             extra.update(spec_k=self.spec_k,
                          spec_windows=self.spec_windows,
@@ -842,15 +911,24 @@ class ContinuousDecoder:
                "live_hwm": self.live_hwm,
                "n_pos": self.n_pos, "paged": self.paged,
                "sync_interval": self.sync_interval, "tp": self.tp,
-               "name": self.name}
+               "name": self.name, "kv_quant": self.kv_quant,
+               "kv_bytes_per_token": self.kv_bytes_per_token}
         if self.paged:
             out["pool"] = self._pool.stats()
             if self._prefix is not None:
                 out["prefix"] = self._prefix.stats()
         if self.spec_k:
+            counts = self._accept_counts
+            total = int(counts.sum())
+            p50 = None
+            if total:
+                p50 = int(np.searchsorted(np.cumsum(counts),
+                                          (total + 1) // 2))
             out.update(spec_k=self.spec_k,
                        spec_windows=self.spec_windows,
                        spec_accepted=self.spec_accepted,
+                       accept_hist=[int(c) for c in counts],
+                       accept_p50=p50,
                        accept_mean=(self.spec_accepted
                                     / max(1, self.spec_windows)))
         return out

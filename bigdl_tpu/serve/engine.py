@@ -69,6 +69,13 @@ _STAGE_DEPTH = 2
 #: default engine names: unique per process so registry series never
 #: collide between replicas that share one process
 _ENGINE_SEQ = itertools.count()
+#: count of pinned-policy warmups currently holding the process dtype
+#: policy swapped (warmup() below): while > 0 the ambient policy is a
+#: TRANSIENT trace-time state, not a drift — _check_policy_drift
+#: suspends so a serving ambient-policy engine does not false-positive
+#: against a sibling engine's compilation window
+_PIN_LOCK = threading.Lock()
+_PIN_DEPTH = 0
 
 
 def max_batch_default() -> int:
@@ -108,6 +115,16 @@ class PoisonedRequestError(ValueError):
     fails — the rest of the micro-batch is served normally."""
 
 
+class DTypePolicyDriftError(RuntimeError):
+    """The process-global dtype policy changed between this engine's
+    warmup and a submit.  The warmed executables were traced under the
+    OLD policy (the policy is baked in at trace time — engine.__init__'s
+    ``policy`` caveat), so serving on would silently answer with
+    stale-precision outputs; failing the submit loudly makes the caller
+    either restore the policy, pin one via ``ServeEngine(policy=...)``,
+    or build a fresh engine under the new policy."""
+
+
 class SheddedError(RuntimeError):
     """The request was rejected by admission control (engine queue bound
     or router overload policy) instead of being served past its
@@ -131,13 +148,28 @@ class ServeEngine:
     concurrent training/tracing on other threads, pass ``input_shape``
     so the whole warmup happens synchronously at construction on the
     calling thread — lazy warmup would otherwise briefly apply the
-    serving policy to traces racing it.
+    serving policy to traces racing it.  The converse drift — the
+    PROCESS policy changing after an ambient-policy engine warmed — is
+    caught at submit: :class:`DTypePolicyDriftError` instead of
+    silently serving stale-precision executables.
+
+    ``quant`` (default from ``BIGDL_SERVE_QUANT``: off/int8/fp8) serves
+    per-channel quantized weights (docs/serving.md "Quantized
+    serving"): the capture quantizes ``model.params()`` through a
+    :class:`~bigdl_tpu.quant.WeightQuantizer` (pass ``calibration`` — a
+    ``quant.calibrate.Calibration`` — to arm the activation-aware clip
+    search), the executables take ``(qweights, scales)`` as ARGUMENTS
+    and dequantize on the fly, and every staged rollout re-quantizes
+    with the same recipe, so hot weight swaps never recompile.  The
+    quant recipe rides the executable-cache function key — quantized
+    and full-precision replicas of one architecture never collide.
     """
 
     def __init__(self, model, max_batch: int | None = None,
                  max_wait_ms: float | None = None, policy=None,
                  input_shape=None, input_dtype=np.float32,
-                 max_queue: int | None = None, name: str | None = None):
+                 max_queue: int | None = None, name: str | None = None,
+                 quant: str | None = None, calibration=None):
         import jax
 
         self.model = model
@@ -153,10 +185,23 @@ class ServeEngine:
         self.max_queue = int(max_queue) if max_queue else None
         self.buckets = bucketing.bucket_sizes(self.max_batch)
         self._policy = policy
+        from bigdl_tpu import quant as quant_mod
+        from bigdl_tpu.quant.weights import ON_MODES as _WEIGHT_MODES
+        self.quant = (quant_mod.weight_mode_default() if quant is None
+                      else quant_mod.normalize_mode(
+                          quant, _WEIGHT_MODES, "quant"))
+        #: maps fp params -> the {"q", "scale"} pack the executables
+        #: take; None on the full-precision path.  May raise
+        #: UnsupportedQuantError here (fp8 capability gate) — at
+        #: construction, never from inside a trace.
+        self._quantizer = None
+        if self.quant != "off":
+            self._quantizer = quant_mod.WeightQuantizer(
+                model, self.quant, calibration=calibration)
         # (params, state) swap as ONE tuple so a refresh/commit racing
         # the compute thread can never pair new params with old state —
         # the half-swap audit tests/test_serve.py holds refresh() to
-        self._weights = (jax.device_put(model.params()),
+        self._weights = (jax.device_put(self._capture(model.params())),
                          jax.device_put(model.state()))
         self.weights_version = 0
         self._staged = None      # (version, (params, state)) or None
@@ -166,12 +211,25 @@ class ServeEngine:
         # eval fn the validators use (optim.local_optimizer._eval_fn) —
         # warmup resolves each bucket through the SHARED executable
         # cache (serve/xcache.py), so a process that validates AND
-        # serves a common (model, shape) pair compiles it exactly once
-        from bigdl_tpu.optim.local_optimizer import _eval_fn
-        self._fwd = _eval_fn(model)
+        # serves a common (model, shape) pair compiles it exactly once.
+        # The quantized path gets its own fn (dequant-in-forward) under
+        # a fn_key extended with the quant recipe: same cache, disjoint
+        # keys.
+        if self._quantizer is not None:
+            from bigdl_tpu.quant.weights import quantized_eval_fn
+            self._fwd = quantized_eval_fn(model, self._quantizer)
+        else:
+            from bigdl_tpu.optim.local_optimizer import _eval_fn
+            self._fwd = _eval_fn(model)
         self._executables: dict = {}   # bucket -> compiled executable
         self._row_shape = None
         self._row_dtype = None
+        #: the dtype-policy the warmed executables were traced under
+        #: (None until the first warmup, or always when ``policy`` pins
+        #: one): submit() refuses to serve across a process-policy
+        #: drift (DTypePolicyDriftError)
+        self._warm_policy_obj = None
+        self._warm_policy_key = None
 
         self._lock = threading.Lock()
         self._closed = False
@@ -239,7 +297,7 @@ class ServeEngine:
         self._compute.start()
         self._emit("start", max_batch=self.max_batch,
                    max_wait_ms=self.max_wait_s * 1e3,
-                   buckets=list(self.buckets))
+                   buckets=list(self.buckets), quant=self.quant)
 
     # -- registry-backed counter views (monotonic; see __init__) ------------
     @property
@@ -268,6 +326,16 @@ class ServeEngine:
     def compiles(self) -> int:
         return int(self._m_compiles.value)
 
+    def _capture(self, params):
+        """Params as the executables expect them: quantized to the
+        ``{"q", "scale"}`` pack when this engine serves quantized, the
+        fp tree otherwise.  Capture, refresh and every staged rollout
+        funnel through here, so a hot swap onto a quantized replica
+        re-quantizes with the SAME recipe."""
+        if self._quantizer is None:
+            return params
+        return self._quantizer.quantize(params)
+
     # -- compilation --------------------------------------------------------
     def warmup(self, row_shape: tuple, row_dtype=np.float32):
         """Pre-lower-and-compile EVERY bucket for rows of ``row_shape``.
@@ -289,12 +357,40 @@ class ServeEngine:
                     f"engine is warmed for rows {self._row_shape} "
                     f"{self._row_dtype}, not {row_shape} {row_dtype}")
         fresh = 0
+        global _PIN_DEPTH
         from bigdl_tpu import tensor as bt
         from bigdl_tpu.serve import xcache
         prev = bt.policy()
         if self._policy is not None:
+            with _PIN_LOCK:
+                _PIN_DEPTH += 1
             bt.set_policy(self._policy)
         try:
+            # record the policy the traces below bake in; submit()
+            # compares against it so a later process-policy flip fails
+            # fast instead of serving stale-precision executables.
+            # Recorded ONLY by the warmup that starts populating the
+            # ladder: a re-warmup that compiles nothing must not adopt
+            # a drifted key (the existing executables keep their old
+            # precision — re-recording would silently defeat the
+            # guard), and compiling MORE buckets under a drifted key
+            # would mix precisions within one engine — refuse both.
+            cur_key = xcache._policy_key()
+            with self._lock:
+                have = bool(self._executables)
+            if not have:
+                with self._lock:
+                    self._warm_policy_obj = bt.policy()
+                    self._warm_policy_key = cur_key
+            elif (self._policy is None
+                    and cur_key != self._warm_policy_key):
+                raise DTypePolicyDriftError(
+                    f"cannot re-warm engine {self.name!r} under a "
+                    f"drifted dtype policy: its executables were "
+                    f"traced under (param/compute/output)="
+                    f"{self._warm_policy_key}, the process policy is "
+                    f"now {cur_key}.  Restore the policy or build a "
+                    f"fresh engine.")
             params, state = self._weights
             for b in self.buckets:
                 if b in self._executables:
@@ -317,6 +413,8 @@ class ServeEngine:
         finally:
             if self._policy is not None:
                 bt.set_policy(prev)
+                with _PIN_LOCK:
+                    _PIN_DEPTH -= 1
         return fresh
 
     def refresh(self):
@@ -338,8 +436,12 @@ class ServeEngine:
         """Phase 1 of a rollout: pin a new (params, state) pair to device
         WITHOUT serving it.  Serving continues on the committed weights;
         a staged pair costs HBM but no latency.  Shapes must match the
-        warmed executables (params are executable ARGUMENTS)."""
+        warmed executables (params are executable ARGUMENTS).  On a
+        quantized engine the incoming FULL-PRECISION tree is quantized
+        here with the capture recipe, so rollouts ship fp weights and
+        every replica applies its own precision."""
         import jax
+        params = self._capture(params)
         cur = self._weights[0]
         if jax.tree_util.tree_structure(params) != \
                 jax.tree_util.tree_structure(cur):
@@ -419,7 +521,13 @@ class ServeEngine:
         A request whose payload is non-finite fails its OWN future with
         :class:`PoisonedRequestError` (the rest of its micro-batch is
         served) — stricter than the pre-engine Predictor loop, which
-        forwarded NaN/Inf rows to the model silently."""
+        forwarded NaN/Inf rows to the model silently.
+
+        Raises :class:`DTypePolicyDriftError` when the process dtype
+        policy no longer matches the one the warmed executables were
+        traced under (engines constructed with an explicit ``policy``
+        pin their own and are immune to process drift)."""
+        self._check_policy_drift()
         req = _Request(np.asarray(x), trace=trace)
         # closed-check and enqueue under the lock: close() flips _closed
         # under the same lock, so a request can never slip into the
@@ -449,6 +557,39 @@ class ServeEngine:
             req.future.set_exception(SheddedError(
                 f"engine queue full ({self.max_queue} requests)"))
         return req.future
+
+    def _check_policy_drift(self):
+        """Fail fast when the ambient dtype policy drifted since warmup
+        (the docstring caveat made loud).  Engines with an explicit
+        ``policy`` re-pin it around every trace, so only ambient-policy
+        engines can drift.  Identity fast path first — the hot submit
+        path pays one ``is`` check."""
+        if self._policy is not None or self._warm_policy_obj is None:
+            return
+        if _PIN_DEPTH:
+            # a sibling engine's pinned-policy warmup holds the process
+            # policy swapped for the duration of its compilation; that
+            # transient is trace-time state, not a drift of THIS
+            # engine's ambient policy — it restores on exit
+            return
+        from bigdl_tpu import tensor as bt
+        cur = bt.policy()
+        if cur is self._warm_policy_obj:
+            return
+        from bigdl_tpu.serve import xcache
+        key = xcache._policy_key()
+        if key == self._warm_policy_key:
+            # same dtypes under a different policy object: executables
+            # are still precision-correct — adopt the new identity
+            self._warm_policy_obj = cur
+            return
+        raise DTypePolicyDriftError(
+            f"dtype policy drifted since warmup: engine {self.name!r} "
+            f"compiled its executables under "
+            f"(param/compute/output)={self._warm_policy_key} but the "
+            f"process policy is now {key}.  Restore the policy, pin one "
+            f"with ServeEngine(policy=...), or build a fresh engine "
+            f"under the new policy.")
 
     def submit_many(self, rows) -> list:
         """Queue an iterable of rows; returns their futures in order."""
@@ -683,6 +824,7 @@ class ServeEngine:
             "errors": failed,
             "compiles": self.compiles,
             "weights_version": version,
+            "quant": self.quant,
             "queue_depth": queue_depth,
             "max_queue_depth": max_depth,
             "bucket_hits": {b: int(c.value)

@@ -25,6 +25,13 @@ A matched request still re-feeds at least its last seed position — the
 first generated token comes from the logits there — so a match is
 capped at ``len(seed) - 1`` positions.
 
+Quantized pools (``BIGDL_SERVE_KV_QUANT``, docs/serving.md "Quantized
+serving") need no cooperation from this cache: the per-page-row scale
+arrays are indexed by PHYSICAL page id exactly like the value pools
+(``quant/kv.py``), so donating a page id ships its scales with it and
+a hit dequantizes to bit-identical K/V — the hit-vs-cold output
+equality contract survives quantization unchanged.
+
 Eviction is LRU over chain entries whose page nobody else holds
 (refcount 1 = cache-only); the decoder evicts on demand when an
 admission cannot find free pages.  Evicting a mid-chain entry strands

@@ -21,7 +21,12 @@
 #     with draft-k self-speculation — ZERO cold compiles after
 #     construction (xcache compile counter + jit trap), prefix
 #     hit-rate > 0 on the shared-prompt wave, every token equal to
-#     serial lm_decode.
+#     serial lm_decode;
+#   - quantized serving drill: the same mixed stream through int8 KV
+#     pages + a calibrated int8-weight engine — greedy drift within
+#     the declared budget, prefix hit-rate and spec acceptance equal
+#     to the fp run within tolerance, zero cold compiles — plus
+#     tools/quant_check.py --strict pinning top1/top5 within budget.
 #
 #   scripts/serve_smoke.sh              # full set + drills
 #   scripts/serve_smoke.sh -k deadline  # narrow (skips the drills)
@@ -29,9 +34,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-python -m pytest -q -m "serve and not slow" \
+python -m pytest -q -m "(serve or quant) and not slow" \
     -p no:cacheprovider -p no:randomly \
-    tests/test_serve.py tests/test_serve_cluster.py \
+    tests/test_serve.py tests/test_serve_cluster.py tests/test_quant.py \
     "$@"
 
 # The narrowed form is a targeted check; the drill needs the full run.
@@ -140,6 +145,90 @@ print(f"OK: 24 mixed-length paged+spec requests, zero cold compiles "
       f"accept mean {st['accept_mean']:.2f}/{st['spec_k']}, "
       f"pool hwm {st['pool']['in_use_hwm']}/{st['pool']['pages']} pages")
 PY
+
+echo "== serve smoke: quantized serving drill =="
+python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu import quant
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.serve import ServeEngine, xcache
+from bigdl_tpu.serve.decode import ContinuousDecoder
+from bigdl_tpu.utils.random import set_seed
+
+set_seed(1)
+model = TransformerLM(vocab_size=128, d_model=64, n_heads=4, n_layers=2,
+                      hidden=128)
+rng = np.random.RandomState(0)
+SYS = [7, 3, 9, 1, 5, 2, 8, 4]
+reqs = []
+for i in range(20):
+    if i % 2:
+        reqs.append(SYS + rng.randint(1, 128, 1 + i % 3).tolist())
+    else:
+        reqs.append(rng.randint(1, 128, 2 + i % 5).tolist())
+n_words = 6
+oracle = [lm_decode(model, s, n_words) for s in reqs]
+
+def drill(kv_quant):
+    dec = ContinuousDecoder(model, max_slots=6, n_pos=24,
+                            sync_interval=2, page_size=4,
+                            prefix_cache=True, spec_k=3,
+                            kv_quant=kv_quant)
+    warm = xcache.get().stats()["compiles"]
+    futs = [dec.submit(s, n_words) for s in reqs[:10]]
+    dec.run()
+    futs += [dec.submit(s, n_words) for s in reqs[10:]]
+    dec.run()
+    rows = [f.result(timeout=60) for f in futs]
+    assert xcache.get().stats()["compiles"] == warm, \
+        f"cold compile on the {kv_quant} stream"
+    st = dec.stats()
+    dec.close()
+    return rows, st
+
+fp_rows, fp_st = drill("off")
+q_rows, q_st = drill("int8")
+assert fp_rows == oracle, "fp decode lost parity"
+agree = np.mean([np.mean(np.asarray(a[len(s):]) == np.asarray(b[len(s):]))
+                 for a, b, s in zip(q_rows, oracle, reqs)])
+assert agree >= 1.0 - quant.KV_TOKEN_DRIFT_BUDGET, \
+    f"int8-KV drift {1-agree:.3f} over budget"
+for key in ("hits", "misses"):
+    assert q_st["prefix"][key] == fp_st["prefix"][key], (key, q_st, fp_st)
+assert abs(q_st["accept_mean"] - fp_st["accept_mean"]) <= 1.0
+assert (q_st["accept_p50"] is None or fp_st["accept_p50"] is None
+        or abs(q_st["accept_p50"] - fp_st["accept_p50"]) <= 1)
+density = fp_st["kv_bytes_per_token"] / q_st["kv_bytes_per_token"]
+
+# int8-weight engine over the LM's head-sized scoring problem
+import bigdl_tpu.nn as nn
+set_seed(2)
+score = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
+                      nn.Linear(32, 8), nn.LogSoftMax())
+rows = np.random.RandomState(1).randn(40, 16).astype(np.float32)
+fp_eng = ServeEngine(score, max_batch=8, max_wait_ms=1,
+                     input_shape=(16,), name="smoke-fp")
+q_eng = ServeEngine(score, max_batch=8, max_wait_ms=1,
+                    input_shape=(16,), name="smoke-q", quant="int8")
+warm = q_eng.compiles
+out_fp, out_q = fp_eng.predict(rows), q_eng.predict(rows)
+assert q_eng.compiles == warm, "cold compile on the quantized engine"
+assert np.array_equal(np.argmax(out_fp, 1), np.argmax(out_q, 1)), \
+    "int8 weights flipped a prediction"
+fp_eng.close(); q_eng.close()
+print(f"OK: 20 mixed paged+spec requests at int8 KV "
+      f"({density:.1f}x tokens/byte): token agreement {agree:.1%}, "
+      f"prefix hits {q_st['prefix']['hits']} == fp, accept mean "
+      f"{q_st['accept_mean']:.2f} vs fp {fp_st['accept_mean']:.2f}, "
+      f"zero cold compiles; int8-weight engine argmax-identical over "
+      f"{len(rows)} rows")
+PY
+
+echo "== serve smoke: quant_check accuracy budget =="
+python tools/quant_check.py --strict --iterations 50 --image-size 16
 
 echo "== serve smoke: 2-replica router drill + hot weight swap =="
 python - <<'PY'

@@ -460,13 +460,17 @@ class TestBenchDecodeSweepContract:
         for key in ("model", "mode", "impl", "offered", "tokens",
                     "wall_s", "tok_per_s", "tok_per_s_per_slot",
                     "live_max", "slots", "pool_tokens", "spec_k",
-                    "accept_mean", "prefix_hits", "compiles"):
+                    "accept_mean", "accept_p50", "prefix_hits",
+                    "compiles", "quant", "kv_quant", "pool_bytes"):
             assert key in d, key
         assert d["mode"] == "decode_sweep" and d["impl"] == "paged"
         assert d["tok_per_s"] == pytest.approx(240.0)
         assert d["live_max"] == 6
         assert d["tok_per_s_per_slot"] == pytest.approx(40.0)
         assert d["pool_tokens"] == 96
+        # no kv_quant/bytes info in the stats: columns default, not KeyError
+        assert d["quant"] == "off" and d["kv_quant"] == "off"
+        assert d["pool_bytes"] is None
 
     def test_decode_sweep_row_slab(self):
         bench = _tool("bench_serve")
@@ -474,6 +478,20 @@ class TestBenchDecodeSweepContract:
         row = bench.decode_sweep_row("slab", 8, 120, 0.5, stats, 3)
         assert row["impl"] == "slab" and row["pool_tokens"] is None
         assert row["spec_k"] == 0 and row["prefix_hits"] == 0
+
+    def test_decode_sweep_row_kv_quant(self):
+        """The quant columns ride the decoder stats: kv_quant mode and
+        the pooled-token HBM budget in bytes (pool_tokens x
+        bytes/token incl. per-page-row scales)."""
+        bench = _tool("bench_serve")
+        stats = {"slots": 8, "live_hwm": 8, "paged": True,
+                 "kv_quant": "int8", "kv_bytes_per_token": 320,
+                 "pool": {"pages": 24, "page_size": 4, "in_use": 0,
+                          "free": 24, "in_use_hwm": 20}}
+        row = bench.decode_sweep_row("paged[int8]", 16, 120, 0.5,
+                                     stats, 0)
+        assert row["kv_quant"] == "int8"
+        assert row["pool_bytes"] == 96 * 320
 
 
 class TestTensorParallelPaged:

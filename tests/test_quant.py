@@ -1,0 +1,582 @@
+"""Quantized-serving suite (docs/serving.md "Quantized serving",
+markers ``quant`` + ``serve``).
+
+The tentpole contracts:
+
+- per-channel int8 round-trip error is bounded by ``amax_c / 254`` per
+  output channel on Linear / conv / attention-projection weights (fp8
+  by the e4m3 relative step where the XLA supports it — otherwise the
+  capability gate reports cleanly);
+- the activation-aware clip search never does worse than plain min-max
+  on the activation-weighted error it optimizes;
+- a quantized ServeEngine serves logits close to the fp engine, rides
+  the shared executable cache under a DISJOINT key (the quant recipe is
+  in the fn_key), keeps the zero-cold-compile invariant, and
+  re-quantizes staged rollouts with the capture recipe;
+- int8 KV pages: the quantized pool's dequantized contents match the
+  fp pool within the per-head bound; greedy decode is deterministic and
+  page-size-robust (including a page size that does not divide n_pos);
+  a prefix hit over QUANTIZED pages reproduces the cold-prefill output
+  exactly; speculative decode commits EXACTLY the non-speculative
+  quantized stream for every draft length k; TP shards the scale
+  arrays with the pools and stays bit-identical to single-device;
+- zero cold compiles after construction on a quantized decode stream
+  (xcache counter + jax.jit trap);
+- the calibration sweep collects per-input-channel amax through the
+  real module tree and lands the ``quant_calib_*`` gauges.
+"""
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.quant import weights as wq
+from bigdl_tpu.quant import kv as kvq
+from bigdl_tpu.serve import ServeEngine, xcache
+from bigdl_tpu.serve.decode import ContinuousDecoder, continuous_decode
+from bigdl_tpu.utils.random import set_seed
+
+pytestmark = [pytest.mark.quant, pytest.mark.serve]
+
+
+def _tool(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def lm():
+    set_seed(1)
+    return TransformerLM(vocab_size=11, d_model=16, n_heads=2,
+                         n_layers=2, hidden=32)
+
+
+SEEDS = [[1, 2, 3], [4, 5], [6], [7, 8, 9, 10], [2, 4]]
+
+
+# ---------------------------------------------------------------------------
+# weight quantization
+# ---------------------------------------------------------------------------
+
+class TestWeightRoundTrip:
+    def _bound_check(self, w, out_axis):
+        q, s = wq.quantize_channelwise(w, out_axis, "int8")
+        assert q.dtype == np.int8
+        dq = q.astype(np.float32) * s
+        err = np.abs(np.asarray(w, np.float32) - dq)
+        red = tuple(i for i in range(w.ndim) if i != out_axis)
+        amax = np.max(np.abs(w), axis=red, keepdims=True)
+        # symmetric int8: worst case half a step = amax/254 per channel
+        assert np.all(err <= amax / 254.0 + 1e-7)
+
+    def test_linear_weight_bound(self):
+        set_seed(1)
+        self._bound_check(np.asarray(nn.Linear(32, 16).params()
+                                     ["~"]["weight"]), 0)
+
+    def test_conv_weight_bound(self):
+        set_seed(1)
+        conv = nn.SpatialConvolution(3, 8, 3, 3)
+        self._bound_check(np.asarray(conv.params()["~"]["weight"]), 0)
+
+    def test_attention_projection_bound(self):
+        set_seed(1)
+        attn = nn.MultiHeadSelfAttention(16, 2)
+        for name in ("wq", "wk", "wv", "wo"):
+            self._bound_check(np.asarray(attn.params()["~"][name]), 1)
+
+    def test_per_channel_scales_are_per_channel(self):
+        w = np.stack([np.linspace(-1, 1, 8),
+                      np.linspace(-100, 100, 8)]).astype(np.float32)
+        q, s = wq.quantize_channelwise(w, 0, "int8")
+        # wildly different channel ranges -> different scales; a
+        # per-tensor scheme would crush the small channel to ~nothing
+        assert s[0, 0] * 50 < s[1, 0]
+        dq = q.astype(np.float32) * s
+        assert np.max(np.abs(w[0] - dq[0])) <= 1.0 / 127 + 1e-6
+
+    def test_fp8_gate_and_bound(self):
+        if not wq.supports_fp8():
+            with pytest.raises(wq.UnsupportedQuantError):
+                wq.quantize_channelwise(np.ones((2, 2), np.float32), 0,
+                                        "fp8")
+            return
+        set_seed(1)
+        w = np.asarray(nn.Linear(32, 16).params()["~"]["weight"])
+        q, s = wq.quantize_channelwise(w, 0, "fp8")
+        dq = np.asarray(q, np.float32) * s
+        # e4m3: 3 mantissa bits -> relative step <= 2^-3 of the value,
+        # plus the absolute floor near zero from the scaled subnormals
+        amax = np.max(np.abs(w), axis=1, keepdims=True)
+        assert np.all(np.abs(w - dq) <= np.abs(w) / 8 + amax / 224)
+
+    def test_quantize_params_structure(self, lm):
+        quantizer = wq.WeightQuantizer(lm, "int8")
+        params = lm.params()
+        pack = quantizer.quantize(params)
+        assert (jax.tree_util.tree_structure(pack["q"])
+                == jax.tree_util.tree_structure(params))
+        flat_q = jax.tree_util.tree_leaves(pack["q"])
+        n_int8 = sum(1 for leaf in flat_q
+                     if np.dtype(getattr(leaf, "dtype", None)) == np.int8)
+        # embedding + head Linear, 2 FFN Linears and 4 attention
+        # projections per block
+        assert n_int8 == len(quantizer.leaves) == 2 + 2 * 6
+        dq = wq.dequantize_params(pack)
+        for a, b in zip(jax.tree_util.tree_leaves(dq), flat_q):
+            assert np.shape(a) == np.shape(b)
+        # biases / LayerNorm weights untouched (bit-identical)
+        assert np.array_equal(dq["0"]["0"]["~"]["bias"],
+                              np.asarray(params["0"]["0"]["~"]["bias"]))
+
+    def test_unquantizable_model_raises(self):
+        m = nn.Sequential(nn.ReLU(True))
+        with pytest.raises(ValueError, match="no quantizable leaves"):
+            wq.WeightQuantizer(m, "int8")
+
+
+class TestCalibration:
+    def _toy_dataset(self, n=8, dim=6):
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.dataset.sample import Sample
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+        rng = np.random.RandomState(3)
+        recs = [Sample(rng.randn(dim).astype(np.float32) * (i + 1),
+                       float(i % 2) + 1) for i in range(n)]
+        return DataSet.array(recs) >> SampleToBatch(4)
+
+    def test_collect_amax_matches_manual(self):
+        from bigdl_tpu.quant import calibrate
+        set_seed(1)
+        model = nn.Sequential(nn.Linear(6, 4), nn.Tanh(),
+                              nn.Linear(4, 2), nn.LogSoftMax())
+        ds = self._toy_dataset()
+        calib = calibrate.collect(model, ds, max_batches=2)
+        # first Linear sits at module path ("0",): its recorded amax is
+        # the max |input| per input column over both batches
+        xs = np.concatenate([np.asarray(b.data)
+                             for b in list(ds.data(train=False))[:2]])
+        want = np.max(np.abs(xs), axis=0)
+        got = calib.amax[("0",)]
+        assert got == pytest.approx(want)
+        assert calib.n_batches == 2 and calib.n_records == 8
+
+    def test_calibration_gauges(self):
+        from bigdl_tpu.obs import metrics as obs_metrics
+        from bigdl_tpu.quant import calibrate
+        set_seed(1)
+        model = nn.Sequential(nn.Linear(6, 4), nn.LogSoftMax())
+        calibrate.collect(model, self._toy_dataset(), max_batches=1)
+        snap = obs_metrics.get().snapshot()
+        assert obs_metrics.family_total(snap, "quant_calib_batches") == 1
+        assert obs_metrics.family_total(snap, "quant_calib_layers") == 1
+
+    def test_clip_search_not_worse_on_weighted_error(self):
+        """The clip search minimizes the activation-weighted error over
+        ratios INCLUDING 1.0 (= plain min-max), so it can only tie or
+        improve that metric."""
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype(np.float32)
+        w[0, 0] = 12.0                       # an outlier worth clipping
+        act = np.abs(rng.randn(16)).astype(np.float32)
+
+        def weighted_err(q, s):
+            dq = q.astype(np.float32) * s
+            return float(np.sum(np.abs(w - dq) * act[None, :]))
+
+        plain = weighted_err(*wq.quantize_channelwise(w, 0, "int8"))
+        calibd = weighted_err(*wq.quantize_channelwise(
+            w, 0, "int8", act_amax=act, in_axis=1))
+        assert calibd <= plain + 1e-6
+
+    def test_taps_restore_on_error(self):
+        from bigdl_tpu.nn.linear import Linear
+        from bigdl_tpu.quant.calibrate import _activation_taps
+        orig = Linear._forward
+        with pytest.raises(RuntimeError):
+            with _activation_taps({}):
+                assert Linear._forward is not orig
+                raise RuntimeError("boom")
+        assert Linear._forward is orig
+
+
+class TestQuantEngine:
+    def _model(self):
+        set_seed(1)
+        return nn.Sequential(nn.Linear(4, 16), nn.Tanh(),
+                             nn.Linear(16, 3), nn.LogSoftMax())
+
+    def test_quantized_outputs_close_and_keys_disjoint(self):
+        model = self._model()
+        rows = np.random.RandomState(0).randn(12, 4).astype(np.float32)
+        fp = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                         input_shape=(4,), name="qfp")
+        out_fp = fp.predict(rows)
+        compiles_fp = xcache.get().stats()["compiles"]
+        q = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                        input_shape=(4,), name="qq", quant="int8")
+        # the quant recipe is in the fn_key: warming the quantized
+        # engine COMPILED fresh executables, it did not collide with
+        # (and silently serve) the fp entries
+        assert xcache.get().stats()["compiles"] > compiles_fp
+        out_q = q.predict(rows)
+        assert np.max(np.abs(out_fp - out_q)) < 0.05
+        assert np.array_equal(np.argmax(out_fp, 1), np.argmax(out_q, 1))
+        assert q.stats()["quant"] == "int8"
+        assert fp.stats()["quant"] == "off"
+        fp.close()
+        q.close()
+
+    def test_zero_cold_compiles_after_warmup(self):
+        model = self._model()
+        q = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                        input_shape=(4,), quant="int8")
+        warm = q.compiles
+        assert warm == len(q.buckets)
+        rows = np.random.RandomState(1).randn(11, 4).astype(np.float32)
+        for burst in (1, 4, 2, 3, 1):
+            futs = q.submit_many(rows[:burst])
+            [f.result(timeout=30) for f in futs]
+        assert q.compiles == warm
+        q.close()
+
+    def test_rollout_requantizes_with_capture_recipe(self):
+        model = self._model()
+        q = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                        input_shape=(4,), quant="int8")
+        row = np.ones((4,), np.float32)
+        before = q.submit(row).result(timeout=30)
+        p2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 1.5,
+                                    model.params())
+        q.stage_weights(p2, model.state())
+        version = q.commit_weights()
+        after = q.submit(row).result(timeout=30)
+        assert version == 1 and not np.allclose(before, after)
+        # staged pack kept int8 leaf dtypes (quantized at stage, so the
+        # warmed executables' avals still match — no recompile)
+        leaf = q._weights[0]["q"]["0"]["~"]["weight"]
+        assert np.dtype(leaf.dtype) == np.int8
+        q.revert_weights()
+        assert np.allclose(q.submit(row).result(timeout=30), before)
+        q.close()
+
+    def test_fp8_capability_path(self):
+        model = self._model()
+        if not wq.supports_fp8():
+            with pytest.raises(wq.UnsupportedQuantError,
+                               match="unsupported on this XLA"):
+                ServeEngine(model, max_batch=4, input_shape=(4,),
+                            quant="fp8")
+            return
+        q = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                        input_shape=(4,), quant="fp8")
+        fp = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                         input_shape=(4,))
+        rows = np.random.RandomState(0).randn(6, 4).astype(np.float32)
+        assert np.max(np.abs(fp.predict(rows) - q.predict(rows))) < 0.2
+        q.close()
+        fp.close()
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_SERVE_QUANT", "int8")
+        q = ServeEngine(self._model(), max_batch=2, max_wait_ms=1,
+                        input_shape=(4,))
+        assert q.quant == "int8" and q._quantizer is not None
+        q.close()
+        monkeypatch.setenv("BIGDL_SERVE_QUANT", "int4")
+        with pytest.raises(ValueError, match="BIGDL_SERVE_QUANT"):
+            ServeEngine(self._model(), max_batch=2, input_shape=(4,))
+
+
+# ---------------------------------------------------------------------------
+# int8 KV pages
+# ---------------------------------------------------------------------------
+
+class TestKVQuantStorage:
+    def test_pool_round_trip_bound(self, lm):
+        """Drive the quantized window forward directly against a fp
+        twin: every written pool row dequantizes within amax/254 of the
+        fp value (per head — the scale granularity)."""
+        from bigdl_tpu.models.transformer import (_lm_forward_window,
+                                                  _lm_handles)
+        import jax.numpy as jnp
+        handles = _lm_handles(lm)
+        L, H, hd = handles.n_layers, handles.n_heads, handles.hd
+        ps, n_pages, B, S = 4, 6, 2, 3
+        pe = jnp.asarray(lm.modules[1].table(2 * ps))
+        ptab = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        tok = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        i = jnp.asarray([[0, 1, 2], [0, 1, 2]], jnp.int32)
+        z = jnp.zeros
+        fp_caches = (z((L, n_pages, ps, H, hd)),
+                     z((L, n_pages, ps, H, hd)))
+        q_caches = (z((L, n_pages, ps, H, hd), jnp.int8),
+                    z((L, n_pages, ps, H, hd), jnp.int8),
+                    z((L, n_pages, ps, H), jnp.float32),
+                    z((L, n_pages, ps, H), jnp.float32))
+        logp_fp, (kf, vf) = _lm_forward_window(
+            tok, i, fp_caches, handles, pe, (ptab, ps))
+        logp_q, (kq, vq, ks, vs) = _lm_forward_window(
+            tok, i, q_caches, handles, pe, (ptab, ps))
+        kf, vf = np.asarray(kf), np.asarray(vf)
+        dq_k = np.asarray(kq, np.float32) * np.asarray(ks)[..., None]
+        dq_v = np.asarray(vq, np.float32) * np.asarray(vs)[..., None]
+        # the exact per-head bound holds at LAYER 0, where both runs
+        # compute identical pre-quant K/V (deeper layers legitimately
+        # diverge a little: their inputs already carry layer-0's
+        # dequant noise)
+        for fp_pool, dq in ((kf[0], dq_k[0]), (vf[0], dq_v[0])):
+            amax = np.max(np.abs(fp_pool), axis=-1, keepdims=True)
+            assert np.all(np.abs(fp_pool - dq) <= amax / 254 + 1e-7)
+        # deeper layers stay close (noise compounds but stays tiny)
+        assert np.max(np.abs(kf - dq_k)) < 0.05
+        assert np.max(np.abs(vf - dq_v)) < 0.05
+        # quantized logits stay close to fp on this tiny window
+        assert np.max(np.abs(np.asarray(logp_fp)
+                             - np.asarray(logp_q))) < 0.5
+
+    def test_bytes_per_token_accounting(self):
+        # fp: 2 pools * H*hd f32; int8 adds the per-head scale rows
+        assert kvq.bytes_per_token(2, 4, 16, "off") == 2 * 2 * 64 * 4
+        assert kvq.bytes_per_token(2, 4, 16, "int8") == 2 * 2 * (64 + 16)
+        assert (kvq.bytes_per_token(2, 4, 16, "off")
+                / kvq.bytes_per_token(2, 4, 16, "int8")) > 3
+
+    def test_slab_mode_rejects_kv_quant(self, lm):
+        with pytest.raises(ValueError, match="paged"):
+            ContinuousDecoder(lm, max_slots=2, n_pos=8, paged=False,
+                              kv_quant="int8")
+        with pytest.raises(ValueError, match="quantization mode"):
+            ContinuousDecoder(lm, max_slots=2, n_pos=8,
+                              kv_quant="int4")
+
+
+class TestKVQuantDecode:
+    @pytest.fixture()
+    def serial(self, lm):
+        return [lm_decode(lm, s, 5, greedy=True) for s in SEEDS]
+
+    @pytest.mark.parametrize("ps", [4, 5, 16])
+    def test_quantized_decode_shape_and_drift(self, lm, serial, ps):
+        """Across page sizes (5 does not divide n_pos=9): right lengths,
+        deterministic, and drift on this TINY near-flat-logit model
+        still leaves most tokens on the fp stream."""
+        rows = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=2, page_size=ps,
+                                 prefix_cache=False, kv_quant="int8")
+        again = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                  sync_interval=2, page_size=ps,
+                                  prefix_cache=False, kv_quant="int8")
+        assert rows == again                    # deterministic
+        agree = np.mean([np.mean(np.asarray(r[len(s):])
+                                 == np.asarray(o[len(s):]))
+                         for r, o, s in zip(rows, serial, SEEDS)])
+        assert all(len(r) == len(o) for r, o in zip(rows, serial))
+        assert agree >= 0.6
+
+    def test_bench_model_holds_token_parity(self):
+        """At the bench model's width (d=64) the int8-KV error sits far
+        below the argmax margins: the greedy stream matches fp exactly
+        — the drift budget the --decode-sweep --check enforces."""
+        set_seed(1)
+        model = TransformerLM(vocab_size=128, d_model=64, n_heads=4,
+                              n_layers=2, hidden=128)
+        rng = np.random.RandomState(0)
+        seeds = [rng.randint(1, 128, rng.randint(2, 6)).tolist()
+                 for _ in range(6)]
+        oracle = [lm_decode(model, s, 8) for s in seeds]
+        rows = continuous_decode(model, seeds, 8, max_slots=3, n_pos=16,
+                                 page_size=8, prefix_cache=False,
+                                 kv_quant="int8")
+        assert rows == oracle
+
+    @pytest.mark.parametrize("k", [1, 2, 3, 5])
+    def test_spec_identity_with_quantized_draft(self, lm, k):
+        """Speculative decode over int8 KV commits EXACTLY the
+        non-speculative quantized stream for every k: rejected draft
+        positions are overwritten value+scale by the next verify
+        window, so no draft outlier can coarsen a page (quant/kv.py)."""
+        base = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=2, page_size=4,
+                                 prefix_cache=False, kv_quant="int8")
+        spec = continuous_decode(lm, SEEDS, 5, max_slots=2, n_pos=9,
+                                 sync_interval=2, page_size=4,
+                                 prefix_cache=False, kv_quant="int8",
+                                 spec_k=k)
+        assert spec == base
+
+    def test_prefix_hit_with_quantized_pages(self, lm):
+        """A prefix hit over int8 pages reproduces the cold-prefill
+        QUANTIZED output exactly: donated pages carry their scale rows
+        (pool-indexed), so the reused K/V dequantizes bit-identically."""
+        sys_p = [7, 3, 9, 1, 5, 2, 8, 4]
+        seeds = [sys_p + [2], sys_p + [5], sys_p + [3, 7]]
+        cold = continuous_decode(lm, seeds, 4, max_slots=3, n_pos=14,
+                                 sync_interval=2, page_size=4,
+                                 prefix_cache=False, kv_quant="int8")
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=14,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=True, kv_quant="int8")
+        f0 = dec.submit(seeds[0], 4)
+        dec.run()
+        futs = [dec.submit(s, 4) for s in seeds[1:]]
+        dec.run()
+        assert f0.result() == cold[0]
+        assert [f.result() for f in futs] == cold[1:]
+        assert dec.stats()["prefix"]["hits"] >= 2
+        dec.close()
+
+    def test_spec_prefix_quant_stack(self, lm):
+        """All three at once — speculative windows over prefix-shared
+        quantized pages — still equals the plain quantized stream."""
+        sys_p = [7, 3, 9, 1]
+        seeds = [sys_p + [2], sys_p + [5]]
+        base = continuous_decode(lm, seeds, 4, max_slots=2, n_pos=10,
+                                 sync_interval=2, page_size=2,
+                                 prefix_cache=False, kv_quant="int8")
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=10,
+                                sync_interval=2, page_size=2,
+                                prefix_cache=True, spec_k=2,
+                                kv_quant="int8")
+        futs = [dec.submit(s, 4) for s in seeds]
+        dec.run()
+        futs2 = [dec.submit(s, 4) for s in seeds]
+        dec.run()
+        assert [f.result() for f in futs] == base
+        assert [f.result() for f in futs2] == base
+        assert dec.stats()["prefix"]["hits"] >= 2
+        dec.close()
+
+    def test_zero_cold_compiles_on_quantized_stream(self, lm):
+        """Construction warms every program; a mixed quantized stream
+        (including admissions and retirements) never builds another —
+        xcache counter AND jit trap."""
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=True, spec_k=2,
+                                kv_quant="int8")
+        warm = xcache.get().stats()["compiles"]
+        calls, real_jit = [], jax.jit
+        jax.jit = lambda fn, *a, **kw: (calls.append(fn),
+                                        real_jit(fn, *a, **kw))[1]
+        try:
+            futs = [dec.submit(s, 5) for s in SEEDS]
+            dec.run()
+            futs += [dec.submit(s, 3) for s in SEEDS[:2]]
+            dec.run()
+        finally:
+            jax.jit = real_jit
+        assert all(f.done() for f in futs)
+        assert not calls, "quantized decode built a jit program mid-stream"
+        assert xcache.get().stats()["compiles"] == warm
+        dec.close()
+
+    def test_fp_and_quant_decoders_never_share_programs(self, lm):
+        """The kv_quant mode rides the xcache key tail: a fp decoder
+        and a quantized decoder over one model compile disjoint
+        programs (dtype differences would reject anyway — the key keeps
+        the compile counter truthful)."""
+        d1 = ContinuousDecoder(lm, max_slots=2, n_pos=9, page_size=4,
+                               prefix_cache=False)
+        c1 = xcache.get().stats()["compiles"]
+        d2 = ContinuousDecoder(lm, max_slots=2, n_pos=9, page_size=4,
+                               prefix_cache=False, kv_quant="int8")
+        assert xcache.get().stats()["compiles"] > c1
+        d1.close()
+        d2.close()
+
+    def test_telemetry(self, lm):
+        from bigdl_tpu.obs import metrics as obs_metrics
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=False, kv_quant="int8")
+        futs = [dec.submit(s, 5) for s in SEEDS[:2]]
+        dec.run()
+        st = dec.stats()
+        assert st["kv_quant"] == "int8"
+        L, H, hd = 2, 2, 8
+        assert st["kv_bytes_per_token"] == kvq.bytes_per_token(
+            L, H, hd, "int8")
+        snap = obs_metrics.get().snapshot()
+        got = obs_metrics.family_total(snap, "decode_kv_bytes_per_token")
+        assert got == st["kv_bytes_per_token"]
+        assert all(f.done() for f in futs)
+        dec.close()
+
+    def test_env_default(self, lm, monkeypatch):
+        monkeypatch.setenv("BIGDL_SERVE_KV_QUANT", "int8")
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9, page_size=4)
+        assert dec.kv_quant == "int8"
+        dec.close()
+        # the env opts the PAGED pool in: a slab decoder under the same
+        # env (the --decode-sweep A/B baseline) quietly serves fp
+        slab = ContinuousDecoder(lm, max_slots=2, n_pos=9, paged=False)
+        assert slab.kv_quant == "off"
+        slab.close()
+        monkeypatch.setenv("BIGDL_SERVE_KV_QUANT", "fp8")
+        with pytest.raises(ValueError, match="BIGDL_SERVE_KV_QUANT"):
+            ContinuousDecoder(lm, max_slots=2, n_pos=9, page_size=4)
+
+
+class TestKVQuantTensorParallel:
+    @pytest.fixture()
+    def mesh(self):
+        from bigdl_tpu.parallel.mesh import hybrid_mesh
+        return hybrid_mesh(dp=1, mp=2, devices=jax.devices()[:2])
+
+    def test_tp_quantized_matches_single_device(self, lm, mesh):
+        """Per-head scale arrays shard on the head dim with the pools
+        (same PartitionSpec, zero cross-shard traffic), so TP quantized
+        decode is bit-identical to the single-device quantized stream —
+        speculative windows included."""
+        sd = continuous_decode(lm, SEEDS[:3], 5, max_slots=2, n_pos=9,
+                               sync_interval=3, page_size=4,
+                               prefix_cache=False, kv_quant="int8")
+        tp = continuous_decode(lm, SEEDS[:3], 5, max_slots=2, n_pos=9,
+                               sync_interval=3, mesh=mesh, page_size=4,
+                               prefix_cache=False, kv_quant="int8")
+        assert tp == sd
+        tps = continuous_decode(lm, SEEDS[:3], 5, max_slots=2, n_pos=9,
+                                sync_interval=3, mesh=mesh, page_size=4,
+                                prefix_cache=False, kv_quant="int8",
+                                spec_k=2)
+        assert tps == sd
+
+
+# ---------------------------------------------------------------------------
+# the accuracy harness (tools/quant_check.py)
+# ---------------------------------------------------------------------------
+
+class TestQuantCheckTool:
+    def test_harness_pins_budget_on_synth_folder(self, tmp_path):
+        qc = _tool("quant_check")
+        qc.synth_image_folder(str(tmp_path), size=16)
+        rows = qc.main(["--data", str(tmp_path), "--iterations", "40",
+                        "--image-size", "16", "--mode", "int8",
+                        "--strict"])
+        (row,) = rows
+        assert row["mode"] == "int8" and row["supported"]
+        assert row["passed"]
+        assert row["quantized"]["top1"] >= row["baseline"]["top1"] - 0.02
+
+    def test_fp8_mode_reports_capability(self, tmp_path):
+        qc = _tool("quant_check")
+        qc.synth_image_folder(str(tmp_path), size=16, per_class=3)
+        rows = qc.main(["--data", str(tmp_path), "--iterations", "30",
+                        "--image-size", "16", "--mode", "fp8"])
+        (row,) = rows
+        if wq.supports_fp8():
+            assert row["supported"]
+        else:
+            # the capability gate reports cleanly instead of tracing
+            assert not row["supported"]
+            assert "unsupported on this XLA" in row["reason"]
+            assert row["passed"]

@@ -462,6 +462,117 @@ class TestContinuousDecode:
         assert isinstance(f.exception(), RequestTooLongError)
 
 
+class TestPolicyDrift:
+    """The dtype policy is process-global at trace time (the engine
+    docstring caveat) — serving across a policy flip must fail LOUDLY
+    at submit, never silently answer with stale-precision executables."""
+
+    def test_ambient_policy_drift_fails_submit(self):
+        from bigdl_tpu import tensor as bt
+        from bigdl_tpu.serve import DTypePolicyDriftError
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                          input_shape=(4,))
+        row = np.ones((4,), np.float32)
+        eng.submit(row).result(timeout=30)
+        prev = bt.policy()
+        try:
+            bt.set_policy(bt.BF16_COMPUTE)
+            with pytest.raises(DTypePolicyDriftError):
+                eng.submit(row)
+        finally:
+            bt.set_policy(prev)
+        # restoring the policy restores service (no re-warm needed)
+        out = eng.submit(row).result(timeout=30)
+        assert out.shape == (3,)
+        eng.close()
+
+    def test_rewarm_under_drifted_policy_cannot_clear_the_guard(self):
+        """A no-op re-warmup after a policy flip must not re-record the
+        policy (nothing retraced — the old executables keep their old
+        precision): warmup refuses, and submit still refuses after."""
+        from bigdl_tpu import tensor as bt
+        from bigdl_tpu.serve import DTypePolicyDriftError
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                          input_shape=(4,))
+        prev = bt.policy()
+        try:
+            bt.set_policy(bt.BF16_COMPUTE)
+            with pytest.raises(DTypePolicyDriftError):
+                eng.warmup((4,))
+            with pytest.raises(DTypePolicyDriftError):
+                eng.submit(np.ones((4,), np.float32))
+        finally:
+            bt.set_policy(prev)
+        eng.close()
+
+    def test_equivalent_policy_object_is_not_drift(self):
+        """A NEW policy object with the same three dtypes is fine —
+        the executables' precision is unchanged."""
+        from bigdl_tpu import tensor as bt
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                          input_shape=(4,))
+        prev = bt.policy()
+        try:
+            bt.set_policy(bt.DTypePolicy())    # same dtypes as FP32
+            out = eng.submit(np.ones((4,), np.float32)).result(timeout=30)
+            assert out.shape == (3,)
+        finally:
+            bt.set_policy(prev)
+        eng.close()
+
+    def test_sibling_pinned_warmup_window_is_not_drift(self):
+        """While a sibling engine's pinned-policy warmup holds the
+        process policy swapped (a compilation-long transient), an
+        ambient engine's submits must NOT false-positive — and the
+        guard re-arms the moment the window closes."""
+        from bigdl_tpu import tensor as bt
+        from bigdl_tpu.serve import DTypePolicyDriftError
+        from bigdl_tpu.serve import engine as engine_mod
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                          input_shape=(4,))
+        row = np.ones((4,), np.float32)
+        prev = bt.policy()
+        try:
+            # simulate the sibling's warmup window: policy swapped AND
+            # the pin depth held (exactly what warmup(policy=...) does)
+            engine_mod._PIN_DEPTH += 1
+            bt.set_policy(bt.BF16_COMPUTE)
+            out = eng.submit(row).result(timeout=30)
+            assert out.shape == (3,)
+        finally:
+            bt.set_policy(prev)
+            engine_mod._PIN_DEPTH -= 1
+        # a REAL drift (no pin held) still trips
+        try:
+            bt.set_policy(bt.BF16_COMPUTE)
+            with pytest.raises(DTypePolicyDriftError):
+                eng.submit(row)
+        finally:
+            bt.set_policy(prev)
+        eng.close()
+
+    def test_pinned_policy_engine_is_immune(self):
+        """An engine constructed with an explicit policy re-pins it
+        around every trace; the process policy flipping underneath is
+        not its problem."""
+        from bigdl_tpu import tensor as bt
+        model = _small_model()
+        eng = ServeEngine(model, max_batch=4, max_wait_ms=1,
+                          input_shape=(4,), policy=bt.BF16_COMPUTE)
+        prev = bt.policy()
+        try:
+            bt.set_policy(bt.BF16_ACT)
+            out = eng.submit(np.ones((4,), np.float32)).result(timeout=30)
+            assert out.shape == (3,)
+        finally:
+            bt.set_policy(prev)
+        eng.close()
+
+
 class TestPredictorRegression:
     """First-ever regression coverage for the Predictor surface."""
 

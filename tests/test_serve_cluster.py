@@ -617,9 +617,14 @@ class TestBenchRouterContract:
         for key in ("model", "mode", "replicas", "offered_rps",
                     "requests", "completed", "shed", "shed_rate",
                     "throughput_rps", "p50_ms", "p95_ms", "p99_ms",
-                    "per_replica"):
+                    "per_replica", "quant", "kv_quant"):
             assert key in d, key
         assert d["mode"] == "router" and d["replicas"] == 2
+        # quant columns default off so downstream parsing of pre-quant
+        # invocations never breaks
+        assert d["quant"] == "off" and d["kv_quant"] == "off"
+        assert bench_serve.router_row("lenet", 2, point, stats, 0.1,
+                                      quant="int8")["quant"] == "int8"
         assert len(d["per_replica"]) == 2
         for pr in d["per_replica"]:
             for key in ("name", "completed", "rps", "shed", "alive"):

@@ -189,10 +189,13 @@ def router_point(pool, rows, rate, slo_ms):
 
 
 def router_row(model_name, replicas, point, replica_stats,
-               wall_s) -> dict:
+               wall_s, quant="off", kv_quant="off") -> dict:
     """The pinned JSON contract for one ``--replicas`` sweep point:
-    aggregate throughput/latency/shed plus a per-replica breakdown.
-    ``tests/test_serve_cluster.py`` keeps this shape honest."""
+    aggregate throughput/latency/shed plus a per-replica breakdown and
+    the replica weight-quant recipe (``quant``/``kv_quant`` — KV quant
+    never applies to the scoring path, the column keeps the row shape
+    uniform with the decode sweep).  ``tests/test_serve_cluster.py``
+    keeps this shape honest."""
     per_replica = [{"name": s.get("name", f"r{i}"),
                     "completed": s.get("completed", 0),
                     "rps": (s.get("completed", 0) / wall_s
@@ -201,7 +204,8 @@ def router_row(model_name, replicas, point, replica_stats,
                     "alive": s.get("alive", True)}
                    for i, s in enumerate(replica_stats)]
     return {"model": model_name, "mode": "router",
-            "replicas": replicas, **point, "per_replica": per_replica}
+            "replicas": replicas, "quant": quant, "kv_quant": kv_quant,
+            **point, "per_replica": per_replica}
 
 
 def bench_router(args):
@@ -213,7 +217,7 @@ def bench_router(args):
     pool = ReplicaPool(model, n_replicas=args.replicas,
                        max_batch=args.max_batch,
                        max_wait_ms=args.max_wait_ms, input_shape=shape,
-                       slo_ms=args.slo_ms or None)
+                       slo_ms=args.slo_ms or None, quant=args.quant)
     try:
         pool.predict(rows[:args.max_batch])          # warm every bucket
         prev = [r.stats() for r in pool.replicas]
@@ -233,7 +237,8 @@ def bench_router(args):
                       for i, (r, p, c) in enumerate(
                           zip(pool.replicas, prev, cur))]
             prev = cur
-            row = router_row(args.model, args.replicas, pt, deltas, wall)
+            row = router_row(args.model, args.replicas, pt, deltas, wall,
+                             quant=args.quant)
             points.append(row)
             print(f"bench_serve: {json.dumps(row)}")
         rstats = pool.router.stats()
@@ -267,13 +272,15 @@ def bench_scoring(args):
     print(f"bench_serve: {json.dumps({'model': args.model, **base})}")
 
     eng = ServeEngine(model, max_batch=args.max_batch,
-                      max_wait_ms=args.max_wait_ms, input_shape=shape)
+                      max_wait_ms=args.max_wait_ms, input_shape=shape,
+                      quant=args.quant)
     try:
         eng.predict(rows[:eng.max_batch])        # warm every hot bucket
         points = []
         for rate in args.loads:
             pt = engine_point(eng, rows, rate)
             pt["compiles"] = eng.stats()["compiles"]
+            pt["quant"] = args.quant
             points.append(pt)
             print(f"bench_serve: {json.dumps({'model': args.model, **pt})}")
         stats = eng.stats()
@@ -347,28 +354,39 @@ def bench_decode(args):
 def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
                      compiles) -> dict:
     """The pinned JSON contract for one ``--decode-sweep`` point:
-    throughput per live slot plus the paging/prefix/speculation
+    throughput per live slot plus the paging/prefix/speculation/quant
     counters that explain it.  ``tests/test_paged_decode.py`` keeps
     this shape honest."""
     live = dec_stats.get("live_hwm") or dec_stats["slots"]
     pool = dec_stats.get("pool") or {}
     prefix = dec_stats.get("prefix") or {}
     rate = tokens / wall_s if wall_s else 0.0
+    pool_tokens = pool["pages"] * pool["page_size"] if pool else None
+    bpt = dec_stats.get("kv_bytes_per_token")
     return {"model": "transformer", "mode": "decode_sweep", "impl": impl,
             "offered": offered, "tokens": tokens, "wall_s": wall_s,
             "tok_per_s": rate,
             "tok_per_s_per_slot": rate / max(1, live),
             "live_max": live, "slots": dec_stats["slots"],
-            "pool_tokens": (pool["pages"] * pool["page_size"]
-                            if pool else None),
+            "pool_tokens": pool_tokens,
+            # the quant columns: weight mode (decode serves fp weights),
+            # KV-page mode, and the pooled-token HBM budget in BYTES —
+            # the quantity held constant across fp-vs-int8 points
+            "quant": dec_stats.get("quant", "off"),
+            "kv_quant": dec_stats.get("kv_quant", "off"),
+            "pool_bytes": (pool_tokens * bpt
+                           if pool_tokens is not None and bpt else None),
             "spec_k": dec_stats.get("spec_k", 0),
             "accept_mean": dec_stats.get("accept_mean"),
+            "accept_p50": dec_stats.get("accept_p50"),
             "prefix_hits": prefix.get("hits", 0),
             "compiles": compiles}
 
 
 def bench_decode_sweep(args):
+    from bigdl_tpu import quant
     from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+    from bigdl_tpu.quant import kv as kvq
     from bigdl_tpu.serve import xcache
     from bigdl_tpu.serve.decode import ContinuousDecoder
     from bigdl_tpu.utils.random import set_seed
@@ -385,6 +403,7 @@ def bench_decode_sweep(args):
     # the FIXED HBM budget both implementations get: what the slab holds
     pool_pages = slab_slots * (-(-n_pos // ps))
     toks = len(seeds) * n_words
+    kv_quant = args.kv_quant
 
     # serial oracle (and scan warmup per distinct seed length)
     for length in {len(s) for s in seeds}:
@@ -399,10 +418,17 @@ def bench_decode_sweep(args):
         futs = [dec.submit(s, n_words) for s in seeds]
         dec.run()
         wall = time.perf_counter() - t0
-        parity = [f.result() for f in futs] == oracle
+        rows = [f.result() for f in futs]
+        # per-token agreement with the serial fp oracle over the
+        # GENERATED tail: 1.0 on every fp point (exact parity contract);
+        # quantized-KV points may drift within the declared budget
+        agree = float(np.mean([
+            np.mean(np.asarray(r[len(s):]) == np.asarray(o[len(s):]))
+            for r, o, s in zip(rows, oracle, seeds)]))
         row = decode_sweep_row(impl, offered, toks, wall, dec.stats(),
                                xcache.get().stats()["compiles"] - c0)
-        row["parity"] = parity
+        row["parity"] = rows == oracle
+        row["agreement"] = agree
         dec.close()
         print(f"bench_serve: {json.dumps(row)}")
         return row
@@ -419,25 +445,66 @@ def bench_decode_sweep(args):
                      spec_k=args.spec_k)
     points.append(spec)
 
+    qpoints = []
+    qspec = None
+    if kv_quant != "off":
+        # int8 KV points at the SAME pooled-token HBM BUDGET: the fp
+        # pool's bytes re-divided by the quantized bytes/token (scales
+        # included), so extra live concurrency is pure density win
+        from bigdl_tpu.models.transformer import _lm_handles
+        h = _lm_handles(model)
+        budget_bytes = pool_pages * ps * kvq.bytes_per_token(
+            h.n_layers, h.n_heads, h.hd, "off")
+        pages_q = budget_bytes // (ps * kvq.bytes_per_token(
+            h.n_layers, h.n_heads, h.hd, kv_quant))
+        for offered in (2 * slab_slots, 4 * slab_slots,
+                        8 * slab_slots):
+            qpoints.append(run_point(
+                f"paged[{kv_quant}]", offered, max_slots=offered,
+                page_size=ps, n_pages=pages_q, prefix_cache=False,
+                kv_quant=kv_quant))
+        qspec = run_point(f"paged+spec[{kv_quant}]", 4 * slab_slots,
+                          max_slots=4 * slab_slots, page_size=ps,
+                          n_pages=pages_q, prefix_cache=True,
+                          spec_k=args.spec_k, kv_quant=kv_quant)
+        qpoints.append(qspec)
+        points += qpoints
+
     slab = points[0]
     print(f"\ntransformer decode sweep (pool {pool_pages} pages x {ps} "
-          f"tokens = slab {slab_slots} x {n_pos}):")
+          f"tokens = slab {slab_slots} x {n_pos}"
+          + (f"; kv_quant={kv_quant}" if kv_quant != "off" else "")
+          + "):")
     for pt in points:
-        print(f"  {pt['impl']:<10} offered {pt['offered']:>3}: "
+        print(f"  {pt['impl']:<12} offered {pt['offered']:>3}: "
               f"{pt['live_max']:>3} live max, "
               f"{pt['tok_per_s']:8.1f} tok/s "
               f"({pt['tok_per_s_per_slot']:.1f}/slot), "
-              f"parity {'OK' if pt['parity'] else 'FAIL'}, "
+              f"agreement {pt['agreement']:.3f}, "
               f"cold compiles {pt['compiles']}"
               + (f", accept mean {pt['accept_mean']:.2f}"
                  if pt["spec_k"] else ""))
     scaled = [p for p in points if p["impl"] == "paged"
               and p["offered"] > slab_slots]
     best_live = max(p["live_max"] for p in scaled)
+    # the fp pool's live bound is only MEASURED when some fp point is
+    # pool-bound (live < offered — admission queued on page exhaustion);
+    # an offered-limited ladder underestimates it, which would make the
+    # quant density ratio below spuriously strict
+    fp_saturated = any(p["live_max"] < p["offered"] for p in scaled)
     print(f"  live-concurrency: slab bound {slab['live_max']}, paged "
-          f"reaches {best_live} on the same pooled tokens")
+          f"reaches {best_live} on the same pooled tokens"
+          + ("" if fp_saturated else
+             " (fp pool never saturated at this offered ladder)"))
+    if qpoints:
+        best_live_q = max(p["live_max"] for p in qpoints)
+        print(f"  {kv_quant} KV at the same HBM budget: {best_live_q} "
+              f"live ({best_live_q / max(1, best_live):.2f}x the fp-KV "
+              f"bound), agreement >= "
+              f"{min(p['agreement'] for p in qpoints):.3f}")
     if args.check:
-        if not all(p["parity"] for p in points):
+        fp_points = [p for p in points if p["kv_quant"] == "off"]
+        if not all(p["parity"] for p in fp_points):
             raise SystemExit("decode sweep lost token parity")
         if best_live <= slab["live_max"]:
             raise SystemExit(
@@ -447,6 +514,34 @@ def bench_decode_sweep(args):
             raise SystemExit(
                 f"speculative stream hit {spec['compiles']} cold "
                 f"compiles after warmup")
+        if qpoints:
+            if not fp_saturated:
+                print("  note: density gate not evaluable — the fp "
+                      "pool never saturated at this offered ladder; "
+                      "raise --requests or lower --decode-npos to "
+                      "measure the fp live bound")
+            elif best_live_q < 1.8 * best_live:
+                raise SystemExit(
+                    f"{kv_quant} KV live-concurrency {best_live_q} < "
+                    f"1.8x the fp bound {best_live} at equal HBM")
+            worst = min(p["agreement"] for p in qpoints)
+            if worst < 1.0 - quant.KV_TOKEN_DRIFT_BUDGET:
+                raise SystemExit(
+                    f"{kv_quant} KV greedy drift {1 - worst:.3f} "
+                    f"exceeds the declared budget "
+                    f"{quant.KV_TOKEN_DRIFT_BUDGET}")
+            if qspec["compiles"]:
+                raise SystemExit(
+                    f"quantized speculative stream hit "
+                    f"{qspec['compiles']} cold compiles after warmup")
+            if (spec["accept_p50"] is not None
+                    and qspec["accept_p50"] is not None
+                    and abs(spec["accept_p50"]
+                            - qspec["accept_p50"]) > 1):
+                raise SystemExit(
+                    f"quantized spec acceptance p50 "
+                    f"{qspec['accept_p50']} drifted more than one "
+                    f"bucket from fp {spec['accept_p50']}")
     return points
 
 
@@ -474,6 +569,13 @@ def main():
                     help="KV page size (tokens) for the sweep")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft length for the speculative sweep point")
+    ap.add_argument("--quant", default=None,
+                    choices=("off", "int8", "fp8"),
+                    help="weight quantization for the scoring/router "
+                         "engines (default: BIGDL_SERVE_QUANT)")
+    ap.add_argument("--kv-quant", default=None, choices=("off", "int8"),
+                    help="KV-page quantization for the decode sweep "
+                         "(default: BIGDL_SERVE_KV_QUANT)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="> 1 sweeps a ReplicaPool behind the SLO "
                          "router instead of one engine")
@@ -484,6 +586,11 @@ def main():
                     help="fail unless batched >= 2x serial throughput")
     args = ap.parse_args()
     args.loads = [float(tok) for tok in str(args.loads).split(",") if tok]
+    from bigdl_tpu import quant as _quant
+    if args.quant is None:
+        args.quant = _quant.weight_mode_default()
+    if args.kv_quant is None:
+        args.kv_quant = _quant.kv_mode_default()
 
     if args.decode_sweep:
         bench_decode_sweep(args)

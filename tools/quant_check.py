@@ -1,0 +1,203 @@
+"""Quantization accuracy harness: calibrate, quantize, and pin
+top1/top5 against the fp32 baseline within the declared budget
+(docs/serving.md "Quantized serving"; the adoption gate for
+``BIGDL_SERVE_QUANT``).
+
+The drill is the real-data loop (``models/utils/real_data.py`` — decode
+actual image files through the framework pipeline, train the small
+convnet, evaluate with ``Top1Accuracy``/``Top5Accuracy``), then:
+
+1. **calibrate**: one eval sweep with activation taps installed
+   (``quant/calibrate.py``) collects per-input-channel amax AND the
+   fp32 baseline metrics in the same pass;
+2. **quantize**: per-channel int8 (and fp8 ``e4m3`` when the installed
+   XLA supports it — the capability gate reports "unsupported on this
+   XLA" cleanly instead of failing) with the activation-aware clip
+   search;
+3. **evaluate**: the SAME ``optim.validate`` loop over the dequantized
+   pack — mathematically the exact values a quantized ServeEngine
+   serves (dequant is deterministic) — and assert top1/top5 within
+   ``bigdl_tpu.quant.WEIGHT_TOP1_BUDGET`` / ``WEIGHT_TOP5_BUDGET`` of
+   the baseline.
+
+``--data`` points at any class-per-subfolder image directory (the
+reference's shipped CIFAR PNG folders are the canonical input); without
+one, a deterministic synthetic PNG folder is generated so the harness
+runs anywhere Pillow does.  One JSON line per mode (``quant_check:``
+prefix) plus a summary table; ``--strict`` exits non-zero on a budget
+violation (wired into ``scripts/serve_smoke.sh``).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os as _os
+import sys as _sys
+import tempfile
+
+import numpy as np
+
+_REPO = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+_sys.path.insert(0, _REPO)
+
+
+def synth_image_folder(root: str, n_classes: int = 2, per_class: int = 4,
+                       size: int = 16, seed: int = 7) -> str:
+    """Write a deterministic class-per-subfolder PNG set: each class is
+    a distinct base color plus pixel noise, so the small convnet
+    separates them in a few dozen iterations.  Real files through the
+    real decode path — the harness exercises the same pipeline as the
+    reference-shipped CIFAR folders."""
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    base = rng.randint(30, 220, (n_classes, 3))
+    for c in range(n_classes):
+        d = _os.path.join(root, f"class{c}")
+        _os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            img = np.clip(base[c] + rng.randint(-40, 40, (size, size, 3)),
+                          0, 255).astype(np.uint8)
+            Image.fromarray(img).save(_os.path.join(d, f"{i}.png"))
+    return root
+
+
+def _dataset(folder: str, image_size: int, batch: int):
+    from bigdl_tpu.dataset.image import ImgToBatch
+    from bigdl_tpu.models.utils.real_data import _byte_record_dataset
+    ds, recs, n_classes = _byte_record_dataset(folder, image_size)
+    return ds >> ImgToBatch(min(batch, len(recs))), len(recs), n_classes
+
+
+def _accuracy(results) -> dict:
+    (_, top1), (_, top5) = results
+    return {"top1": round(float(top1.result()[0]), 4),
+            "top5": round(float(top5.result()[0]), 4)}
+
+
+def run_mode(model, batched, calib, mode: str, budget_top1: float,
+             budget_top5: float, baseline: dict) -> dict:
+    """Quantize under ``mode`` (with the calibration) and evaluate the
+    dequantized pack through the shared validate loop.  Returns the
+    pinned JSON row for this mode."""
+    from bigdl_tpu.optim import Top1Accuracy, Top5Accuracy, validate
+    from bigdl_tpu.quant import (UnsupportedQuantError, WeightQuantizer,
+                                 dequantize_params)
+
+    row = {"mode": mode, "baseline": baseline,
+           "budget": {"top1": budget_top1, "top5": budget_top5}}
+    try:
+        quantizer = WeightQuantizer(model, mode, calibration=calib)
+    except UnsupportedQuantError as e:
+        # the capability gate: report cleanly, never a trace failure
+        row.update(supported=False, reason=str(e), passed=True)
+        return row
+    pack = quantizer.quantize(model.params())
+    qparams = dequantize_params(pack)
+    results = validate(model, qparams, model.state(), batched,
+                       [Top1Accuracy(), Top5Accuracy()])
+    acc = _accuracy(results)
+    row.update(supported=True, quantized=acc,
+               leaves=len(quantizer.leaves),
+               drop_top1=round(baseline["top1"] - acc["top1"], 4),
+               drop_top5=round(baseline["top5"] - acc["top5"], 4))
+    row["passed"] = (row["drop_top1"] <= budget_top1
+                     and row["drop_top5"] <= budget_top5)
+    return row
+
+
+def main(argv=None):
+    from bigdl_tpu import quant
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--data", default=None,
+                    help="class-per-subfolder image directory (default: "
+                         "a deterministic synthetic PNG set)")
+    ap.add_argument("--mode", default="both",
+                    choices=("int8", "fp8", "both"))
+    ap.add_argument("--image-size", type=int, default=16)
+    ap.add_argument("--iterations", type=int, default=60,
+                    help="training iterations for the fp baseline model")
+    ap.add_argument("--calib-batches", type=int, default=4)
+    ap.add_argument("--budget-top1", type=float,
+                    default=quant.WEIGHT_TOP1_BUDGET)
+    ap.add_argument("--budget-top5", type=float,
+                    default=quant.WEIGHT_TOP5_BUDGET)
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero when any supported mode misses "
+                         "the accuracy budget")
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.models.utils.real_data import (
+        train_and_eval_image_folder)
+
+    tmp = None
+    folder = args.data
+    if folder is None:
+        tmp = tempfile.TemporaryDirectory(prefix="quant_check_")
+        folder = synth_image_folder(tmp.name, size=args.image_size)
+
+    try:
+        # fp32 baseline: decode -> train -> validate (the model comes
+        # back trained in place, so the quantizer sees the real
+        # weights).  Class count comes from the folder LISTING — no
+        # image decode; the pixels are decoded by the train pass and
+        # once more for the calibration/eval dataset below.
+        from bigdl_tpu.dataset.dataset import DataSet
+        from bigdl_tpu.models.utils.real_data import small_convnet
+        paths = DataSet.image_folder(folder).data(train=False)
+        n_classes = len({lab for p, lab in paths if p.lower().endswith(
+            (".png", ".jpeg", ".jpg", ".bmp"))})
+        model = small_convnet(n_classes, args.image_size)
+        fp = train_and_eval_image_folder(
+            folder, image_size=args.image_size,
+            iterations=args.iterations, model=model)
+        baseline = {"top1": fp["top1"], "top5": fp["top5"]}
+
+        # calibration sweep: activation amax over the eval split (the
+        # accuracy anchor is the FULL-set validate above — the sweep's
+        # optional methods= pass is not needed here)
+        from bigdl_tpu.quant import calibrate
+        batched, n_records, _ = _dataset(folder, args.image_size, 32)
+        calib = calibrate.collect(model, batched,
+                                  max_batches=args.calib_batches)
+
+        modes = ("int8", "fp8") if args.mode == "both" else (args.mode,)
+        rows, failed = [], []
+        for mode in modes:
+            row = run_mode(model, batched, calib, mode,
+                           args.budget_top1, args.budget_top5, baseline)
+            rows.append(row)
+            print(f"quant_check: {json.dumps(row)}")
+            if not row["passed"]:
+                failed.append(mode)
+
+        print(f"\nquant_check over {n_records} records "
+              f"({len(calib)} calibrated layers, "
+              f"{calib.n_batches} calibration batches):")
+        print(f"  fp32 baseline: top1 {baseline['top1']:.4f}  "
+              f"top5 {baseline['top5']:.4f}")
+        for row in rows:
+            if not row["supported"]:
+                print(f"  {row['mode']:>5}: unsupported on this XLA "
+                      f"(capability gate) — skipped")
+                continue
+            acc = row["quantized"]
+            print(f"  {row['mode']:>5}: top1 {acc['top1']:.4f} "
+                  f"(drop {row['drop_top1']:+.4f})  top5 "
+                  f"{acc['top5']:.4f} (drop {row['drop_top5']:+.4f})  "
+                  f"-> {'PASS' if row['passed'] else 'FAIL'} (budget "
+                  f"{row['budget']['top1']:.3f}/{row['budget']['top5']:.3f})")
+        if failed:
+            msg = (f"quantized accuracy outside the declared budget: "
+                   f"{', '.join(failed)}")
+            if args.strict:
+                raise SystemExit(msg)
+            print(f"  WARNING: {msg}")
+        return rows
+    finally:
+        if tmp is not None:
+            tmp.cleanup()
+
+
+if __name__ == "__main__":
+    main()
