@@ -88,6 +88,8 @@ SERVE_KINDS = {
     "router_start": ("replicas",),
     "router_stop": (),
     "replica_dead": ("replica",),
+    "fleet_start": ("replicas",),
+    "fleet_stop": ("replicas",),
     "rollout_begin": ("version",),
     "rollout_commit": ("version",),
     "rollout_rollback": ("version", "phase"),

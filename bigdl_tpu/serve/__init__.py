@@ -28,7 +28,14 @@ counterpart, reusing the training stack's pipeline idioms:
   least-loaded dispatch, requeue-on-replica-death);
 - :mod:`bigdl_tpu.serve.cluster` — :class:`ReplicaPool` /
   :class:`WeightStore`: in-process or subprocess replica fleets with
-  two-phase (stage → atomic flip, rollback on failure) weight rollout.
+  two-phase (stage → atomic flip, rollback on failure) weight rollout;
+- :mod:`bigdl_tpu.serve.fleet` / :mod:`bigdl_tpu.serve.kvtier` — the
+  disaggregated decode fleet (:class:`DecodeFleet`): prefix-affinity
+  routing (dispatch to the replica whose cache holds the longest
+  matching chain), dedicated prefill replicas shipping seed KV pages
+  over the replica frames (colocated-prefill fallback on death), and a
+  per-replica host-RAM KV tier (:class:`HostKVTier`) that spills
+  evicted prefix pages D2H and re-admits them on chain-hash hit.
 
 Quantized serving (``bigdl_tpu/quant``, docs/serving.md "Quantized
 serving"): ``BIGDL_SERVE_QUANT`` serves per-channel int8/fp8 weights
@@ -48,9 +55,13 @@ Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
 ``BIGDL_SERVE_KV_QUANT`` (int8 KV pages, default off),
 ``BIGDL_SERVE_REPLICAS`` (pool size, default 2), ``BIGDL_SERVE_SLO_MS``
 (default request deadline, 0 = none), ``BIGDL_SERVE_SHED`` (overload
-shedding, default on), ``BIGDL_OBS_TRACE_SAMPLE`` (request-trace
-sample rate, default 0) and ``BIGDL_SERVE_EXPORT_PORT`` (metrics pull
-exporter — docs/observability.md "Serving telemetry").
+shedding, default on), ``BIGDL_SERVE_AFFINITY`` (prefix-affinity fleet
+dispatch, default on), ``BIGDL_SERVE_PREFILL_REPLICAS`` (dedicated
+prefill replicas, default 0), ``BIGDL_SERVE_KV_HOST_MB`` (host-RAM KV
+tier budget per decode replica, default 0 = off),
+``BIGDL_OBS_TRACE_SAMPLE`` (request-trace sample rate, default 0) and
+``BIGDL_SERVE_EXPORT_PORT`` (metrics pull exporter —
+docs/observability.md "Serving telemetry").
 """
 from bigdl_tpu.serve import bucketing, xcache  # noqa: F401
 from bigdl_tpu.serve.bucketing import (  # noqa: F401
@@ -66,10 +77,15 @@ from bigdl_tpu.serve.engine import (  # noqa: F401
     DTypePolicyDriftError, PoisonedRequestError, ServeEngine,
     SheddedError,
 )
+from bigdl_tpu.serve.fleet import (  # noqa: F401
+    AffinityIndex, DecodeFleet, DecodeReplica, FleetRouter,
+    PrefillReplica, ProcessDecodeReplica, ProcessPrefillReplica,
+)
+from bigdl_tpu.serve.kvtier import HostKVTier  # noqa: F401
 from bigdl_tpu.serve.paging import (  # noqa: F401
     PagePool, RequestTooLongError,
 )
-from bigdl_tpu.serve.prefix import PrefixCache  # noqa: F401
+from bigdl_tpu.serve.prefix import PrefixCache, chain_keys  # noqa: F401
 from bigdl_tpu.serve.router import (  # noqa: F401
     DeadReplicaError, Router,
 )
@@ -81,5 +97,7 @@ __all__ = [
     "SheddedError", "ContinuousDecoder", "continuous_decode", "Router",
     "DeadReplicaError", "ReplicaPool", "LocalReplica", "ProcessReplica",
     "WeightStore", "RolloutError", "PagePool", "PrefixCache",
-    "RequestTooLongError",
+    "RequestTooLongError", "chain_keys", "DecodeFleet", "FleetRouter",
+    "AffinityIndex", "DecodeReplica", "PrefillReplica",
+    "ProcessDecodeReplica", "ProcessPrefillReplica", "HostKVTier",
 ]

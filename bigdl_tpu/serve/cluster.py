@@ -66,6 +66,7 @@ import numpy as np
 
 from bigdl_tpu.serve.engine import (PoisonedRequestError, ServeEngine,
                                     SheddedError)
+from bigdl_tpu.serve.paging import RequestTooLongError
 from bigdl_tpu.serve.router import (DeadReplicaError, Router,
                                     replicas_default)
 
@@ -83,6 +84,8 @@ _STDERR_LINES = 256
 _EXC_TYPES = {
     "PoisonedRequestError": PoisonedRequestError,
     "SheddedError": SheddedError,
+    "DeadReplicaError": DeadReplicaError,
+    "RequestTooLongError": RequestTooLongError,
     "ValueError": ValueError,
     "RuntimeError": RuntimeError,
     "OSError": OSError,
@@ -228,7 +231,19 @@ class ProcessReplica:
     rollout verbs ride length-prefixed pickle frames over stdin/stdout.
     Process death — including a ``BIGDL_FAULTS=serve_kill@...`` chaos
     kill — fails every outstanding future with :class:`DeadReplicaError`
-    so the router can requeue them on a surviving replica."""
+    so the router can requeue them on a surviving replica.
+
+    Subclasses repoint ``_WORKER_MODULE`` / override :meth:`_init_frame`
+    to spawn a different worker over the SAME frame transport — the
+    disaggregated fleet's prefill/decode replicas (``serve/fleet.py``)
+    ride this class unchanged below the init handshake."""
+
+    #: ``python -m <module>`` entry point of the child worker
+    _WORKER_MODULE = "bigdl_tpu.serve.cluster"
+
+    def _init_frame(self, model, worker_kwargs) -> dict:
+        """The first frame shipped to the child (the spawn handshake)."""
+        return {"op": "init", "model": model, "engine": worker_kwargs}
 
     def __init__(self, model, name: str = "proc", env=None,
                  spawn_timeout: float = 120.0, **engine_kwargs):
@@ -265,7 +280,7 @@ class ProcessReplica:
         # thing a dead-replica postmortem needs (the old DEVNULL made
         # every child crash an unexplained DeadReplicaError)
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "bigdl_tpu.serve.cluster"],
+            [sys.executable, "-m", self._WORKER_MODULE],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, env=child_env)
         self._stderr_reader = threading.Thread(
@@ -273,8 +288,7 @@ class ProcessReplica:
             name=f"bigdl-serve-{name}-stderr")
         self._stderr_reader.start()
         _write_frame(self.proc.stdin,
-                     {"op": "init", "model": model,
-                      "engine": engine_kwargs}, self._wlock)
+                     self._init_frame(model, engine_kwargs), self._wlock)
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True,
                                         name=f"bigdl-serve-{name}-reader")

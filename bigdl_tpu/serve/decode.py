@@ -81,6 +81,7 @@ from __future__ import annotations
 import itertools
 import logging
 import os
+import time
 import weakref
 from collections import deque
 from concurrent.futures import Future
@@ -88,7 +89,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from bigdl_tpu.serve.paging import PagePool, RequestTooLongError
-from bigdl_tpu.serve.prefix import PrefixCache
+from bigdl_tpu.serve.prefix import PrefixCache, chain_keys
 
 logger = logging.getLogger("bigdl_tpu.serve")
 
@@ -184,6 +185,16 @@ class ContinuousDecoder:
     blocks), and ``kv_quant="int8"`` (default from
     ``BIGDL_SERVE_KV_QUANT``) int8 KV pages with per-page-row scales —
     all paged-only.
+
+    ``host_tier`` attaches a host-RAM KV tier
+    (:class:`~bigdl_tpu.serve.kvtier.HostKVTier`): prefix pages evicted
+    under allocation pressure spill D2H instead of dying, and an
+    admission whose chain walk runs past the device cache re-admits
+    matching tier pages H2D as prefix hits.  Defaults from
+    ``BIGDL_SERVE_KV_HOST_MB`` (> 0 builds an owned tier; requires the
+    paged pool with the prefix cache).  ``prefill_adopt`` pre-compiles
+    the page re-admit program so :meth:`adopt_pages` can accept KV
+    pages shipped by a prefill replica (``serve/fleet.py``).
     """
 
     def __init__(self, model, max_slots: int = 4, n_pos: int = 64,
@@ -193,7 +204,9 @@ class ContinuousDecoder:
                  prefix_cache: bool | None = None,
                  spec_k: int | None = None,
                  draft_layers: int | None = None,
-                 kv_quant: str | None = None):
+                 kv_quant: str | None = None,
+                 host_tier=None, prefill_adopt: bool = False,
+                 name: str | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -244,9 +257,26 @@ class ContinuousDecoder:
         self.draft_layers = (max(1, L // 2) if draft_layers is None
                              else min(L, max(1, int(draft_layers))))
         Ld, k = self.draft_layers, self.spec_k
+        # host-RAM KV tier: explicit instance, or owned-from-env when
+        # BIGDL_SERVE_KV_HOST_MB > 0 (spill rides the prefix cache's
+        # on_evict hook, so the tier needs paged + prefix)
+        from bigdl_tpu.serve import kvtier
+        self._tier_owned = False
+        if host_tier is None and self.paged and use_prefix:
+            mb = kvtier.host_mb_default()
+            if mb > 0:
+                host_tier = kvtier.HostKVTier(mb)
+                self._tier_owned = True
+        if host_tier is not None and not (self.paged and use_prefix):
+            raise ValueError("the host KV tier spills evicted prefix "
+                             "pages — it needs the paged pool with the "
+                             "prefix cache enabled")
+        self._tier = host_tier
         if self.paged:
             self._pool = PagePool(int(n_pages), ps)
-            self._prefix = PrefixCache(self._pool) if use_prefix else None
+            on_evict = self._spill_page if self._tier is not None else None
+            self._prefix = (PrefixCache(self._pool, on_evict=on_evict)
+                            if use_prefix else None)
             n_view = self.pages_per_slot * ps
         else:
             self._pool = self._prefix = None
@@ -526,6 +556,35 @@ class ContinuousDecoder:
             retire, ("decode_retire_" + kind, fp, B) + key_tail,
             mesh=mesh)
 
+        # page re-admit program (host-tier H2D / shipped-prefill
+        # adoption): write one host page payload into pool page ``pid``
+        # across every cache array.  ``pid`` is traced, the payload
+        # shapes are fixed, so it compiles ONCE at construction and
+        # re-admits never cold-compile mid-stream.
+        self._readmit_fn = None
+        if self.paged and (self._tier is not None or prefill_adopt):
+            def readmit(caches, pid, payload):
+                return tuple(c.at[:, pid].set(p)
+                             for c, p in zip(caches, payload))
+            if self.tp > 1:
+                from bigdl_tpu.parallel import compat
+                cache, rep = P(None, None, None, "model"), P()
+                # payload dims mirror a page slice: values (L, ps, H,
+                # hd), scales (L, ps, H) — the head dim shards exactly
+                # like the pools, so adoption ships zero cross-shard
+                pay = tuple(
+                    (P(None, None, "model", None) if i < 2
+                     else P(None, None, "model"))
+                    for i in range(n_caches))
+                readmit = compat.shard_map(
+                    readmit, mesh=mesh,
+                    in_specs=((cache,) * n_caches, rep, pay),
+                    out_specs=(cache,) * n_caches)
+            self._readmit_fn = xcache.tracked_jit(
+                readmit,
+                ("decode_readmit_" + kind, fp, B, n_pos) + key_tail,
+                mesh=mesh)
+
         z = jnp.zeros
         if self.kv_quant == "int8":
             # int8 pools + per-page-row per-head scale arrays; a fresh
@@ -566,7 +625,9 @@ class ContinuousDecoder:
         # (labelled decoder=<name>) so slot occupancy and throughput
         # show up in the fleet exporter next to the engine numbers
         from bigdl_tpu.obs import metrics as obs_metrics
-        self.name = f"decoder{next(_DECODER_SEQ)}"
+        # fleet replicas pass an explicit name so per-replica decoder
+        # series stay attributable after the child-registry merge
+        self.name = name or f"decoder{next(_DECODER_SEQ)}"
         reg = obs_metrics.get()
         lab = {"decoder": self.name}
         self._m_steps = reg.counter(
@@ -698,11 +759,122 @@ class ContinuousDecoder:
             self._apply_admit(0, warm)
         self._run_step()
         self._apply_retire(0)
+        if self._readmit_fn is not None:
+            # the readmit warm writes zeros into page 0 — unallocated at
+            # construction, and overwritten position-by-position by its
+            # next real owner before any masked-in read (same argument
+            # as the warm admission above)
+            self._caches = self._readmit_fn(
+                self._caches, np.int32(0), self._zero_page_payload())
         self._run_step()
         if self.spec_k:
             # the warm pass ran live speculative windows; exclude them
             # from the acceptance histogram — they judged garbage
             self._acc_seen = np.asarray(self._acc_hist, np.int64)
+
+    # -- host tier + shipped-prefill adoption -------------------------------
+    def _page_payload_shape(self, cache) -> tuple:
+        """Host payload shape for one pool array's page slice
+        (``pool[:, pid]`` — the page dim removed)."""
+        return tuple(cache.shape[:1]) + tuple(cache.shape[2:])
+
+    def _zero_page_payload(self) -> tuple:
+        return tuple(np.zeros(self._page_payload_shape(c), c.dtype)
+                     for c in self._caches)
+
+    def _payload_ok(self, payload) -> bool:
+        if len(payload) != len(self._caches):
+            return False
+        return all(tuple(p.shape) == self._page_payload_shape(c)
+                   and p.dtype == c.dtype
+                   for c, p in zip(self._caches, payload))
+
+    def _spill_page(self, key, pid):
+        """Prefix-cache ``on_evict`` intercept: snapshot the evicted
+        page as cheap on-device slices and enqueue them for the tier's
+        writer thread (the async-checkpoint pattern — eviction runs on
+        the admission path and must not pay a blocking D2H).  The
+        slices are functional arrays, so the pool page's next owner can
+        never corrupt what was spilled."""
+        self._tier.spill(key, tuple(c[:, pid] for c in self._caches))
+
+    def _extend_from_tier(self, seed, shared) -> int:
+        """Continue an admission's chain walk past the device cache:
+        for each further chain key, prefer a (stranded) device-cache
+        entry, else re-admit the host tier's copy H2D through the
+        compiled re-admit program and register it back in the prefix
+        cache.  Extends ``shared`` in place (every appended page id is
+        retained for the slot); returns the number of tier re-admits."""
+        ps = self.page_size
+        max_pages = max(0, (len(seed) - 1) // ps)
+        if len(shared) >= max_pages:
+            return 0
+        keys = list(chain_keys(seed, max_pages, ps))
+        n = 0
+        for j in range(len(shared), max_pages):
+            pid = self._prefix.lookup(keys[j])   # retained for the slot
+            if pid is not None:
+                shared.append(pid)
+                continue
+            payload = self._tier.lookup(keys[j])
+            if payload is None:
+                break
+            t0 = time.perf_counter()
+            pids = self._alloc_pages(1)
+            if pids is None:
+                break
+            pid = pids[0]
+            self._caches = self._readmit_fn(
+                self._caches, np.int32(pid),
+                tuple(np.asarray(p) for p in payload))
+            self._prefix.adopt(keys[j], pid)     # the cache's reference
+            self._pool.retain(pid)               # the slot's reference
+            shared.append(pid)
+            n += 1
+            self._tier.note_readmit(1, time.perf_counter() - t0)
+        return n
+
+    def adopt_pages(self, seed, payloads) -> int:
+        """Adopt KV pages shipped by a prefill replica
+        (``serve/fleet.py``): ``payloads[j]`` is the tuple of host
+        arrays for the page holding positions ``j*ps .. (j+1)*ps - 1``
+        computed under ``seed`` — the per-array page slices, int8 +
+        scales under KV quantization.  Each page lands in the pool
+        through the compiled re-admit program and registers in the
+        prefix cache under ``seed``'s chain keys, so the request (and
+        every later request sharing the prefix) admits with a prefix
+        hit instead of a cold prefill.
+
+        Best-effort by design: adoption needs ``prefill_adopt=True``
+        (or an attached host tier) and payloads matching this pool's
+        page shape/dtype — on any mismatch or pool pressure it adopts
+        what it can and returns; the request still decodes correctly
+        via colocated prefill.  Returns the number of NEWLY adopted
+        pages."""
+        if (not self.paged or self._prefix is None
+                or self._readmit_fn is None or not payloads):
+            return 0
+        ps = self.page_size
+        n_pages = min(len(payloads), max(0, (len(seed) - 1) // ps))
+        adopted = 0
+        for key, payload in zip(chain_keys(seed, n_pages, ps), payloads):
+            payload = tuple(np.asarray(p) for p in payload)
+            if not self._payload_ok(payload):
+                logger.warning(
+                    "adopt_pages: shipped payload does not match this "
+                    "pool's page shape/dtype (prefill kv_quant drift?); "
+                    "serving via colocated prefill")
+                break
+            if self._prefix.has(key):
+                continue             # already resident — chain intact
+            pids = self._alloc_pages(1)
+            if pids is None:
+                break                # pool pressure: partial adoption
+            self._caches = self._readmit_fn(
+                self._caches, np.int32(pids[0]), payload)
+            self._prefix.adopt(key, pids[0])
+            adopted += 1
+        return adopted
 
     # -- submit -------------------------------------------------------------
     def submit(self, seed_ids, n_words: int) -> Future:
@@ -747,6 +919,10 @@ class ContinuousDecoder:
     def _try_admit_paged(self, req) -> bool:
         shared = (self._prefix.match(req.seed)
                   if self._prefix is not None else [])
+        if self._tier is not None:
+            # a failed admission leaves tier re-admits in the prefix
+            # cache (content already written) — the retry matches them
+            self._extend_from_tier(req.seed, shared)
         total = -(-req.steps_needed // self.page_size)
         fresh = self._alloc_pages(total - len(shared))
         if fresh is None:
@@ -813,18 +989,23 @@ class ContinuousDecoder:
                 self.spec_windows += n
                 self.spec_accepted += n * a
 
-    def run(self):
-        """Drive the decoder until every submitted request has resolved.
-        Admissions and retirements happen only at ``sync_interval``
-        step boundaries; the only device->host reads are one
-        generated-slab fetch per boundary that retires a request (plus,
-        under speculative decode, one (B,)-int position fetch per
-        boundary — acceptance lengths make completion data-dependent)."""
+    def outstanding(self) -> int:
+        """Queued + live requests — the fleet replica's inflight signal."""
+        return (len(self._pending)
+                + sum(1 for r in self._slots if r is not None))
+
+    def step_boundary(self) -> int:
+        """One admit → ``sync_interval``-step window → retire cycle —
+        the unit :meth:`run` loops and a fleet decode replica's driver
+        thread calls incrementally (``serve/fleet.py``).  Returns the
+        number of slots served this boundary (0 = nothing admissible:
+        drained, or — defensively — a stalled queue whose futures were
+        just failed)."""
         spec = self.spec_k > 0
-        while self._pending or any(r is not None for r in self._slots):
-            self._admit_waiting()
-            live = [r for r in self._slots if r is not None]
-            if not live:   # pragma: no cover - defensive
+        self._admit_waiting()
+        live = [r for r in self._slots if r is not None]
+        if not live:
+            if self._pending:   # pragma: no cover - defensive
                 # submit() guarantees every queued request can fit an
                 # empty pool, so an empty slab with work pending is a
                 # bug — fail the futures loudly instead of dropping them
@@ -832,28 +1013,27 @@ class ContinuousDecoder:
                     req.future.set_exception(RuntimeError(
                         "decoder stalled with no admissible request"))
                 self._pending.clear()
-                break
-            self.live_hwm = max(self.live_hwm, len(live))
-            self._m_slots.set(len(live))
-            self._m_slots_hwm.set(self.live_hwm)
-            for _ in range(self.sync_interval):
-                self._run_step()
-            self.steps += self.sync_interval
-            self._m_steps.inc(self.sync_interval)
-            if spec:
-                pos_host = np.asarray(self._pos)
-                self.host_syncs += 1
-                self._m_syncs.inc()
-                self._drain_accept_hist()
-                done = [r for r in live
-                        if int(pos_host[r.slot]) >= r.steps_needed]
-            else:
-                for r in live:
-                    r.steps_run += self.sync_interval
-                done = [r for r in live
-                        if r.start_pos + r.steps_run >= r.steps_needed]
-            if not done:
-                continue
+            return 0
+        self.live_hwm = max(self.live_hwm, len(live))
+        self._m_slots.set(len(live))
+        self._m_slots_hwm.set(self.live_hwm)
+        for _ in range(self.sync_interval):
+            self._run_step()
+        self.steps += self.sync_interval
+        self._m_steps.inc(self.sync_interval)
+        if spec:
+            pos_host = np.asarray(self._pos)
+            self.host_syncs += 1
+            self._m_syncs.inc()
+            self._drain_accept_hist()
+            done = [r for r in live
+                    if int(pos_host[r.slot]) >= r.steps_needed]
+        else:
+            for r in live:
+                r.steps_run += self.sync_interval
+            done = [r for r in live
+                    if r.start_pos + r.steps_run >= r.steps_needed]
+        if done:
             gen_host = np.asarray(self._gen)   # the boundary host sync
             if not spec:
                 self.host_syncs += 1
@@ -865,6 +1045,24 @@ class ContinuousDecoder:
                 self._retire_req(r)
             self._m_slots.set(sum(1 for r in self._slots
                                   if r is not None))
+        return len(live)
+
+    def run(self):
+        """Drive the decoder until every submitted request has resolved.
+        Admissions and retirements happen only at ``sync_interval``
+        step boundaries; the only device->host reads are one
+        generated-slab fetch per boundary that retires a request (plus,
+        under speculative decode, one (B,)-int position fetch per
+        boundary — acceptance lengths make completion data-dependent)."""
+        while self._pending or any(r is not None for r in self._slots):
+            if self.step_boundary() == 0:
+                break
+        self.emit_decode_event()
+        return self
+
+    def emit_decode_event(self):
+        """The lifetime ``decode`` obs event (``run`` emits one per
+        drain; a fleet replica emits one at close)."""
         from bigdl_tpu.obs import events
         extra = {}
         if self.paged:
@@ -876,6 +1074,12 @@ class ContinuousDecoder:
                 extra.update(prefix_hits=self._prefix.hits,
                              prefix_misses=self._prefix.misses,
                              prefix_pages=self._prefix.pages_reused)
+            if self._tier is not None:
+                ts = self._tier.stats()
+                extra.update(kv_host_spilled=ts["spilled"],
+                             kv_host_readmitted=ts["readmitted"],
+                             kv_host_dropped=ts["dropped"],
+                             kv_host_bytes=ts["bytes"])
         if self.kv_quant != "off":
             extra.update(kv_quant=self.kv_quant,
                          kv_bytes_per_token=self.kv_bytes_per_token)
@@ -900,6 +1104,9 @@ class ContinuousDecoder:
         idempotent."""
         if self._prefix is not None:
             self._prefix.drop_all()
+        if self._tier is not None and self._tier_owned:
+            self._tier.close()
+            self._tier = None
         self._drop_series()
 
     def stats(self) -> dict:
@@ -917,6 +1124,8 @@ class ContinuousDecoder:
             out["pool"] = self._pool.stats()
             if self._prefix is not None:
                 out["prefix"] = self._prefix.stats()
+            if self._tier is not None:
+                out["kv_host"] = self._tier.stats()
         if self.spec_k:
             counts = self._accept_counts
             total = int(counts.sum())

@@ -1,0 +1,237 @@
+"""Host-RAM KV tier: evicted prefix pages spill D2H instead of dying
+(docs/serving.md "Disaggregated fleet").
+
+The prefix cache (``serve/prefix.py``) lives entirely in the paged
+device pool, so its capacity is whatever HBM the live requests leave
+over — under allocation pressure the LRU sweep simply frees pages and
+their K/V is recomputed from scratch on the next matching request.
+Host RAM is roughly an order of magnitude larger than HBM; this module
+turns that into a second cache tier:
+
+- **spill** — when the prefix cache evicts a page (its ``on_evict``
+  hook), the decoder takes cheap ON-DEVICE slices of the page across
+  every cache array and enqueues them here; one background writer
+  thread materializes the device→host copy — the async-checkpoint
+  writer's pattern (``resilience/checkpoint.py``), so eviction (which
+  happens on the admission path) never pays a blocking D2H.  The
+  slices are functional jax arrays snapshotted at eviction time, so a
+  later reuse of the physical page can never corrupt what was spilled.
+- **re-admit** — an admission whose chain walk runs past the device
+  cache consults the tier by the SAME chain-hash keys; a hit allocates
+  a fresh pool page, writes the host copy back H2D through the
+  decoder's compiled re-admit program, and registers the page in the
+  prefix cache again — the request gets a prefix HIT that would
+  otherwise have been a cold prefill.
+- **budget** — entries are LRU inside ``BIGDL_SERVE_KV_HOST_MB``
+  (default 0 = tier off); insertions past the budget drop the oldest
+  entries (``kv_host_dropped_pages_total``).
+
+Quantized pools need no cooperation: a page's payload is the tuple of
+per-array slices — ``(k, v)`` float32 or ``(k, v, kscale, vscale)``
+int8+scales — so a spilled quantized page re-admits bit-identical
+(the spill/re-admit parity contract ``tests/test_fleet.py`` pins).
+
+Telemetry (mergeable registry, ``obs/metrics.py``, labels
+``tier=<name>``): ``kv_host_{spilled,readmitted,dropped}_pages_total``
+counters, the ``kv_host_bytes`` / ``kv_host_pages`` gauges, and
+spill/re-admit latency histograms on the pinned ``LATENCY_BUCKETS``
+(spill latency = the writer thread's materialize+insert; re-admit
+latency = the H2D program dispatch on the admission path).
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import queue
+import threading
+import time
+import weakref
+from collections import OrderedDict
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+ENV_HOST_MB = "BIGDL_SERVE_KV_HOST_MB"
+
+_TIER_SEQ = itertools.count()
+
+
+def host_mb_default() -> int:
+    """The env-configured host-tier budget in MiB (0 = tier off)."""
+    try:
+        return max(0, int(os.environ.get(ENV_HOST_MB, "0")))
+    except ValueError:
+        return 0
+
+
+class HostKVTier:
+    """Chain-hash → host page payload store under a byte budget.
+
+    One writer thread owns every D2H materialization; ``spill`` is a
+    cheap enqueue from the eviction path.  ``lookup`` is
+    NON-destructive — a re-admitted page stays in the tier (LRU
+    refreshed) so a second eviction of the same chain refreshes rather
+    than re-copies; only budget pressure drops entries.
+    """
+
+    def __init__(self, budget_mb: int | None = None,
+                 name: str | None = None):
+        self.budget_bytes = (host_mb_default() if budget_mb is None
+                             else max(0, int(budget_mb))) * (1 << 20)
+        self.name = name or f"kvtier{next(_TIER_SEQ)}"
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._entry_bytes: dict = {}
+        self._bytes = 0
+        # writer thread: the checkpoint-writer pattern (outstanding
+        # counter under a condvar so flush() cannot return while a
+        # spill is still materializing)
+        self._q: "queue.Queue" = queue.Queue()
+        self._cond = threading.Condition()
+        self._outstanding = 0
+        self._stop = False
+
+        from bigdl_tpu.obs import metrics as obs_metrics
+        reg = obs_metrics.get()
+        lab = {"tier": self.name}
+        self._m_spilled = reg.counter(
+            "kv_host_spilled_pages_total",
+            "prefix pages spilled D2H into the host tier", **lab)
+        self._m_readmitted = reg.counter(
+            "kv_host_readmitted_pages_total",
+            "host-tier pages re-admitted H2D as prefix hits", **lab)
+        self._m_dropped = reg.counter(
+            "kv_host_dropped_pages_total",
+            "host-tier pages dropped under the byte budget", **lab)
+        self._m_bytes = reg.gauge(
+            "kv_host_bytes", "host-tier resident bytes", **lab)
+        self._m_pages = reg.gauge(
+            "kv_host_pages", "host-tier resident pages", **lab)
+        self._m_spill_lat = reg.histogram(
+            "kv_host_spill_seconds",
+            "per-page D2H materialize latency on the writer thread",
+            **lab)
+        self._m_readmit_lat = reg.histogram(
+            "kv_host_readmit_seconds",
+            "per-page H2D re-admit dispatch latency", **lab)
+
+        # tiers are uniquely named and often short-lived (one per
+        # decoder under BIGDL_SERVE_KV_HOST_MB) — drop their series at
+        # close/GC so the process registry cannot grow without bound
+        # (the ContinuousDecoder._drop_series precedent); the held
+        # instrument handles keep working for stats() after the drop
+        self._drop_series = weakref.finalize(
+            self, reg.drop_series, tier=self.name)
+
+        self._thread = threading.Thread(
+            target=self._drain, daemon=True,
+            name=f"bigdl-serve-{self.name}")
+        self._thread.start()
+
+    # -- spill path (eviction side) -----------------------------------------
+    def spill(self, key: bytes, device_slices):
+        """Enqueue one evicted page: ``device_slices`` is the tuple of
+        per-cache-array page slices (``pool[:, pid]`` — functional jax
+        arrays, content frozen at eviction time).  Returns immediately;
+        the writer thread pays the D2H."""
+        with self._cond:
+            if self._stop:
+                return
+            self._outstanding += 1
+        self._q.put((key, tuple(device_slices)))
+
+    def _drain(self):
+        while True:
+            try:
+                key, slices = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop:
+                    return
+                continue
+            t0 = time.perf_counter()
+            try:
+                payload = tuple(np.asarray(s) for s in slices)
+                self._insert(key, payload)
+                self._m_spilled.inc()
+                self._m_spill_lat.observe(time.perf_counter() - t0)
+            except Exception as e:  # pragma: no cover - telemetry path
+                logger.warning("host KV tier spill failed: %s", e)
+            finally:
+                with self._cond:
+                    self._outstanding -= 1
+                    self._cond.notify_all()
+
+    def _insert(self, key, payload):
+        nbytes = sum(int(a.nbytes) for a in payload)
+        with self._lock:
+            old = self._entry_bytes.pop(key, None)
+            if old is not None:
+                del self._entries[key]
+                self._bytes -= old
+            if nbytes > self.budget_bytes:
+                # a single page over budget can never be resident
+                self._m_dropped.inc()
+                self._refresh_gauges()
+                return
+            self._entries[key] = payload
+            self._entry_bytes[key] = nbytes
+            self._bytes += nbytes
+            while self._bytes > self.budget_bytes and self._entries:
+                k, _ = self._entries.popitem(last=False)
+                self._bytes -= self._entry_bytes.pop(k)
+                self._m_dropped.inc()
+            self._refresh_gauges()
+
+    def _refresh_gauges(self):
+        self._m_bytes.set(self._bytes)
+        self._m_pages.set(len(self._entries))
+
+    # -- re-admit path (admission side) -------------------------------------
+    def lookup(self, key: bytes):
+        """The host payload for ``key`` (LRU-refreshed) or ``None``.
+        Non-destructive — the entry survives until budget pressure."""
+        with self._lock:
+            payload = self._entries.get(key)
+            if payload is not None:
+                self._entries.move_to_end(key)
+            return payload
+
+    def note_readmit(self, n_pages: int, seconds: float):
+        """Count a completed H2D re-admit (the decoder calls this after
+        dispatching its re-admit program)."""
+        self._m_readmitted.inc(n_pages)
+        self._m_readmit_lat.observe(max(0.0, seconds))
+
+    # -- lifecycle ----------------------------------------------------------
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until every enqueued spill is resident (tests, close).
+        Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._outstanding > 0:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(timeout=left)
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            pages, nbytes = len(self._entries), self._bytes
+        return {"name": self.name, "pages": pages, "bytes": nbytes,
+                "budget_bytes": self.budget_bytes,
+                "spilled": int(self._m_spilled.value),
+                "readmitted": int(self._m_readmitted.value),
+                "dropped": int(self._m_dropped.value)}
+
+    def close(self, timeout: float = 30.0):
+        ok = self.flush(timeout=timeout)
+        with self._cond:
+            self._stop = True
+        # join the writer: an orphaned daemon thread running into
+        # interpreter teardown can abort inside the jax runtime
+        self._thread.join(timeout=timeout)
+        self._drop_series()
+        return ok

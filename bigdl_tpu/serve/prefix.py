@@ -37,16 +37,28 @@ Eviction is LRU over chain entries whose page nobody else holds
 admission cannot find free pages.  Evicting a mid-chain entry strands
 its descendants unreachable — they stop being refreshed and drain out
 of the same LRU sweep, so reclamation is eventual, not leaked.
+
+``on_evict`` lets a second tier intercept the page content before the
+pool reclaims it (the host-RAM KV tier, ``serve/kvtier.py``) without
+this module importing the tier: the hook fires AFTER the chain entry
+is removed and BEFORE the pool reference drops, so the page's content
+is still addressable and a hook that re-enters the cache (or the pool
+free-list) observes consistent state — the mid-allocation regression
+``tests/test_fleet.py`` pins.  A hook failure is logged and the
+eviction completes; the page is never leaked for a telemetry error.
 """
 from __future__ import annotations
 
 import hashlib
+import logging
 from collections import OrderedDict
 
 import numpy as np
 
+logger = logging.getLogger("bigdl_tpu.serve")
 
-def _chain_keys(seed, n_pages: int, page_size: int):
+
+def chain_keys(seed, n_pages: int, page_size: int):
     """Yield page ``j``'s chain key for ``j = 0 .. n_pages - 1``:
     ``digest(parent_key || tokens of page j)``, an incremental digest
     over the whole prefix through page ``j`` (O(tokens) for the whole
@@ -62,22 +74,33 @@ def _chain_keys(seed, n_pages: int, page_size: int):
         yield key
 
 
+#: back-compat alias (the public name is :func:`chain_keys` — the
+#: fleet router and the host tier key on the same chain)
+_chain_keys = chain_keys
+
+
 class PrefixCache:
     """Chain-hash → page-id map over one :class:`~bigdl_tpu.serve.paging.PagePool`.
 
     The cache owns one reference on every page it holds; :meth:`match`
     retains matched pages for the requesting slot (the caller releases
     them at retire through :meth:`insert`'s duplicate path or
-    ``pool.release``)."""
+    ``pool.release``).
 
-    def __init__(self, pool):
+    ``on_evict(key, pid)`` — optional tier intercept: called once per
+    evicted entry while the page content is still live (see module
+    docstring for the ordering/failure contract)."""
+
+    def __init__(self, pool, on_evict=None):
         self.pool = pool
+        self.on_evict = on_evict
         self._entries: "OrderedDict[bytes, int]" = OrderedDict()
         self.hits = 0          # requests that matched >= 1 page
         self.misses = 0        # requests that matched none
         self.pages_reused = 0  # total pages served from the cache
         self.inserted = 0      # pages donated into the cache
         self.evicted = 0       # pages evicted back to the pool
+        self.adopted = 0       # pages adopted (prefill ship / re-admit)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -93,7 +116,7 @@ class PrefixCache:
         ps = self.pool.page_size
         max_pages = max(0, (len(seed) - 1) // ps)
         pids = []
-        for key in _chain_keys(seed, max_pages, ps):
+        for key in chain_keys(seed, max_pages, ps):
             pid = self._entries.get(key)
             if pid is None:
                 break
@@ -102,6 +125,36 @@ class PrefixCache:
         for pid in pids:
             self.pool.retain(pid)
         return pids
+
+    def has(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def lookup(self, key: bytes):
+        """The page id cached under one chain key (LRU-touched and
+        RETAINED for the caller), or ``None``.  The per-key counterpart
+        of :meth:`match` for callers that walk the chain themselves
+        (the tier re-admit path interleaves cache and tier lookups)."""
+        pid = self._entries.get(key)
+        if pid is None:
+            return None
+        self._entries.move_to_end(key)
+        self.pool.retain(pid)
+        return pid
+
+    def adopt(self, key: bytes, pid: int) -> bool:
+        """Register a freshly written page under ``key`` — the prefill
+        ship / host-tier re-admit entry point: ownership of the
+        caller's reference transfers to the cache (exactly
+        :meth:`insert`'s contract for one page whose chain key is
+        already known).  False (and the reference is released) when the
+        key is already cached."""
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            self.pool.release(pid)
+            return False
+        self._entries[key] = pid
+        self.adopted += 1
+        return True
 
     def note_request(self, matched_pages: int):
         """Count one admitted request against the hit/miss ledger."""
@@ -119,7 +172,7 @@ class PrefixCache:
         already cached — including the pages this very request matched
         at admit — the caller's reference is simply released."""
         ps = self.pool.page_size
-        for key, pid in zip(_chain_keys(seed, len(pids), ps), pids):
+        for key, pid in zip(chain_keys(seed, len(pids), ps), pids):
             have = self._entries.get(key)
             if have is not None:
                 self._entries.move_to_end(key)
@@ -138,9 +191,23 @@ class PrefixCache:
         for key in list(self._entries):
             if freed >= n:
                 break
-            pid = self._entries[key]
+            pid = self._entries.get(key)
+            if pid is None:     # a hook re-entered and evicted it
+                continue
             if self.pool.refcount(pid) == 1:
+                # entry removed BEFORE the hook fires so a re-entrant
+                # hook (alloc/evict from inside the intercept) sees a
+                # consistent cache; the page is released AFTER so the
+                # hook can still snapshot its content — and released
+                # even when the hook fails (no leak for telemetry)
                 del self._entries[key]
+                if self.on_evict is not None:
+                    try:
+                        self.on_evict(key, pid)
+                    except Exception:
+                        logger.warning(
+                            "prefix on_evict hook failed for page %d",
+                            pid, exc_info=True)
                 self.pool.release(pid)
                 self.evicted += 1
                 freed += 1
@@ -160,4 +227,5 @@ class PrefixCache:
     def stats(self) -> dict:
         return {"entries": len(self._entries), "hits": self.hits,
                 "misses": self.misses, "pages_reused": self.pages_reused,
-                "inserted": self.inserted, "evicted": self.evicted}
+                "inserted": self.inserted, "evicted": self.evicted,
+                "adopted": self.adopted}

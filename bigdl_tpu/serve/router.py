@@ -98,7 +98,7 @@ class DeadReplicaError(RuntimeError):
 
 class _RouterReq:
     __slots__ = ("x", "future", "priority", "deadline", "t_submit",
-                 "attempts", "queued", "trace")
+                 "attempts", "queued", "trace", "affinity", "aff_note")
 
     def __init__(self, x, priority, deadline, trace=None):
         self.x = x
@@ -107,6 +107,13 @@ class _RouterReq:
         self.deadline = deadline          # absolute perf_counter, or None
         self.t_submit = time.perf_counter()
         self.trace = trace                # obs.trace.Trace when sampled
+        #: pages the dispatcher predicts the chosen replica's prefix
+        #: cache already holds (fleet affinity routing; None = unknown)
+        self.affinity = None
+        #: deferred affinity bookkeeping (name, keys, outcome) consumed
+        #: at dispatch — a request shed BEFORE dispatch must pollute
+        #: neither the index nor the hit/miss counters
+        self.aff_note = None
         self.attempts = 0
         #: True while sitting in the admission heap — the idempotence
         #: guard for requeue-on-death (a dying replica's request can be
@@ -185,6 +192,7 @@ class Router:
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name="bigdl-serve-router")
+        self._stop_health = threading.Event()
         self._health = threading.Thread(
             target=self._health_loop, args=(health_interval,),
             daemon=True, name="bigdl-serve-router-health")
@@ -276,7 +284,7 @@ class Router:
                     self._dispatching -= 1
 
     def _route(self, req, est):
-        replica, load = self._pick()
+        replica, load = self._pick_for(req)
         if replica is None:
             self._fail(req, RuntimeError("no live replica in the pool"))
             return
@@ -302,10 +310,7 @@ class Router:
         if req.trace is not None:
             req.trace.stamp("dispatch")
         try:
-            if req.trace is not None and self._accepts_trace(replica):
-                inner = replica.submit(req.x, trace=req.trace)
-            else:
-                inner = replica.submit(req.x)
+            inner = self._submit_to(replica, req)
         except Exception as e:
             with self._lock:
                 self._outstanding[id(replica)].pop(id(req), None)
@@ -313,6 +318,21 @@ class Router:
             return
         inner.add_done_callback(
             lambda f, r=replica, q=req: self._on_done(r, q, f))
+
+    def _pick_for(self, req):
+        """Replica choice for one request — the base policy ignores the
+        payload (least-loaded); :class:`~bigdl_tpu.serve.fleet.FleetRouter`
+        overrides this with prefix-affinity dispatch."""
+        return self._pick()
+
+    def _submit_to(self, replica, req):
+        """Hand ``req`` to the chosen replica, returning its inner
+        future.  Subclass hook (the fleet router interposes the
+        prefill-replica hop here); exceptions propagate to the caller's
+        requeue/fail handling."""
+        if req.trace is not None and self._accepts_trace(replica):
+            return replica.submit(req.x, trace=req.trace)
+        return replica.submit(req.x)
 
     def _accepts_trace(self, replica) -> bool:
         """Whether ``replica.submit`` takes the ``trace`` kwarg
@@ -475,7 +495,11 @@ class Router:
                     ok = False
                 if not ok:
                     self._mark_dead(r)
-            time.sleep(interval)
+            # interruptible sleep: close() joins this thread, and an
+            # orphaned daemon probe running into interpreter teardown
+            # can abort the process inside the jax runtime's destructor
+            if self._stop_health.wait(timeout=interval):
+                return
 
     def live_replicas(self) -> list:
         with self._lock:
@@ -533,6 +557,8 @@ class Router:
         for req in leftovers:
             self._fail(req, RuntimeError("Router closed"))
         self._dispatcher.join(timeout=10.0)
+        self._stop_health.set()
+        self._health.join(timeout=10.0)
         self._emit("router_stop", **self.stats())
 
     def __enter__(self):

@@ -230,6 +230,90 @@ PY
 echo "== serve smoke: quant_check accuracy budget =="
 python tools/quant_check.py --strict --iterations 50 --image-size 16
 
+echo "== serve smoke: disaggregated fleet drill (1 prefill + 2 decode) =="
+python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.serve.fleet import (DecodeFleet, ProcessDecodeReplica,
+                                   ProcessPrefillReplica)
+from bigdl_tpu.utils.random import set_seed
+
+set_seed(1)
+model = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                      hidden=64)
+rng = np.random.RandomState(0)
+FAMS = [[7, 3, 9, 1, 5, 2, 8, 4], [4, 8, 2, 5, 1, 9, 3, 7],
+        [1, 1, 2, 2, 3, 3, 4, 4]]                # 2 full pages at ps=4
+reqs = [FAMS[i % 3] + rng.randint(1, 64, 1 + i % 2).tolist()
+        for i in range(18)]
+n_words = 5
+oracle = [lm_decode(model, s, n_words) for s in reqs]
+kw = dict(max_slots=4, n_pos=16, page_size=4, sync_interval=2)
+
+# round-robin-ish baseline: least-loaded dispatch, no prefill replicas
+base = DecodeFleet(model, n_decode=2, affinity=False, **kw)
+futs = base.submit_many(reqs, n_words)
+assert [f.result(timeout=120) for f in futs] == oracle
+bstats = base.stats()
+bh = sum(r["prefix"]["hits"] for r in bstats["replicas"]
+         if r["role"] == "decode")
+bm = sum(r["prefix"]["misses"] for r in bstats["replicas"]
+         if r["role"] == "decode")
+base.close()
+base_rate = bh / (bh + bm)
+
+# the disaggregated fleet: 2 decode + 1 prefill, every replica its own
+# OS process; chaos kills the prefill replica mid-stream
+dec = [ProcessDecodeReplica(model, name=f"decode{i}", **kw)
+       for i in range(2)]
+# affinity skips the prefill hop for already-cached chains, so only
+# cold-chain requests reach the prefill replica — kill on its second
+pf = [ProcessPrefillReplica(model, name="prefill0", page_size=4,
+                            env={"BIGDL_FAULTS": "serve_kill@at=2"})]
+fleet = DecodeFleet(replicas=dec, prefill=pf, affinity=True, page_size=4)
+
+def compiles():
+    # parent + each DECODE child (the prefill replica dies mid-drill,
+    # taking its registry snapshot with it)
+    tot = obs_metrics.family_total(obs_metrics.get().snapshot(),
+                                   "xcache_compiles_total")
+    for rep in dec:
+        tot += obs_metrics.family_total(rep.registry_snapshot(),
+                                        "xcache_compiles_total")
+    return tot
+
+c0 = compiles()
+futs = fleet.submit_many(reqs[:9], n_words)
+rows = [f.result(timeout=120) for f in futs]
+futs = fleet.submit_many(reqs[9:], n_words)          # the affinity wave
+rows += [f.result(timeout=120) for f in futs]
+assert rows == oracle, "fleet drill lost token parity"
+st = fleet.stats()
+r = st["router"]
+assert r["failed"] == 0, r                 # zero dropped futures
+assert r["prefill_fallback"] > 0, r        # colocated prefill took over
+assert not pf[0].alive(), "chaos kill never fired"
+fh = sum(x["prefix"]["hits"] for x in st["replicas"]
+         if x["role"] == "decode" and x["alive"])
+fm = sum(x["prefix"]["misses"] for x in st["replicas"]
+         if x["role"] == "decode" and x["alive"])
+fleet_rate = fh / (fh + fm)
+assert fleet_rate > base_rate, (fleet_rate, base_rate)
+c1 = compiles()
+assert c1 == c0, f"cold compile mid-stream: {c0} -> {c1}"
+fleet.close()
+print(f"OK: 18 shared-prefix requests over 1 prefill + 2 decode "
+      f"subprocess replicas; prefill killed mid-burst, zero dropped "
+      f"futures ({r['prefill_shipped']} shipped, "
+      f"{r['prefill_fallback']} colocated), affinity hit-rate "
+      f"{fleet_rate:.0%} > least-loaded {base_rate:.0%}, zero cold "
+      f"compiles after warmup")
+PY
+
 echo "== serve smoke: 2-replica router drill + hot weight swap =="
 python - <<'PY'
 import threading, time

@@ -1,0 +1,717 @@
+"""Disaggregated serving fleet (docs/serving.md "Disaggregated fleet",
+marker ``serve``): prefix-affinity routing, prefill/decode split, and
+the host-RAM KV tier.
+
+The tentpole contracts:
+
+- a 2-replica shared-prefix drill recovers >= 1.5x the prefix hit rate
+  of least-loaded dispatch, with every decoded stream token-identical
+  to single-replica ``lm_decode``;
+- KV pages shipped by a dedicated prefill replica adopt into the
+  decode replica's prefix cache and preserve greedy parity; a prefill
+  replica dying loses ZERO futures (colocated-prefill fallback);
+- decode-replica death mid-burst requeues onto survivors (the router's
+  requeue-once idempotence machinery, unchanged);
+- prefix pages evicted under pressure spill D2H into the host tier and
+  re-admit on chain-hash hit as prefix hits that would otherwise be
+  cold prefills — with int8 KV pages, a spilled-then-re-admitted hit
+  is bit-identical to a never-spilled hit and to cold prefill;
+- the ``on_evict`` hook fires between entry removal and page release,
+  tolerates hook failure without leaking the page, and a re-entrant
+  hook cannot corrupt (or deadlock) the page-pool free-list;
+- the ``--fleet-sweep`` JSON row contract and the fleet obs series
+  (``fleet_affinity_*``, ``kv_host_*``, ``serve_replica_role``) stay
+  pinned.
+"""
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.serve import PagePool, PrefixCache
+from bigdl_tpu.serve.decode import ContinuousDecoder
+from bigdl_tpu.serve.fleet import (AffinityIndex, DecodeFleet,
+                                   DecodeReplica, PrefillReplica)
+from bigdl_tpu.serve.kvtier import HostKVTier
+from bigdl_tpu.serve.prefix import chain_keys
+from bigdl_tpu.serve.router import DeadReplicaError
+from bigdl_tpu.utils.random import set_seed
+
+pytestmark = pytest.mark.serve
+
+
+def _tool(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def lm():
+    set_seed(1)
+    return TransformerLM(vocab_size=11, d_model=16, n_heads=2,
+                         n_layers=2, hidden=32)
+
+
+def _keys(seed, ps=4):
+    return list(chain_keys(seed, max(0, (len(seed) - 1) // ps), ps))
+
+
+class TestAffinityIndex:
+    def test_note_and_match_len(self):
+        idx = AffinityIndex()
+        keys = [b"a", b"b", b"c"]
+        assert idx.match_len("r0", keys) == 0
+        idx.note("r0", keys[:2])
+        assert idx.match_len("r0", keys) == 2
+        assert idx.match_len("r1", keys) == 0
+        # the chain property: a mid-chain gap caps the run
+        idx.note("r1", [b"a", b"c"])
+        assert idx.match_len("r1", keys) == 1
+
+    def test_lru_bound_evicts_oldest(self):
+        idx = AffinityIndex(max_keys=2)
+        idx.note("r0", [b"a", b"b"])
+        idx.note("r0", [b"c"])            # evicts a
+        assert idx.match_len("r0", [b"a"]) == 0
+        assert idx.match_len("r0", [b"c"]) == 1
+
+    def test_forget_drops_replica(self):
+        idx = AffinityIndex()
+        idx.note("r0", [b"a"])
+        idx.forget("r0")
+        assert idx.match_len("r0", [b"a"]) == 0
+        assert idx.stats() == {}
+
+
+class TestPrefixEvictHook:
+    def test_hook_fires_before_release_after_removal(self):
+        pool = PagePool(4, 2)
+        seen = []
+
+        def hook(key, pid):
+            # entry already removed, page still allocated
+            assert not cache.has(key)
+            assert pool.refcount(pid) == 1
+            seen.append((key, pid))
+
+        cache = PrefixCache(pool, on_evict=hook)
+        pid = pool.alloc_one()
+        cache.insert([1, 2, 3], [pid])
+        assert cache.evict_one()
+        assert seen and seen[0][1] == pid
+        assert pool.refcount(pid) == 0     # released after the hook
+
+    def test_hook_failure_never_leaks_the_page(self):
+        pool = PagePool(2, 2)
+
+        def bad_hook(key, pid):
+            raise RuntimeError("tier writer on fire")
+
+        cache = PrefixCache(pool, on_evict=bad_hook)
+        cache.insert([1, 2, 3], [pool.alloc_one()])
+        assert cache.evict_one()           # eviction completes
+        assert pool.in_use == 0            # page freed despite the hook
+        assert len(cache) == 0
+
+    def test_reentrant_hook_cannot_corrupt_the_free_list(self):
+        """The mid-allocation regression: a hook that re-enters the
+        pool (alloc) AND the cache (another evict) mid-sweep must leave
+        refcounts and the free list consistent — no deadlock, no
+        double-free, pages conserved."""
+        pool = PagePool(6, 2)
+        cache = PrefixCache(pool)
+
+        def hook(key, pid):
+            # allocate-and-free mid-eviction (what a tier re-admit on
+            # another thread interleaves with), then evict deeper
+            p = pool.alloc_one()
+            pool.release(p)
+            cache.evict(1)
+
+        cache.on_evict = hook
+        for i in range(3):
+            cache.insert([i, i + 1, i + 2], [pool.alloc_one()])
+        freed = cache.evict(3)
+        assert freed >= 1                  # sweep made progress
+        # conservation: every page either free or legitimately held
+        assert pool.in_use == len(cache)
+        assert pool.in_use + pool.free_count == pool.n_pages
+        # and the cache can still be driven to empty without errors
+        while cache.evict_one():
+            pass
+        assert pool.in_use == 0
+
+    def test_drop_all_skips_the_hook(self):
+        fired = []
+        pool = PagePool(2, 2)
+        cache = PrefixCache(pool, on_evict=lambda k, p: fired.append(p))
+        cache.insert([1, 2, 3], [pool.alloc_one()])
+        cache.drop_all()                   # teardown, not eviction
+        assert fired == []
+        assert pool.in_use == 0
+
+
+class TestHostKVTier:
+    def test_spill_lookup_roundtrip(self):
+        tier = HostKVTier(budget_mb=4)
+        payload = (np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+                   np.ones((2, 3), np.float32))
+        tier.spill(b"k1", payload)
+        assert tier.flush()
+        got = tier.lookup(b"k1")
+        assert got is not None
+        for a, b in zip(got, payload):
+            np.testing.assert_array_equal(a, b)
+        assert tier.lookup(b"nope") is None
+        assert tier.stats()["spilled"] == 1
+        tier.close()
+
+    def test_budget_drops_lru(self):
+        # 1 MiB budget; 384 KiB pages -> the third insert drops the LRU
+        tier = HostKVTier(budget_mb=1)
+        page = np.zeros((384 * 1024 // 4,), np.float32)
+        for i in range(3):
+            tier.spill(b"k%d" % i, (page,))
+        assert tier.flush()
+        st = tier.stats()
+        assert st["dropped"] == 1 and st["pages"] == 2
+        assert tier.lookup(b"k0") is None          # the LRU fell out
+        assert tier.lookup(b"k2") is not None
+        assert st["bytes"] <= tier.budget_bytes
+        tier.close()
+
+    def test_single_entry_over_budget_is_dropped(self):
+        tier = HostKVTier(budget_mb=1)
+        tier.spill(b"big", (np.zeros((2 << 20,), np.float32),))
+        assert tier.flush()
+        assert tier.lookup(b"big") is None
+        assert tier.stats()["dropped"] == 1
+        tier.close()
+
+    def test_refresh_replaces_entry(self):
+        tier = HostKVTier(budget_mb=4)
+        tier.spill(b"k", (np.zeros((4,), np.float32),))
+        tier.spill(b"k", (np.ones((4,), np.float32),))
+        assert tier.flush()
+        np.testing.assert_array_equal(tier.lookup(b"k")[0],
+                                      np.ones((4,), np.float32))
+        assert tier.stats()["pages"] == 1
+        tier.close()
+
+
+#: 2-full-page family prefixes (page size 4) over the lm fixture vocab
+FAM = [[1, 2, 3, 4, 5, 6, 7, 8], [8, 7, 6, 5, 4, 3, 2, 1],
+       [2, 2, 4, 4, 6, 6, 8, 8], [9, 1, 9, 1, 9, 1, 9, 1]]
+
+
+def _tier_decoder(lm, tier, kv_quant="off"):
+    # 1 slot x 12 positions = 3 pages live; n_pages=4 forces the cache
+    # to evict (and spill) one family's pages to admit the next
+    return ContinuousDecoder(lm, max_slots=1, n_pos=12, sync_interval=2,
+                             page_size=4, n_pages=4, host_tier=tier,
+                             kv_quant=kv_quant)
+
+
+class TestHostTierDecode:
+    def test_readmit_serves_prefix_hits_with_parity(self, lm):
+        """The KV-pressure drill: pages evicted under pressure spill,
+        a later shared-prefix request re-admits them as a prefix hit
+        that would otherwise be a cold prefill — token-identical to
+        ``lm_decode``."""
+        tier = HostKVTier(budget_mb=16)
+        dec = _tier_decoder(lm, tier)
+        seeds = [FAM[0] + [9], FAM[1] + [3], FAM[2] + [5], FAM[0] + [7]]
+        oracle = [lm_decode(lm, s, 4) for s in seeds]
+        outs = []
+        for s in seeds:
+            f = dec.submit(s, 4)
+            dec.run()
+            outs.append(f.result())
+            tier.flush()
+        assert outs == oracle
+        st = dec.stats()
+        assert st["kv_host"]["spilled"] > 0, st
+        assert st["kv_host"]["readmitted"] > 0, st
+        # the re-requested family admitted as a HIT, not a cold prefill
+        assert st["prefix"]["hits"] >= 1, st
+        assert st["prefix"]["adopted"] >= 1, st
+        dec.close()
+        tier.close()
+
+    @pytest.mark.parametrize("kv_quant", ["off", "int8"])
+    def test_spilled_hit_identical_to_never_spilled_and_cold(
+            self, lm, kv_quant):
+        """Spill/re-admit parity (quantized pages round-trip WITH their
+        per-page-row scales): cold prefill, never-spilled hit, and
+        spilled-then-re-admitted hit produce bit-identical streams."""
+        seed, n_words = FAM[0] + [9], 4
+
+        def run(dec):
+            f = dec.submit(seed, n_words)
+            dec.run()
+            return f.result()
+
+        # cold prefill (no tier, fresh cache)
+        cold_dec = ContinuousDecoder(lm, max_slots=1, n_pos=12,
+                                     sync_interval=2, page_size=4,
+                                     n_pages=4, kv_quant=kv_quant)
+        cold = run(cold_dec)
+        never_spilled = run(cold_dec)      # prefix hit, same decoder
+        cold_dec.close()
+
+        tier = HostKVTier(budget_mb=16)
+        dec = _tier_decoder(lm, tier, kv_quant=kv_quant)
+        first = run(dec)
+        # pressure: two other families evict (and spill) FAM[0]'s pages
+        run_o = [run(dec) for _ in range(2)]  # noqa: F841
+        for s in (FAM[1] + [3], FAM[2] + [5]):
+            f = dec.submit(s, n_words)
+            dec.run()
+            f.result()
+        tier.flush()
+        assert tier.stats()["spilled"] > 0
+        readmitted = run(dec)              # chain-hash hit -> H2D
+        assert tier.stats()["readmitted"] > 0
+        dec.close()
+        tier.close()
+
+        assert cold == never_spilled == first == readmitted
+        if kv_quant == "off":
+            assert cold == lm_decode(lm, seed, n_words)
+
+
+class TestAffinityDrill:
+    def test_affinity_recovers_hit_rate_with_parity(self, lm):
+        """The acceptance drill: 4 shared-prefix families over 2
+        replicas whose caches each hold ~half the families.  With
+        affinity, every family stays pinned to one replica (near
+        single-replica hit rate); without it, each replica sees ALL
+        families rotate through a too-small cache and thrashes.  Both
+        runs stay token-identical to ``lm_decode``.
+
+        Requests go one at a time so the dispatch pattern is
+        deterministic (no load-race: least-loaded degenerates to the
+        first replica, which then serves every family); the affinity
+        run pre-seeds the router's index with the steady-state
+        family→replica pinning — the same assignment organic first
+        touches converge to, minus the tie-break timing (the smoke
+        drill and ``--fleet-sweep`` measure the organic version)."""
+        n_words = 4
+        rng = np.random.RandomState(0)
+        order = [0, 1, 2, 3] * 6
+        seeds = [FAM[f] + [int(rng.randint(1, 11))] for f in order]
+        oracle = [lm_decode(lm, s, n_words) for s in seeds]
+
+        def drill(affinity, pin=None):
+            # per replica: 1 slot (3 live pages) + ~4 cache pages =
+            # capacity for about TWO family prefixes
+            fleet = DecodeFleet(lm, n_decode=2, affinity=affinity,
+                                max_slots=1, n_pos=12, page_size=4,
+                                n_pages=7, sync_interval=2)
+            try:
+                for fam, name in (pin or {}).items():
+                    fleet.router.index.note(name, _keys(FAM[fam]))
+                for s, o in zip(seeds, oracle):
+                    assert fleet.submit(s, n_words).result(
+                        timeout=120) == o
+                st = fleet.stats()
+                hits = sum(r["prefix"]["hits"] for r in st["replicas"])
+                misses = sum(r["prefix"]["misses"]
+                             for r in st["replicas"])
+                return hits / (hits + misses)
+            finally:
+                fleet.close()
+
+        base = drill(affinity=False)
+        aff = drill(affinity=True, pin={0: "decode0", 2: "decode0",
+                                        1: "decode1", 3: "decode1"})
+        assert aff >= 0.5, (aff, base)
+        assert aff >= 1.5 * max(base, 1e-9), (aff, base)
+
+    def test_affinity_metrics_and_index(self, lm):
+        fleet = DecodeFleet(lm, n_decode=2, affinity=True, max_slots=2,
+                            n_pos=12, page_size=4, sync_interval=2)
+        seeds = [FAM[0] + [9], FAM[0] + [3], FAM[0] + [5]]
+        for s in seeds:
+            fleet.submit(s, 3).result(timeout=60)
+        st = fleet.router.stats()
+        assert st["affinity"] is True
+        assert st["affinity_hits"] >= 1          # repeats hit the index
+        assert st["affinity_hits"] + st["affinity_misses"] == 3
+        snap = obs_metrics.get().snapshot()
+        assert obs_metrics.family_total(
+            snap, "fleet_affinity_hits_total") == st["affinity_hits"]
+        assert obs_metrics.family_total(
+            snap, "serve_replica_role", role="decode") == 2
+        fleet.close()
+
+
+class TestPrefillSplit:
+    def test_shipped_pages_adopt_with_parity(self, lm):
+        """The disaggregation contract: seed KV computed on a prefill
+        replica, shipped, adopted — every admission is a prefix hit
+        and the stream equals ``lm_decode`` exactly."""
+        fleet = DecodeFleet(lm, n_decode=2, n_prefill=1, affinity=False,
+                            max_slots=2, n_pos=12, page_size=4,
+                            sync_interval=2)
+        rng = np.random.RandomState(1)
+        seeds = [FAM[i % 4] + [int(rng.randint(1, 11))] for i in range(8)]
+        oracle = [lm_decode(lm, s, 4) for s in seeds]
+        futs = fleet.submit_many(seeds, 4)
+        assert [f.result(timeout=120) for f in futs] == oracle
+        st = fleet.stats()
+        r = st["router"]
+        assert r["prefill_shipped"] == 8, r
+        assert r["failed"] == 0
+        # every dispatch adopted its chain -> zero cold prefills
+        hits = sum(x["prefix"]["hits"] for x in st["replicas"]
+                   if x["role"] == "decode")
+        misses = sum(x["prefix"]["misses"] for x in st["replicas"]
+                     if x["role"] == "decode")
+        assert (hits, misses) == (8, 0), st
+        pf = [x for x in st["replicas"] if x["role"] == "prefill"]
+        assert pf and pf[0]["prefills"] == 8
+        fleet.close()
+
+    def test_affinity_skips_prefill_on_cached_chains(self, lm):
+        fleet = DecodeFleet(lm, n_decode=1, n_prefill=1, affinity=True,
+                            max_slots=2, n_pos=12, page_size=4,
+                            sync_interval=2)
+        for _ in range(3):
+            fleet.submit(FAM[0] + [9], 3).result(timeout=60)
+        r = fleet.router.stats()
+        # first dispatch ships; the cached chain skips the hop after
+        assert r["prefill_shipped"] == 1 and r["prefill_skipped"] == 2, r
+        fleet.close()
+
+    def test_prefill_death_falls_back_colocated_zero_lost(self, lm):
+        """A prefill replica dying mid-burst loses ZERO futures: the
+        router falls back to colocated prefill and keeps serving."""
+
+        class DyingPrefill:
+            name = "prefill-doomed"
+
+            def __init__(self, inner):
+                self.inner, self.calls = inner, 0
+
+            def alive(self):
+                return self.calls < 2
+
+            def inflight(self):
+                return 0
+
+            def prefill_async(self, seed):
+                self.calls += 1
+                if self.calls >= 2:
+                    raise DeadReplicaError("prefill replica died")
+                return self.inner.prefill_async(seed)
+
+            def registry_snapshot(self):
+                return None
+
+            def stats(self):
+                return {"role": "prefill", "name": self.name}
+
+            def close(self, drain=True):
+                self.inner.close(drain=drain)
+
+        real = PrefillReplica(lm, name="pf-real", page_size=4)
+        fleet = DecodeFleet(lm, n_decode=2, prefill=[DyingPrefill(real)],
+                            affinity=False, max_slots=2, n_pos=12,
+                            page_size=4, sync_interval=2)
+        rng = np.random.RandomState(2)
+        seeds = [FAM[i % 4] + [int(rng.randint(1, 11))]
+                 for i in range(6)]
+        oracle = [lm_decode(lm, s, 4) for s in seeds]
+        futs = fleet.submit_many(seeds, 4)
+        assert [f.result(timeout=120) for f in futs] == oracle
+        r = fleet.router.stats()
+        assert r["failed"] == 0, r
+        assert r["prefill_shipped"] >= 1
+        assert r["prefill_fallback"] >= 1, r   # colocated took over
+        fleet.close()
+
+    def test_prefill_pages_match_decode_written_pages(self, lm):
+        """The ship-adopt path is bit-identical storage: a prefill
+        replica's pages for a seed equal what a decode replica's own
+        prefill writes (same window math) — pinned by decoding the
+        adopted stream against a never-shipped decoder."""
+        pf = PrefillReplica(lm, name="pf0", page_size=4)
+        seed = FAM[0] + [9]
+        pages = pf.prefill(seed)
+        assert len(pages) == 2             # (9-1)//4 full pages
+        rep = DecodeReplica(lm, name="d0", max_slots=1, n_pos=12,
+                            page_size=4, sync_interval=2)
+        fut = rep.submit({"seed": seed, "n_words": 4, "pages": pages})
+        assert fut.result(timeout=60) == lm_decode(lm, seed, 4)
+        st = rep.stats()
+        assert st["prefix"]["adopted"] == 2
+        assert st["prefix"]["hits"] == 1   # admitted on the shipped chain
+        rep.close()
+        pf.close()
+
+
+class _FakeDecode:
+    def __init__(self, name, load=0):
+        self.name, self.load = name, load
+
+    def alive(self):
+        return True
+
+    def inflight(self):
+        return self.load
+
+    def submit(self, x, trace=None):
+        raise AssertionError("must not dispatch")
+
+
+class TestFleetRouterPolicy:
+    def test_shed_requests_do_not_pollute_affinity_state(self):
+        """A request shed BEFORE dispatch must not inflate the affinity
+        counters or seed the index with chains no replica ever cached."""
+        from bigdl_tpu.serve import SheddedError
+        from bigdl_tpu.serve.fleet import FleetRouter
+        router = FleetRouter([_FakeDecode("r0")], affinity=True,
+                             page_size=4, shed=True, est_ms=10000.0)
+        try:
+            fut = router.submit({"seed": list(range(1, 10)),
+                                 "n_words": 4}, slo_ms=1.0)
+            with pytest.raises(SheddedError):
+                fut.result(timeout=30)
+            st = router.stats()
+            assert st["affinity_hits"] == 0
+            assert st["affinity_misses"] == 0
+            assert st["index"] == {}
+        finally:
+            router.close()
+
+    def test_load_guard_overrides_hot_affinity_pick(self):
+        """A hot prefix family must not funnel onto a backlogged
+        replica while others idle: past ``affinity_max_skew`` the pick
+        falls back to least-loaded."""
+        from bigdl_tpu.serve.fleet import FleetRouter
+        from bigdl_tpu.serve.router import _RouterReq
+        hot, idle = _FakeDecode("hot", load=50), _FakeDecode("idle")
+        router = FleetRouter([hot, idle], affinity=True, page_size=4,
+                             affinity_max_skew=8)
+        try:
+            seed = list(range(1, 10))
+            router.index.note("hot", _keys(seed))
+            req = _RouterReq({"seed": seed, "n_words": 4}, 1, None)
+            replica, _load = router._pick_for(req)
+            assert replica is idle
+            hot.load = 2                   # inside the skew budget
+            req2 = _RouterReq({"seed": seed, "n_words": 4}, 1, None)
+            replica, _load = router._pick_for(req2)
+            assert replica is hot
+        finally:
+            router.close()
+
+
+class TestFleetRequeue:
+    def test_decode_replica_death_requeues_zero_lost(self, lm):
+        """Decode-replica death mid-burst: outstanding futures fail
+        with DeadReplicaError inside the replica, the router requeues
+        them once onto the survivor, and every stream still matches
+        ``lm_decode``."""
+        import time as _time
+        n_words = 40
+        fleet = DecodeFleet(lm, n_decode=2, affinity=False, max_slots=2,
+                            n_pos=50, page_size=4, sync_interval=1)
+        rng = np.random.RandomState(3)
+        seeds = [FAM[i % 4] + [int(rng.randint(1, 11))]
+                 for i in range(8)]
+        oracle = [lm_decode(lm, s, n_words) for s in seeds]
+        futs = fleet.submit_many(seeds, n_words)
+        victim = fleet.replicas[0]
+        t0 = _time.monotonic()             # kill WHILE it holds work
+        while victim.inflight() == 0 and _time.monotonic() - t0 < 10:
+            _time.sleep(0.002)
+        assert victim.inflight() > 0
+        victim.kill()
+        assert [f.result(timeout=120) for f in futs] == oracle
+        r = fleet.router.stats()
+        assert r["failed"] == 0, r
+        assert r["requeued"] >= 1, r
+        assert r["dead_replicas"] == 1
+        fleet.close()
+
+
+class TestBenchFleetContract:
+    """The --fleet-sweep apparatus must not bit-rot (the
+    TestBenchRouterContract pattern)."""
+
+    def test_fleet_row_keys(self):
+        bench = _tool("bench_serve")
+        router = {"affinity_hits": 5, "affinity_misses": 2,
+                  "prefill_shipped": 3, "prefill_fallback": 1,
+                  "prefill_skipped": 4}
+        replicas = [
+            {"name": "decode0", "role": "decode", "alive": True,
+             "admitted": 6, "prefix": {"hits": 4, "misses": 2},
+             "kv_host": {"readmitted": 1}},
+            {"name": "prefill0", "role": "prefill", "alive": True,
+             "prefills": 3, "pages_shipped": 6},
+        ]
+        row = bench.fleet_row("affinity", 2, 1, 6, 1.1, 8, 32, 0.5,
+                              router, replicas)
+        assert set(row) == {
+            "model", "mode", "impl", "replicas", "prefill_replicas",
+            "families", "zipf_a", "requests", "tokens", "wall_s",
+            "tok_per_s", "hit_rate", "affinity_hits", "affinity_misses",
+            "prefill_shipped", "prefill_fallback", "prefill_skipped",
+            "kv_host_readmitted", "per_replica"}
+        assert row["mode"] == "fleet_sweep"
+        assert row["hit_rate"] == pytest.approx(4 / 6)
+        assert row["kv_host_readmitted"] == 1
+        roles = {p["name"]: p["role"] for p in row["per_replica"]}
+        assert roles == {"decode0": "decode", "prefill0": "prefill"}
+        assert row["per_replica"][1]["pages_shipped"] == 6
+
+    def test_fleet_families_shape_and_zipf(self):
+        bench = _tool("bench_serve")
+        rng = np.random.RandomState(0)
+        seeds, fams = bench.fleet_families(rng, 4, 200, 1.5, 2, 4, 32)
+        assert len(seeds) == 200 and len(fams) == 200
+        plen = 2 * 4
+        by_fam = {}
+        for s, f in zip(seeds, fams):
+            assert len(s) > plen           # prefix + nonempty suffix
+            by_fam.setdefault(f, set()).add(tuple(s[:plen]))
+        # one fixed prefix per family, Zipf head heavier than tail
+        assert all(len(v) == 1 for v in by_fam.values())
+        assert fams.count(0) > fams.count(3)
+
+
+class TestFleetTelemetry:
+    def test_serve_top_fleet_line_and_roles(self, lm):
+        fleet = DecodeFleet(lm, n_decode=2, n_prefill=1, affinity=True,
+                            host_mb=8, max_slots=2, n_pos=12,
+                            page_size=4, sync_interval=2)
+        for i in range(4):
+            fleet.submit(FAM[i % 2] + [9], 3).result(timeout=60)
+        snap = fleet.merged_registry()
+        serve_top = _tool("serve_top")
+        roles = serve_top.replica_roles(snap)
+        assert roles == {"decode0": "decode", "decode1": "decode",
+                         "prefill0": "prefill"}
+        line = serve_top.fleet_line(snap, None, 1.0)
+        assert line is not None and line.startswith("fleet:")
+        assert "2 decode + 1 prefill" in line
+        assert "affinity hit" in line and "kv host" in line
+        # fleet replicas get their own role-tagged rows
+        rows = serve_top.frame_rows(snap, None, 1.0)
+        by_name = {r["name"]: r for r in rows}
+        assert by_name["decode0"]["role"] == "decode"
+        assert by_name["prefill0"]["role"] == "prefill"
+        assert rows[-1]["name"] == "fleet"     # fleet row stays last
+        frame = serve_top.render(rows, "test", 1.0, fleet=line)
+        assert "fleet:" in frame
+        assert "decode0[d]" in frame and "prefill0[p]" in frame
+        fleet.close()
+
+    def test_obs_report_renders_fleet_and_tier(self, lm, tmp_path):
+        from bigdl_tpu.obs import events as obs_events
+        obs_events.configure(str(tmp_path))
+        tier = HostKVTier(budget_mb=16)
+        dec = _tier_decoder(lm, tier)
+        for s in (FAM[0] + [9], FAM[1] + [3], FAM[2] + [5],
+                  FAM[0] + [7]):
+            f = dec.submit(s, 4)
+            dec.run()
+            f.result()
+        tier.flush()
+        dec.close()
+        fleet = DecodeFleet(lm, n_decode=1, affinity=True, max_slots=2,
+                            n_pos=12, page_size=4, sync_interval=2)
+        fleet.submit(FAM[0] + [9], 3).result(timeout=60)
+        fleet.close()
+        report = _tool("obs_report")
+        events = obs_events.read_events(obs_events.get().path)
+        lines = "\n".join(report._serving_section(events))
+        assert "host KV tier" in lines
+        assert "re-admitted" in lines
+        assert "Disaggregated fleet" in lines
+        obs_events.reset()
+
+    def test_sampled_trace_carries_replica_compute_hop(self, lm,
+                                                       tmp_path):
+        """A sampled request through a decode replica stamps a
+        replica-side ``compute`` hop before the router's terminal
+        ``complete`` (the engine-fleet trace contract)."""
+        from bigdl_tpu.obs import events as obs_events
+        obs_events.configure(str(tmp_path))
+        fleet = DecodeFleet(lm, n_decode=1, affinity=True, max_slots=2,
+                            n_pos=12, page_size=4, sync_interval=2,
+                            trace_sample=1.0)
+        fleet.submit(FAM[0] + [9], 3).result(timeout=60)
+        fleet.drain()
+        fleet.close()
+        events = obs_events.read_events(obs_events.get().path)
+        traces = [e for e in events if e["type"] == "trace"
+                  and e["status"] == "ok"]
+        assert traces
+        phases = [h[0] for h in traces[0]["hops"]]
+        assert "compute" in phases and phases[-1] == "complete"
+        stamps = [h[1] for h in traces[0]["hops"]]
+        assert stamps == sorted(stamps)
+        obs_events.reset()
+
+    def test_kv_host_series_on_the_registry(self, lm):
+        tier = HostKVTier(budget_mb=16)
+        dec = _tier_decoder(lm, tier)
+        for s in (FAM[0] + [9], FAM[1] + [3], FAM[2] + [5],
+                  FAM[0] + [7]):
+            f = dec.submit(s, 4)
+            dec.run()
+            f.result()
+        tier.flush()
+        snap = obs_metrics.get().snapshot()
+        spilled = obs_metrics.family_total(snap,
+                                           "kv_host_spilled_pages_total")
+        readm = obs_metrics.family_total(
+            snap, "kv_host_readmitted_pages_total")
+        assert spilled > 0 and readm > 0
+        assert obs_metrics.family_total(snap, "kv_host_bytes") > 0
+        # latency histograms observe on the pinned buckets
+        fam = snap["kv_host_spill_seconds"]["series"][0]
+        assert fam["count"] == spilled
+        assert list(snap["kv_host_spill_seconds"]["bounds"]) == \
+            list(obs_metrics.LATENCY_BUCKETS)
+        dec.close()
+        tier.close()
+
+
+@pytest.mark.slow
+class TestProcessFleet:
+    def test_subprocess_decode_roundtrip_and_prefill_kill(self, lm):
+        """Mini version of the smoke drill: subprocess decode + a
+        chaos-killed subprocess prefill; zero lost futures, parity."""
+        from bigdl_tpu.serve.fleet import (ProcessDecodeReplica,
+                                           ProcessPrefillReplica)
+        dec = [ProcessDecodeReplica(lm, name="pd0", max_slots=2,
+                                    n_pos=12, page_size=4,
+                                    sync_interval=2)]
+        pf = [ProcessPrefillReplica(
+            lm, name="pp0", page_size=4,
+            env={"BIGDL_FAULTS": "serve_kill@at=2"})]
+        fleet = DecodeFleet(replicas=dec, prefill=pf, affinity=False,
+                            page_size=4)
+        rng = np.random.RandomState(4)
+        seeds = [FAM[i % 3] + [int(rng.randint(1, 11))]
+                 for i in range(6)]
+        oracle = [lm_decode(lm, s, 4) for s in seeds]
+        futs = fleet.submit_many(seeds, 4)
+        assert [f.result(timeout=180) for f in futs] == oracle
+        r = fleet.router.stats()
+        assert r["failed"] == 0, r
+        assert r["prefill_fallback"] >= 1, r
+        assert not pf[0].alive()
+        fleet.close()

@@ -545,6 +545,155 @@ def bench_decode_sweep(args):
     return points
 
 
+def fleet_families(rng, n_families: int, n_requests: int, zipf_a: float,
+                   prefix_pages: int, page_size: int, vocab: int,
+                   suffix_max: int = 3):
+    """Shared-prefix request families: ``n_families`` fixed prefixes of
+    ``prefix_pages`` full pages each, requests drawing their family
+    Zipf(``zipf_a``)-distributed (family 0 hottest) with a short random
+    suffix — the system-prompt traffic shape affinity routing and the
+    host tier exist for.  Returns ``(seeds, family_ids)``."""
+    plen = prefix_pages * page_size
+    prefixes = [rng.randint(1, vocab, plen).tolist()
+                for _ in range(n_families)]
+    w = 1.0 / np.power(np.arange(1, n_families + 1), zipf_a)
+    w /= w.sum()
+    fams = rng.choice(n_families, size=n_requests, p=w)
+    seeds = [prefixes[f] + rng.randint(1, vocab,
+                                       1 + rng.randint(suffix_max)).tolist()
+             for f in fams]
+    return seeds, [int(f) for f in fams]
+
+
+def fleet_row(impl, replicas, prefill_replicas, families, zipf_a,
+              requests, tokens, wall_s, router_stats,
+              replica_stats) -> dict:
+    """The pinned JSON contract for one ``--fleet-sweep`` point:
+    fleet-aggregate throughput plus the affinity/prefill/host-tier
+    counters that explain it and a per-replica breakdown (role-labelled
+    — prefill replicas ride along with their ship counts).
+    ``tests/test_fleet.py::TestBenchFleetContract`` keeps this shape
+    honest."""
+    per_replica, hits, misses, readmitted = [], 0, 0, 0
+    for s in replica_stats:
+        entry = {"name": s.get("name", "?"), "role": s.get("role", "?"),
+                 "alive": s.get("alive", True)}
+        if s.get("role") == "decode":
+            pfx = s.get("prefix") or {}
+            entry.update(admitted=s.get("admitted", 0),
+                         prefix_hits=pfx.get("hits", 0),
+                         prefix_misses=pfx.get("misses", 0))
+            hits += pfx.get("hits", 0)
+            misses += pfx.get("misses", 0)
+            readmitted += (s.get("kv_host") or {}).get("readmitted", 0)
+        else:
+            entry.update(prefills=s.get("prefills", 0),
+                         pages_shipped=s.get("pages_shipped", 0))
+        per_replica.append(entry)
+    rate = tokens / wall_s if wall_s else 0.0
+    return {"model": "transformer", "mode": "fleet_sweep", "impl": impl,
+            "replicas": replicas, "prefill_replicas": prefill_replicas,
+            "families": families, "zipf_a": zipf_a,
+            "requests": requests, "tokens": tokens, "wall_s": wall_s,
+            "tok_per_s": rate,
+            "hit_rate": hits / max(1, hits + misses),
+            "affinity_hits": router_stats.get("affinity_hits", 0),
+            "affinity_misses": router_stats.get("affinity_misses", 0),
+            "prefill_shipped": router_stats.get("prefill_shipped", 0),
+            "prefill_fallback": router_stats.get("prefill_fallback", 0),
+            "prefill_skipped": router_stats.get("prefill_skipped", 0),
+            "kv_host_readmitted": readmitted,
+            "per_replica": per_replica}
+
+
+def bench_fleet(args):
+    """``--fleet-sweep``: the same Zipf shared-prefix family stream
+    through a least-loaded fleet and an affinity-routed fleet — the
+    per-replica prefix hit-rate recovery (and, with
+    ``--prefill-replicas`` / ``--host-mb``, the prefill offload and
+    host-tier re-admits) is the headline."""
+    from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+    from bigdl_tpu.serve.fleet import DecodeFleet
+    from bigdl_tpu.utils.random import set_seed
+    set_seed(1)
+    model = TransformerLM(vocab_size=128, d_model=64, n_heads=4,
+                          n_layers=2, hidden=128)
+    rng = np.random.RandomState(0)
+    ps, n_words = args.page_size, args.decode_words
+    seeds, _fams = fleet_families(
+        rng, args.families, args.requests, args.zipf_a,
+        args.prefix_pages, ps, 128)
+    n_pos = max(len(s) for s in seeds) + n_words - 1
+    toks = len(seeds) * n_words
+
+    for length in sorted({len(s) for s in seeds}):
+        lm_decode(model, [1] * length, n_words)
+    oracle = [lm_decode(model, s, n_words) for s in seeds]
+
+    def run_point(impl, affinity):
+        fleet = DecodeFleet(
+            model, n_decode=args.replicas,
+            n_prefill=args.prefill_replicas, affinity=affinity,
+            host_mb=args.host_mb or None, max_slots=args.decode_slots,
+            n_pos=n_pos, page_size=ps, sync_interval=args.decode_sync,
+            kv_quant=args.kv_quant)
+        t0 = time.perf_counter()
+        futs = fleet.submit_many(seeds, n_words)
+        rows = [f.result(timeout=600) for f in futs]
+        wall = time.perf_counter() - t0
+        st = fleet.stats()
+        row = fleet_row(impl, args.replicas, args.prefill_replicas,
+                        args.families, args.zipf_a, len(seeds), toks,
+                        wall, st["router"], st["replicas"])
+        row["parity"] = rows == oracle if args.kv_quant == "off" else None
+        row["agreement"] = float(np.mean([
+            np.mean(np.asarray(r[len(s):]) == np.asarray(o[len(s):]))
+            for r, o, s in zip(rows, oracle, seeds)]))
+        fleet.close()
+        print(f"bench_serve: {json.dumps(row)}")
+        return row
+
+    base = run_point("least_loaded", affinity=False)
+    aff = run_point("affinity", affinity=True)
+
+    print(f"\ntransformer fleet sweep ({args.replicas} decode + "
+          f"{args.prefill_replicas} prefill; {args.families} families, "
+          f"zipf {args.zipf_a}, {len(seeds)} requests):")
+    for pt in (base, aff):
+        print(f"  {pt['impl']:<13} {pt['tok_per_s']:8.1f} tok/s, "
+              f"prefix hit-rate {pt['hit_rate']:.0%}, affinity "
+              f"{pt['affinity_hits']}/{pt['affinity_hits'] + pt['affinity_misses']}, "
+              f"shipped {pt['prefill_shipped']}, agreement "
+              f"{pt['agreement']:.3f}")
+    if args.prefill_replicas:
+        # shipped pages equalize the ADMISSION hit rate (every request
+        # adopts its chain), so affinity's win shows as prefill work
+        # SHED instead: hops skipped because the pick already cached it
+        print(f"  affinity skipped {aff['prefill_skipped']} prefill "
+              f"hops (least-loaded skipped "
+              f"{base['prefill_skipped']})")
+    else:
+        ratio = (aff["hit_rate"] / base["hit_rate"]
+                 if base["hit_rate"] else float("inf"))
+        print(f"  affinity recovers {ratio:.2f}x the least-loaded "
+              f"prefix hit rate")
+    if args.check:
+        if args.kv_quant == "off" and not (base["parity"]
+                                           and aff["parity"]):
+            raise SystemExit("fleet sweep lost token parity")
+        if args.prefill_replicas:
+            if aff["prefill_skipped"] <= base["prefill_skipped"]:
+                raise SystemExit(
+                    f"affinity skipped {aff['prefill_skipped']} "
+                    f"prefill hops vs least-loaded "
+                    f"{base['prefill_skipped']} — no offload win")
+        elif aff["hit_rate"] <= base["hit_rate"]:
+            raise SystemExit(
+                f"affinity hit rate {aff['hit_rate']:.2f} did not beat "
+                f"least-loaded {base['hit_rate']:.2f}")
+    return [base, aff]
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--model", default="lenet",
@@ -576,9 +725,28 @@ def main():
     ap.add_argument("--kv-quant", default=None, choices=("off", "int8"),
                     help="KV-page quantization for the decode sweep "
                          "(default: BIGDL_SERVE_KV_QUANT)")
+    ap.add_argument("--fleet-sweep", action="store_true",
+                    help="shared-prefix family stream through a "
+                         "least-loaded vs an affinity-routed decode "
+                         "fleet (docs/serving.md 'Disaggregated "
+                         "fleet')")
+    ap.add_argument("--families", type=int, default=6,
+                    help="shared-prefix request families for the fleet "
+                         "sweep")
+    ap.add_argument("--zipf-a", type=float, default=1.1,
+                    help="Zipf exponent over the request families")
+    ap.add_argument("--prefix-pages", type=int, default=2,
+                    help="full KV pages per family prefix")
+    ap.add_argument("--prefill-replicas", type=int, default=0,
+                    help="dedicated prefill replicas for the fleet "
+                         "sweep")
+    ap.add_argument("--host-mb", type=int, default=0,
+                    help="per-replica host-RAM KV tier budget (MiB) "
+                         "for the fleet sweep (0 = off)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="> 1 sweeps a ReplicaPool behind the SLO "
-                         "router instead of one engine")
+                         "router instead of one engine (also the fleet "
+                         "sweep's decode-replica count)")
     ap.add_argument("--slo-ms", type=float, default=0.0,
                     help="per-request deadline for the router sweep "
                          "(0 = none; arms the shed policy)")
@@ -592,7 +760,10 @@ def main():
     if args.kv_quant is None:
         args.kv_quant = _quant.kv_mode_default()
 
-    if args.decode_sweep:
+    if args.fleet_sweep:
+        args.replicas = max(2, args.replicas)
+        bench_fleet(args)
+    elif args.decode_sweep:
         bench_decode_sweep(args)
     elif args.model == "transformer":
         bench_decode(args)

@@ -151,6 +151,38 @@ def _serving_section(events, waterfall=5):
             out.append(f"- speculative: k={ks}, {wins} verify windows, "
                        f"mean accepted "
                        f"{acc / wins if wins else 0.0:.2f} drafts")
+        tiers = [e for e in decodes if "kv_host_spilled" in e]
+        if tiers:
+            spilled = sum(int(e["kv_host_spilled"]) for e in tiers)
+            readm = sum(int(e.get("kv_host_readmitted", 0))
+                        for e in tiers)
+            dropped = sum(int(e.get("kv_host_dropped", 0))
+                          for e in tiers)
+            out.append(f"- host KV tier: {spilled} pages spilled, "
+                       f"{readm} re-admitted as prefix hits, "
+                       f"{dropped} dropped under budget")
+        out.append("")
+
+    fleets = [e for e in serves if e["kind"] == "fleet_stop"]
+    if fleets:
+        out.append("### Disaggregated fleet")
+        out.append("")
+        for e in fleets:
+            hits = int(e.get("affinity_hits", 0))
+            misses = int(e.get("affinity_misses", 0))
+            line = (f"- fleet of {e.get('replicas', '?')} decode + "
+                    f"{e.get('prefill_replicas', 0)} prefill: affinity "
+                    f"{hits}/{hits + misses} dispatches on a cached "
+                    f"chain" if hits + misses else
+                    f"- fleet of {e.get('replicas', '?')} decode + "
+                    f"{e.get('prefill_replicas', 0)} prefill "
+                    f"(affinity off)")
+            shipped = int(e.get("prefill_shipped", 0))
+            fallback = int(e.get("prefill_fallback", 0))
+            if shipped or fallback:
+                line += (f"; prefill shipped {shipped}, colocated "
+                         f"fallback {fallback}")
+            out.append(line)
         out.append("")
 
     if traces and waterfall > 0:

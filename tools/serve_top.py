@@ -119,6 +119,7 @@ def frame_rows(cur: dict, prev: dict | None, dt: float,
     """One dict per engine plus a trailing ``fleet`` row; pure function
     of two snapshots (testable offline)."""
     rows = []
+    roles = replica_roles(cur)
     scopes = [({"engine": e}, e) for e in engines_in(cur)]
     scopes.append(({}, "fleet"))
     for match, label in scopes:
@@ -145,6 +146,7 @@ def frame_rows(cur: dict, prev: dict | None, dt: float,
         bad, offered = shed + failed, acc + shed
         rows.append({
             "name": label,
+            "role": roles.get(label),
             "rows_s": comp,
             "queue": int(metrics.family_total(cur, "serve_queue_depth",
                                               **match)),
@@ -156,7 +158,84 @@ def frame_rows(cur: dict, prev: dict | None, dt: float,
             "p99_ms": None if qs["p99"] is None else qs["p99"] * 1e3,
             "burn": (bad / offered / budget) if offered > 0 else 0.0,
         })
+    # disaggregated-fleet replicas are decoders/prefill workers, not
+    # engines — synthesize their rows from the decoder/prefill series
+    # so the role tags land on real rows (fleet row stays last)
+    engine_names = {r["name"] for r in rows}
+    fleet_rows = []
+    for name, role in sorted(roles.items()):
+        if name in engine_names:
+            continue
+        if role == "decode":
+            comp = _rate(cur, prev, dt, "decode_retired_total",
+                         decoder=name)
+            occupied = int(metrics.family_total(
+                cur, "decode_slots_active", decoder=name))
+        else:
+            comp = _rate(cur, prev, dt, "fleet_prefill_requests_total",
+                         replica=name)
+            occupied = 0
+        fleet_rows.append({
+            "name": name, "role": role, "rows_s": comp, "queue": 0,
+            "inflight": occupied, "shed_s": 0.0, "p50_ms": None,
+            "p95_ms": None, "p99_ms": None, "burn": 0.0,
+        })
+    if fleet_rows:
+        rows[-1:-1] = fleet_rows       # before the trailing fleet row
     return rows
+
+
+def replica_roles(snapshot: dict) -> dict:
+    """``replica name -> role`` from the fleet's ``serve_replica_role``
+    gauges (prefill/decode disaggregation, docs/serving.md
+    "Disaggregated fleet"); empty for non-fleet snapshots."""
+    fam = snapshot.get("serve_replica_role", {"series": []})
+    return {row["labels"].get("replica"): row["labels"].get("role")
+            for row in fam["series"]
+            if row["labels"].get("replica")}
+
+
+def fleet_line(cur: dict, prev: dict | None, dt: float) -> str | None:
+    """One trailing line of disaggregated-fleet telemetry when a fleet
+    router / host KV tier is exporting: affinity hit-rate (windowed
+    like the engine rates), prefill ship/skip/fallback counts, and the
+    host tier's resident bytes + spill/re-admit counters.  None when no
+    fleet series are present."""
+    has_aff = "fleet_affinity_hits_total" in cur
+    has_tier = "kv_host_bytes" in cur
+    if not has_aff and not has_tier:
+        return None
+    parts = []
+    roles = replica_roles(cur)
+    if roles:
+        n_dec = sum(1 for r in roles.values() if r == "decode")
+        n_pre = sum(1 for r in roles.values() if r == "prefill")
+        parts.append(f"{n_dec} decode + {n_pre} prefill")
+    if has_aff:
+        h = _rate(cur, prev, dt, "fleet_affinity_hits_total") * dt
+        m = _rate(cur, prev, dt, "fleet_affinity_misses_total") * dt
+        if h + m == 0:          # idle window: last known rate
+            h = metrics.family_total(cur, "fleet_affinity_hits_total")
+            m = metrics.family_total(cur, "fleet_affinity_misses_total")
+        rate = h / (h + m) if (h + m) else None
+        parts.append("affinity hit "
+                     + (f"{rate:.0%}" if rate is not None else "-"))
+        shipped = metrics.family_total(cur, "fleet_prefill_shipped_total")
+        skipped = metrics.family_total(cur, "fleet_prefill_skipped_total")
+        fallback = metrics.family_total(cur,
+                                        "fleet_prefill_fallback_total")
+        if shipped or skipped or fallback:
+            parts.append(f"prefill {int(shipped)} shipped / "
+                         f"{int(skipped)} skipped / "
+                         f"{int(fallback)} colocated")
+    if has_tier:
+        mb = metrics.family_total(cur, "kv_host_bytes") / (1 << 20)
+        spilled = metrics.family_total(cur, "kv_host_spilled_pages_total")
+        readm = metrics.family_total(cur,
+                                     "kv_host_readmitted_pages_total")
+        parts.append(f"kv host {mb:.1f} MiB "
+                     f"({int(spilled)} spilled / {int(readm)} re-admitted)")
+    return "fleet: " + "   ".join(parts)
 
 
 def decode_line(cur: dict, prev: dict | None, dt: float) -> str | None:
@@ -191,19 +270,24 @@ def _ms(v):
 
 
 def render(rows: list, source: str, dt: float,
-           decode: str | None = None) -> str:
+           decode: str | None = None,
+           fleet: str | None = None) -> str:
     out = [f"serve_top — {source}  (window {dt:.1f}s)", "",
            f"{'engine':<12} {'rows/s':>8} {'queue':>6} {'inflt':>6} "
            f"{'shed/s':>7} {'p50 ms':>8} {'p95 ms':>8} {'p99 ms':>8} "
            f"{'burn':>6}"]
     for r in rows:
         marker = "*" if r["name"] == "fleet" else " "
+        # disaggregated-fleet role label (prefill/decode) when known
+        name = r["name"] if not r.get("role") \
+            else f"{r['name']}[{r['role'][0]}]"
         out.append(
-            f"{marker}{r['name']:<11} {r['rows_s']:8.1f} {r['queue']:6d} "
+            f"{marker}{name:<11} {r['rows_s']:8.1f} {r['queue']:6d} "
             f"{r['inflight']:6d} {r['shed_s']:7.1f} {_ms(r['p50_ms'])} "
             f"{_ms(r['p95_ms'])} {_ms(r['p99_ms'])} {r['burn']:6.2f}")
-    if decode:
-        out += ["", decode]
+    for line in (decode, fleet):
+        if line:
+            out += ["", line]
     return "\n".join(out)
 
 
@@ -229,7 +313,9 @@ def main(argv=None) -> int:
                           budget=args.budget)
         frame = render(rows, args.source, dt,
                        decode=decode_line(cur, prev[1] if prev else None,
-                                          dt))
+                                          dt),
+                       fleet=fleet_line(cur, prev[1] if prev else None,
+                                        dt))
         if args.once:
             print(frame)
             return 0
