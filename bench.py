@@ -27,20 +27,14 @@ import time
 import numpy as np
 
 
-PEAK_FLOPS = {
-    # bf16 dense peak per chip
-    "TPU v2": 45e12, "TPU v3": 123e12, "TPU v4": 275e12,
-    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5": 459e12,
-    "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
-}
-
-
 def guess_peak(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for k, v in PEAK_FLOPS.items():
-        if kind.startswith(k):
-            return v
-    return 197e12  # default to v5e
+    """Datasheet bf16 peak — resolved through the obs ledger's shared
+    table (``bigdl_tpu/obs/ledger.py``), the SAME denominator the live
+    ``train_mfu`` gauge divides by, so bench MFU and runtime MFU can
+    never disagree on the peak.  Lazy import keeps the bench CLI's
+    startup jax-free."""
+    from bigdl_tpu.obs.ledger import device_peak_flops
+    return device_peak_flops(device)
 
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -197,15 +191,25 @@ def bench_config(build, records_per_batch, warmup=3, iters=10, windows=3,
         for _ in range(2):   # transient relay errors can fail one attempt
             try:
                 # XLA cost analysis counts a lax.scan body ONCE, so the
-                # chunk's number is already the per-step count
-                flops = float(
-                    step.lower(params, net_state, opt_state, xs, ys, key)
-                    .compile().cost_analysis()["flops"])
-                break
-            except (KeyError, TypeError):
-                break        # deterministic shape of the analysis: no retry
+                # chunk's number is already the per-step count.  The
+                # probe resolves through the shared CostLedger — ONE
+                # cost code path with the live train_mfu gauge and
+                # tools/profile_step.py, which also normalizes the
+                # list-form cost_analysis newer jax returns (indexing
+                # it with ["flops"] used to silently nan this number)
+                from bigdl_tpu.obs import ledger as cost_ledger
+                entry = cost_ledger.get().capture_compiled(
+                    ("bench_chunk", records_per_batch, n),
+                    step.lower(params, net_state, opt_state, xs, ys,
+                               key).compile())
             except Exception:
                 continue     # transient relay/compile error: one more try
+            if entry is not None and np.isfinite(entry.flops):
+                flops = entry.flops
+                break
+            # the ledger swallowed an analysis hiccup (entry missing or
+            # flops nan): retry once — entries key per call, so this is
+            # a fresh probe, not a cache hit
     for _ in range(warmup):
         params, net_state, opt_state, loss = step(
             params, net_state, opt_state, xs, ys, key)

@@ -21,7 +21,15 @@ resilience layer:
   with Prometheus text + JSONL snapshot export (``obs/export.py`` is
   the pull endpoint, ``tools/serve_top.py`` the terminal dashboard);
 - ``trace``: sampled per-request trace contexts for the serving stack
-  (``BIGDL_OBS_TRACE_SAMPLE``), emitted as ``trace`` events.
+  (``BIGDL_OBS_TRACE_SAMPLE``), emitted as ``trace`` events;
+- ``ledger``: the compile-time cost/memory ledger (flops, bytes,
+  peak HBM per compiled executable, captured at the executable-cache
+  chokepoint), live ``train_mfu``/``decode_model_flops_util`` truth,
+  static HBM tenant accounting and the cadence device-memory sampler;
+- ``alerts``: declarative alert rules (threshold / windowed rate /
+  multiwindow SLO burn / baseline regression / HBM headroom) evaluated
+  against any registry snapshot — local or fleet-merged — with
+  hysteresis, ``alert`` events and ``alert_active`` gauges.
 
 Master switch: ``BIGDL_OBS=0`` turns the event/diagnostic machinery
 off; ``BIGDL_OBS_TAPS=0`` removes the taps from the compiled step.
@@ -32,7 +40,7 @@ off; ``BIGDL_OBS_TAPS=0`` removes the taps from the compiled step.
 # otherwise pay at import time; its consumers (serve/cluster.py, the
 # exporter tests) import it lazily.
 from bigdl_tpu.obs import (  # noqa: F401
-    diagnostics, events, metrics, spans, taps, trace,
+    alerts, diagnostics, events, ledger, metrics, spans, taps, trace,
 )
 from bigdl_tpu.obs.diagnostics import dump_crash_bundle  # noqa: F401
 from bigdl_tpu.obs.events import (  # noqa: F401

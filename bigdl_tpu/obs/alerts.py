@@ -1,0 +1,400 @@
+"""Declarative alert engine over metrics-registry snapshots
+(docs/observability.md "Performance observatory").
+
+``serve_top`` re-derived SLO burn and queue pressure inside its render
+loop; the autoscaler story (ROADMAP item 5) needs those judgements to
+live in the shared telemetry plane, evaluated against ANY registry —
+one process's (``metrics.get().snapshot``) or the whole fleet's
+(``ReplicaPool.merged_registry``).  An :class:`AlertEngine` holds a
+short snapshot history and evaluates a list of declarative
+:class:`Rule`\\ s on a cadence; rule kinds:
+
+- ``threshold`` — a counter/gauge family total vs a bound (queue depth,
+  pages in use);
+- ``rate``      — a counter's per-second delta over ``window_s`` (shed
+  rate, error rate);
+- ``burn``      — multiwindow SLO burn rate (the Google-SRE pattern):
+  (shed+failed)/offered divided by the error budget, required to exceed
+  the threshold over BOTH a short and a long window — the short window
+  makes detection fast, the long window keeps one blip from paging;
+- ``baseline``  — regression vs a rolling self-baseline: the latest
+  sample of a gauge vs the median of its own recent history (step-time
+  regression needs no absolute bound);
+- ``headroom``  — ``1 - used/limit`` of a gauge pair below a floor
+  (HBM headroom).
+
+Transitions carry hysteresis (``for_n`` consecutive breaches to fire,
+``clear_n`` consecutive OKs to resolve) so a value dancing on the bound
+cannot flap pages.  Each transition emits a schema-validated ``alert``
+event (kind firing/resolved) and mirrors an ``alert_active`` gauge
+(agg='max': any replica firing marks the fleet) so the exporter,
+``serve_top``'s ``alerts:`` line and ``obs_report``'s alert timeline
+all read the same truth.
+
+The evaluation thread follows the sampler's lifecycle contract:
+``close()`` sets the stop event and JOINS the thread (bounded).
+Evaluation happens only at cadence boundaries — the serving/training
+hot paths never see this module.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+from bigdl_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger("bigdl_tpu.obs")
+
+KINDS = ("threshold", "rate", "burn", "baseline", "headroom")
+
+
+class Rule:
+    """One declarative alert rule.  Pure data + validation; evaluation
+    lives in the engine so a rule set can be listed/serialized."""
+
+    def __init__(self, name: str, kind: str, metric: str | None = None,
+                 match: dict | None = None, op: str = ">",
+                 threshold: float = 0.0, window_s: float = 60.0,
+                 short_s: float = 60.0, long_s: float = 600.0,
+                 budget: float = 0.01, baseline_n: int = 16,
+                 min_n: int = 4, used: str | None = None,
+                 limit: str | None = None, for_n: int = 1,
+                 clear_n: int = 1, description: str = ""):
+        if kind not in KINDS:
+            raise ValueError(f"unknown rule kind {kind!r} "
+                             f"(known: {KINDS})")
+        if op not in (">", "<"):
+            raise ValueError(f"rule op must be '>' or '<': {op!r}")
+        if kind in ("threshold", "rate", "baseline") and not metric:
+            raise ValueError(f"rule {name!r} ({kind}) needs a metric")
+        if kind == "headroom" and not (used and limit):
+            raise ValueError(f"rule {name!r} (headroom) needs "
+                             f"used= and limit= metric names")
+        self.name = name
+        self.kind = kind
+        self.metric = metric
+        self.match = dict(match or {})
+        self.op = "<" if kind == "headroom" else op
+        self.threshold = float(threshold)
+        self.window_s = float(window_s)
+        self.short_s = float(short_s)
+        self.long_s = float(long_s)
+        self.budget = float(budget)
+        self.baseline_n = int(baseline_n)
+        self.min_n = int(min_n)
+        self.used = used
+        self.limit = limit
+        self.for_n = max(1, int(for_n))
+        self.clear_n = max(1, int(clear_n))
+        self.description = description
+
+    def max_window(self) -> float:
+        if self.kind == "burn":
+            return self.long_s
+        return self.window_s
+
+    def __repr__(self):
+        return (f"Rule({self.name!r}, {self.kind!r}, "
+                f"threshold={self.threshold})")
+
+
+def slo_burn(cur: dict, prev: dict | None, budget: float) -> float | None:
+    """Burn rate between two snapshots: (shed+failed)/offered over the
+    window, divided by the error budget — EXACTLY serve_top's column
+    math (offered = accepted+shed so each request counts once; router
+    admission-stage sheds never reached an engine counter).  None when
+    the window offered nothing (no traffic is not an SLO violation)."""
+    def tot(snap, **match):
+        return obs_metrics.family_total(snap, "serve_requests_total",
+                                        **match) if snap else 0.0
+
+    def admission(snap):
+        return obs_metrics.family_total(
+            snap, "router_requests_total", outcome="shed",
+            stage="admission") if snap else 0.0
+
+    d = {k: max(tot(cur, outcome=k) - tot(prev, outcome=k), 0.0)
+         for k in ("accepted", "shed", "failed")}
+    d["shed"] += max(admission(cur) - admission(prev), 0.0)
+    offered = d["accepted"] + d["shed"]
+    if offered <= 0:
+        return None
+    return (d["shed"] + d["failed"]) / offered / max(budget, 1e-9)
+
+
+class AlertEngine:
+    """Evaluate ``rules`` against ``snapshot_fn()`` on demand
+    (:meth:`evaluate_once`) or on a cadence (:meth:`start`).
+
+    Keeps a (ts, snapshot) history deque spanning the longest rule
+    window; windowed values difference the newest snapshot against the
+    oldest one inside the window (counters are monotonic, so a replica
+    restart mid-window clamps to 0 instead of going negative)."""
+
+    def __init__(self, snapshot_fn, rules, registry=None,
+                 interval: float = 5.0, emit_events: bool = True):
+        self.snapshot_fn = snapshot_fn
+        self.rules = list(rules)
+        self.interval = max(float(interval), 1e-3)
+        self._registry = registry
+        self._emit_events = emit_events
+        self._hist: deque = deque()
+        self._state = {r.name: {"active": False, "breach": 0, "ok": 0,
+                                "value": None} for r in self.rules}
+        self._baselines = {r.name: deque(maxlen=max(r.baseline_n, 2))
+                           for r in self.rules if r.kind == "baseline"}
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+        self.evaluations = 0      # cadence audit hook
+        # declare every rule's gauge at 0 up front, so the exporter
+        # carries the family from the first scrape and serve_top can
+        # render "alerts: none" while quiet (a family that only
+        # appears on first firing is indistinguishable from no alert
+        # engine at all)
+        try:
+            reg = self._registry if self._registry is not None \
+                else obs_metrics.get()
+            for r in self.rules:
+                reg.gauge("alert_active",
+                          "1 while the named alert rule is firing",
+                          agg="max", rule=r.name).set(0.0)
+        except Exception:   # pragma: no cover - obs layer unavailable
+            pass
+
+    # -- value computation --------------------------------------------------
+    def _window_snap(self, now: float, window_s: float):
+        """Oldest retained snapshot still inside [now - window, now]
+        (None until the history spans the window start)."""
+        chosen = None
+        for ts, snap in self._hist:
+            if ts >= now - window_s:
+                chosen = (ts, snap)
+                break
+        # too-young history: fall back to the oldest we have (a shorter
+        # window biases a RATE toward firing later, never spuriously)
+        if chosen is None and self._hist:
+            chosen = self._hist[0]
+        return chosen
+
+    def _span_snap(self, now: float, window_s: float):
+        """Newest retained snapshot at least ``window_s`` old — the
+        delta against it SPANS the window.  None until the history is
+        old enough (unlike :meth:`_window_snap` there is no fallback:
+        burn is a ratio, not time-normalized, so a short span does not
+        bias it conservative)."""
+        chosen = None
+        for ts, snap in self._hist:
+            if ts <= now - window_s:
+                chosen = (ts, snap)
+            else:
+                break
+        return chosen
+
+    def _value(self, rule: Rule, cur: dict, now: float):
+        if rule.kind == "threshold":
+            return obs_metrics.family_total(cur, rule.metric,
+                                            **rule.match)
+        if rule.kind == "rate":
+            ref = self._window_snap(now, rule.window_s)
+            if ref is None or now <= ref[0]:
+                return None
+            d = (obs_metrics.family_total(cur, rule.metric, **rule.match)
+                 - obs_metrics.family_total(ref[1], rule.metric,
+                                            **rule.match))
+            return max(d, 0.0) / (now - ref[0])
+        if rule.kind == "burn":
+            shorts = self._window_snap(now, rule.short_s)
+            # the long reference must actually SPAN long_s: with young
+            # history a within-window lookup would degenerate both
+            # windows to the same young snapshot and one startup blip
+            # would page — exactly what the multiwindow pattern exists
+            # to prevent
+            longs = self._span_snap(now, rule.long_s)
+            if shorts is None or longs is None:
+                return None
+            bs = slo_burn(cur, shorts[1], rule.budget)
+            bl = slo_burn(cur, longs[1], rule.budget)
+            if bs is None or bl is None:
+                return None
+            # multiwindow: BOTH windows must exceed, so the comparable
+            # value is the smaller burn of the two
+            return min(bs, bl)
+        if rule.kind == "baseline":
+            sample = obs_metrics.family_total(cur, rule.metric,
+                                              **rule.match)
+            hist = self._baselines[rule.name]
+            if sample <= 0:
+                return None
+            prior = sorted(hist)
+            # append only on CHANGE: the gauge updates at its own
+            # cadence (flush boundaries), slower than the evaluation
+            # tick — re-appending an unchanged regressed value would
+            # drag the rolling median up to it and self-resolve the
+            # alert while the regression persists
+            if not hist or hist[-1] != sample:
+                hist.append(sample)
+            if len(prior) < rule.min_n:
+                return None
+            baseline = prior[len(prior) // 2]     # median of history
+            if baseline <= 0:
+                return None
+            return sample / baseline
+        if rule.kind == "headroom":
+            used = obs_metrics.family_total(cur, rule.used, **rule.match)
+            limit = obs_metrics.family_total(cur, rule.limit,
+                                             **rule.match)
+            if limit <= 0:
+                return None
+            return 1.0 - used / limit
+        return None   # pragma: no cover - kinds validated in Rule
+
+    # -- transitions --------------------------------------------------------
+    def _transition(self, rule: Rule, kind: str, value):
+        try:
+            reg = self._registry
+            if reg is None:
+                reg = obs_metrics.get()
+            reg.gauge("alert_active",
+                      "1 while the named alert rule is firing",
+                      agg="max", rule=rule.name).set(
+                          1.0 if kind == "firing" else 0.0)
+        except Exception:   # pragma: no cover - obs layer mid-teardown
+            pass
+        if self._emit_events:
+            try:
+                from bigdl_tpu.obs import events
+                events.emit("alert", kind=kind, rule=rule.name,
+                            value=float(value), threshold=rule.threshold,
+                            rule_kind=rule.kind,
+                            description=rule.description)
+            except Exception:   # pragma: no cover - defensive
+                pass
+        logger.info("alert %s: %s (value=%.4g threshold=%.4g)",
+                    kind, rule.name, value, rule.threshold)
+
+    def evaluate_once(self, snapshot=None, now=None) -> list:
+        """One evaluation pass; returns the transitions fired this pass
+        as ``(rule_name, 'firing'|'resolved', value)`` tuples.  Safe to
+        call concurrently with the cadence thread (locked)."""
+        with self._lock:
+            if now is None:
+                now = time.time()
+            if snapshot is None:
+                try:
+                    snapshot = self.snapshot_fn()
+                except Exception as e:  # pragma: no cover - racing close
+                    logger.warning("alert snapshot pull failed: %s", e)
+                    return []
+            transitions = []
+            for rule in self.rules:
+                st = self._state[rule.name]
+                value = self._value(rule, snapshot, now)
+                st["value"] = value
+                breached = False
+                if value is not None:
+                    breached = (value > rule.threshold if rule.op == ">"
+                                else value < rule.threshold)
+                if breached:
+                    st["breach"] += 1
+                    st["ok"] = 0
+                    if not st["active"] and st["breach"] >= rule.for_n:
+                        st["active"] = True
+                        self._transition(rule, "firing", value)
+                        transitions.append((rule.name, "firing", value))
+                else:
+                    st["ok"] += 1
+                    st["breach"] = 0
+                    if st["active"] and st["ok"] >= rule.clear_n:
+                        st["active"] = False
+                        self._transition(
+                            rule, "resolved",
+                            value if value is not None else 0.0)
+                        transitions.append((rule.name, "resolved",
+                                            value))
+            # history AFTER evaluation: windowed rules difference the
+            # current snapshot against strictly older ones
+            self._hist.append((now, snapshot))
+            horizon = max([r.max_window() for r in self.rules],
+                          default=0.0) * 1.25 + self.interval
+            while len(self._hist) > 2 and \
+                    self._hist[0][0] < now - horizon:
+                self._hist.popleft()
+            self.evaluations += 1
+            return transitions
+
+    def active(self) -> list:
+        """Names of currently-firing rules (sorted)."""
+        with self._lock:
+            return sorted(n for n, st in self._state.items()
+                          if st["active"])
+
+    def state(self) -> dict:
+        with self._lock:
+            return {n: dict(st) for n, st in self._state.items()}
+
+    # -- cadence thread -----------------------------------------------------
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.evaluate_once()
+            except Exception as e:  # pragma: no cover - defensive
+                logger.warning("alert evaluation failed: %s", e)
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="bigdl-obs-alerts")
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = 10.0):
+        """Stop-event + bounded join (the sampler/Router lifecycle
+        contract) — idempotent."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def default_rules(budget: float = 0.01, queue_depth: float = 64.0,
+                  shed_per_s: float = 1.0, burn: float = 1.0,
+                  step_time_factor: float = 2.0,
+                  hbm_headroom: float = 0.05, short_s: float = 60.0,
+                  long_s: float = 600.0) -> list:
+    """The shipped rule set (docs/observability.md has the table):
+    SLO burn (multiwindow), shed rate, queue depth, train step-time
+    regression vs a rolling self-baseline, and HBM headroom."""
+    return [
+        Rule("slo_burn", "burn", budget=budget, threshold=burn,
+             short_s=short_s, long_s=long_s, clear_n=2,
+             description="error budget burning faster than it accrues "
+                         "over both windows"),
+        Rule("shed_rate", "rate", metric="serve_requests_total",
+             match={"outcome": "shed"}, window_s=short_s,
+             threshold=shed_per_s, clear_n=2,
+             description="admission shedding sustained above "
+                         f"{shed_per_s}/s"),
+        Rule("queue_depth", "threshold", metric="serve_queue_depth",
+             threshold=queue_depth,
+             description="fleet queue depth above bound"),
+        Rule("step_time_regression", "baseline",
+             metric="train_step_wall_seconds",
+             threshold=step_time_factor, min_n=4, for_n=2, clear_n=2,
+             description="windowed train step wall above "
+                         f"{step_time_factor}x its rolling median"),
+        Rule("hbm_headroom", "headroom", used="hbm_bytes_in_use",
+             limit="hbm_bytes_limit", threshold=hbm_headroom,
+             description="free HBM below "
+                         f"{hbm_headroom:.0%} of capacity"),
+    ]

@@ -34,11 +34,16 @@ logger = logging.getLogger("bigdl_tpu.obs")
 #: bump when an event type gains/loses REQUIRED fields; readers accept
 #: unknown optional fields at any version.  v2: `serve` events grew
 #: per-kind required fields (SERVE_KINDS) and the `trace` type landed.
-SCHEMA_VERSION = 2
+#: v3: the `ledger` (compile-time cost/HBM truth) and `alert`
+#: (declarative rule transitions) types landed, each with per-kind
+#: required fields (LEDGER_KINDS / ALERT_KINDS).
+SCHEMA_VERSION = 3
 
 ENV_OBS = "BIGDL_OBS"
 ENV_DIR = "BIGDL_OBS_DIR"
 ENV_RING = "BIGDL_OBS_RING"
+ENV_MAX_MB = "BIGDL_OBS_MAX_MB"
+ENV_KEEP = "BIGDL_OBS_KEEP"
 
 #: required fields per event type (beyond the common envelope); optional
 #: fields (taps, straggler_dropped, skips, ...) are free-form
@@ -70,6 +75,13 @@ EVENT_TYPES = {
     "preempt": ("step",),
     "abort": ("step", "reason"),
     "crash_bundle": ("reason", "path"),
+    # compile-time cost/HBM ledger (obs/ledger.py): kind-specific
+    # required fields in LEDGER_KINDS — exec captures, tenant bytes,
+    # device-memory samples (the obs_report HBM timeline)
+    "ledger": ("kind",),
+    # declarative alert transitions (obs/alerts.py): firing/resolved
+    # with the rule name + the value/threshold that judged it
+    "alert": ("kind", "rule"),
 }
 
 #: per-kind REQUIRED fields for `serve` events (v2).  An unknown kind is
@@ -111,7 +123,29 @@ RECOVER_KINDS = {
     "abort": ("reason",),
 }
 
+#: per-kind REQUIRED fields for `ledger` events (schema v3, same
+#: contract as SERVE_KINDS): an unknown kind is a validation error.
+#: `exec` is one compiled executable's cost truth (obs/ledger.py
+#: capture), `tenant` a named large allocation's current bytes,
+#: `hbm` one device-memory sampler tick (the report's HBM timeline).
+LEDGER_KINDS = {
+    "exec": ("fn", "flops", "bytes_accessed"),
+    "tenant": ("tenant", "bytes"),
+    "hbm": ("in_use",),
+}
+
+#: per-kind REQUIRED fields for `alert` events (schema v3): every
+#: transition carries the value that judged it and the rule's bound,
+#: so a postmortem reads the margin without replaying the registry.
+ALERT_KINDS = {
+    "firing": ("value", "threshold"),
+    "resolved": ("value", "threshold"),
+}
+
 _COMMON = ("v", "ts", "proc", "type")
+
+_KINDED = {"serve": SERVE_KINDS, "recover": RECOVER_KINDS,
+           "ledger": LEDGER_KINDS, "alert": ALERT_KINDS}
 
 
 def validate_event(event: dict) -> dict:
@@ -136,27 +170,18 @@ def validate_event(event: dict) -> dict:
     missing = [k for k in required if k not in event]
     if missing:
         raise ValueError(f"{etype!r} event missing {missing}: {event}")
-    if etype == "serve":
+    kinds = _KINDED.get(etype)
+    if kinds is not None:
         kind = event["kind"]
-        per_kind = SERVE_KINDS.get(kind)
+        per_kind = kinds.get(kind)
         if per_kind is None:
-            raise ValueError(f"unknown serve kind {kind!r} "
-                             f"(known: {sorted(SERVE_KINDS)})")
+            raise ValueError(f"unknown {etype} kind {kind!r} "
+                             f"(known: {sorted(kinds)})")
         missing = [k for k in per_kind if k not in event]
         if missing:
             raise ValueError(
-                f"serve/{kind} event missing {missing}: {event}")
-    elif etype == "recover":
-        kind = event["kind"]
-        per_kind = RECOVER_KINDS.get(kind)
-        if per_kind is None:
-            raise ValueError(f"unknown recover kind {kind!r} "
-                             f"(known: {sorted(RECOVER_KINDS)})")
-        missing = [k for k in per_kind if k not in event]
-        if missing:
-            raise ValueError(
-                f"recover/{kind} event missing {missing}: {event}")
-    elif etype == "trace":
+                f"{etype}/{kind} event missing {missing}: {event}")
+    if etype == "trace":
         hops = event["hops"]
         if (not isinstance(hops, list) or not hops
                 or not all(isinstance(h, (list, tuple)) and len(h) == 2
@@ -184,9 +209,20 @@ class EventLog:
     signal-handler epilogue may all emit concurrently."""
 
     def __init__(self, run_dir: str | None = None, ring: int | None = None,
-                 process_index: int | None = None):
+                 process_index: int | None = None,
+                 max_mb: float | None = None, keep: int | None = None):
         if ring is None:
             ring = int(os.environ.get(ENV_RING, "512"))
+        if max_mb is None:
+            try:
+                max_mb = float(os.environ.get(ENV_MAX_MB, "0") or 0)
+            except ValueError:
+                max_mb = 0.0
+        if keep is None:
+            try:
+                keep = int(os.environ.get(ENV_KEEP, "2"))
+            except ValueError:
+                keep = 2
         self.run_dir = run_dir
         self._proc = process_index
         self._ring = deque(maxlen=max(int(ring), 1))
@@ -194,6 +230,15 @@ class EventLog:
         self._sinks = []     # extra per-event callbacks (add_sink)
         self._fh = None
         self.path = None
+        #: JSONL size cap (bytes; 0 = unlimited): a week-long serving
+        #: run must not fill the disk.  On overflow the current file
+        #: rotates to `<path>.1` with keep-last semantics (like
+        #: `BIGDL_CKPT_KEEP`): the newest `keep` rotated segments
+        #: survive, older ones are deleted.  The in-memory ring — and
+        #: therefore crash bundles — is unaffected by rotation.
+        self._max_bytes = int(float(max_mb) * (1 << 20))
+        self._keep = max(1, int(keep))
+        self.rotations = 0
         if run_dir:
             os.makedirs(run_dir, exist_ok=True)
             self.path = os.path.join(
@@ -215,8 +260,31 @@ class EventLog:
                 self._fh.write(json.dumps(event, default=_jsonable))
                 self._fh.write("\n")
                 self._fh.flush()
+                if self._max_bytes and self._fh.tell() >= self._max_bytes:
+                    self._rotate()
             except (OSError, ValueError) as e:
                 logger.warning("event sink write failed: %s", e)
+
+    def _rotate(self):
+        """Shift the full JSONL to ``<path>.1`` (``.1``→``.2``, ...;
+        segments beyond ``keep`` deleted) and reopen a fresh file.
+        Called under the lock from :meth:`_record`; best-effort — a
+        rotation failure must not kill the emitter."""
+        try:
+            self._fh.close()
+            last = self.path + f".{self._keep}"
+            if os.path.exists(last):
+                os.unlink(last)
+            for j in range(self._keep - 1, 0, -1):
+                src = self.path + f".{j}"
+                if os.path.exists(src):
+                    os.replace(src, self.path + f".{j + 1}")
+            os.replace(self.path, self.path + ".1")
+            self.rotations += 1
+        except OSError as e:   # pragma: no cover - fs race/perm
+            logger.warning("event log rotation failed: %s", e)
+        finally:
+            self._fh = open(self.path, "a")
 
     def emit(self, etype: str, **fields) -> dict:
         """Append one event (common envelope added here).  Never raises
@@ -317,12 +385,15 @@ def get() -> EventLog | None:
 
 
 def configure(run_dir: str | None = None, ring: int | None = None,
-              process_index: int | None = None) -> EventLog:
+              process_index: int | None = None,
+              max_mb: float | None = None,
+              keep: int | None = None) -> EventLog:
     """Install a process event log programmatically (launchers, tests)."""
     global _LOG, _LOADED
     if _LOG is not None:
         _LOG.close()
-    _LOG = EventLog(run_dir=run_dir, ring=ring, process_index=process_index)
+    _LOG = EventLog(run_dir=run_dir, ring=ring, process_index=process_index,
+                    max_mb=max_mb, keep=keep)
     _LOADED = True
     return _LOG
 

@@ -1244,6 +1244,9 @@ class DistriOptimizer(LocalOptimizer):
         state["preempted"] = False
 
         step_fn = self._build_step()  # pipeline mode builds its plan here
+        # ledger key for the windowed train_mfu gauge (pipeline-mode
+        # steps carry no fn_key; the gauge just stays silent there)
+        self._step_fn_key = getattr(step_fn, "fn_key", None)
         params = jax.tree_util.tree_map(jnp.copy, self.model.params())
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
         if self._pipe_plan is not None:

@@ -488,6 +488,7 @@ class LocalOptimizer:
         w.flush_reasons.append(reason)
         records = sum(e.records for e in entries)
         rate = records / max(wall, 1e-9)
+        self._note_window_utilization(entries, wall)
         epoch_size = self.dataset.size()
         abort = None
         for e, lv, fv in zip(entries, losses, finites):
@@ -508,6 +509,44 @@ class LocalOptimizer:
         if abort is not None:
             raise abort
 
+    def _note_window_utilization(self, entries, wall):
+        """Windowed ``train_mfu`` + ``train_step_wall_seconds`` gauges,
+        published at flush boundaries ONLY (the host-sync cadence — the
+        warm path never pays this): ledger flops for the compiled step
+        x iterations in the window / (window wall x datasheet peak).
+        The flops come from the compile-time capture of THIS loop's
+        tracked-jit step (``obs/ledger.py``; a scanned chunk's scan
+        body is counted once by XLA, so the chunk entry is already the
+        per-iteration count), which is the same number ``bench.py``
+        resolves — live MFU and bench MFU cannot silently diverge.
+        Best-effort: absent ledger/flops just skips the gauge."""
+        fn_key = getattr(self, "_step_fn_key", None)
+        if fn_key is None or wall <= 0 or not entries:
+            return
+        try:
+            from bigdl_tpu.obs import ledger as obs_ledger
+            from bigdl_tpu.obs import metrics as obs_metrics
+            iters = len(entries) * max(
+                1, int(getattr(self, "iters_per_dispatch", 1)))
+            label = ("distri" if type(self).__name__.startswith("Distri")
+                     else "local")
+            reg = obs_metrics.get()
+            reg.gauge("train_step_wall_seconds",
+                      "windowed mean train-step wall (fetch + dispatch "
+                      "+ sync)", agg="max",
+                      optimizer=label).set(wall / iters)
+            flops = obs_ledger.get().flops_for(fn_key)
+            if flops:
+                mfu = (flops * iters
+                       / (wall * obs_ledger.device_peak_flops()))
+                reg.gauge("train_mfu",
+                          "windowed model flops utilization of the "
+                          "training loop (ledger flops x step rate / "
+                          "datasheet peak)", agg="max",
+                          optimizer=label).set(mfu)
+        except Exception as e:  # pragma: no cover - obs mid-teardown
+            logger.warning("train utilization gauge failed: %s", e)
+
     # -- main loop (ref LocalOptimizer.optimize :77) ----------------------
     def optimize(self):
         state = self.state
@@ -524,6 +563,9 @@ class LocalOptimizer:
         net_state = jax.tree_util.tree_map(jnp.copy, self.model.state())
         opt_state = self._initial_opt_state(params)
         step_fn = self._build_step()
+        # the ledger key the MFU gauge resolves flops through (the
+        # tracked-jit wrapper captured cost at its compiling dispatch)
+        self._step_fn_key = getattr(step_fn, "fn_key", None)
         monitor = self._start_obs_run()
 
         count = 0
@@ -835,6 +877,13 @@ class LocalOptimizer:
         """Fresh taps monitor + run_start event at each optimize()."""
         self._taps_monitor = obs_taps.TapsMonitor(self._taps_cadence,
                                                   self._taps_enabled)
+        try:
+            # BIGDL_OBS_HBM_SAMPLE=<s>: cadence HBM sampler for the
+            # run (process-wide, started once; obs/ledger.py)
+            from bigdl_tpu.obs import ledger as obs_ledger
+            obs_ledger.maybe_start_sampler_from_env()
+        except Exception:   # pragma: no cover - obs layer unavailable
+            pass
         obs_events.emit("run_start", flags=self._obs_flags())
         return self._taps_monitor
 

@@ -127,6 +127,13 @@ class WeightStore:
             self._versions[version] = snap
             while len(self._versions) > self.keep:
                 del self._versions[min(self._versions)]
+            retained = list(self._versions.values())
+        # host-RAM tenant truth: every retained snapshot's bytes (the
+        # store is host numpy, not HBM — the breakdown table labels it)
+        from bigdl_tpu.obs import ledger as obs_ledger
+        obs_ledger.note_tenant(
+            "weight_store_host",
+            sum(obs_ledger.tree_nbytes(s) for s in retained))
         return version
 
     def put_model(self, model) -> int:
@@ -536,6 +543,14 @@ class ReplicaPool:
                              est_ms=est_ms, trace_sample=trace_sample)
         self.store = store if store is not None else WeightStore()
         self.exporter = None
+        self.alerts = None
+        try:
+            # BIGDL_OBS_HBM_SAMPLE=<s>: cadence HBM sampler for the
+            # serving process (process-wide, started once)
+            from bigdl_tpu.obs import ledger as obs_ledger
+            obs_ledger.maybe_start_sampler_from_env()
+        except Exception:   # pragma: no cover - obs layer unavailable
+            pass
         from bigdl_tpu.obs import export as obs_export
         port = obs_export.export_port_default()
         if port is not None:
@@ -672,6 +687,26 @@ class ReplicaPool:
                 self.merged_registry, port=port, host=host)
         return self.exporter
 
+    def start_alerts(self, rules=None, interval: float = 5.0,
+                     **rule_kwargs):
+        """Start the declarative alert engine (``obs/alerts.py``) over
+        :meth:`merged_registry` — the fleet-truth signal surface the
+        autoscaler story consumes.  ``rules=None`` installs the default
+        set (SLO burn, shed rate, queue depth, step-time regression,
+        HBM headroom; ``rule_kwargs`` tune its bounds).  Fired/resolved
+        transitions emit ``alert`` events and ``alert_active`` gauges,
+        which ride THIS process's registry and therefore the exporter
+        and ``serve_top``'s ``alerts:`` line.  Closed with the pool;
+        idempotent."""
+        if self.alerts is None:
+            from bigdl_tpu.obs import alerts as obs_alerts
+            if rules is None:
+                rules = obs_alerts.default_rules(**rule_kwargs)
+            self.alerts = obs_alerts.AlertEngine(
+                self.merged_registry, rules,
+                interval=interval).start()
+        return self.alerts
+
     def stats(self) -> dict:
         """Fleet snapshot: the router's counters, one entry per replica
         (its ``engine.stats()`` view), and ``merged`` — the TRUE merge
@@ -715,6 +750,9 @@ class ReplicaPool:
                 self.router.drain()
             except TimeoutError:  # pragma: no cover - shutdown path
                 pass
+        if self.alerts is not None:
+            self.alerts.close()
+            self.alerts = None
         if self.exporter is not None:
             self.exporter.close()
             self.exporter = None

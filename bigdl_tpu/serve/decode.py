@@ -652,6 +652,18 @@ class ContinuousDecoder:
         reg.gauge("decode_kv_bytes_per_token",
                   "KV bytes per pooled token incl. scales",
                   **lab).set(self.kv_bytes_per_token)
+        #: live decode utilization (docs/observability.md "Performance
+        #: observatory"): ledger flops of the compiled step program x
+        #: step rate over the boundary window / datasheet peak — set
+        #: once per sync boundary, never per token
+        self._m_util = reg.gauge(
+            "decode_model_flops_util",
+            "model flops utilization of the decode step over the last "
+            "sync-boundary window", agg="max", **lab)
+        self._m_toks = reg.gauge(
+            "decode_tokens_per_s",
+            "committed tokens per second over the last sync-boundary "
+            "window", **lab)
         if self.paged:
             self._m_pages = reg.gauge(
                 "decode_pages_in_use", "allocated KV pool pages", **lab)
@@ -685,6 +697,19 @@ class ContinuousDecoder:
         self.spec_accepted = 0
 
         self._warm()
+
+        # cost truth for the utilization gauge: the step program's
+        # compile-time ledger capture (its tracked_jit key), plus the
+        # KV pool's static HBM tenant entry — both labelled with this
+        # decoder's name so close()'s drop_series reclaims them
+        from bigdl_tpu.obs import ledger as obs_ledger
+        self._step_flops = obs_ledger.get().flops_for(self._step.fn_key)
+        self._peak_flops = obs_ledger.device_peak_flops()
+        self._util_t_last = time.perf_counter()
+        obs_ledger.note_tenant(
+            "kv_pool", sum(obs_ledger.tree_nbytes(c)
+                           for c in self._caches),
+            decoder=self.name, paged=self.paged, kv_quant=self.kv_quant)
 
     # -- compiled-program drivers -------------------------------------------
     def _run_step(self):
@@ -1002,9 +1027,13 @@ class ContinuousDecoder:
         drained, or — defensively — a stalled queue whose futures were
         just failed)."""
         spec = self.spec_k > 0
+        w0, a0 = self.spec_windows, self.spec_accepted
         self._admit_waiting()
         live = [r for r in self._slots if r is not None]
         if not live:
+            # idle boundary: restart the utilization window so wait
+            # time between submissions is not charged to the next one
+            self._util_t_last = time.perf_counter()
             if self._pending:   # pragma: no cover - defensive
                 # submit() guarantees every queued request can fit an
                 # empty pool, so an empty slab with work pending is a
@@ -1041,11 +1070,43 @@ class ContinuousDecoder:
             for r in done:
                 s = len(r.seed)
                 toks = gen_host[r.slot, s - 1:s - 1 + r.n_words]
-                r.future.set_result(r.seed + [int(t) for t in toks])
+                # retire BEFORE resolving: a serial client waiting on
+                # this future may submit again the instant it resolves,
+                # and the dispatch decision it triggers (least-loaded /
+                # affinity, serve/fleet.py) must see this slot free —
+                # resolving first leaves a window where outstanding()
+                # still counts the finished request (the fleet drill's
+                # old flake)
                 self._retire_req(r)
+                r.future.set_result(r.seed + [int(t) for t in toks])
             self._m_slots.set(sum(1 for r in self._slots
                                   if r is not None))
+        if spec:
+            # a speculative window commits its accepted drafts plus the
+            # verify token — both counters were drained this boundary
+            tokens = ((self.spec_windows - w0)
+                      + (self.spec_accepted - a0))
+        else:
+            tokens = len(live) * self.sync_interval
+        self._note_util(tokens)
         return len(live)
+
+    def _note_util(self, tokens: int):
+        """``decode_model_flops_util`` + ``decode_tokens_per_s``: one
+        gauge set per sync boundary (the decode cadence unit — never
+        per token or per step).  The window is boundary-entry to
+        boundary-entry wall, so asynchronously queued device work
+        amortizes across boundaries without forcing an extra host
+        sync; flops come from the step program's compile-time ledger
+        capture."""
+        now = time.perf_counter()
+        wall, self._util_t_last = now - self._util_t_last, now
+        if wall <= 0:
+            return
+        self._m_toks.set(tokens / wall)
+        if self._step_flops:
+            self._m_util.set(self._step_flops * self.sync_interval
+                             / (wall * self._peak_flops))
 
     def run(self):
         """Drive the decoder until every submitted request has resolved.

@@ -206,6 +206,13 @@ class ServeEngine:
         self.weights_version = 0
         self._staged = None      # (version, (params, state)) or None
         self._prev_weights = None  # one-deep history for revert_weights
+        # HBM tenant truth (obs/ledger.py): the pinned weight pack's
+        # bytes — under weight quantization this is the int8/fp8 pack
+        # size, i.e. the density the quantized-serving docs claim
+        from bigdl_tpu.obs import ledger as obs_ledger
+        obs_ledger.note_tenant(
+            "serve_weights", obs_ledger.tree_nbytes(self._weights),
+            engine=self.name, quant=self.quant)
 
         # ONE compiled-forward path per model: the same xcache-backed
         # eval fn the validators use (optim.local_optimizer._eval_fn) —
@@ -468,7 +475,17 @@ class ServeEngine:
             # rollback-by-version rollout intentionally serves an older
             # store entry; only the WeightStore numbering is monotonic
             self._staged = (int(version), staged)
+        # a staged pair costs HBM but no latency — exactly what the
+        # ledger's tenant breakdown exists to make visible
+        from bigdl_tpu.obs import ledger as obs_ledger
+        obs_ledger.note_tenant("staged_weights",
+                               obs_ledger.tree_nbytes(staged),
+                               engine=self.name)
         return self
+
+    def _clear_staged_tenant(self):
+        from bigdl_tpu.obs import ledger as obs_ledger
+        obs_ledger.note_tenant("staged_weights", 0, engine=self.name)
 
     def commit_weights(self) -> int:
         """Phase 2: atomically flip serving to the staged weights.  The
@@ -483,6 +500,7 @@ class ServeEngine:
             self._weights = staged
             self.weights_version = version
             self._staged = None
+        self._clear_staged_tenant()
         self._m_version.set(version)
         self._emit("weights_commit", version=version)
         return version
@@ -492,6 +510,7 @@ class ServeEngine:
         the flip).  No-op when nothing is staged."""
         with self._lock:
             self._staged = None
+        self._clear_staged_tenant()
         return self
 
     def revert_weights(self) -> int:

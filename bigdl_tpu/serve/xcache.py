@@ -172,6 +172,14 @@ class ExecutableCache:
             self._exes[key] = exe
             self.compiles += 1
         _note_metric("xcache_compiles_total")
+        # cost/HBM ledger capture rides the compile, keyed by the SAME
+        # cache key (obs/ledger.py); hits above never reach this line,
+        # so the warm path stays ledger-free
+        try:
+            from bigdl_tpu.obs import ledger as obs_ledger
+            obs_ledger.get().capture_compiled(fn_key, exe, key=key)
+        except Exception:   # pragma: no cover - obs layer unavailable
+            pass
         return exe, True
 
     def note_jit_dispatch(self, fn_key, key_args, mesh=None) -> bool:
@@ -289,7 +297,19 @@ def tracked_jit(fn, fn_key, key_argnums=None, mesh=None, **jit_kwargs):
     def wrapper(*args):
         sel = args if key_argnums is None else tuple(
             args[i] for i in key_argnums)
-        cache.note_jit_dispatch(fn_key, sel, mesh)
+        fresh = cache.note_jit_dispatch(fn_key, sel, mesh)
+        if fresh:
+            # ledger capture on the dispatch that compiles, BEFORE the
+            # dispatch runs — it may donate these argument buffers.
+            # Cost comes from the lowering alone (one extra trace, no
+            # second XLA compile); warm dispatches skip this entirely.
+            try:
+                from bigdl_tpu.obs import ledger as obs_ledger
+                obs_ledger.get().capture_lowered(
+                    fn_key, cache.key_for(fn_key, sel, mesh), jitted,
+                    args)
+            except Exception:  # pragma: no cover - obs layer unavailable
+                pass
         return jitted(*args)
 
     wrapper.jitted = jitted

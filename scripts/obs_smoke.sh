@@ -8,6 +8,10 @@
 #      the step-time overhead vs taps-off must be in the noise
 #   3. a BIGDL_FAULTS proc_kill drill under the heartbeat watchdog: the
 #      survivor must exit 43 AND leave a crash bundle the report renders
+#   4. the performance-observatory drill (ISSUE 13): a 5-step LeNet run
+#      must leave ledger events + a finite, stable train_mfu gauge, an
+#      injected queue-depth spike must fire then resolve an alert, and
+#      obs_report must render the ledger + alert sections
 #
 #   scripts/obs_smoke.sh            # full smoke
 #
@@ -16,13 +20,14 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== obs smoke 1/3: fast obs-marked tests =="
-python -m pytest tests/test_obs.py tests/test_obs_metrics.py -q \
+echo "== obs smoke 1/4: fast obs-marked tests =="
+python -m pytest tests/test_obs.py tests/test_obs_metrics.py \
+    tests/test_obs_ledger.py tests/test_obs_alerts.py -q \
     -m "obs and not slow" \
     -p no:cacheprovider -p no:randomly
 
 RUN=$(mktemp -d)
-echo "== obs smoke 2/3: 5-step LeNet with taps+events ($RUN) =="
+echo "== obs smoke 2/4: 5-step LeNet with taps+events ($RUN) =="
 BIGDL_OBS_DIR="$RUN" BIGDL_OBS_TAPS=1 BIGDL_OBS_TAPS_CADENCE=2 \
 python - "$RUN" <<'PY'
 import json, sys, time
@@ -103,7 +108,7 @@ echo "OK: report rendered ($RUN/report.md)"
 
 RUN2=$(mktemp -d)
 HB=$(mktemp -d)
-echo "== obs smoke 3/3: watchdog trip via BIGDL_FAULTS ($RUN2) =="
+echo "== obs smoke 3/4: watchdog trip via BIGDL_FAULTS ($RUN2) =="
 python - "$RUN2" "$HB" <<'PY'
 import os, socket, subprocess, sys
 
@@ -132,4 +137,80 @@ print(f"OK: watchdog trip left crash bundle {bundles[0]}")
 PY
 python tools/obs_report.py "$RUN2" -o "$RUN2/report.md"
 grep -q "Crash bundles" "$RUN2/report.md"
+
+RUN3=$(mktemp -d)
+echo "== obs smoke 4/4: performance observatory drill ($RUN3) =="
+BIGDL_OBS_DIR="$RUN3" python - <<'PY'
+import math
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.dataset.transformer import SampleToBatch
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.obs import alerts as obs_alerts
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.obs import ledger as obs_ledger
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.obs.events import read_events, validate_event
+from bigdl_tpu.optim import LocalOptimizer, max_iteration
+from bigdl_tpu.utils.random import set_seed
+from bigdl_tpu.utils.table import T
+
+rng = np.random.RandomState(0)
+samples = [Sample(rng.rand(28, 28).astype(np.float32),
+                  np.asarray([float(rng.randint(1, 11))]))
+           for _ in range(64)]
+ds = DataSet.array(samples) >> SampleToBatch(8)
+
+
+def mfu_after(steps):
+    set_seed(1)
+    opt = LocalOptimizer(LeNet5(10), ds, nn.ClassNLLCriterion())
+    opt.set_state(T(learningRate=0.05))
+    opt.set_end_when(max_iteration(steps))
+    opt.optimize()
+    return obs_metrics.family_total(obs_metrics.get().snapshot(),
+                                    "train_mfu", optimizer="local")
+
+
+# ledger + MFU: the capture rides the compile, the gauge the flushes
+mfu1 = mfu_after(5)
+assert math.isfinite(mfu1) and mfu1 > 0, mfu1
+led = obs_ledger.get().stats()
+assert led["captures"] >= 1, led
+mfu2 = mfu_after(5)      # warm re-run: finite and same order (stable)
+assert math.isfinite(mfu2) and mfu2 > 0, mfu2
+assert 0.2 < mfu2 / mfu1 < 5.0, (mfu1, mfu2)
+events = read_events(obs_events.get().path)
+for e in events:
+    validate_event(e)
+execs = [e for e in events if e["type"] == "ledger"
+         and e["kind"] == "exec"]
+assert execs, "ledger/exec events must ride the JSONL stream"
+print(f"OK: {len(execs)} ledger capture(s); train_mfu {mfu1:.2e} "
+      f"(re-run {mfu2:.2e})")
+
+# alert drill: inject a queue-depth spike, watch it fire then resolve
+reg = obs_metrics.get()
+engine = obs_alerts.AlertEngine(
+    reg.snapshot, [r for r in obs_alerts.default_rules()
+                   if r.name == "queue_depth"])
+assert engine.evaluate_once() == []
+spike = reg.gauge("serve_queue_depth", "drill", engine="drill")
+spike.set(999)
+assert engine.evaluate_once() == [("queue_depth", "firing", 999.0)]
+spike.set(0)
+assert engine.evaluate_once() == [("queue_depth", "resolved", 0.0)]
+kinds = [e["kind"] for e in obs_events.get().ring_events()
+         if e["type"] == "alert"]
+assert kinds == ["firing", "resolved"], kinds
+print("OK: queue-depth spike fired and resolved")
+PY
+python tools/obs_report.py "$RUN3" --strict -o "$RUN3/report.md"
+grep -q "Performance ledger" "$RUN3/report.md"
+grep -q "Alert timeline" "$RUN3/report.md"
+echo "OK: observatory report rendered ($RUN3/report.md)"
 echo "obs smoke: all green"

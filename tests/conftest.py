@@ -58,6 +58,11 @@ def _seed():
     mx = sys.modules.get("bigdl_tpu.obs.metrics")
     if mx is not None:
         mx.reset()
+    # and the cost ledger, whose capture counter the warm-path audits
+    # assert on (reset also stops an env-started HBM sampler thread)
+    lg = sys.modules.get("bigdl_tpu.obs.ledger")
+    if lg is not None:
+        lg.reset()
     yield
 
 

@@ -1,0 +1,417 @@
+"""Declarative alert engine tests (docs/observability.md "Performance
+observatory", pytest -m obs).
+
+Load-bearing contracts:
+
+- rule kinds compute the documented values: threshold on family
+  totals, windowed per-second rates, multiwindow SLO burn with
+  serve_top's exact offered/bad arithmetic, baseline regression vs a
+  rolling median, HBM headroom;
+- hysteresis: ``for_n`` consecutive breaches to fire, ``clear_n``
+  consecutive OKs to resolve — a value dancing on the bound cannot
+  flap;
+- transitions emit schema-valid ``alert`` events and mirror
+  ``alert_active`` gauges (agg max — any replica firing marks the
+  fleet);
+- the cadence thread evaluates at its interval only and joins on
+  close (the stop-event lifecycle contract);
+- ``serve_top`` renders the ``alerts:`` line from the gauges and
+  falls back to its lifetime histogram on idle/first frames (the
+  documented-but-previously-untested fallback).
+"""
+import os
+import time
+
+import pytest
+
+from bigdl_tpu.obs import alerts as obs_alerts
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.obs.events import validate_event
+
+pytestmark = pytest.mark.obs
+
+
+def _snap(**families):
+    """Registry snapshot with counter families from kwargs:
+    ``name={"label=value,...": total}`` shorthand."""
+    reg = obs_metrics.Registry()
+    for name, series in families.items():
+        for labelstr, total in series.items():
+            labels = dict(kv.split("=") for kv in labelstr.split(",")
+                          if kv)
+            if name.endswith("_total"):
+                reg.counter(name, "", **labels).inc(total)
+            else:
+                reg.gauge(name, "", **labels).set(total)
+    return reg.snapshot()
+
+
+class TestRuleValidation:
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown rule kind"):
+            obs_alerts.Rule("r", "bogus")
+
+    def test_metric_required(self):
+        with pytest.raises(ValueError, match="needs a metric"):
+            obs_alerts.Rule("r", "threshold")
+
+    def test_headroom_needs_pair(self):
+        with pytest.raises(ValueError, match="used"):
+            obs_alerts.Rule("r", "headroom", used="hbm_bytes_in_use")
+
+    def test_default_rules_well_formed(self):
+        rules = obs_alerts.default_rules()
+        names = [r.name for r in rules]
+        assert names == ["slo_burn", "shed_rate", "queue_depth",
+                         "step_time_regression", "hbm_headroom"]
+        # evaluate them against an empty snapshot: nothing fires,
+        # nothing crashes (the no-data contract)
+        eng = obs_alerts.AlertEngine(lambda: {}, rules)
+        assert eng.evaluate_once({}, now=0.0) == []
+        assert eng.active() == []
+
+
+class TestThresholdAndHysteresis:
+    def _eng(self, **kw):
+        rule = obs_alerts.Rule("q", "threshold",
+                               metric="serve_queue_depth", threshold=10,
+                               **kw)
+        return obs_alerts.AlertEngine(lambda: None, [rule],
+                                      emit_events=False)
+
+    def test_fire_and_resolve(self):
+        eng = self._eng()
+        assert eng.evaluate_once(_snap(serve_queue_depth={"e=a": 5}),
+                                 now=0) == []
+        assert eng.evaluate_once(_snap(serve_queue_depth={"e=a": 50}),
+                                 now=1) == [("q", "firing", 50.0)]
+        assert eng.active() == ["q"]
+        assert eng.evaluate_once(_snap(serve_queue_depth={"e=a": 0}),
+                                 now=2) == [("q", "resolved", 0.0)]
+        assert eng.active() == []
+
+    def test_for_n_requires_consecutive_breaches(self):
+        eng = self._eng(for_n=3)
+        hot = _snap(serve_queue_depth={"e=a": 50})
+        cold = _snap(serve_queue_depth={"e=a": 0})
+        assert eng.evaluate_once(hot, now=0) == []
+        assert eng.evaluate_once(hot, now=1) == []
+        assert eng.evaluate_once(cold, now=2) == []   # streak broken
+        assert eng.evaluate_once(hot, now=3) == []
+        assert eng.evaluate_once(hot, now=4) == []
+        assert eng.evaluate_once(hot, now=5) == [("q", "firing", 50.0)]
+
+    def test_clear_n_holds_through_blips(self):
+        eng = self._eng(clear_n=2)
+        hot = _snap(serve_queue_depth={"e=a": 50})
+        cold = _snap(serve_queue_depth={"e=a": 0})
+        eng.evaluate_once(hot, now=0)
+        assert eng.active() == ["q"]
+        assert eng.evaluate_once(cold, now=1) == []    # 1 ok: held
+        assert eng.evaluate_once(hot, now=2) == []     # still firing
+        assert eng.evaluate_once(cold, now=3) == []
+        assert eng.evaluate_once(cold, now=4) == \
+            [("q", "resolved", 0.0)]
+
+    def test_sums_across_labels(self):
+        eng = self._eng()
+        snap = _snap(serve_queue_depth={"e=a": 6, "e=b": 6})
+        assert eng.evaluate_once(snap, now=0) == \
+            [("q", "firing", 12.0)]
+
+
+class TestRateRule:
+    def test_windowed_per_second_rate(self):
+        rule = obs_alerts.Rule("shed", "rate",
+                               metric="serve_requests_total",
+                               match={"outcome": "shed"},
+                               window_s=10, threshold=2.0)
+        eng = obs_alerts.AlertEngine(lambda: None, [rule],
+                                     emit_events=False)
+        s = {"outcome=shed,e=a": 0}
+        assert eng.evaluate_once(_snap(serve_requests_total=s),
+                                 now=0) == []     # no history yet
+        s = {"outcome=shed,e=a": 5}
+        assert eng.evaluate_once(_snap(serve_requests_total=s),
+                                 now=10) == []    # 0.5/s
+        s = {"outcome=shed,e=a": 100}
+        out = eng.evaluate_once(_snap(serve_requests_total=s), now=20)
+        assert out and out[0][:2] == ("shed", "firing")
+        assert out[0][2] == pytest.approx(9.5)    # (100-5)/10s
+
+    def test_counter_reset_clamps_to_zero(self):
+        rule = obs_alerts.Rule("shed", "rate",
+                               metric="serve_requests_total",
+                               window_s=10, threshold=1.0)
+        eng = obs_alerts.AlertEngine(lambda: None, [rule],
+                                     emit_events=False)
+        eng.evaluate_once(_snap(serve_requests_total={"e=a": 100}),
+                          now=0)
+        # restart mid-window: counter went backwards — not a fire
+        assert eng.evaluate_once(
+            _snap(serve_requests_total={"e=a": 3}), now=10) == []
+
+
+class TestBurnRule:
+    def _eng(self, short_s=10, long_s=40):
+        rule = obs_alerts.Rule("burn", "burn", budget=0.01,
+                               threshold=1.0, short_s=short_s,
+                               long_s=long_s)
+        return obs_alerts.AlertEngine(lambda: None, [rule],
+                                      emit_events=False)
+
+    def _snap(self, accepted, shed, admission=0):
+        reg = obs_metrics.Registry()
+        reg.counter("serve_requests_total", outcome="accepted",
+                    engine="x").inc(accepted)
+        reg.counter("serve_requests_total", outcome="shed",
+                    engine="x").inc(shed)
+        if admission:
+            reg.counter("router_requests_total", outcome="shed",
+                        stage="admission").inc(admission)
+        return reg.snapshot()
+
+    def test_requires_history_then_fires(self):
+        eng = self._eng()
+        assert eng.evaluate_once(self._snap(100, 0), now=0) == []
+        # burn 1/1001/0.01 ~ 0.1: inside budget, no fire
+        assert eng.evaluate_once(self._snap(1100, 1), now=5) == []
+        # sustained sheds push BOTH windows over 1.0
+        out = eng.evaluate_once(self._snap(1200, 50), now=45)
+        assert out and out[0][:2] == ("burn", "firing")
+
+    def test_young_history_never_pages_on_a_blip(self):
+        """Until the snapshot history spans the LONG window, burn must
+        not fire: a startup-window blip paging is exactly what the
+        multiwindow pattern exists to prevent."""
+        eng = self._eng(short_s=10, long_s=40)
+        eng.evaluate_once(self._snap(100, 0), now=0)
+        # t=20: 60% of offered shed — a monster blip, but the history
+        # spans only 20s of the 40s long window
+        assert eng.evaluate_once(self._snap(110, 6), now=20) == []
+        # once the long window is spanned AND the burn persists, fire
+        out = eng.evaluate_once(self._snap(120, 60), now=45)
+        assert out and out[0][1] == "firing"
+
+    def test_no_traffic_is_not_a_violation(self):
+        eng = self._eng()
+        s = self._snap(100, 0)
+        eng.evaluate_once(s, now=0)
+        assert eng.evaluate_once(s, now=60) == []   # offered delta 0
+
+    def test_router_admission_sheds_count(self):
+        eng = self._eng()
+        eng.evaluate_once(self._snap(100, 0), now=0)
+        eng.evaluate_once(self._snap(100, 0), now=5)
+        out = eng.evaluate_once(self._snap(200, 0, admission=50),
+                                now=45)
+        assert out and out[0][1] == "firing"
+
+    def test_burn_matches_serve_top_math(self):
+        prev = self._snap(100, 0)
+        cur = self._snap(200, 50)     # offered=150, bad=50
+        assert obs_alerts.slo_burn(cur, prev, 0.01) == \
+            pytest.approx(50 / 150 / 0.01)
+
+
+class TestBaselineRule:
+    def test_step_time_regression(self):
+        rule = obs_alerts.Rule("reg", "baseline",
+                               metric="train_step_wall_seconds",
+                               threshold=2.0, min_n=3, for_n=1)
+        eng = obs_alerts.AlertEngine(lambda: None, [rule],
+                                     emit_events=False)
+        for i, v in enumerate([0.10, 0.11, 0.09, 0.10]):
+            assert eng.evaluate_once(
+                _snap(train_step_wall_seconds={"o=local": v}),
+                now=i) == []
+        # 3x the median: regression fires with the RATIO as the value
+        out = eng.evaluate_once(
+            _snap(train_step_wall_seconds={"o=local": 0.30}), now=5)
+        assert out and out[0][1] == "firing"
+        assert out[0][2] == pytest.approx(3.0, rel=0.1)
+        # back to normal resolves
+        out = eng.evaluate_once(
+            _snap(train_step_wall_seconds={"o=local": 0.10}), now=6)
+        assert out and out[0][1] == "resolved"
+
+    def test_stale_gauge_does_not_self_resolve(self):
+        """The gauge updates at flush cadence, the engine at its own —
+        re-evaluating an UNCHANGED regressed value must not drag the
+        rolling median up to it and auto-resolve a live regression."""
+        rule = obs_alerts.Rule("reg", "baseline",
+                               metric="train_step_wall_seconds",
+                               threshold=2.0, min_n=3, for_n=1,
+                               baseline_n=8)
+        eng = obs_alerts.AlertEngine(lambda: None, [rule],
+                                     emit_events=False)
+        for i, v in enumerate([0.10, 0.11, 0.09, 0.10]):
+            eng.evaluate_once(
+                _snap(train_step_wall_seconds={"o=local": v}), now=i)
+        bad = _snap(train_step_wall_seconds={"o=local": 0.30})
+        out = eng.evaluate_once(bad, now=5)
+        assert out and out[0][1] == "firing"
+        # ticks 6..20 re-see the SAME stale 0.30: still firing
+        for i in range(6, 21):
+            assert eng.evaluate_once(bad, now=i) == []
+        assert eng.active() == ["reg"]
+
+    def test_needs_min_history(self):
+        rule = obs_alerts.Rule("reg", "baseline", metric="g",
+                               threshold=1.5, min_n=5)
+        eng = obs_alerts.AlertEngine(lambda: None, [rule],
+                                     emit_events=False)
+        for i in range(4):
+            assert eng.evaluate_once(_snap(g={"": 100.0}),
+                                     now=i) == []
+
+
+class TestHeadroomRule:
+    def _eng(self):
+        rule = obs_alerts.Rule("hbm", "headroom",
+                               used="hbm_bytes_in_use",
+                               limit="hbm_bytes_limit", threshold=0.1)
+        return obs_alerts.AlertEngine(lambda: None, [rule],
+                                      emit_events=False)
+
+    def test_fires_below_floor(self):
+        eng = self._eng()
+        ok = _snap(hbm_bytes_in_use={"device=d": 500},
+                   hbm_bytes_limit={"device=d": 1000})
+        assert eng.evaluate_once(ok, now=0) == []
+        tight = _snap(hbm_bytes_in_use={"device=d": 950},
+                      hbm_bytes_limit={"device=d": 1000})
+        out = eng.evaluate_once(tight, now=1)
+        assert out and out[0][1] == "firing"
+        assert out[0][2] == pytest.approx(0.05)
+
+    def test_no_limit_no_data(self):
+        eng = self._eng()
+        assert eng.evaluate_once(
+            _snap(hbm_bytes_in_use={"device=d": 950}), now=0) == []
+
+
+class TestTransitionsSurface:
+    def test_events_and_gauge(self, obs_run_dir):
+        reg = obs_metrics.get()
+        rule = obs_alerts.Rule("q", "threshold",
+                               metric="serve_queue_depth", threshold=10,
+                               description="queue too deep")
+        eng = obs_alerts.AlertEngine(lambda: None, [rule], registry=reg)
+        eng.evaluate_once(_snap(serve_queue_depth={"e=a": 99}), now=0)
+        assert obs_metrics.family_total(reg.snapshot(), "alert_active",
+                                        rule="q") == 1.0
+        eng.evaluate_once(_snap(serve_queue_depth={"e=a": 0}), now=1)
+        assert obs_metrics.family_total(reg.snapshot(), "alert_active",
+                                        rule="q") == 0.0
+        evs = [e for e in obs_events.get().ring_events()
+               if e["type"] == "alert"]
+        assert [e["kind"] for e in evs] == ["firing", "resolved"]
+        for e in evs:
+            validate_event(e)
+        assert evs[0]["value"] == 99.0 and evs[0]["threshold"] == 10.0
+        assert evs[0]["description"] == "queue too deep"
+
+    def test_cadence_thread_joins_on_close(self):
+        rule = obs_alerts.Rule("q", "threshold",
+                               metric="serve_queue_depth", threshold=10)
+        eng = obs_alerts.AlertEngine(lambda: {}, [rule],
+                                     interval=0.005, emit_events=False)
+        eng.start()
+        deadline = time.time() + 5.0
+        while eng.evaluations < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        t = eng._thread
+        eng.close()
+        assert eng._thread is None and not t.is_alive()
+        assert eng.evaluations >= 3
+        eng.close()   # idempotent
+
+    def test_pool_start_alerts_lifecycle(self):
+        import numpy as np
+
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.serve import ReplicaPool
+        from bigdl_tpu.utils.random import set_seed
+        set_seed(7)
+        model = nn.Sequential(nn.Linear(6, 8), nn.Tanh(),
+                              nn.Linear(8, 3), nn.LogSoftMax())
+        pool = ReplicaPool(model, n_replicas=1, max_batch=4,
+                           max_wait_ms=1, shed=False)
+        try:
+            eng = pool.start_alerts(interval=60.0, queue_depth=4)
+            assert pool.start_alerts() is eng       # idempotent
+            assert [r.name for r in eng.rules] == \
+                [r.name for r in obs_alerts.default_rules()]
+            eng.evaluate_once()
+            thread = eng._thread
+        finally:
+            pool.close()
+        assert pool.alerts is None and not thread.is_alive()
+
+
+class TestServeTopSurface:
+    @pytest.fixture()
+    def serve_top(self):
+        import importlib.util
+        path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "serve_top.py")
+        spec = importlib.util.spec_from_file_location("serve_top_alerts",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_alerts_line_states(self, serve_top):
+        assert serve_top.alerts_line({}) is None
+        reg = obs_metrics.Registry()
+        reg.gauge("alert_active", "", agg="max", rule="q").set(0)
+        assert serve_top.alerts_line(reg.snapshot()) == "alerts: none"
+        reg.gauge("alert_active", "", agg="max", rule="q").set(1)
+        reg.gauge("alert_active", "", agg="max", rule="hbm").set(1)
+        assert serve_top.alerts_line(reg.snapshot()) == \
+            "alerts: FIRING hbm, q"
+
+    def test_alerts_line_rendered_in_frame(self, serve_top):
+        reg = obs_metrics.Registry()
+        reg.counter("serve_requests_total", engine="a",
+                    outcome="completed").inc(3)
+        reg.gauge("alert_active", "", agg="max", rule="q").set(1)
+        snap = reg.snapshot()
+        rows = serve_top.frame_rows(snap, None, 1.0)
+        frame = serve_top.render(rows, "test", 1.0,
+                                 alerts=serve_top.alerts_line(snap))
+        assert "alerts: FIRING q" in frame
+
+
+class TestReportAlertTimeline:
+    def test_rendered_from_events(self, tmp_path):
+        import importlib.util
+        import json as _json
+        path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                            "obs_report.py")
+        spec = importlib.util.spec_from_file_location("obs_report_a",
+                                                      path)
+        rep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rep)
+        v = obs_events.SCHEMA_VERSION
+        lines = [
+            {"v": v, "ts": 10.0, "proc": 0, "type": "alert",
+             "kind": "firing", "rule": "queue_depth", "value": 99.0,
+             "threshold": 10.0},
+            {"v": v, "ts": 12.5, "proc": 0, "type": "alert",
+             "kind": "resolved", "rule": "queue_depth", "value": 0.0,
+             "threshold": 10.0},
+            {"v": v, "ts": 13.0, "proc": 0, "type": "alert",
+             "kind": "firing", "rule": "hbm_headroom", "value": 0.02,
+             "threshold": 0.05},
+        ]
+        f = tmp_path / "events.p0.jsonl"
+        f.write_text("\n".join(_json.dumps(e) for e in lines) + "\n")
+        events_, bad, bundles = rep.load_run(str(f))
+        assert not bad
+        md = rep.render(events_, bad, bundles)
+        assert "## Alert timeline" in md
+        assert "queue_depth" in md and "+2.500" in md
+        assert "still firing at end of log: **hbm_headroom**" in md

@@ -530,6 +530,50 @@ class TestServeTop:
         rows = serve_top.frame_rows(self._snap(5, 5), None, dt=1.0)
         assert all(r["rows_s"] == 0.0 for r in rows)
 
+    def test_idle_window_falls_back_to_lifetime(self, serve_top):
+        """The docstring-only contract, now pinned: a window that saw
+        ZERO observations (idle fleet between frames) renders the
+        lifetime quantiles — last known latency beats a blank column —
+        and the fallback never fabricates a windowed value."""
+        snap = self._snap(10, 10)
+        lifetime = metrics.histogram_quantiles(
+            snap, "serve_latency_seconds")
+        # identical snapshots: the window's count diff is all zeros
+        qs = serve_top._window_quantiles(snap, snap,
+                                         "serve_latency_seconds")
+        assert qs == lifetime and qs["p50"] is not None
+        rows = serve_top.frame_rows(snap, snap, dt=1.0)
+        fleet = [r for r in rows if r["name"] == "fleet"][0]
+        assert fleet["p50_ms"] == pytest.approx(lifetime["p50"] * 1e3)
+        assert fleet["rows_s"] == 0.0          # rates honestly idle
+
+    def test_first_frame_falls_back_to_lifetime(self, serve_top):
+        """prev=None (the dashboard's very first frame): quantiles come
+        from the lifetime histogram instead of rendering blank."""
+        snap = self._snap(10, 10)
+        lifetime = metrics.histogram_quantiles(
+            snap, "serve_latency_seconds")
+        assert serve_top._window_quantiles(
+            snap, None, "serve_latency_seconds") == lifetime
+        # an idle ENGINE with no observations at all stays blank (the
+        # fallback reports last known truth, never invents one)
+        empty = metrics.Registry().snapshot()
+        qs = serve_top._window_quantiles(empty, None,
+                                         "serve_latency_seconds")
+        assert qs == {"p50": None, "p95": None, "p99": None}
+
+    def test_bounds_change_falls_back_to_lifetime(self, serve_top):
+        """A prev snapshot with different bucket bounds (reader version
+        skew) cannot be differenced — lifetime fallback, not garbage."""
+        cur = self._snap(10, 10)
+        reg = metrics.Registry()
+        reg.histogram("serve_latency_seconds", "x",
+                      bounds=(0.1, 1.0, 10.0), engine="a").observe(0.5)
+        prev = reg.snapshot()
+        assert serve_top._window_quantiles(
+            cur, prev, "serve_latency_seconds") == \
+            metrics.histogram_quantiles(cur, "serve_latency_seconds")
+
     def test_jsonl_source(self, serve_top, tmp_path):
         path = str(tmp_path / "snaps.jsonl")
         metrics.append_snapshot_jsonl(path, self._snap(10, 10), ts=1.0)

@@ -12,7 +12,9 @@ summary, fault/watchdog/preemption timeline, the elastic recovery
 timeline (``recover`` events), the serving section
 (rollout timeline, shed/error/replica-death counts, decode summary,
 and a per-hop latency waterfall for the slowest traced requests —
-``--waterfall N``), crash bundles.
+``--waterfall N``), the performance ledger (top executables by flops,
+HBM tenant breakdown, device-memory timeline), the alert timeline
+(``alert`` firing/resolved transitions), crash bundles.
 
 Lines that fail schema validation are counted and quoted, not fatal —
 a postmortem tool that dies on the interesting input is useless.
@@ -31,9 +33,14 @@ from bigdl_tpu.obs.events import validate_event  # noqa: E402
 
 
 def load_run(path):
-    """(events, bad_lines, bundle_dirs) from a run dir or one jsonl."""
+    """(events, bad_lines, bundle_dirs) from a run dir or one jsonl.
+    Rotated segments (``events.p0.jsonl.1`` ... — the
+    ``BIGDL_OBS_MAX_MB`` size cap) are loaded too; the ts-sort below
+    restores stream order."""
     if os.path.isdir(path):
-        files = sorted(glob.glob(os.path.join(path, "events.p*.jsonl")))
+        files = sorted(glob.glob(os.path.join(path, "events.p*.jsonl"))
+                       + glob.glob(os.path.join(path,
+                                                "events.p*.jsonl.*")))
         bundles = sorted(g for g in glob.glob(os.path.join(path, "crash-*"))
                          if os.path.isdir(g))
     else:
@@ -211,6 +218,110 @@ def _serving_section(events, waterfall=5):
     return out
 
 
+def _bytes_h(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"   # pragma: no cover - loop always returns
+
+
+def _ledger_section(events):
+    """Markdown lines for the ``ledger`` event type (obs/ledger.py):
+    top compiled executables by flops, the HBM tenant breakdown (last
+    reported bytes per tenant series), and the device-memory timeline
+    from the sampler's ``hbm`` ticks."""
+    ledgers = _by_type(events, "ledger")
+    if not ledgers:
+        return []
+    out = ["## Performance ledger", ""]
+
+    execs = [e for e in ledgers if e["kind"] == "exec"]
+    if execs:
+        out.append(f"- compiled executables captured: **{len(execs)}**")
+        out += ["", "| fn | key | Gflops/dispatch | MiB accessed | "
+                "peak HBM |", "|---|---|---|---|---|"]
+        top = sorted(execs, key=lambda e: -(e.get("flops") or 0))[:10]
+        for e in top:
+            peak = e.get("peak_bytes")
+            out.append(
+                f"| `{e['fn']}` | `{e.get('key', '-')}` | "
+                f"{(e.get('flops') or 0) / 1e9:.3f} | "
+                f"{(e.get('bytes_accessed') or 0) / (1 << 20):.2f} | "
+                f"{_bytes_h(peak) if peak is not None else '-'} |")
+        out.append("")
+
+    tenants = [e for e in ledgers if e["kind"] == "tenant"]
+    if tenants:
+        # last report per tenant series (the extra labels — decoder,
+        # engine — keep one replica's pool distinct from another's)
+        latest = {}
+        for e in tenants:
+            key = tuple(sorted((k, str(v)) for k, v in e.items()
+                               if k not in ("v", "ts", "proc", "type",
+                                            "kind", "bytes")))
+            latest[key] = e
+        rows = [e for e in latest.values() if e.get("bytes")]
+        if rows:
+            out += ["### HBM breakdown (known tenants, last reported)",
+                    "", "| tenant | owner | bytes |", "|---|---|---|"]
+            for e in sorted(rows, key=lambda e: -e["bytes"]):
+                owner = ", ".join(
+                    f"{k}={_fmt(v)}" for k, v in sorted(e.items())
+                    if k not in ("v", "ts", "proc", "type", "kind",
+                                 "tenant", "bytes"))
+                out.append(f"| {e['tenant']} | {owner or '-'} | "
+                           f"{_bytes_h(e['bytes'])} |")
+            out.append("")
+
+    hbms = [e for e in ledgers if e["kind"] == "hbm"]
+    if hbms:
+        t0 = hbms[0]["ts"]
+        peak = max(int(e.get("peak", e["in_use"])) for e in hbms)
+        out.append(f"### HBM timeline ({len(hbms)} samples, watermark "
+                   f"{_bytes_h(peak)})")
+        out += ["", "| t (s) | in use | watermark | limit |",
+                "|---|---|---|---|"]
+        step = max(1, len(hbms) // 12)      # at most ~12 rows
+        for e in hbms[::step]:
+            lim = e.get("limit")
+            out.append(f"| {e['ts'] - t0:+.1f} | "
+                       f"{_bytes_h(e['in_use'])} | "
+                       f"{_bytes_h(e.get('peak', e['in_use']))} | "
+                       f"{_bytes_h(lim) if lim else '-'} |")
+        out.append("")
+    return out
+
+
+def _alerts_section(events):
+    """Markdown lines for the ``alert`` event type (obs/alerts.py):
+    the firing/resolved transition timeline plus the rules still
+    firing at end of log."""
+    alerts = _by_type(events, "alert")
+    if not alerts:
+        return []
+    out = ["## Alert timeline", ""]
+    fired = sum(1 for e in alerts if e["kind"] == "firing")
+    active = {}
+    for e in alerts:
+        active[e["rule"]] = (e["kind"] == "firing")
+    still = sorted(r for r, on in active.items() if on)
+    out.append(f"- transitions: **{fired}** firing / "
+               f"{len(alerts) - fired} resolved"
+               + (f"; still firing at end of log: **{', '.join(still)}**"
+                  if still else ""))
+    out += ["", "| t (s) | rule | transition | value | threshold |",
+            "|---|---|---|---|---|"]
+    t0 = alerts[0]["ts"]
+    for e in alerts:
+        out.append(f"| {e['ts'] - t0:+.3f} | {e['rule']} | {e['kind']} "
+                   f"| {_fmt(e.get('value', '-'))} | "
+                   f"{_fmt(e.get('threshold', '-'))} |")
+    out.append("")
+    return out
+
+
 def _recovery_section(events):
     """Markdown lines for the ``recover`` event type (elastic training,
     docs/resilience.md): the trip→quiesce→reform→reshard→resume chain
@@ -302,6 +413,8 @@ def render(events, bad, bundles, title="obs run report",
         out.append("")
 
     out.extend(_serving_section(events, waterfall))
+    out.extend(_ledger_section(events))
+    out.extend(_alerts_section(events))
     out.extend(_recovery_section(events))
 
     incidents = [e for e in events if e["type"] in

@@ -408,7 +408,13 @@ def profile(model_name="inception", batch=128, nsteps=5, step=None, args=None):
                     srcs += r2
         return fl, sigs, onames, srcs
 
-    total_flops = float(compiled.cost_analysis().get("flops", float("nan")))
+    # one cost code path (obs/ledger.py): the ledger normalizes the
+    # dict/list cost_analysis forms and records the entry next to the
+    # runtime captures, so this probe and bench.py report ONE number
+    from bigdl_tpu.obs import ledger as cost_ledger
+    _entry = cost_ledger.get().capture_compiled(("profile_step",),
+                                                compiled)
+    total_flops = _entry.flops if _entry is not None else float("nan")
 
     params, net_state, opt_state, x, y, key = args
     state = {"a": (params, net_state, opt_state)}

@@ -11,7 +11,9 @@ renders, per engine and fleet-wide:
 plus, when a paged continuous decoder is exporting, one trailing
 ``decode:`` line with KV page-pool occupancy, the prefix-cache
 hit-rate and the speculative acceptance p50 (docs/serving.md "Paged
-KV + speculative decode").
+KV + speculative decode"), and — when an alert engine is exporting
+``alert_active`` gauges (``obs/alerts.py``) — one ``alerts:`` line
+naming every firing rule (``alerts: none`` when quiet).
 
 Rates are differences between consecutive snapshots (the counters are
 monotonic, so the math survives engine restarts landing mid-window as a
@@ -265,13 +267,29 @@ def decode_line(cur: dict, prev: dict | None, dt: float) -> str | None:
             + (f"{accept:.1f}" if accept is not None else "-"))
 
 
+def alerts_line(cur: dict) -> str | None:
+    """One trailing ``alerts:`` line from the ``alert_active`` gauges
+    the declarative alert engine exports (``obs/alerts.py`` — rides the
+    merged registry, so a rule firing on ANY replica shows here).  None
+    when no alert engine has ever exported (family absent)."""
+    fam = cur.get("alert_active")
+    if fam is None:
+        return None
+    firing = sorted(row["labels"].get("rule", "?")
+                    for row in fam["series"] if row.get("value"))
+    if not firing:
+        return "alerts: none"
+    return "alerts: FIRING " + ", ".join(firing)
+
+
 def _ms(v):
     return "-" if v is None else f"{v:8.2f}"
 
 
 def render(rows: list, source: str, dt: float,
            decode: str | None = None,
-           fleet: str | None = None) -> str:
+           fleet: str | None = None,
+           alerts: str | None = None) -> str:
     out = [f"serve_top — {source}  (window {dt:.1f}s)", "",
            f"{'engine':<12} {'rows/s':>8} {'queue':>6} {'inflt':>6} "
            f"{'shed/s':>7} {'p50 ms':>8} {'p95 ms':>8} {'p99 ms':>8} "
@@ -285,7 +303,7 @@ def render(rows: list, source: str, dt: float,
             f"{marker}{name:<11} {r['rows_s']:8.1f} {r['queue']:6d} "
             f"{r['inflight']:6d} {r['shed_s']:7.1f} {_ms(r['p50_ms'])} "
             f"{_ms(r['p95_ms'])} {_ms(r['p99_ms'])} {r['burn']:6.2f}")
-    for line in (decode, fleet):
+    for line in (decode, fleet, alerts):
         if line:
             out += ["", line]
     return "\n".join(out)
@@ -315,7 +333,8 @@ def main(argv=None) -> int:
                        decode=decode_line(cur, prev[1] if prev else None,
                                           dt),
                        fleet=fleet_line(cur, prev[1] if prev else None,
-                                        dt))
+                                        dt),
+                       alerts=alerts_line(cur))
         if args.once:
             print(frame)
             return 0
