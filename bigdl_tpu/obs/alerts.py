@@ -19,7 +19,13 @@ short snapshot history and evaluates a list of declarative
   makes detection fast, the long window keeps one blip from paging;
 - ``baseline``  — regression vs a rolling self-baseline: the latest
   sample of a gauge vs the median of its own recent history (step-time
-  regression needs no absolute bound);
+  regression needs no absolute bound).  A HISTOGRAM metric samples its
+  windowed ``q``-quantile instead (the ``itl_regression`` default:
+  windowed ITL p50 vs its own rolling median);
+- ``quantile``  — a histogram family's windowed ``q``-quantile vs an
+  absolute bound (bucket-count deltas over ``window_s``, exactly
+  serve_top's windowed-quantile math — the ``ttft_burn`` default:
+  windowed TTFT p95 above the per-token SLO budget);
 - ``headroom``  — ``1 - used/limit`` of a gauge pair below a floor
   (HBM headroom).
 
@@ -47,7 +53,8 @@ from bigdl_tpu.obs import metrics as obs_metrics
 
 logger = logging.getLogger("bigdl_tpu.obs")
 
-KINDS = ("threshold", "rate", "burn", "baseline", "headroom")
+KINDS = ("threshold", "rate", "burn", "baseline", "quantile",
+         "headroom")
 
 
 class Rule:
@@ -61,13 +68,15 @@ class Rule:
                  budget: float = 0.01, baseline_n: int = 16,
                  min_n: int = 4, used: str | None = None,
                  limit: str | None = None, for_n: int = 1,
-                 clear_n: int = 1, description: str = ""):
+                 clear_n: int = 1, q: float = 50.0,
+                 description: str = ""):
         if kind not in KINDS:
             raise ValueError(f"unknown rule kind {kind!r} "
                              f"(known: {KINDS})")
         if op not in (">", "<"):
             raise ValueError(f"rule op must be '>' or '<': {op!r}")
-        if kind in ("threshold", "rate", "baseline") and not metric:
+        if kind in ("threshold", "rate", "baseline",
+                    "quantile") and not metric:
             raise ValueError(f"rule {name!r} ({kind}) needs a metric")
         if kind == "headroom" and not (used and limit):
             raise ValueError(f"rule {name!r} (headroom) needs "
@@ -88,6 +97,7 @@ class Rule:
         self.limit = limit
         self.for_n = max(1, int(for_n))
         self.clear_n = max(1, int(clear_n))
+        self.q = float(q)
         self.description = description
 
     def max_window(self) -> float:
@@ -193,10 +203,27 @@ class AlertEngine:
                 break
         return chosen
 
+    def _window_hist_quantile(self, rule: Rule, cur: dict, now: float):
+        """The windowed ``q``-quantile of a histogram family
+        (``metrics.windowed_counts`` — the same windowing rule
+        serve_top's columns use; bucket deltas against the oldest
+        in-window snapshot, lifetime when history is younger than the
+        window).  None when the window saw no observations (idle is
+        not a latency violation)."""
+        ref = self._window_snap(now, rule.window_s)
+        wc = obs_metrics.windowed_counts(
+            cur, ref[1] if ref is not None else None, rule.metric,
+            **rule.match)
+        if wc is None or sum(wc[1]) == 0:
+            return None
+        return obs_metrics.quantile(wc[0], wc[1], rule.q)
+
     def _value(self, rule: Rule, cur: dict, now: float):
         if rule.kind == "threshold":
             return obs_metrics.family_total(cur, rule.metric,
                                             **rule.match)
+        if rule.kind == "quantile":
+            return self._window_hist_quantile(rule, cur, now)
         if rule.kind == "rate":
             ref = self._window_snap(now, rule.window_s)
             if ref is None or now <= ref[0]:
@@ -223,8 +250,17 @@ class AlertEngine:
             # value is the smaller burn of the two
             return min(bs, bl)
         if rule.kind == "baseline":
-            sample = obs_metrics.family_total(cur, rule.metric,
-                                              **rule.match)
+            fam = cur.get(rule.metric)
+            if fam is not None and fam.get("type") == "histogram":
+                # histogram metric: the regression sample is the
+                # windowed quantile (e.g. ITL p50) — same hysteresis
+                # and rolling-median machinery as the gauge path
+                sample = self._window_hist_quantile(rule, cur, now)
+                if sample is None:
+                    return None
+            else:
+                sample = obs_metrics.family_total(cur, rule.metric,
+                                                  **rule.match)
             hist = self._baselines[rule.name]
             if sample <= 0:
                 return None
@@ -371,10 +407,44 @@ def default_rules(budget: float = 0.01, queue_depth: float = 64.0,
                   shed_per_s: float = 1.0, burn: float = 1.0,
                   step_time_factor: float = 2.0,
                   hbm_headroom: float = 0.05, short_s: float = 60.0,
-                  long_s: float = 600.0) -> list:
+                  long_s: float = 600.0,
+                  ttft_slo_ms: float | None = None,
+                  itl_factor: float = 3.0,
+                  itl_slo_ms: float | None = None) -> list:
     """The shipped rule set (docs/observability.md has the table):
     SLO burn (multiwindow), shed rate, queue depth, train step-time
-    regression vs a rolling self-baseline, and HBM headroom."""
+    regression vs a rolling self-baseline, HBM headroom, plus the
+    per-token streaming pair — ``ttft_burn`` (windowed TTFT p95 above
+    the first-token SLO budget; ``ttft_slo_ms`` defaults to
+    ``BIGDL_SERVE_SLO_TTFT_MS``, falling back to 500 ms when no class
+    is declared, and an EXPLICIT 0 disables the rule) and
+    ``itl_regression`` (windowed ITL p50 above ``itl_factor``x its own
+    rolling median — stalls show up without an absolute bound).  A
+    DECLARED inter-token budget (``itl_slo_ms``, default
+    ``BIGDL_SERVE_SLO_ITL_MS``; 0 = none) additionally arms an
+    absolute ``itl_burn`` rule: windowed ITL p95 above the budget."""
+    # same env names the router's per-token SLO class reads
+    # (serve/streaming.py ttft_ms_default/itl_ms_default); parsed
+    # locally so the obs layer never drags the serve package (and jax)
+    # into a training-only process just to arm alerts
+    if ttft_slo_ms is None:
+        ttft_slo_ms = _slo_env_ms("BIGDL_SERVE_SLO_TTFT_MS") or 500.0
+    if itl_slo_ms is None:
+        itl_slo_ms = _slo_env_ms("BIGDL_SERVE_SLO_ITL_MS")
+    extra = []
+    if ttft_slo_ms and ttft_slo_ms > 0:
+        extra.append(Rule(
+            "ttft_burn", "quantile", metric="decode_ttft_seconds",
+            q=95, threshold=ttft_slo_ms / 1e3, window_s=short_s,
+            clear_n=2,
+            description="windowed time-to-first-token p95 above the "
+                        f"{ttft_slo_ms:g} ms streaming SLO budget"))
+    if itl_slo_ms and itl_slo_ms > 0:
+        extra.append(Rule(
+            "itl_burn", "quantile", metric="decode_itl_seconds", q=95,
+            threshold=itl_slo_ms / 1e3, window_s=short_s, clear_n=2,
+            description="windowed inter-token latency p95 above the "
+                        f"{itl_slo_ms:g} ms streaming SLO budget"))
     return [
         Rule("slo_burn", "burn", budget=budget, threshold=burn,
              short_s=short_s, long_s=long_s, clear_n=2,
@@ -397,4 +467,20 @@ def default_rules(budget: float = 0.01, queue_depth: float = 64.0,
              limit="hbm_bytes_limit", threshold=hbm_headroom,
              description="free HBM below "
                          f"{hbm_headroom:.0%} of capacity"),
-    ]
+        Rule("itl_regression", "baseline", metric="decode_itl_seconds",
+             q=50, threshold=itl_factor, window_s=short_s, min_n=4,
+             for_n=2, clear_n=2,
+             description="windowed inter-token latency p50 above "
+                         f"{itl_factor}x its rolling median"),
+    ] + extra
+
+
+def _slo_env_ms(name: str) -> float:
+    """A millisecond SLO budget env var (0/-/malformed = none) —
+    mirrors serve/streaming's parse without importing the serve
+    package."""
+    import os
+    try:
+        return max(0.0, float(os.environ.get(name, "0") or 0))
+    except ValueError:
+        return 0.0

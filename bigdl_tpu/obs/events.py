@@ -36,8 +36,11 @@ logger = logging.getLogger("bigdl_tpu.obs")
 #: per-kind required fields (SERVE_KINDS) and the `trace` type landed.
 #: v3: the `ledger` (compile-time cost/HBM truth) and `alert`
 #: (declarative rule transitions) types landed, each with per-kind
-#: required fields (LEDGER_KINDS / ALERT_KINDS).
-SCHEMA_VERSION = 3
+#: required fields (LEDGER_KINDS / ALERT_KINDS).  v4: the `stream`
+#: serve kind landed (one streamed decode request's token timeline),
+#: and `decode` events that report streaming (``streaming: true``)
+#: must carry `first_token_ms` + `stream_boundaries`.
+SCHEMA_VERSION = 4
 
 ENV_OBS = "BIGDL_OBS"
 ENV_DIR = "BIGDL_OBS_DIR"
@@ -94,6 +97,11 @@ SERVE_KINDS = {
     "stop": (),
     "error": ("error",),
     "decode": ("steps",),
+    # one streamed decode request's per-token timeline (serve/decode.py
+    # emits at retire): tokens delivered, submit→first-token latency,
+    # and the per-boundary [ms-since-submit, token-count] pairs the
+    # obs_report token waterfall renders (schema v4)
+    "stream": ("tokens", "ttft_ms", "timeline"),
     "shed": (),
     "weights_commit": ("version",),
     "weights_revert": ("version",),
@@ -181,6 +189,24 @@ def validate_event(event: dict) -> dict:
         if missing:
             raise ValueError(
                 f"{etype}/{kind} event missing {missing}: {event}")
+    if etype == "serve":
+        kind = event["kind"]
+        if kind == "decode" and event.get("streaming"):
+            # required-when-streaming (schema v4): a decode run that
+            # claims streaming must carry its SLO aggregates
+            missing = [k for k in ("first_token_ms", "stream_boundaries")
+                       if k not in event]
+            if missing:
+                raise ValueError(
+                    f"streaming decode event missing {missing}: {event}")
+        if kind == "stream":
+            tl = event["timeline"]
+            if (not isinstance(tl, list) or not tl
+                    or not all(isinstance(b, (list, tuple)) and len(b) == 2
+                               for b in tl)):
+                raise ValueError(
+                    f"stream timeline must be a non-empty list of "
+                    f"[ms, tokens] pairs: {tl!r}")
     if etype == "trace":
         hops = event["hops"]
         if (not isinstance(hops, list) or not hops

@@ -47,6 +47,15 @@ import threading
 #: +Inf overflow).
 LATENCY_BUCKETS = tuple(1e-4 * 10 ** (i / 4) for i in range(28))
 
+#: pinned bucket bounds for streamed-decode INTER-TOKEN latency
+#: (seconds): 1 µs ... ~5.6 s at the same 10^(1/4) ratio.  On-chip
+#: inter-token gaps sit in the tens of microseconds — two decades below
+#: LATENCY_BUCKETS' 100 µs floor, which would fold every healthy gap
+#: into its underflow bucket and make ITL quantiles meaningless.  Same
+#: merge contract: module-pinned bounds, so per-replica ITL histograms
+#: add element-wise and fleet quantiles equal pooled quantiles exactly.
+ITL_BUCKETS = tuple(1e-6 * 10 ** (i / 4) for i in range(28))
+
 #: pinned bucket bounds for speculative-decode acceptance lengths
 #: (serve/decode.py): integers 0..32, one bucket per exact length so the
 #: merged histogram reconstructs the full distribution and the fleet
@@ -383,6 +392,26 @@ def merged_histogram(snapshot: dict, name: str, **match):
     if counts is None:
         return None
     return bounds, counts, total_sum, total_n
+
+
+def windowed_counts(cur: dict, prev: dict | None, name: str, **match):
+    """``(bounds, counts)`` of the observations that landed BETWEEN two
+    snapshots: per-series bucket counts are monotonic, so the window's
+    histogram is the element-wise count difference, clamped at 0 to
+    absorb a replica restart mid-window.  Falls back to the lifetime
+    counts when there is no ``prev`` (or its bounds mismatch); None
+    when the family is absent.  The ONE windowing rule ``serve_top``'s
+    quantile columns and the alert engine's ``quantile``/``baseline``
+    rules share — they must judge the same numbers."""
+    agg_cur = merged_histogram(cur, name, **match)
+    if agg_cur is None:
+        return None
+    bounds, counts = list(agg_cur[0]), list(agg_cur[1])
+    if prev is not None:
+        agg_prev = merged_histogram(prev, name, **match)
+        if agg_prev is not None and list(agg_prev[0]) == bounds:
+            counts = [max(a - b, 0) for a, b in zip(counts, agg_prev[1])]
+    return bounds, counts
 
 
 def histogram_quantiles(snapshot: dict, name: str, qs=(50, 95, 99),

@@ -18,6 +18,12 @@ counterpart, reusing the training stack's pipeline idioms:
   continuous batching over the ``TransformerLM`` KV-cache step, with
   admissions/retirements at step boundaries, cadenced host syncs, and
   optional tensor-parallel serving over a mesh ``model`` axis;
+- :mod:`bigdl_tpu.serve.streaming` — :class:`StreamFuture` /
+  :class:`SafeFuture`: incremental per-token delivery at each sync
+  boundary (``on_tokens``; byte-identical to the all-at-once result,
+  dedup-by-index across requeues and process hops), callback-safe
+  futures, and the dedicated delivery thread — the TTFT/ITL SLO
+  surface (docs/observability.md "Streaming telemetry");
 - :mod:`bigdl_tpu.serve.paging` / :mod:`bigdl_tpu.serve.prefix` — the
   block-paged KV pool behind the decoder (:class:`PagePool` refcounted
   page allocation; concurrency scales with pooled tokens, not slab
@@ -54,8 +60,10 @@ Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
 ``BIGDL_SERVE_QUANT`` (weight quantization: off/int8/fp8, default off),
 ``BIGDL_SERVE_KV_QUANT`` (int8 KV pages, default off),
 ``BIGDL_SERVE_REPLICAS`` (pool size, default 2), ``BIGDL_SERVE_SLO_MS``
-(default request deadline, 0 = none), ``BIGDL_SERVE_SHED`` (overload
-shedding, default on), ``BIGDL_SERVE_AFFINITY`` (prefix-affinity fleet
+(default request deadline, 0 = none), ``BIGDL_SERVE_SLO_TTFT_MS`` /
+``BIGDL_SERVE_SLO_ITL_MS`` (per-token SLO class for streaming requests
+— projected FIRST-token completion drives shed-before-miss; 0 = none),
+``BIGDL_SERVE_SHED`` (overload shedding, default on), ``BIGDL_SERVE_AFFINITY`` (prefix-affinity fleet
 dispatch, default on), ``BIGDL_SERVE_PREFILL_REPLICAS`` (dedicated
 prefill replicas, default 0), ``BIGDL_SERVE_KV_HOST_MB`` (host-RAM KV
 tier budget per decode replica, default 0 = off),
@@ -89,6 +97,9 @@ from bigdl_tpu.serve.prefix import PrefixCache, chain_keys  # noqa: F401
 from bigdl_tpu.serve.router import (  # noqa: F401
     DeadReplicaError, Router,
 )
+from bigdl_tpu.serve.streaming import (  # noqa: F401
+    SafeFuture, StreamFuture, TokenDelivery,
+)
 
 __all__ = [
     "bucketing", "xcache", "bucket_sizes", "bucket_for", "pad_rows",
@@ -100,4 +111,5 @@ __all__ = [
     "RequestTooLongError", "chain_keys", "DecodeFleet", "FleetRouter",
     "AffinityIndex", "DecodeReplica", "PrefillReplica",
     "ProcessDecodeReplica", "ProcessPrefillReplica", "HostKVTier",
+    "SafeFuture", "StreamFuture", "TokenDelivery",
 ]

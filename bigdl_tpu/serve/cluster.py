@@ -69,6 +69,7 @@ from bigdl_tpu.serve.engine import (PoisonedRequestError, ServeEngine,
 from bigdl_tpu.serve.paging import RequestTooLongError
 from bigdl_tpu.serve.router import (DeadReplicaError, Router,
                                     replicas_default)
+from bigdl_tpu.serve.streaming import StreamFuture, TokenDelivery
 
 logger = logging.getLogger("bigdl_tpu.serve")
 
@@ -262,6 +263,10 @@ class ProcessReplica:
         self._dead = False
         self._closing = False
         self._stderr_ring = deque(maxlen=_STDERR_LINES)
+        #: lazy parent-side delivery thread for incremental token
+        #: frames (streaming decode replicas) — user callbacks must
+        #: never run on, or block, the frame-reader thread
+        self._delivery = None
 
         child_env = dict(os.environ)
         # the child must NOT inherit the parent's event-log dir: its
@@ -329,6 +334,24 @@ class ProcessReplica:
                 # land it in the PARENT's event log, attributed
                 self._forward_event(msg.get("event"))
                 continue
+            if op == "tokens":
+                # an incremental token chunk from a streaming decode
+                # request (serve/fleet.py fleet_main): feed the rpc
+                # future WITHOUT popping it — the terminal reply frame
+                # still resolves it.  The chunk's absolute start index
+                # rides the frame so the StreamFuture dedup survives
+                # the process hop.  Fed through a parent-side delivery
+                # thread, NOT inline: user on_tokens callbacks hang off
+                # the piped chain, and a slow (or cross-request
+                # blocking) consumer must never park the reader thread
+                # that every reply frame from this replica rides.
+                with self._lock:
+                    entry = self._futures.get(msg.get("id"))
+                if entry is not None:
+                    self._ensure_delivery().enqueue(
+                        entry[0], msg.get("tokens") or [],
+                        msg.get("start"), None)
+                continue
             with self._lock:
                 entry = self._futures.pop(msg.get("id"), None)
             if entry is None:
@@ -338,7 +361,13 @@ class ProcessReplica:
                 if tr is not None:
                     # hops the child stamped after the wire crossing
                     tr.extend(msg.get("hops") or ())
-                fut.set_result(msg.get("out"))
+                if fut.streaming and self._delivery is not None:
+                    # streaming submits resolve through the delivery
+                    # FIFO so the final token chunk always lands before
+                    # result() unblocks (the decoder-side contract)
+                    self._delivery.resolve(fut, msg.get("out"))
+                else:
+                    fut.set_result(msg.get("out"))
             else:
                 cls = _EXC_TYPES.get(msg.get("etype"), RuntimeError)
                 fut.set_exception(cls(msg.get("error", "replica error")))
@@ -422,13 +451,20 @@ class ProcessReplica:
             except Exception:  # pragma: no cover - diagnostics bug
                 pass
 
+    def _ensure_delivery(self) -> TokenDelivery:
+        if self._delivery is None:
+            self._delivery = TokenDelivery(name=self.name)
+        return self._delivery
+
     def _rpc(self, op: str, timeout: float | None = None, **fields):
         fut = self._send(op, **fields)
         return fut.result(timeout=timeout)
 
     def _send(self, op: str, _trace=None, **fields) -> Future:
         rid = next(self._ids)
-        fut = Future()
+        # StreamFuture so decode submits can receive incremental token
+        # frames (op: tokens); every other rpc just resolves it
+        fut = StreamFuture()
         with self._lock:
             if self._dead:
                 fut.set_exception(self._dead_error())
@@ -500,6 +536,10 @@ class ProcessReplica:
         # included) is complete
         if threading.current_thread() is not self._reader:
             self._reader.join(timeout=10.0)
+        if self._delivery is not None:
+            # flush pending chunks/resolutions, then stop the thread
+            self._delivery.close()
+            self._delivery = None
 
 
 # ---------------------------------------------------------------------------

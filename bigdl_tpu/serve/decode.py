@@ -20,6 +20,23 @@ driver treats the rows of a fixed-width device batch as **slots**:
   is materialized once per boundary that retires anything — never per
   token.
 
+**Streaming delivery** (``serve/streaming.py``, docs/observability.md
+"Streaming telemetry"): :meth:`ContinuousDecoder.submit` returns a
+:class:`~bigdl_tpu.serve.streaming.StreamFuture` — register
+``on_tokens(cb)`` (or ship the fleet payload's ``stream`` flag) and the
+request's freshly generated tokens are delivered incrementally at each
+sync boundary.  Delivery reuses the boundary's one slab
+materialization (a boundary with live streams materializes exactly
+once, for delivery AND retirement — never per token, never twice), the
+committed stream is byte-identical to the all-at-once result in every
+configuration, and consumer callbacks run on a dedicated delivery
+thread so a slow or raising consumer can never stall the step loop.
+Each streamed request lands a per-request token timeline (admit →
+first-token boundary → per-boundary counts → retire) as a ``stream``
+obs event plus trace hops when sampled, and feeds the
+``decode_ttft_seconds`` / ``decode_itl_seconds`` / ``decode_stream_tokens_total``
+SLO surface in the mergeable metrics registry.
+
 **Paged KV (default, env ``BIGDL_SERVE_PAGED``)**: KV storage is a
 block-paged pool — ``(layers, n_pages, page_size, heads, hd)`` plus a
 per-slot slot→page table carried as traced state — instead of the PR-5
@@ -84,12 +101,12 @@ import os
 import time
 import weakref
 from collections import deque
-from concurrent.futures import Future
 
 import numpy as np
 
 from bigdl_tpu.serve.paging import PagePool, RequestTooLongError
 from bigdl_tpu.serve.prefix import PrefixCache, chain_keys
+from bigdl_tpu.serve.streaming import StreamFuture, TokenDelivery
 
 logger = logging.getLogger("bigdl_tpu.serve")
 
@@ -114,6 +131,18 @@ def _env_int(name, default):
 
 def sync_interval_default() -> int:
     return max(1, _env_int(ENV_SYNC, DEFAULT_SYNC))
+
+
+def _decoder_gc_cleanup(reg, name, delivery_box):
+    """weakref.finalize target for decoders nobody closes: stop the
+    lazily created delivery thread (else one blocked daemon thread
+    leaks per GC'd streaming decoder) and drop the registry series."""
+    for d in delivery_box:
+        try:
+            d.close(timeout=2.0)
+        except Exception:  # pragma: no cover - teardown
+            pass
+    reg.drop_series(decoder=name)
 
 
 def _tp_weight_specs(handles, ax: str):
@@ -151,18 +180,29 @@ def _tp_weight_specs(handles, ax: str):
 
 class _DecodeReq:
     __slots__ = ("seed", "n_words", "future", "slot", "steps_needed",
-                 "steps_run", "start_pos", "pages")
+                 "steps_run", "start_pos", "pages", "rid", "trace",
+                 "t_submit", "t_admit", "first_ts", "last_ts",
+                 "streamed", "timeline")
 
-    def __init__(self, seed, n_words):
+    def __init__(self, seed, n_words, trace=None):
         self.seed = [int(t) for t in seed]
         self.n_words = int(n_words)
-        self.future = Future()
+        self.future = StreamFuture()
         self.slot = None
         # positions fed through = n_seed + n_words - 1 (lm_decode's n_pos)
         self.steps_needed = len(self.seed) + self.n_words - 1
         self.steps_run = 0
         self.start_pos = 0       # > 0 on a prefix-cache hit
         self.pages = []          # pool page ids, logical order (paged)
+        # per-request token timeline (streaming telemetry)
+        self.rid = 0
+        self.trace = trace       # obs.trace.Trace for sampled requests
+        self.t_submit = time.perf_counter()
+        self.t_admit = None      # slot admission boundary
+        self.first_ts = None     # first-token boundary
+        self.last_ts = None      # last boundary that delivered tokens
+        self.streamed = 0        # generated tokens delivered so far
+        self.timeline = []       # [(perf_counter ts, n new tokens)]
 
 
 class ContinuousDecoder:
@@ -683,11 +723,29 @@ class ContinuousDecoder:
                 "decode_spec_accept_len",
                 "accepted draft tokens per speculative window",
                 bounds=obs_metrics.SPEC_ACCEPT_BUCKETS, **lab)
+        # streaming SLO surface (docs/observability.md "Streaming
+        # telemetry"): TTFT on the shared LATENCY_BUCKETS, ITL on the
+        # finer ITL_BUCKETS (on-chip inter-token gaps sit well below
+        # the 100 µs latency floor) — both fleet-mergeable
+        self._m_ttft = reg.histogram(
+            "decode_ttft_seconds",
+            "submit-to-first-streamed-token latency", **lab)
+        self._m_itl = reg.histogram(
+            "decode_itl_seconds",
+            "inter-token gap of streamed tokens (per-token, averaged "
+            "within a boundary)", bounds=obs_metrics.ITL_BUCKETS, **lab)
+        self._m_stream_toks = reg.counter(
+            "decode_stream_tokens_total",
+            "tokens delivered incrementally at sync boundaries", **lab)
         # directly-constructed decoders (the TP-serving entry point)
         # may never see close() — drop the uniquely-labelled series at
-        # GC so the process registry cannot grow without bound
+        # GC so the process registry cannot grow without bound, and
+        # stop the lazily created delivery thread (the box is filled by
+        # _ensure_delivery; a finalizer must not reference self)
+        self._delivery_box: list = []
         self._drop_series = weakref.finalize(
-            self, reg.drop_series, decoder=self.name)
+            self, _decoder_gc_cleanup, reg, self.name,
+            self._delivery_box)
         self.steps = 0
         self.host_syncs = 0
         self.admitted = 0
@@ -695,6 +753,18 @@ class ContinuousDecoder:
         self.live_hwm = 0
         self.spec_windows = 0
         self.spec_accepted = 0
+        # streaming lifetime aggregates (stats() / emit_decode_event)
+        self.streams = 0           # requests that streamed >= 1 token
+        self.stream_tokens = 0
+        #: DISTINCT sync boundaries that delivered tokens to at least
+        #: one stream (per-request boundary counts live on the
+        #: `stream` events' timelines)
+        self.stream_boundaries = 0
+        self._ttft_sum = 0.0
+        self._req_seq = itertools.count(1)
+        #: lazy dedicated delivery thread — consumer callbacks and
+        #: streaming-future resolution run there, never the step loop
+        self._delivery = None
 
         self._warm()
 
@@ -902,18 +972,27 @@ class ContinuousDecoder:
         return adopted
 
     # -- submit -------------------------------------------------------------
-    def submit(self, seed_ids, n_words: int) -> Future:
+    def submit(self, seed_ids, n_words: int,
+               trace=None) -> StreamFuture:
         """Queue one request; the future resolves to the full token row
         (seed + ``n_words`` generated ids), exactly ``lm_decode``'s
         greedy output for the same seed.  A request that cannot ever
         fit fails ONLY its own future with :class:`RequestTooLongError`
-        — other submitted requests are untouched."""
+        — other submitted requests are untouched.
+
+        The returned :class:`~bigdl_tpu.serve.streaming.StreamFuture`
+        additionally streams: ``on_tokens(cb)`` (or ``request_stream``)
+        turns on incremental delivery of the generated tokens at each
+        sync boundary, byte-identical to the resolved row's tail.
+        ``trace`` (an ``obs.trace.Trace``) gains ``decode_admit`` /
+        ``first_token`` / ``retire`` hops as the request moves."""
         seed = np.asarray(seed_ids, np.int32)
         if seed.ndim != 1 or seed.size == 0:
             raise ValueError("seed_ids must be one flat non-empty id row")
         if n_words < 1:
             raise ValueError("n_words must be >= 1")
-        req = _DecodeReq(seed.tolist(), n_words)
+        req = _DecodeReq(seed.tolist(), n_words, trace=trace)
+        req.rid = next(self._req_seq)
         too_long = req.steps_needed > self.n_pos
         if self.paged and not too_long:
             too_long = (-(-req.steps_needed // self.page_size)
@@ -974,6 +1053,9 @@ class ContinuousDecoder:
             req.slot = slot
             self._apply_admit(slot, req)
             self._slots[slot] = req
+            req.t_admit = time.perf_counter()
+            if req.trace is not None:
+                req.trace.stamp("decode_admit", req.t_admit)
             self.admitted += 1
             self._m_admitted.inc()
         if self.paged:
@@ -1050,6 +1132,7 @@ class ContinuousDecoder:
             self._run_step()
         self.steps += self.sync_interval
         self._m_steps.inc(self.sync_interval)
+        pos_host = None
         if spec:
             pos_host = np.asarray(self._pos)
             self.host_syncs += 1
@@ -1062,14 +1145,31 @@ class ContinuousDecoder:
                 r.steps_run += self.sync_interval
             done = [r for r in live
                     if r.start_pos + r.steps_run >= r.steps_needed]
-        if done:
+        # ONE slab materialization per boundary, shared by streaming
+        # delivery AND retirement — streaming never adds a second fetch
+        # to a boundary, and a boundary with neither live streams nor
+        # retirements still fetches nothing (the pre-streaming count)
+        streaming = [r for r in live if r.future.streaming]
+        gen_host = None
+        if done or streaming:
             gen_host = np.asarray(self._gen)   # the boundary host sync
             if not spec:
                 self.host_syncs += 1
                 self._m_syncs.inc()
+        delivered = False
+        if streaming:
+            ts = time.perf_counter()
+            for r in streaming:
+                consumed = (int(pos_host[r.slot]) if spec
+                            else r.start_pos + r.steps_run)
+                delivered |= self._feed_stream(r, gen_host, consumed,
+                                               ts)
+        if done:
+            ts = time.perf_counter()
             for r in done:
                 s = len(r.seed)
                 toks = gen_host[r.slot, s - 1:s - 1 + r.n_words]
+                row = r.seed + [int(t) for t in toks]
                 # retire BEFORE resolving: a serial client waiting on
                 # this future may submit again the instant it resolves,
                 # and the dispatch decision it triggers (least-loaded /
@@ -1078,9 +1178,21 @@ class ContinuousDecoder:
                 # still counts the finished request (the fleet drill's
                 # old flake)
                 self._retire_req(r)
-                r.future.set_result(r.seed + [int(t) for t in toks])
+                if r.future.streaming:
+                    # catch-up (a consumer registered this boundary),
+                    # then the stream epilogue; the resolution rides
+                    # the delivery FIFO so the final chunk is always
+                    # delivered before result() unblocks
+                    delivered |= self._feed_stream(r, gen_host,
+                                                   r.steps_needed, ts)
+                    self._finish_stream(r, ts)
+                    self._ensure_delivery().resolve(r.future, row)
+                else:
+                    r.future.set_result(row)
             self._m_slots.set(sum(1 for r in self._slots
                                   if r is not None))
+        if delivered:
+            self.stream_boundaries += 1
         if spec:
             # a speculative window commits its accepted drafts plus the
             # verify token — both counters were drained this boundary
@@ -1090,6 +1202,76 @@ class ContinuousDecoder:
             tokens = len(live) * self.sync_interval
         self._note_util(tokens)
         return len(live)
+
+    # -- streaming delivery -------------------------------------------------
+    def _ensure_delivery(self) -> TokenDelivery:
+        if self._delivery is None:
+            self._delivery = TokenDelivery(name=self.name)
+            self._delivery_box.append(self._delivery)
+        return self._delivery
+
+    def _feed_stream(self, req, gen_host, consumed: int,
+                     ts: float) -> bool:
+        """Deliver the tokens that became visible this boundary for one
+        streaming request: everything generated past what was already
+        delivered, read from the boundary's ONE slab materialization.
+        Stamps the request timeline and the TTFT/ITL histograms; the
+        actual consumer callbacks run on the delivery thread.
+        Idempotent per boundary (``streamed`` only grows); returns
+        whether anything was delivered."""
+        s = len(req.seed)
+        avail = min(int(consumed), req.steps_needed) - (s - 1)
+        new = avail - req.streamed
+        if new <= 0:
+            return False
+        toks = [int(t) for t in
+                gen_host[req.slot, s - 1 + req.streamed:s - 1 + avail]]
+        start = req.streamed
+        req.streamed = avail
+        if req.first_ts is None:
+            req.first_ts = ts
+            self.streams += 1
+            self._m_ttft.observe(ts - req.t_submit)
+            self._ttft_sum += ts - req.t_submit
+            if req.trace is not None:
+                req.trace.stamp("first_token", ts)
+        else:
+            # per-token gaps, averaged within the boundary: n tokens
+            # landing dt after the previous delivery are n observations
+            # of dt/n (co-delivered tokens share the window; the first
+            # boundary's tokens belong to TTFT, not ITL)
+            gap = ts - req.last_ts
+            if gap > 0:
+                self._m_itl.observe_n(gap / new, new)
+        req.last_ts = ts
+        req.timeline.append((ts, new))
+        self.stream_tokens += new
+        self._m_stream_toks.inc(new)
+        self._ensure_delivery().enqueue(req.future, toks, start, ts)
+        return True
+
+    def _finish_stream(self, req, ts: float):
+        """The per-request stream epilogue at retire: the ``retire``
+        trace hop and one ``stream`` obs event carrying the token
+        timeline (admit → first token → per-boundary counts → retire)
+        — what the obs_report token waterfall renders."""
+        if req.trace is not None:
+            req.trace.stamp("retire", ts)
+        if req.first_ts is None:   # pragma: no cover - n_words >= 1
+            return
+        from bigdl_tpu.obs import events
+        rel = req.t_submit
+        events.emit(
+            "serve", kind="stream", request=f"{self.name}/{req.rid}",
+            decoder=self.name, tokens=req.streamed,
+            n_seed=len(req.seed),
+            admit_ms=(None if req.t_admit is None
+                      else round((req.t_admit - rel) * 1e3, 3)),
+            ttft_ms=round((req.first_ts - rel) * 1e3, 3),
+            retire_ms=round((ts - rel) * 1e3, 3),
+            boundaries=len(req.timeline),
+            timeline=[[round((t - rel) * 1e3, 3), n]
+                      for t, n in req.timeline])
 
     def _note_util(self, tokens: int):
         """``decode_model_flops_util`` + ``decode_tokens_per_s``: one
@@ -1149,6 +1331,13 @@ class ContinuousDecoder:
                          spec_windows=self.spec_windows,
                          accept_mean=(self.spec_accepted
                                       / max(1, self.spec_windows)))
+        if self.streams:
+            # required-when-streaming (events schema v4)
+            extra.update(streaming=True, streams=self.streams,
+                         stream_tokens=self.stream_tokens,
+                         stream_boundaries=self.stream_boundaries,
+                         first_token_ms=(self._ttft_sum / self.streams
+                                         * 1e3))
         events.emit("serve", kind="decode", steps=self.steps,
                     host_syncs=self.host_syncs, admitted=self.admitted,
                     retired=self.retired, slots=self.B, **extra)
@@ -1163,6 +1352,12 @@ class ContinuousDecoder:
         the registry — and every snapshot/exposition — without bound.
         The series drop also runs at GC for decoders nobody closes;
         idempotent."""
+        if self._delivery is not None:
+            # FIFO drain: every pending chunk and streaming resolution
+            # lands before the thread stops (then joined — the orphaned
+            # daemon-thread-at-teardown lesson, Router.close)
+            self._delivery.close()
+            self._delivery = None
         if self._prefix is not None:
             self._prefix.drop_all()
         if self._tier is not None and self._tier_owned:
@@ -1187,6 +1382,12 @@ class ContinuousDecoder:
                 out["prefix"] = self._prefix.stats()
             if self._tier is not None:
                 out["kv_host"] = self._tier.stats()
+        if self.streams:
+            out["stream"] = {
+                "streams": self.streams,
+                "tokens": self.stream_tokens,
+                "boundaries": self.stream_boundaries,
+                "ttft_mean_ms": self._ttft_sum / self.streams * 1e3}
         if self.spec_k:
             counts = self._accept_counts
             total = int(counts.sum())

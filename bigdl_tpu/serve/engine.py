@@ -55,6 +55,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from bigdl_tpu.serve import bucketing
+from bigdl_tpu.serve.streaming import SafeFuture
 
 logger = logging.getLogger("bigdl_tpu.serve")
 
@@ -98,7 +99,10 @@ class _Request:
 
     def __init__(self, x, trace=None):
         self.x = x
-        self.future = Future()
+        # SafeFuture: a user add_done_callback that raises fails only
+        # its own registration (obs error event) — it can never kill
+        # the compute thread resolving the batch (serve/streaming.py)
+        self.future = SafeFuture()
         self.t_submit = time.perf_counter()
         self.trace = trace       # obs.trace.Trace for sampled requests
 

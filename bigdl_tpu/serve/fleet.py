@@ -72,6 +72,7 @@ from bigdl_tpu.serve.kvtier import HostKVTier, host_mb_default
 from bigdl_tpu.serve.prefix import chain_keys
 from bigdl_tpu.serve.router import (DeadReplicaError, Router,
                                     replicas_default)
+from bigdl_tpu.serve.streaming import StreamFuture
 
 logger = logging.getLogger("bigdl_tpu.serve")
 
@@ -199,12 +200,17 @@ class DecodeReplica:
 
     # -- replica surface ----------------------------------------------------
     def submit(self, x, trace=None) -> Future:
-        fut = Future()
+        fut = StreamFuture()
+        if isinstance(x, dict) and x.get("stream"):
+            # stream intent travels in the payload (it can cross a
+            # process boundary ahead of the consumer pipe): the driver
+            # pipes the decoder's chunks into this proxy from admission
+            fut.request_stream()
         with self._cv:
             if self._dead or self._closed:
                 raise DeadReplicaError(
                     f"decode replica {self.name} is closed")
-            self._inbox.append((x, fut))
+            self._inbox.append((x, fut, trace))
             self._inflight[id(fut)] = fut
             self._cv.notify()
         fut.add_done_callback(
@@ -237,7 +243,7 @@ class DecodeReplica:
     def _admit_inbox(self, items):
         """Adopt shipped pages and queue inbox requests on the decoder
         (driver thread only — the decoder is single-threaded state)."""
-        for x, fut in items:
+        for x, fut, trace in items:
             try:
                 if x.get("pages"):
                     try:
@@ -248,11 +254,17 @@ class DecodeReplica:
                         logger.warning(
                             "replica %s: shipped-page adoption failed",
                             self.name, exc_info=True)
-                inner = self.decoder.submit(x["seed"], x["n_words"])
+                inner = self.decoder.submit(x["seed"], x["n_words"],
+                                            trace=trace)
             except Exception as e:
                 if not fut.done():
                     fut.set_exception(e)
                 continue
+            if fut.streaming:
+                # chunks flow decoder → proxy on the decoder's
+                # delivery thread, before the result copy below (the
+                # delivery FIFO resolves `inner` after its last chunk)
+                inner.pipe_to(fut)
             inner.add_done_callback(
                 lambda f, proxy=fut: self._copy_result(f, proxy))
 
@@ -340,6 +352,7 @@ class ProcessDecodeReplica(ProcessReplica):
             "submit", _trace=trace,
             seed=[int(t) for t in x["seed"]],
             n_words=int(x["n_words"]), pages=x.get("pages"),
+            stream=bool(x.get("stream")),
             trace=None if trace is None else trace.to_wire())
 
 
@@ -738,7 +751,13 @@ class FleetRouter(Router):
             self._m_fallback.inc()
             return super()._submit_to(replica, req)
 
-        outer = Future()
+        outer = StreamFuture()
+        if req.future.streaming:
+            # mark intent NOW: the async prefill hop may land (and
+            # pipe the replica chunks in) before the base router
+            # registers its outer→client pipe — the backlog replays to
+            # that late registration, so no chunk is lost either way
+            outer.request_stream()
 
         def land(pages):
             x2 = dict(x)
@@ -752,6 +771,10 @@ class FleetRouter(Router):
             except Exception as e:
                 outer.set_exception(e)
                 return
+            if outer.streaming and hasattr(inner, "pipe_to"):
+                # the base router pipes from `outer`; chain the replica
+                # chunks through it (index-preserving)
+                inner.pipe_to(outer)
             inner.add_done_callback(_copy)
 
         def _copy(inner):
@@ -888,9 +911,20 @@ class DecodeFleet:
 
     # -- request path -------------------------------------------------------
     def submit(self, seed, n_words: int, priority: int = 1,
-               slo_ms: float | None = None) -> Future:
+               slo_ms: float | None = None, ttft_ms: float | None = None,
+               on_tokens=None, stream: bool = False) -> Future:
+        """One decode request through the fleet.  ``on_tokens`` (or
+        ``stream=True``) turns on incremental token delivery: chunks
+        flow decode replica → router → the returned
+        :class:`~bigdl_tpu.serve.streaming.StreamFuture` (across the
+        frame protocol for subprocess replicas), byte-identical to the
+        resolved row's tail, and the request joins the per-token SLO
+        class (``ttft_ms`` / ``BIGDL_SERVE_SLO_TTFT_MS``)."""
         x = {"seed": [int(t) for t in seed], "n_words": int(n_words)}
-        return self.router.submit(x, priority=priority, slo_ms=slo_ms)
+        if stream or on_tokens is not None:
+            x["stream"] = True
+        return self.router.submit(x, priority=priority, slo_ms=slo_ms,
+                                  ttft_ms=ttft_ms, on_tokens=on_tokens)
 
     def submit_many(self, seeds, n_words: int, priority: int = 1,
                     slo_ms: float | None = None) -> list:
@@ -1049,9 +1083,22 @@ def fleet_main(stdin=None, stdout=None):
                 x = {"seed": msg["seed"], "n_words": msg["n_words"]}
                 if msg.get("pages"):
                     x["pages"] = msg["pages"]
+                if msg.get("stream"):
+                    x["stream"] = True
                 tr = (obs_trace.Trace.from_wire(msg["trace"])
                       if msg.get("trace") else None)
                 fut = replica.submit(x, trace=tr)
+                if msg.get("stream"):
+                    # incremental token frames: each chunk crosses the
+                    # wire with its absolute start index, so the
+                    # parent-side StreamFuture dedup holds across the
+                    # process hop (runs on the delivery thread; wlock
+                    # keeps frames atomic vs replies/events)
+                    fut.on_tokens_indexed(
+                        lambda toks, start, r=rid: _write_frame(
+                            stdout, {"op": "tokens", "id": r,
+                                     "tokens": toks, "start": start},
+                            wlock))
                 fut.add_done_callback(
                     lambda f, r=rid, t=tr: reply(r, f, t))
             elif op == "prefill" and role == "prefill":

@@ -58,6 +58,7 @@ from concurrent.futures import Future
 
 from bigdl_tpu.obs import trace as obs_trace
 from bigdl_tpu.serve.engine import SheddedError  # noqa: F401 (re-export)
+from bigdl_tpu.serve.streaming import StreamFuture, ttft_ms_default
 
 logger = logging.getLogger("bigdl_tpu.serve")
 
@@ -97,14 +98,23 @@ class DeadReplicaError(RuntimeError):
 
 
 class _RouterReq:
-    __slots__ = ("x", "future", "priority", "deadline", "t_submit",
-                 "attempts", "queued", "trace", "affinity", "aff_note")
+    __slots__ = ("x", "future", "priority", "deadline", "ttft_deadline",
+                 "t_submit", "attempts", "queued", "trace", "affinity",
+                 "aff_note")
 
-    def __init__(self, x, priority, deadline, trace=None):
+    def __init__(self, x, priority, deadline, trace=None,
+                 ttft_deadline=None):
         self.x = x
-        self.future = Future()
+        # StreamFuture: decode replicas pipe incremental token chunks
+        # into it (dedup by absolute index, so a requeue after replica
+        # death re-delivers nothing twice); plain engine replicas just
+        # resolve it like a Future
+        self.future = StreamFuture()
         self.priority = int(priority)
         self.deadline = deadline          # absolute perf_counter, or None
+        #: the per-token SLO class deadline: projected FIRST-token
+        #: completion past this sheds the request (streaming classes)
+        self.ttft_deadline = ttft_deadline
         self.t_submit = time.perf_counter()
         self.trace = trace                # obs.trace.Trace when sampled
         #: pages the dispatcher predicts the chosen replica's prefix
@@ -136,16 +146,26 @@ class Router:
                  shed: bool | None = None, est_ms: float = 50.0,
                  max_requeues: int = 3, health_interval: float = 0.2,
                  name: str | None = None,
-                 trace_sample: float | None = None):
+                 trace_sample: float | None = None,
+                 ttft_ms: float | None = None):
         if not replicas:
             raise ValueError("Router needs at least one replica")
         self.replicas = list(replicas)
         self.name = name or f"router{next(_ROUTER_SEQ)}"
         self.slo_s = (slo_ms_default() if slo_ms is None
                       else max(0.0, float(slo_ms))) / 1e3
+        #: default per-token SLO class: a first-token budget for
+        #: streaming requests (``BIGDL_SERVE_SLO_TTFT_MS``; 0 = no
+        #: class — requests only shed on their e2e deadline)
+        self.ttft_slo_s = (ttft_ms_default() if ttft_ms is None
+                           else max(0.0, float(ttft_ms))) / 1e3
         self.shed_enabled = shed_default() if shed is None else bool(shed)
         self.max_requeues = int(max_requeues)
         self._est_s = max(float(est_ms), 0.0) / 1e3
+        #: EWMA of observed submit→first-token latency (streamed
+        #: requests feed it) — the projection the TTFT shed check uses;
+        #: seeded from the service estimate until a stream completes
+        self._est_ttft_s = self._est_s
         self._seq = itertools.count()
         #: request tracing: deterministic sampler, default rate from
         #: BIGDL_OBS_TRACE_SAMPLE (0 = the hot path never stamps)
@@ -188,6 +208,10 @@ class Router:
             "router_est_ms", "EWMA service-time estimate (ms)",
             agg="max", **lab)
         self._m_est.set(self._est_s * 1e3)
+        self._m_est_ttft = reg.gauge(
+            "router_est_ttft_ms",
+            "EWMA first-token latency estimate (ms)", agg="max", **lab)
+        self._m_est_ttft.set(self._est_ttft_s * 1e3)
 
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
@@ -224,18 +248,40 @@ class Router:
         return int(self._m_req["requeued"].value)
 
     # -- submit -------------------------------------------------------------
-    def submit(self, x, priority: int = 1,
-               slo_ms: float | None = None) -> Future:
+    def submit(self, x, priority: int = 1, slo_ms: float | None = None,
+               ttft_ms: float | None = None, on_tokens=None) -> Future:
         """Queue one row; returns a future resolving to its output.
         ``priority``: lower = more urgent (0 is the most urgent class).
         ``slo_ms`` overrides the router default; ``None``+default-0
-        means no deadline (the request is never shed)."""
+        means no deadline (the request is never shed).
+
+        Streaming (decode fleets): ``on_tokens`` registers an
+        incremental token consumer on the returned
+        :class:`~bigdl_tpu.serve.streaming.StreamFuture` (replica-side
+        chunks are piped into it at dispatch), and ``ttft_ms`` arms the
+        per-token SLO class — EDF orders by the FIRST-token deadline
+        and the shed policy projects first-token completion, not
+        end-to-end retire (``BIGDL_SERVE_SLO_TTFT_MS`` default)."""
+        now = time.perf_counter()
         slo_s = self.slo_s if slo_ms is None else max(0.0, slo_ms) / 1e3
-        deadline = (time.perf_counter() + slo_s) if slo_s > 0 else None
+        deadline = (now + slo_s) if slo_s > 0 else None
+        wants_stream = (on_tokens is not None
+                        or (isinstance(x, dict) and x.get("stream")))
+        ttft_s = (self.ttft_slo_s if ttft_ms is None
+                  else max(0.0, ttft_ms) / 1e3)
+        # the per-token class applies to STREAMING requests: a request
+        # nobody consumes incrementally has no observable first token
+        ttft_deadline = (now + ttft_s) if ttft_s > 0 and wants_stream \
+            else None
         tr = self._sampler.next()
         if tr is not None:
             tr.stamp("admit")
-        req = _RouterReq(x, priority, deadline, trace=tr)
+        req = _RouterReq(x, priority, deadline, trace=tr,
+                         ttft_deadline=ttft_deadline)
+        if wants_stream:
+            req.future.request_stream()
+        if on_tokens is not None:
+            req.future.on_tokens(on_tokens)
         with self._cv:
             if self._closed:
                 raise RuntimeError("Router is closed")
@@ -255,8 +301,12 @@ class Router:
         if req.queued or req.future.done():
             return False
         req.queued = True
+        # EDF on the EARLIEST obligation: a streaming request's
+        # first-token deadline (usually tighter than e2e) orders it;
         # None deadlines sort last inside their class
-        dl = req.deadline if req.deadline is not None else float("inf")
+        dl = min(req.deadline if req.deadline is not None else float("inf"),
+                 req.ttft_deadline if req.ttft_deadline is not None
+                 else float("inf"))
         heapq.heappush(self._heap, (req.priority, dl, next(self._seq),
                                     req))
         return True
@@ -294,17 +344,37 @@ class Router:
         # the submitter can retry elsewhere — instead of burning
         # replica time to miss anyway.  High-priority classes dispatch
         # first, so overload drains budget from the LOWEST class first.
-        if (self.shed_enabled and req.deadline is not None
-                and time.perf_counter() + est * (load + 1) > req.deadline):
-            self._m_shed["admission"].inc()
-            self._emit("shed", priority=req.priority,
-                       wait_ms=(time.perf_counter() - req.t_submit) * 1e3)
-            self._finish_trace(req, "shed", hop="shed")
-            req.future.set_exception(SheddedError(
-                f"projected completion past deadline (priority "
-                f"{req.priority}, backlog {load}, est "
-                f"{est * 1e3:.1f} ms)"))
-            return
+        # Streaming classes are judged on their FIRST-token projection
+        # (backlog x the EWMA TTFT estimate): a stream that would start
+        # past its TTFT budget is already failing its user even if it
+        # could retire inside the e2e deadline.
+        if self.shed_enabled:
+            now = time.perf_counter()
+            miss = reason = None
+            if (req.deadline is not None
+                    and now + est * (load + 1) > req.deadline):
+                miss, reason = est, "completion past deadline"
+            elif (req.ttft_deadline is not None
+                    and req.future.t_first_token is None):
+                # the first-token obligation only judges requests that
+                # have not streamed yet: a requeue-after-replica-death
+                # re-dispatch of a mid-stream request (its client HAS
+                # tokens; re-delivery dedups by index) must not shed on
+                # a deadline it already met
+                with self._lock:
+                    est_ttft = self._est_ttft_s
+                if now + est_ttft * (load + 1) > req.ttft_deadline:
+                    miss = est_ttft
+                    reason = "first token past TTFT budget"
+            if miss is not None:
+                self._m_shed["admission"].inc()
+                self._emit("shed", priority=req.priority,
+                           wait_ms=(now - req.t_submit) * 1e3)
+                self._finish_trace(req, "shed", hop="shed")
+                req.future.set_exception(SheddedError(
+                    f"projected {reason} (priority {req.priority}, "
+                    f"backlog {load}, est {miss * 1e3:.1f} ms)"))
+                return
         with self._lock:
             self._outstanding[id(replica)][id(req)] = req
         if req.trace is not None:
@@ -316,6 +386,12 @@ class Router:
                 self._outstanding[id(replica)].pop(id(req), None)
             self._on_replica_error(replica, req, e)
             return
+        if req.future.streaming and hasattr(inner, "pipe_to"):
+            # incremental token chunks flow replica → client; the
+            # absolute-index dedup makes a requeued request's
+            # re-delivery (same greedy stream, fresh replica) a no-op
+            # for tokens the client already has
+            inner.pipe_to(req.future)
         inner.add_done_callback(
             lambda f, r=replica, q=req: self._on_done(r, q, f))
 
@@ -383,9 +459,14 @@ class Router:
         exc = inner.exception()
         if exc is None:
             lat = time.perf_counter() - req.t_submit
+            ttft = getattr(req.future, "ttft_s", None)
             with self._lock:
                 self._est_s += _EST_ALPHA * (lat - self._est_s)
                 self._m_est.set(self._est_s * 1e3)
+                if ttft is not None:
+                    self._est_ttft_s += _EST_ALPHA * (ttft
+                                                      - self._est_ttft_s)
+                    self._m_est_ttft.set(self._est_ttft_s * 1e3)
             self._m_req["completed"].inc()
             self._finish_trace(req, "ok", hop="complete",
                                replica=getattr(replica, "name", None),
@@ -518,6 +599,7 @@ class Router:
         with self._lock:
             queue_depth = len(self._heap)
             est_ms = self._est_s * 1e3
+            est_ttft_ms = self._est_ttft_s * 1e3
             dead = len(self._dead)
         return {
             "accepted": self.accepted,
@@ -527,6 +609,8 @@ class Router:
             "requeued": self.requeued,
             "queue_depth": queue_depth,
             "est_ms": est_ms,
+            "est_ttft_ms": est_ttft_ms,
+            "ttft_slo_ms": self.ttft_slo_s * 1e3,
             "replicas": len(self.replicas),
             "dead_replicas": dead,
         }

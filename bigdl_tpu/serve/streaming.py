@@ -1,0 +1,335 @@
+"""Streaming decode futures: incremental per-token delivery with
+callback safety (docs/observability.md "Streaming telemetry").
+
+The ContinuousDecoder historically resolved one future per request with
+the whole token row at retire, which makes the two SLOs production LM
+serving is judged on — time-to-first-token (TTFT) and inter-token
+latency (ITL) — unmeasurable anywhere in the stack.  This module grows
+decode futures into :class:`StreamFuture`\\ s:
+
+- :meth:`StreamFuture.on_tokens` registers an incremental consumer fed
+  at each existing ``BIGDL_SERVE_SYNC`` boundary — the decoder's token
+  slab is materialized at the boundary anyway, so delivery adds zero
+  extra device syncs and never happens per token;
+- chunks carry an absolute **start index**, so a requeued request
+  (replica death) re-delivering its deterministic greedy stream from a
+  survivor is deduplicated instead of duplicated — consumers see every
+  token exactly once, byte-identical to the all-at-once result;
+- consumer callbacks run on a dedicated delivery thread
+  (:class:`TokenDelivery`) or a frame-forwarding thread — NEVER the
+  decode step loop — so a slow or raising consumer can not stall the
+  device;
+- a raising consumer (``on_tokens`` or ``add_done_callback``) fails
+  only its own registration: it is dropped with an obs ``serve`` error
+  event, and the stream, its future, and the delivery/dispatch threads
+  all keep running (:class:`SafeFuture` is the ``add_done_callback``
+  half of that contract — ``ServeEngine`` futures use it too).
+
+Per-token SLO class (``serve/router.py``): ``BIGDL_SERVE_SLO_TTFT_MS``
+/ ``BIGDL_SERVE_SLO_ITL_MS`` declare first-token and inter-token
+budgets for streaming requests; the router's EDF deadline and
+shed-before-miss projection then run against the projected FIRST-token
+completion, not end-to-end retire (a stream that starts late is already
+failing its users even if it retires on time).
+
+Do not block inside an ``on_tokens`` callback waiting on the same
+future's ``result()`` — the result is resolved on the delivery thread
+the callback occupies.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+ENV_TTFT_MS = "BIGDL_SERVE_SLO_TTFT_MS"
+ENV_ITL_MS = "BIGDL_SERVE_SLO_ITL_MS"
+
+
+def ttft_ms_default() -> float:
+    """Default first-token SLO budget (ms; 0 = no per-token class)."""
+    try:
+        return max(0.0, float(os.environ.get(ENV_TTFT_MS, "0") or 0))
+    except ValueError:
+        return 0.0
+
+
+def itl_ms_default() -> float:
+    """Declared inter-token SLO budget (ms; 0 = none).  A positive
+    budget arms the absolute ``itl_burn`` alert default — windowed ITL
+    p95 above it — next to the always-on relative ``itl_regression``
+    rule (obs/alerts.py ``default_rules``)."""
+    try:
+        return max(0.0, float(os.environ.get(ENV_ITL_MS, "0") or 0))
+    except ValueError:
+        return 0.0
+
+
+def _consumer_error(where: str, exc: BaseException):
+    """One obs ``serve`` error event per raising user callback — the
+    callback is the failure, never the stream machinery around it."""
+    logger.warning("serve %s callback raised: %s: %s", where,
+                   type(exc).__name__, exc)
+    try:
+        from bigdl_tpu.obs import events
+        events.emit("serve", kind="error",
+                    error=f"{type(exc).__name__}: {exc}", callback=where)
+    except Exception:  # pragma: no cover - telemetry must not mask
+        pass
+
+
+class SafeFuture(Future):
+    """A Future whose user callbacks can never kill the resolving
+    thread: every ``add_done_callback`` invocation — at set-time on the
+    engine compute / decoder delivery thread, or inline when the future
+    is already done — is guarded, and a raise is converted into an obs
+    ``serve`` error event instead of propagating.  (CPython already
+    swallows ``Exception`` from set-time callbacks into a logger; this
+    widens the guard to ``BaseException``, covers the already-done
+    inline path, and lands the failure in the event stream where a
+    postmortem can see it.)"""
+
+    def add_done_callback(self, fn):
+        # mirror CPython's implementation so the inline already-done
+        # call path raises into OUR guard (the stdlib's own guard logs
+        # to a stdlib logger the obs stream never sees)
+        try:
+            with self._condition:
+                if self._state not in ("CANCELLED",
+                                       "CANCELLED_AND_NOTIFIED",
+                                       "FINISHED"):
+                    self._done_callbacks.append(fn)
+                    return
+        except AttributeError:   # pragma: no cover - exotic runtime
+            super().add_done_callback(fn)
+            return
+        try:
+            fn(self)
+        except BaseException as e:
+            _consumer_error("done_callback", e)
+
+    def _invoke_callbacks(self):
+        callbacks, self._done_callbacks = self._done_callbacks, []
+        for fn in callbacks:
+            try:
+                fn(self)
+            except BaseException as e:
+                _consumer_error("done_callback", e)
+
+
+class StreamFuture(SafeFuture):
+    """A decode future that can ALSO deliver its generated tokens
+    incrementally.
+
+    Producers call :meth:`feed` with each boundary's new tokens and the
+    chunk's absolute start index; consumers register with
+    :meth:`on_tokens` (``cb(tokens)`` — a list of fresh token ids) and
+    are replayed the backlog on registration, so a consumer attached a
+    moment after the first boundary still sees every token exactly
+    once.  :meth:`pipe_to` chains futures (decoder → replica proxy →
+    router future → client) preserving the start-index dedup, which is
+    what makes requeue-after-replica-death re-delivery idempotent: the
+    retried request regenerates the same greedy prefix, and the overlap
+    is dropped here.
+
+    ``streaming`` is the producer's signal to start per-boundary
+    delivery: true once any consumer is registered, or after
+    :meth:`request_stream` (the fleet payload's ``stream`` flag —
+    intent can cross a process boundary before the consumer pipe is
+    attached).  The future still resolves with the full token row
+    either way."""
+
+    def __init__(self):
+        super().__init__()
+        self._slock = threading.Lock()
+        self._stream_tokens: list = []
+        #: consumer entries [cb, indexed, sent, draining] — ``sent`` is
+        #: how many tokens this consumer has been handed, ``draining``
+        #: marks the one thread currently delivering to it
+        self._consumers: list = []
+        self._want_stream = False
+        self.t_create = time.perf_counter()
+        self.t_first_token: float | None = None
+        self.stream_chunks = 0
+
+    # -- consumer side ------------------------------------------------------
+    @property
+    def streaming(self) -> bool:
+        # lock-free: two atomic attribute reads — the decode step loop
+        # polls this per live request per boundary and must never wait
+        # behind a consumer callback
+        return self._want_stream or bool(self._consumers)
+
+    def request_stream(self) -> "StreamFuture":
+        """Mark this future as wanting per-boundary delivery even
+        before a consumer is attached (chunks buffer and replay)."""
+        with self._slock:
+            self._want_stream = True
+        return self
+
+    def on_tokens(self, cb) -> "StreamFuture":
+        """Register ``cb(tokens)`` for every delivered chunk; the
+        backlog already delivered is replayed first (under the stream
+        lock, so no chunk can race between replay and registration).  A
+        raising ``cb`` is dropped with an obs error event — it fails
+        only its own registration, never the stream or the delivery
+        thread."""
+        return self._register(cb, indexed=False)
+
+    def on_tokens_indexed(self, cb) -> "StreamFuture":
+        """Like :meth:`on_tokens` but ``cb(tokens, start)`` — the
+        chunk's absolute index in the generated stream.  Forwarders
+        (frame protocol, :meth:`pipe_to`) use this so dedup survives
+        process hops."""
+        return self._register(cb, indexed=True)
+
+    def _register(self, cb, indexed: bool):
+        entry = [cb, indexed, 0, False]
+        with self._slock:
+            self._consumers.append(entry)
+        self._drain(entry)          # replay any backlog (outside lock)
+        return self
+
+    def pipe_to(self, dst: "StreamFuture") -> "StreamFuture":
+        """Forward every chunk into ``dst`` (index-preserving)."""
+        dst.request_stream()
+        return self.on_tokens_indexed(dst.feed)
+
+    # -- producer side ------------------------------------------------------
+    def feed(self, tokens, start: int | None = None,
+             ts: float | None = None) -> int:
+        """Deliver a chunk.  ``start`` is the chunk's absolute index in
+        the generated stream (``None`` = append at the current end);
+        already-delivered overlap — a requeued request re-streaming
+        from a fresh replica — is trimmed, so consumers see each index
+        exactly once.  Returns the number of NEW tokens delivered.
+
+        Consumer callbacks are invoked OUTSIDE the stream lock (a slow
+        consumer can block its delivery thread, never a thread that
+        merely checks :attr:`streaming` or feeds a sibling)."""
+        tokens = [int(t) for t in tokens]
+        with self._slock:
+            n = len(self._stream_tokens)
+            if start is None:
+                start = n
+            if start > n:   # a gap would silently corrupt the stream
+                raise ValueError(
+                    f"stream chunk starts at {start} but only {n} "
+                    f"tokens were delivered")
+            tokens = tokens[n - start:]
+            if not tokens:
+                return 0
+            if self.t_first_token is None:
+                self.t_first_token = (time.perf_counter() if ts is None
+                                      else float(ts))
+            self.stream_chunks += 1
+            self._stream_tokens.extend(tokens)
+            consumers = list(self._consumers)
+        for entry in consumers:
+            self._drain(entry)
+        return len(tokens)
+
+    def _drain(self, entry):
+        """Hand ``entry`` everything past its ``sent`` watermark, one
+        drainer at a time per consumer (``draining`` flag), callbacks
+        outside the lock.  The empty-check and flag-clear share one
+        lock acquisition, so a chunk fed concurrently with the last
+        iteration either lands in this loop or finds the flag already
+        cleared and drains it itself — nothing strands."""
+        cb, indexed = entry[0], entry[1]
+        with self._slock:
+            if entry[3] or entry not in self._consumers:
+                return              # another thread is delivering
+            entry[3] = True
+        while True:
+            with self._slock:
+                sent = entry[2]
+                pending = list(self._stream_tokens[sent:])
+                if not pending:
+                    entry[3] = False
+                    return
+                entry[2] = sent + len(pending)
+            try:
+                if indexed:
+                    cb(pending, sent)
+                else:
+                    cb(pending)
+            except BaseException as e:
+                # fail ONLY this registration: drop it so one broken
+                # consumer cannot re-raise on every later boundary
+                with self._slock:
+                    try:
+                        self._consumers.remove(entry)
+                    except ValueError:  # pragma: no cover - raced drop
+                        pass
+                    entry[3] = False
+                _consumer_error("on_tokens", e)
+                return
+
+    # -- introspection ------------------------------------------------------
+    def tokens_streamed(self) -> int:
+        with self._slock:
+            return len(self._stream_tokens)
+
+    def streamed(self) -> list:
+        """Every token delivered so far (a copy, in order)."""
+        with self._slock:
+            return list(self._stream_tokens)
+
+    @property
+    def ttft_s(self) -> float | None:
+        """Seconds from this future's creation to its first streamed
+        token (None until the first chunk lands) — the router's
+        first-token service estimate reads this."""
+        t = self.t_first_token
+        return None if t is None else t - self.t_create
+
+
+class TokenDelivery:
+    """The decoder's dedicated delivery thread: a FIFO of chunk feeds
+    and final resolutions, so user callbacks (and ``set_result``'s
+    done-callback fan-out for streaming futures) run HERE and the step
+    loop never blocks on a consumer.  FIFO order guarantees a stream's
+    final chunk is delivered before its future resolves — a client that
+    waits on ``result()`` has, by then, seen the full stream."""
+
+    def __init__(self, name: str = "stream"):
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"bigdl-serve-{name}-delivery")
+        self._thread.start()
+
+    def enqueue(self, fut: StreamFuture, tokens, start: int, ts: float):
+        self._q.put(("feed", fut, tokens, start, ts))
+
+    def resolve(self, fut: Future, value):
+        self._q.put(("result", fut, value, None, None))
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            try:
+                if item[0] == "feed":
+                    _, fut, tokens, start, ts = item
+                    fut.feed(tokens, start=start, ts=ts)
+                else:
+                    fut = item[1]
+                    if not fut.done():
+                        fut.set_result(item[2])
+            except BaseException as e:  # pragma: no cover - defensive
+                logger.warning("token delivery failed: %s: %s",
+                               type(e).__name__, e)
+
+    def close(self, timeout: float = 10.0):
+        """Drain everything already queued, then stop (FIFO: the
+        sentinel lands after every pending chunk/resolution)."""
+        self._q.put(None)
+        self._thread.join(timeout=timeout)

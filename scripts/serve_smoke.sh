@@ -22,6 +22,12 @@
 #     construction (xcache compile counter + jit trap), prefix
 #     hit-rate > 0 on the shared-prompt wave, every token equal to
 #     serial lm_decode;
+#   - streaming telemetry drill: mixed stream/non-stream load on a
+#     2-replica SUBPROCESS decode fleet — every streamed chunk chain
+#     equals the all-at-once result, per-token timelines in the PARENT
+#     event log are monotone, serve_top's stream: line renders from the
+#     merged registry, and a ttft_burn alert fires on an injected
+#     stalled-prefill and resolves when fast first tokens return;
 #   - quantized serving drill: the same mixed stream through int8 KV
 #     pages + a calibrated int8-weight engine — greedy drift within
 #     the declared budget, prefix hit-rate and spec acceptance equal
@@ -34,9 +40,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-python -m pytest -q -m "(serve or quant) and not slow" \
+python -m pytest -q -m "(serve or quant or stream) and not slow" \
     -p no:cacheprovider -p no:randomly \
     tests/test_serve.py tests/test_serve_cluster.py tests/test_quant.py \
+    tests/test_streaming.py \
     "$@"
 
 # The narrowed form is a targeted check; the drill needs the full run.
@@ -145,6 +152,108 @@ print(f"OK: 24 mixed-length paged+spec requests, zero cold compiles "
       f"accept mean {st['accept_mean']:.2f}/{st['spec_k']}, "
       f"pool hwm {st['pool']['in_use_hwm']}/{st['pool']['pages']} pages")
 PY
+
+echo "== serve smoke: streaming telemetry drill (2-replica fleet) =="
+STREAMRUN=$(mktemp -d)
+python - "$STREAMRUN" <<'PY'
+import sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.obs.alerts import AlertEngine, default_rules
+from bigdl_tpu.obs.events import read_events, validate_event
+from bigdl_tpu.serve.fleet import DecodeFleet
+from bigdl_tpu.utils.random import set_seed
+sys.path.insert(0, "tools")
+import serve_top
+
+obs_events.configure(sys.argv[1])
+set_seed(1)
+model = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                      hidden=64)
+rng = np.random.RandomState(0)
+SYS = [7, 3, 9, 1, 5, 2, 8, 4]
+reqs = [(SYS if i % 2 else []) + rng.randint(1, 64, 2 + i % 4).tolist()
+        for i in range(16)]
+n_words = 6
+oracle = [lm_decode(model, s, n_words) for s in reqs]
+
+fleet = DecodeFleet(model, n_decode=2, process=True, max_slots=4,
+                    n_pos=20, page_size=4, sync_interval=2)
+# mixed load: even requests stream, odd ride the all-at-once path
+chunks = {i: [] for i in range(len(reqs))}
+futs = []
+for i, s in enumerate(reqs):
+    if i % 2 == 0:
+        futs.append(fleet.submit(
+            s, n_words,
+            on_tokens=lambda toks, i=i: chunks[i].append(list(toks))))
+    else:
+        futs.append(fleet.submit(s, n_words))
+rows = [f.result(timeout=120) for f in futs]
+assert rows == oracle, "streaming drill lost token parity"
+deadline = time.time() + 10
+while time.time() < deadline:
+    if all([t for c in chunks[i] for t in c] == rows[i][len(reqs[i]):]
+           for i in range(0, len(reqs), 2)):
+        break
+    time.sleep(0.02)
+else:
+    raise SystemExit("streamed chunks never matched the resolved rows")
+n_chunks = sum(len(chunks[i]) for i in range(0, len(reqs), 2))
+assert n_chunks > len(reqs) // 2, "streams were not incremental"
+
+# serve_top stream: line renders from the merged fleet registry
+merged = fleet.merged_registry()
+line = serve_top.stream_line(merged, None, 1.0)
+assert line and line.startswith("stream:") and "ttft" in line, line
+snap_ttft = obs_metrics.merged_histogram(merged, "decode_ttft_seconds")
+assert snap_ttft is not None and snap_ttft[3] == len(reqs) // 2
+fleet.close()
+
+# monotone per-token timelines in the PARENT log (child stream events
+# forwarded over the frame protocol, attributed replica=decodeN)
+events = read_events(obs_events.get().path)
+streams = [e for e in events if e.get("type") == "serve"
+           and e.get("kind") == "stream"]
+assert len(streams) == len(reqs) // 2, len(streams)
+for e in streams:
+    validate_event(e)
+    assert e.get("replica", "").startswith("decode"), e
+    ts = [b[0] for b in e["timeline"]]
+    assert ts == sorted(ts) and e["ttft_ms"] <= e["retire_ms"]
+    assert sum(b[1] for b in e["timeline"]) == e["tokens"] == n_words
+
+# ttft_burn fires on an injected stalled prefill, resolves on recovery
+reg = obs_metrics.Registry()
+h = reg.histogram("decode_ttft_seconds", decoder="drill")
+rules = [r for r in default_rules(ttft_slo_ms=100.0, short_s=30.0)
+         if r.name == "ttft_burn"]
+eng = AlertEngine(reg.snapshot, rules, registry=reg, emit_events=True)
+t0 = time.time()
+eng.evaluate_once(now=t0)
+for _ in range(10):
+    h.observe(2.0)                      # stalled prefill: 2 s TTFT
+trans = eng.evaluate_once(now=t0 + 5)
+assert any(k == "firing" for _, k, _ in trans), trans
+for _ in range(300):
+    h.observe(0.005)                    # recovery
+trans = eng.evaluate_once(now=t0 + 40)
+trans += eng.evaluate_once(now=t0 + 45)
+assert any(k == "resolved" for _, k, _ in trans), trans
+print(f"OK: {len(reqs)} mixed stream/non-stream requests over a "
+      f"2-subprocess-replica fleet; {n_chunks} incremental chunks "
+      f"byte-identical to retire, {len(streams)} monotone timelines "
+      f"in the parent log, serve_top [{line.split('   ')[0]}], "
+      f"ttft_burn fired and resolved")
+PY
+python tools/obs_report.py "$STREAMRUN" --strict -o "$STREAMRUN/report.md"
+grep -q "Token waterfall" "$STREAMRUN/report.md"
+echo "OK: token waterfall rendered ($STREAMRUN/report.md)"
 
 echo "== serve smoke: quantized serving drill =="
 python - <<'PY'

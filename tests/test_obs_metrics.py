@@ -290,8 +290,11 @@ class TestServeEventSchema:
     @pytest.mark.parametrize("kind,required", sorted(
         (k, v) for k, v in events.SERVE_KINDS.items() if v))
     def test_kind_required_fields(self, kind, required):
+        # `stream` timelines are structurally validated beyond mere
+        # presence (schema v4) — the generic fill must be well-formed
+        fills = {"timeline": [[1.0, 2]]}
         filled = _serve_event(kind=kind,
-                              **{f: 1 for f in required})
+                              **{f: fills.get(f, 1) for f in required})
         assert events.validate_event(filled)
         for missing in required:
             broken = dict(filled)

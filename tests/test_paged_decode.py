@@ -461,7 +461,8 @@ class TestBenchDecodeSweepContract:
                     "wall_s", "tok_per_s", "tok_per_s_per_slot",
                     "live_max", "slots", "pool_tokens", "spec_k",
                     "accept_mean", "accept_p50", "prefix_hits",
-                    "compiles", "quant", "kv_quant", "pool_bytes"):
+                    "compiles", "quant", "kv_quant", "pool_bytes",
+                    "ttft_p50", "ttft_p99", "itl_p50", "e2e_p50"):
             assert key in d, key
         assert d["mode"] == "decode_sweep" and d["impl"] == "paged"
         assert d["tok_per_s"] == pytest.approx(240.0)
@@ -471,6 +472,24 @@ class TestBenchDecodeSweepContract:
         # no kv_quant/bytes info in the stats: columns default, not KeyError
         assert d["quant"] == "off" and d["kv_quant"] == "off"
         assert d["pool_bytes"] is None
+        # no streaming measurement passed: the SLO columns default to
+        # None so pre-streaming parsers keep working
+        assert d["ttft_p50"] is None and d["ttft_p99"] is None
+        assert d["itl_p50"] is None
+
+    def test_decode_sweep_row_stream_columns(self):
+        """The streaming SLO columns ride a measurement dict (ms
+        values, tests/test_streaming.py covers the client math)."""
+        bench = _tool("bench_serve")
+        stats = {"slots": 8, "live_hwm": 6, "paged": True,
+                 "pool": {"pages": 24, "page_size": 4, "in_use": 0,
+                          "free": 24, "in_use_hwm": 18}}
+        row = bench.decode_sweep_row(
+            "paged", 8, 120, 0.5, stats, 0,
+            stream={"ttft_p50": 4.2, "ttft_p99": 11.0, "itl_p50": 0.7,
+                    "e2e_p50": 20.0})
+        assert row["ttft_p50"] == 4.2 and row["ttft_p99"] == 11.0
+        assert row["itl_p50"] == 0.7 and row["e2e_p50"] == 20.0
 
     def test_decode_sweep_row_slab(self):
         bench = _tool("bench_serve")

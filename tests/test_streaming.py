@@ -1,0 +1,975 @@
+"""Streaming decode telemetry (docs/observability.md "Streaming
+telemetry"; markers ``stream`` + ``serve``).
+
+The tentpole contracts:
+
+- the streamed token sequence (the concatenation of every
+  ``on_tokens`` chunk) is byte-identical to the all-at-once resolved
+  row's generated tail in EVERY configuration — paged, prefix-hit,
+  speculative (k in {1, 3}), int8 KV pages, tensor-parallel, and a
+  subprocess fleet replica over the frame protocol;
+- streaming adds ZERO new compiled programs (jit-trap + xcache-counter
+  audit) and zero extra device syncs: one slab materialization per
+  boundary, shared by delivery and retirement, never per token;
+- TTFT and ITL land on pinned fleet-mergeable histograms
+  (``decode_ttft_seconds`` on LATENCY_BUCKETS, ``decode_itl_seconds``
+  on the finer ITL_BUCKETS — merged quantiles == pooled quantiles);
+- a raising consumer callback (``on_tokens`` or ``add_done_callback``)
+  fails only its own registration with an obs error event — the
+  stream, its future, and the delivery/dispatch threads live on;
+- the router's per-token SLO class (``BIGDL_SERVE_SLO_TTFT_MS``):
+  EDF orders on the first-token deadline and shed-before-miss projects
+  FIRST-token completion for streaming requests;
+- events schema v4: the ``stream`` serve kind round-trips, streaming
+  ``decode`` events require their aggregates, unknown kinds still
+  error; ``serve_top`` renders the ``stream:`` line and ``obs_report``
+  the per-request token waterfall.
+"""
+import importlib.util
+import os
+import time
+
+import jax
+import pytest
+
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.obs import events, metrics
+from bigdl_tpu.serve import xcache
+from bigdl_tpu.serve.decode import ContinuousDecoder
+from bigdl_tpu.serve.streaming import (SafeFuture, StreamFuture,
+                                       TokenDelivery)
+from bigdl_tpu.utils.random import set_seed
+
+pytestmark = [pytest.mark.stream, pytest.mark.serve]
+
+
+def _tool(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def lm():
+    set_seed(1)
+    return TransformerLM(vocab_size=11, d_model=16, n_heads=2,
+                         n_layers=2, hidden=32)
+
+
+SEEDS = [[1, 2, 3], [4, 5], [6], [7, 8, 9, 10], [2, 4]]
+
+
+@pytest.fixture()
+def serial(lm):
+    return [lm_decode(lm, s, 5, greedy=True) for s in SEEDS]
+
+
+def _stream_all(dec, seeds, n_words):
+    """Submit every seed with an on_tokens collector; returns
+    (rows, per-request chunk lists) after the run drains."""
+    chunks = [[] for _ in seeds]
+    futs = []
+    for i, s in enumerate(seeds):
+        f = dec.submit(s, n_words)
+        f.on_tokens(lambda toks, i=i: chunks[i].append(list(toks)))
+        futs.append(f)
+    dec.run()
+    rows = [f.result(timeout=60) for f in futs]
+    return rows, [[t for c in ch for t in c] for ch in chunks], futs
+
+
+# ---------------------------------------------------------------------------
+# StreamFuture / SafeFuture units
+# ---------------------------------------------------------------------------
+
+class TestStreamFuture:
+    def test_feed_and_on_tokens(self):
+        f = StreamFuture()
+        got = []
+        f.on_tokens(got.append)
+        assert f.feed([1, 2]) == 2
+        assert f.feed([3]) == 1
+        assert got == [[1, 2], [3]]
+        assert f.streamed() == [1, 2, 3]
+        assert f.tokens_streamed() == 3
+        assert f.stream_chunks == 2
+
+    def test_backlog_replays_to_late_consumer(self):
+        f = StreamFuture()
+        f.request_stream()
+        f.feed([5, 6])
+        f.feed([7])
+        got = []
+        f.on_tokens(got.append)
+        assert got == [[5, 6, 7]]       # one replay chunk, in order
+        f.feed([8])
+        assert got == [[5, 6, 7], [8]]
+
+    def test_start_index_dedup(self):
+        """A requeued request re-delivers its deterministic stream from
+        index 0 — overlap is trimmed, consumers see each index once."""
+        f = StreamFuture()
+        got = []
+        f.on_tokens(got.append)
+        f.feed([1, 2, 3], start=0)
+        assert f.feed([1, 2], start=0) == 0      # full duplicate
+        assert f.feed([1, 2, 3, 4, 5], start=0) == 2   # overlap trim
+        assert f.streamed() == [1, 2, 3, 4, 5]
+        assert got == [[1, 2, 3], [4, 5]]
+
+    def test_gap_raises(self):
+        f = StreamFuture()
+        f.feed([1], start=0)
+        with pytest.raises(ValueError):
+            f.feed([9], start=5)
+
+    def test_pipe_chain_preserves_indexes(self):
+        a, b, c = StreamFuture(), StreamFuture(), StreamFuture()
+        a.pipe_to(b)
+        b.pipe_to(c)
+        a.feed([1, 2], start=0)
+        a.feed([1, 2, 3], start=0)     # re-delivery dedups end to end
+        assert c.streamed() == [1, 2, 3]
+        assert b.streaming and c.streaming
+
+    def test_streaming_flag(self):
+        f = StreamFuture()
+        assert not f.streaming
+        f.on_tokens(lambda t: None)
+        assert f.streaming
+        g = StreamFuture()
+        g.request_stream()
+        assert g.streaming
+
+    def test_ttft_records_first_chunk(self):
+        f = StreamFuture()
+        assert f.ttft_s is None
+        f.feed([1], ts=f.t_create + 0.25)
+        f.feed([2], ts=f.t_create + 0.50)
+        assert f.ttft_s == pytest.approx(0.25)
+
+    def test_raising_on_tokens_fails_only_itself(self):
+        events.reset()
+        try:
+            f = StreamFuture()
+            good = []
+
+            def bad(_toks):
+                raise RuntimeError("consumer bug")
+
+            f.on_tokens(bad)
+            f.on_tokens(good.append)
+            f.feed([1, 2])
+            f.feed([3])                 # bad was dropped, no re-raise
+            assert good == [[1, 2], [3]]
+            errs = [e for e in (events.get().ring_events() if events.get()
+                                else [])
+                    if e.get("type") == "serve"
+                    and e.get("kind") == "error"]
+            assert errs and errs[0]["callback"] == "on_tokens"
+        finally:
+            events.reset()
+
+    def test_safe_future_raising_done_callback(self):
+        events.reset()
+        try:
+            f = SafeFuture()
+
+            def bad(_f):
+                raise RuntimeError("done-callback bug")
+
+            f.add_done_callback(bad)
+            f.set_result(42)            # must not raise
+            assert f.result() == 42
+            f.add_done_callback(bad)    # already-done inline path
+            errs = [e for e in events.get().ring_events()
+                    if e.get("type") == "serve"
+                    and e.get("kind") == "error"]
+            assert len(errs) == 2
+            assert all(e["callback"] == "done_callback" for e in errs)
+        finally:
+            events.reset()
+
+    def test_engine_raising_done_callback_mid_drill(self):
+        """The ServeEngine regression: a user add_done_callback that
+        raises on the compute thread fails only its own registration —
+        every future (its own included) still resolves, the pipeline
+        threads survive the drill, and obs error events land."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.serve import ServeEngine
+        events.reset()
+        set_seed(3)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(),
+                              nn.Linear(8, 3))
+        eng = ServeEngine(model, max_batch=8, max_wait_ms=1,
+                          input_shape=(4,), name="cbsafe")
+        try:
+            import numpy as np
+            rows = np.random.RandomState(0).randn(24, 4).astype(
+                np.float32)
+            futs = []
+            for i, r in enumerate(rows):
+                f = eng.submit(r)
+                if i % 3 == 0:
+                    f.add_done_callback(lambda _f: (_ for _ in ()).throw(
+                        RuntimeError("user callback bug")))
+                futs.append(f)
+            outs = [f.result(timeout=60) for f in futs]
+            assert len(outs) == len(rows)
+            assert eng.stats()["failed"] == 0
+            # the compute thread survived and a later wave still serves
+            assert eng.predict(rows[:4]).shape == (4, 3)
+            errs = [e for e in events.get().ring_events()
+                    if e.get("type") == "serve"
+                    and e.get("kind") == "error"
+                    and e.get("callback") == "done_callback"]
+            assert len(errs) == len(rows) // 3
+        finally:
+            eng.close()
+            events.reset()
+
+    def test_token_delivery_fifo_resolves_after_chunks(self):
+        d = TokenDelivery(name="t")
+        try:
+            f = StreamFuture()
+            seen = []
+            f.on_tokens(lambda toks: seen.append(list(toks)))
+            f.add_done_callback(lambda _f: seen.append("done"))
+            d.enqueue(f, [1], 0, time.perf_counter())
+            d.enqueue(f, [2], 1, time.perf_counter())
+            d.resolve(f, "row")
+            assert f.result(timeout=10) == "row"
+            deadline = time.time() + 5
+            while seen[-1:] != ["done"] and time.time() < deadline:
+                time.sleep(0.005)
+            assert seen == [[1], [2], "done"]
+        finally:
+            d.close()
+
+
+# ---------------------------------------------------------------------------
+# decoder streaming: parity matrix + sync/compile audits
+# ---------------------------------------------------------------------------
+
+class TestStreamingDecode:
+    def test_paged_stream_parity(self, lm, serial):
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=False)
+        rows, streamed, futs = _stream_all(dec, SEEDS, 5)
+        assert rows == serial
+        for r, st, s in zip(rows, streamed, SEEDS):
+            assert st == r[len(s):]
+        # the future's own backlog agrees with the consumer's view
+        for f, st in zip(futs, streamed):
+            assert f.streamed() == st
+        dec.close()
+
+    def test_slab_stream_parity(self, lm, serial):
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, paged=False)
+        rows, streamed, _ = _stream_all(dec, SEEDS, 5)
+        assert rows == serial
+        for r, st, s in zip(rows, streamed, SEEDS):
+            assert st == r[len(s):]
+        dec.close()
+
+    def test_prefix_hit_stream_parity(self, lm):
+        """The second wave hits the prefix cache (start_pos > 0): the
+        stream starts at the divergence point's boundary but still
+        delivers exactly the generated tail."""
+        sys_prompt = [1, 2, 3, 4, 5, 6, 7, 8]      # 2 full pages
+        seeds = [sys_prompt + [9], sys_prompt + [10]]
+        oracle = [lm_decode(lm, s, 4, greedy=True) for s in seeds]
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=16,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=True)
+        futs = [dec.submit(seeds[0], 4)]
+        dec.run()                                   # populate the cache
+        assert futs[0].result(timeout=60) == oracle[0]
+        rows, streamed, _ = _stream_all(dec, [seeds[1]], 4)
+        assert rows == [oracle[1]]
+        assert dec._prefix.hits >= 1
+        assert streamed[0] == oracle[1][len(seeds[1]):]
+        dec.close()
+
+    @pytest.mark.parametrize("k", [1, 3])
+    def test_spec_stream_parity(self, lm, serial, k):
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=True, spec_k=k)
+        rows, streamed, _ = _stream_all(dec, SEEDS, 5)
+        assert rows == serial
+        for r, st, s in zip(rows, streamed, SEEDS):
+            assert st == r[len(s):]
+        dec.close()
+
+    def test_int8_kv_stream_parity(self, lm):
+        """Streamed chunks equal the SAME decoder's all-at-once rows
+        exactly (the quantized stream may drift from the fp oracle
+        within budget; streaming must add zero drift of its own)."""
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=True, kv_quant="int8")
+        rows, streamed, _ = _stream_all(dec, SEEDS, 5)
+        for r, st, s in zip(rows, streamed, SEEDS):
+            assert st == r[len(s):]
+        dec.close()
+
+    def test_tp_stream_parity(self, lm, serial):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        from bigdl_tpu.parallel.mesh import hybrid_mesh
+        mesh = hybrid_mesh(dp=1, mp=2, devices=jax.devices()[:2])
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=3, mesh=mesh, page_size=4)
+        rows, streamed, _ = _stream_all(dec, SEEDS, 5)
+        assert rows == serial
+        for r, st, s in zip(rows, streamed, SEEDS):
+            assert st == r[len(s):]
+        dec.close()
+
+    def test_streaming_zero_new_programs(self, lm, serial):
+        """After a non-streamed warm run, a fully streamed run builds
+        ZERO new jit programs and hits zero cold compiles — delivery is
+        host bookkeeping on the boundary's existing materialization."""
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=False, spec_k=2)
+        futs = [dec.submit(s, 5) for s in SEEDS]
+        dec.run()
+        [f.result(timeout=60) for f in futs]
+        warm = xcache.get().stats()["compiles"]
+        calls, real_jit = [], jax.jit
+        jax.jit = lambda fn, *a, **kw: (calls.append(fn),
+                                        real_jit(fn, *a, **kw))[1]
+        try:
+            rows, streamed, _ = _stream_all(dec, SEEDS, 5)
+        finally:
+            jax.jit = real_jit
+        assert rows == serial
+        for r, st, s in zip(rows, streamed, SEEDS):
+            assert st == r[len(s):]
+        assert not calls, "streaming built a new jit program"
+        assert xcache.get().stats()["compiles"] == warm
+        dec.close()
+
+    def test_stream_sync_accounting(self, lm):
+        """One slab materialization per boundary with live streams —
+        never one per token, never a second for retirement — and a
+        non-streamed run on the same decoder keeps the old count
+        (materialize only at retiring boundaries)."""
+        seed, n_words = [1, 2], 9         # 10 positions, sync 2
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=10,
+                                sync_interval=2, page_size=5,
+                                prefix_cache=False)
+        # non-streamed: only the final (retiring) boundary fetches
+        f = dec.submit(seed, n_words)
+        dec.run()
+        f.result(timeout=60)
+        assert dec.host_syncs == 1
+        # streamed: exactly one fetch per live boundary (5 boundaries
+        # for 10 positions at sync 2), far fewer than the 9 tokens
+        got = []
+        f = dec.submit(seed, n_words)
+        f.on_tokens(got.append)
+        dec.run()
+        row = f.result(timeout=60)
+        assert dec.host_syncs == 1 + 5
+        assert [t for c in got for t in c] == row[len(seed):]
+        assert dec.stats()["stream"]["boundaries"] < n_words
+        dec.close()
+
+    def test_spec_stream_adds_no_sync(self, lm):
+        """Speculative boundaries already fetch per boundary (the
+        data-dependent position read); streaming must not raise the
+        count."""
+        dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                sync_interval=2, page_size=4,
+                                prefix_cache=False, spec_k=2)
+        futs = [dec.submit(s, 5) for s in SEEDS]
+        dec.run()
+        [f.result(timeout=60) for f in futs]
+        plain = dec.host_syncs
+        rows, streamed, _ = _stream_all(dec, SEEDS, 5)
+        # same workload, same greedy acceptance ⇒ same boundary count:
+        # streaming reuses the boundary fetch, adding none
+        assert dec.host_syncs - plain == plain
+        dec.close()
+
+    def test_raising_consumer_mid_drill(self, lm, serial):
+        """One raising on_tokens consumer: its own stream still
+        resolves correctly, sibling streams are untouched, the decoder
+        serves a second round, and an obs error event lands."""
+        events.reset()
+        try:
+            dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                    sync_interval=2, page_size=4)
+            good = []
+            f0 = dec.submit(SEEDS[0], 5)
+            f0.on_tokens(lambda toks: (_ for _ in ()).throw(
+                RuntimeError("bad consumer")))
+            f1 = dec.submit(SEEDS[1], 5)
+            f1.on_tokens(good.append)
+            dec.run()
+            assert f0.result(timeout=60) == serial[0]
+            assert f1.result(timeout=60) == serial[1]
+            assert [t for c in good for t in c] == \
+                serial[1][len(SEEDS[1]):]
+            errs = [e for e in events.get().ring_events()
+                    if e.get("type") == "serve"
+                    and e.get("kind") == "error"
+                    and e.get("callback") == "on_tokens"]
+            assert errs
+            # the delivery thread survived: a second round streams fine
+            rows, streamed, _ = _stream_all(dec, SEEDS[2:], 5)
+            assert rows == serial[2:]
+            dec.close()
+        finally:
+            events.reset()
+
+    def test_timeline_and_metrics(self, lm, serial):
+        """Per-request timelines are monotone, TTFT/ITL histograms and
+        the stream-token counter fill, and stats()/decode-event carry
+        the streaming aggregates."""
+        events.reset()
+        try:
+            dec = ContinuousDecoder(lm, max_slots=2, n_pos=9,
+                                    sync_interval=2, page_size=4)
+            rows, streamed, _ = _stream_all(dec, SEEDS, 5)
+            assert rows == serial
+            snap = metrics.get().snapshot()
+            ttft = metrics.merged_histogram(snap, "decode_ttft_seconds")
+            assert ttft is not None and ttft[3] == len(SEEDS)
+            assert list(ttft[0]) == list(metrics.LATENCY_BUCKETS)
+            itl = metrics.merged_histogram(snap, "decode_itl_seconds")
+            assert itl is not None and itl[3] > 0
+            assert list(itl[0]) == list(metrics.ITL_BUCKETS)
+            assert metrics.family_total(
+                snap, "decode_stream_tokens_total") == 5 * len(SEEDS)
+            st = dec.stats()["stream"]
+            assert st["streams"] == len(SEEDS)
+            assert st["tokens"] == 5 * len(SEEDS)
+            assert st["ttft_mean_ms"] > 0
+            ring = events.get().ring_events()
+            stream_evs = [e for e in ring if e.get("type") == "serve"
+                          and e.get("kind") == "stream"]
+            assert len(stream_evs) == len(SEEDS)
+            for e in stream_evs:
+                events.validate_event(e)
+                ts = [b[0] for b in e["timeline"]]
+                assert ts == sorted(ts)
+                assert sum(b[1] for b in e["timeline"]) == e["tokens"]
+                assert e["ttft_ms"] <= e["retire_ms"]
+            dec.emit_decode_event()
+            decode_ev = [e for e in events.get().ring_events()
+                         if e.get("type") == "serve"
+                         and e.get("kind") == "decode"][-1]
+            assert decode_ev["streaming"] is True
+            assert decode_ev["streams"] == len(SEEDS)
+            events.validate_event(decode_ev)
+            dec.close()
+        finally:
+            events.reset()
+
+
+# ---------------------------------------------------------------------------
+# fleet / cluster streaming
+# ---------------------------------------------------------------------------
+
+def _settle(cond, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestFleetStreaming:
+    def test_decode_replica_stream(self, lm, serial):
+        from bigdl_tpu.serve.fleet import DecodeReplica
+        rep = DecodeReplica(lm, name="sdec0", max_slots=2, n_pos=9,
+                            sync_interval=2, page_size=4)
+        try:
+            chunks = []
+            fut = rep.submit({"seed": SEEDS[0], "n_words": 5,
+                              "stream": True})
+            fut.on_tokens(chunks.append)
+            assert fut.result(timeout=60) == serial[0]
+            assert _settle(lambda: sum(len(c) for c in chunks) == 5)
+            assert [t for c in chunks for t in c] == \
+                serial[0][len(SEEDS[0]):]
+        finally:
+            rep.close()
+
+    def test_fleet_stream_parity_and_ttft_est(self, lm, serial):
+        from bigdl_tpu.serve.fleet import DecodeFleet
+        fleet = DecodeFleet(lm, n_decode=2, max_slots=2, n_pos=9,
+                            page_size=4, sync_interval=2)
+        try:
+            chunks = [[] for _ in SEEDS]
+            futs = [fleet.submit(s, 5, on_tokens=(
+                        lambda toks, i=i: chunks[i].append(list(toks))))
+                    for i, s in enumerate(SEEDS)]
+            rows = [f.result(timeout=120) for f in futs]
+            assert rows == serial
+            assert _settle(lambda: all(
+                [t for c in chunks[i] for t in c] == rows[i][len(s):]
+                for i, s in enumerate(SEEDS)))
+            # streamed completions feed the router's TTFT estimate
+            st = fleet.router.stats()
+            assert st["est_ttft_ms"] > 0
+        finally:
+            fleet.close()
+
+    def test_fleet_non_stream_unchanged(self, lm, serial):
+        """Requests without a consumer keep the all-at-once path (no
+        stream flag in the payload, no per-boundary delivery)."""
+        from bigdl_tpu.serve.fleet import DecodeFleet
+        fleet = DecodeFleet(lm, n_decode=1, max_slots=2, n_pos=9,
+                            page_size=4, sync_interval=2)
+        try:
+            futs = fleet.submit_many(SEEDS, 5)
+            assert [f.result(timeout=120) for f in futs] == serial
+            for r in fleet.replicas:
+                assert r.decoder.streams == 0
+        finally:
+            fleet.close()
+
+    def test_subprocess_fleet_replica_stream(self, lm, serial):
+        """Incremental token frames cross the ProcessDecodeReplica
+        stdio boundary with their start indexes; the parent-side
+        chunks equal the resolved row's tail."""
+        from bigdl_tpu.serve.fleet import ProcessDecodeReplica
+        rep = ProcessDecodeReplica(lm, name="sproc0", max_slots=2,
+                                   n_pos=9, sync_interval=2,
+                                   page_size=4)
+        try:
+            chunks = [[] for _ in SEEDS]
+            futs = []
+            for i, s in enumerate(SEEDS):
+                f = rep.submit({"seed": s, "n_words": 5,
+                                "stream": True})
+                f.on_tokens(lambda toks, i=i: chunks[i].append(
+                    list(toks)))
+                futs.append(f)
+            rows = [f.result(timeout=120) for f in futs]
+            assert rows == serial
+            assert _settle(lambda: all(
+                [t for c in chunks[i] for t in c] == rows[i][len(s):]
+                for i, s in enumerate(SEEDS)), timeout=30.0)
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# router per-token SLO class
+# ---------------------------------------------------------------------------
+
+class _FakeReplica:
+    """Minimal replica: resolves after a configurable hold (on a
+    thread), reporting a configurable inflight load."""
+
+    def __init__(self, name="fake", load=0):
+        self.name = name
+        self.load = load
+        self.submitted = []
+
+    def submit(self, x, trace=None):
+        fut = StreamFuture()
+        self.submitted.append(x)
+        fut.set_result(x)
+        return fut
+
+    def inflight(self):
+        return self.load
+
+    def alive(self):
+        return True
+
+
+class TestRouterTTFTClass:
+    def test_ttft_shed_before_miss(self):
+        """A streaming request whose projected FIRST token lands past
+        its TTFT budget is shed; the same request without a stream
+        consumer (no per-token class) is served."""
+        from bigdl_tpu.serve.router import Router, SheddedError
+        rep = _FakeReplica(load=50)
+        r = Router([rep], est_ms=100.0, shed=True, slo_ms=0)
+        try:
+            # 50 backlog x 100 ms est >> 5 ms budget -> shed
+            f = r.submit({"seed": [1], "stream": True}, ttft_ms=5.0,
+                         on_tokens=lambda t: None)
+            with pytest.raises(SheddedError, match="TTFT"):
+                f.result(timeout=30)
+            # no stream consumer: the per-token class does not apply
+            g = r.submit({"seed": [1]}, ttft_ms=5.0)
+            assert g.result(timeout=30) == {"seed": [1]}
+        finally:
+            r.close()
+
+    def test_ttft_deadline_orders_edf(self):
+        """The EDF key is the EARLIEST obligation: a later-submitted
+        stream with a tight TTFT budget dispatches before an earlier
+        request with only a loose e2e deadline."""
+        from bigdl_tpu.serve.router import Router
+
+        class _SlowFirst(_FakeReplica):
+            def submit(self, x, trace=None):
+                if x.get("tag") == "blocker":
+                    time.sleep(0.3)     # hold the dispatcher thread
+                return super().submit(x, trace=trace)
+
+        rep = _SlowFirst()
+        r = Router([rep], shed=False, slo_ms=0)
+        try:
+            r.submit({"tag": "blocker"}, priority=0)
+            time.sleep(0.05)            # dispatcher is inside submit()
+            loose = r.submit({"tag": "loose"}, slo_ms=10_000.0)
+            tight = r.submit({"tag": "tight", "stream": True},
+                             ttft_ms=50.0, on_tokens=lambda t: None)
+            loose.result(timeout=30)
+            tight.result(timeout=30)
+            tags = [x.get("tag") for x in rep.submitted]
+            assert tags == ["blocker", "tight", "loose"]
+        finally:
+            r.close()
+
+    def test_requeue_after_first_token_not_ttft_shed(self):
+        """A mid-stream request requeued by replica death has already
+        met its first-token obligation: the re-dispatch must serve it
+        (re-delivery dedups by index), never shed it on the elapsed
+        TTFT deadline."""
+        from bigdl_tpu.serve.router import DeadReplicaError, Router
+
+        class _DiesMidStream:
+            name = "dying"
+
+            def __init__(self):
+                self.up = True
+
+            def submit(self, x, trace=None):
+                fut = StreamFuture()
+                fut.feed([1, 2], start=0)       # first token delivered
+                time.sleep(0.08)    # outlive the 50 ms TTFT deadline
+                self.up = False
+                fut.set_exception(DeadReplicaError("died mid-stream"))
+                return fut
+
+            def inflight(self):
+                return 0
+
+            def alive(self):
+                return self.up
+
+        class _Survivor(_FakeReplica):
+            def submit(self, x, trace=None):
+                fut = StreamFuture()
+                fut.feed([1, 2, 3], start=0)    # full re-delivery
+                self.submitted.append(x)
+                fut.set_result([9, 1, 2, 3])
+                return fut
+
+        dying, ok = _DiesMidStream(), _Survivor(name="ok")
+        r = Router([dying, ok], shed=True, slo_ms=0, est_ms=1.0)
+        try:
+            got = []
+            # the survivor reports more load, so least-loaded dispatch
+            # prefers `dying` first; the deadline lapses mid-service
+            ok.load = 5
+            f = r.submit({"seed": [9], "stream": True}, ttft_ms=50.0,
+                         on_tokens=got.append)
+            assert f.result(timeout=30) == [9, 1, 2, 3]
+            # chunks deduped across the requeue: exactly one stream
+            assert [t for c in got for t in c] == [1, 2, 3]
+            assert r.stats()["requeued"] == 1
+            assert r.stats()["shed"] == 0
+        finally:
+            r.close()
+
+    def test_ttft_default_env(self, monkeypatch):
+        from bigdl_tpu.serve import streaming as s
+        monkeypatch.setenv(s.ENV_TTFT_MS, "250")
+        assert s.ttft_ms_default() == 250.0
+        monkeypatch.setenv(s.ENV_TTFT_MS, "junk")
+        assert s.ttft_ms_default() == 0.0
+        monkeypatch.setenv(s.ENV_ITL_MS, "30")
+        assert s.itl_ms_default() == 30.0
+
+    def test_router_stats_carry_ttft(self):
+        from bigdl_tpu.serve.router import Router
+        rep = _FakeReplica()
+        r = Router([rep], ttft_ms=123.0)
+        try:
+            st = r.stats()
+            assert st["ttft_slo_ms"] == 123.0
+            assert "est_ttft_ms" in st
+        finally:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# events schema v4
+# ---------------------------------------------------------------------------
+
+class TestEventsV4:
+    def _env(self, **fields):
+        return {"v": events.SCHEMA_VERSION, "ts": 0.0, "proc": 0,
+                "type": "serve", **fields}
+
+    def test_schema_version_bumped(self):
+        assert events.SCHEMA_VERSION == 4
+
+    def test_stream_event_round_trip(self):
+        ev = self._env(kind="stream", request="d0/1", tokens=5,
+                       ttft_ms=3.2, boundaries=2,
+                       timeline=[[3.2, 2], [5.0, 3]])
+        assert events.validate_event(ev) is ev
+
+    def test_stream_event_requires_fields(self):
+        with pytest.raises(ValueError, match="missing"):
+            events.validate_event(self._env(kind="stream", tokens=5,
+                                            ttft_ms=1.0))
+        with pytest.raises(ValueError, match="timeline"):
+            events.validate_event(self._env(kind="stream", tokens=5,
+                                            ttft_ms=1.0, timeline=[]))
+        with pytest.raises(ValueError, match="timeline"):
+            events.validate_event(self._env(
+                kind="stream", tokens=5, ttft_ms=1.0,
+                timeline=[[1.0, 2, 3]]))
+
+    def test_streaming_decode_requires_aggregates(self):
+        base = self._env(kind="decode", steps=10)
+        assert events.validate_event(dict(base)) is not None
+        with pytest.raises(ValueError, match="streaming decode"):
+            events.validate_event(dict(base, streaming=True))
+        ok = dict(base, streaming=True, first_token_ms=2.0,
+                  stream_boundaries=3)
+        assert events.validate_event(ok) is ok
+
+    def test_unknown_kind_still_errors(self):
+        with pytest.raises(ValueError, match="unknown serve kind"):
+            events.validate_event(self._env(kind="streem"))
+
+
+# ---------------------------------------------------------------------------
+# metrics: pinned buckets + exact merge
+# ---------------------------------------------------------------------------
+
+class TestStreamMetrics:
+    def test_itl_buckets_pinned(self):
+        b = metrics.ITL_BUCKETS
+        assert b[0] == pytest.approx(1e-6)
+        assert len(b) == 28
+        for lo, hi in zip(b, b[1:]):
+            assert hi / lo == pytest.approx(10 ** 0.25)
+        # two decades finer than the latency floor
+        assert b[0] < metrics.LATENCY_BUCKETS[0] / 50
+
+    def test_merged_equals_pooled_quantiles(self):
+        """Two replicas' ITL histograms merge to exactly the pooled
+        stream's quantiles (the PR-7 property on the new buckets)."""
+        import random
+        rng = random.Random(7)
+        pooled = metrics.Histogram(bounds=metrics.ITL_BUCKETS)
+        snaps = []
+        for _ in range(2):
+            r = metrics.Registry()
+            h = r.histogram("decode_itl_seconds",
+                            bounds=metrics.ITL_BUCKETS, decoder="x")
+            for _ in range(200):
+                v = 10 ** rng.uniform(-5.5, -1.5)
+                h.observe(v)
+                pooled.observe(v)
+            snaps.append(r.snapshot())
+        merged = metrics.merge(snaps)
+        agg = metrics.merged_histogram(merged, "decode_itl_seconds")
+        for q in (50, 90, 95, 99):
+            assert metrics.quantile(agg[0], agg[1], q) == \
+                metrics.quantile(pooled.bounds, pooled.counts(), q)
+
+
+# ---------------------------------------------------------------------------
+# alerts: quantile rules, ttft_burn / itl_regression
+# ---------------------------------------------------------------------------
+
+class TestStreamAlerts:
+    def test_quantile_rule_fires_and_resolves(self):
+        from bigdl_tpu.obs.alerts import AlertEngine, Rule
+        reg = metrics.Registry()
+        h = reg.histogram("decode_ttft_seconds", decoder="d0")
+        eng = AlertEngine(reg.snapshot,
+                          [Rule("ttft_burn", "quantile",
+                                metric="decode_ttft_seconds", q=95,
+                                threshold=0.5, window_s=60.0)],
+                          registry=reg, emit_events=False)
+        t0 = 1000.0
+        assert eng.evaluate_once(now=t0) == []      # no observations
+        for _ in range(20):
+            h.observe(2.0)                          # stalled prefill
+        fired = eng.evaluate_once(now=t0 + 5)
+        assert any(n == "ttft_burn" and k == "firing" and v > 0.5
+                   for n, k, v in fired)
+        # recovery: fast first tokens dominate the next window
+        for _ in range(400):
+            h.observe(0.01)
+        out = eng.evaluate_once(now=t0 + 80)
+        assert any(n == "ttft_burn" and k == "resolved"
+                   for n, k, _ in out)
+        assert metrics.family_total(reg.snapshot(), "alert_active",
+                                    rule="ttft_burn") == 0.0
+
+    def test_baseline_histogram_rule(self):
+        """itl_regression: the baseline kind samples a histogram's
+        windowed quantile and judges it against its rolling median."""
+        from bigdl_tpu.obs.alerts import AlertEngine, Rule
+        reg = metrics.Registry()
+        h = reg.histogram("decode_itl_seconds",
+                          bounds=metrics.ITL_BUCKETS, decoder="d0")
+        eng = AlertEngine(reg.snapshot,
+                          [Rule("itl_regression", "baseline",
+                                metric="decode_itl_seconds", q=50,
+                                threshold=3.0, window_s=30.0,
+                                min_n=4, for_n=1)],
+                          registry=reg, emit_events=False)
+        now = 2000.0
+        # healthy history with realistic jitter (identical samples
+        # dedup out of the rolling baseline by design — a live ITL p50
+        # always moves a little)
+        for i in range(8):
+            h.observe_n(1e-4 * 10 ** ((i % 4) / 4), 50)
+            eng.evaluate_once(now=now + i * 10)
+        h.observe_n(1e-1, 500)                  # ~1000x stall
+        out = eng.evaluate_once(now=now + 90)
+        assert any(n == "itl_regression" and k == "firing"
+                   for n, k, _ in out)
+
+    def test_default_rules_include_stream_pair(self):
+        from bigdl_tpu.obs import alerts
+        names = [r.name for r in alerts.default_rules()]
+        assert "ttft_burn" in names and "itl_regression" in names
+        ttft = next(r for r in alerts.default_rules()
+                    if r.name == "ttft_burn")
+        assert ttft.kind == "quantile"
+        assert ttft.threshold == pytest.approx(0.5)   # 500 ms fallback
+        custom = alerts.default_rules(ttft_slo_ms=200.0)
+        assert next(r for r in custom
+                    if r.name == "ttft_burn").threshold == \
+            pytest.approx(0.2)
+        # an EXPLICIT 0 disables the TTFT class (the itl convention) —
+        # it must not build an always-firing threshold-0 rule
+        assert not any(r.name == "ttft_burn"
+                       for r in alerts.default_rules(ttft_slo_ms=0.0))
+
+    def test_default_rules_import_stays_obs_local(self):
+        """Arming the default rules must not drag the serve package
+        (and with it jax) into a training-only process."""
+        import subprocess
+        import sys
+        code = (
+            "import sys\n"
+            "from bigdl_tpu.obs import alerts\n"
+            "alerts.default_rules()\n"
+            "assert not any(m.startswith('bigdl_tpu.serve')"
+            " for m in sys.modules), 'serve leaked'\n"
+            "print('clean')\n")
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "clean" in out.stdout
+
+    def test_itl_budget_arms_absolute_rule(self, monkeypatch):
+        """A declared BIGDL_SERVE_SLO_ITL_MS arms the absolute
+        itl_burn rule; without one only the relative regression rule
+        ships (the no-budget default set is unchanged)."""
+        from bigdl_tpu.obs import alerts
+        from bigdl_tpu.serve import streaming as s
+        assert not any(r.name == "itl_burn"
+                       for r in alerts.default_rules())
+        armed = alerts.default_rules(itl_slo_ms=20.0)
+        rule = next(r for r in armed if r.name == "itl_burn")
+        assert rule.kind == "quantile"
+        assert rule.threshold == pytest.approx(0.02)
+        monkeypatch.setenv(s.ENV_ITL_MS, "40")
+        env_armed = alerts.default_rules()
+        assert next(r for r in env_armed
+                    if r.name == "itl_burn").threshold == \
+            pytest.approx(0.04)
+
+
+# ---------------------------------------------------------------------------
+# tools: serve_top stream line, obs_report token waterfall, bench row
+# ---------------------------------------------------------------------------
+
+class TestStreamTools:
+    def _stream_snap(self):
+        reg = metrics.Registry()
+        t = reg.histogram("decode_ttft_seconds", decoder="d0")
+        i = reg.histogram("decode_itl_seconds",
+                          bounds=metrics.ITL_BUCKETS, decoder="d0")
+        c = reg.counter("decode_stream_tokens_total", decoder="d0")
+        for _ in range(10):
+            t.observe(0.02)
+            i.observe_n(5e-4, 4)
+            c.inc(5)
+        return reg.snapshot()
+
+    def test_serve_top_stream_line(self):
+        serve_top = _tool("serve_top")
+        snap = self._stream_snap()
+        line = serve_top.stream_line(snap, None, 1.0)
+        assert line is not None and line.startswith("stream:")
+        assert "ttft" in line and "itl" in line and "tok/s" in line
+        assert serve_top.stream_line({}, None, 1.0) is None
+
+    def test_serve_top_stream_line_windowed(self):
+        serve_top = _tool("serve_top")
+        reg = metrics.Registry()
+        t = reg.histogram("decode_ttft_seconds", decoder="d0")
+        t.observe(0.01)
+        prev = reg.snapshot()
+        t.observe(10.0)                # the regression this window
+        line = serve_top.stream_line(reg.snapshot(), prev, 1.0)
+        # windowed p50 reflects only the new (slow) observation
+        assert "ttft p50/p99" in line
+        val = float(line.split("ttft p50/p99 ")[1].split("/")[0])
+        assert val > 1000.0            # ms — the 10 s sample
+
+    def test_obs_report_token_waterfall(self, tmp_path):
+        obs_report = _tool("obs_report")
+        events.configure(str(tmp_path))
+        try:
+            events.emit("serve", kind="stream", request="d0/1",
+                        decoder="d0", tokens=5, n_seed=3, admit_ms=0.1,
+                        ttft_ms=4.0, retire_ms=9.0, boundaries=2,
+                        timeline=[[4.0, 2], [9.0, 3]])
+            events.emit("serve", kind="stream", request="d0/2",
+                        decoder="d0", tokens=4, n_seed=2, admit_ms=0.2,
+                        ttft_ms=12.0, retire_ms=15.0, boundaries=1,
+                        timeline=[[12.0, 4]])
+            path = events.get().path
+        finally:
+            events.reset()
+        evs, bad, bundles = obs_report.load_run(path)
+        assert not bad
+        md = obs_report.render(evs, bad, bundles)
+        assert "Token waterfall" in md
+        assert "`d0/2`" in md                  # slowest ttft first
+        assert "+4@12.0" in md
+
+    def test_bench_row_stream_columns(self):
+        bench = _tool("bench_serve")
+        stats = {"slots": 4, "live_hwm": 4, "paged": False}
+        row = bench.decode_sweep_row(
+            "slab", 8, 120, 0.5, stats, 0,
+            stream={"ttft_p50": 3.0, "ttft_p99": 9.0, "itl_p50": 0.4,
+                    "e2e_p50": 12.0})
+        assert row["ttft_p50"] == 3.0 and row["ttft_p99"] == 9.0
+        assert row["itl_p50"] == 0.4 and row["e2e_p50"] == 12.0
+        # defaults keep old parsers working
+        old = bench.decode_sweep_row("slab", 8, 120, 0.5, stats, 0)
+        assert old["ttft_p50"] is None and old["itl_p50"] is None

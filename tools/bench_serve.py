@@ -23,10 +23,14 @@ at the slab width) against the paged pool (live requests capped only by
 pooled tokens), asserts paged output token-for-token equal to serial
 ``lm_decode``, and finishes with a mixed-length SPECULATIVE stream
 (``--spec-k``) audited for zero cold compiles after warmup through the
-shared executable-cache counter.  One JSON row per point (contract
-pinned by ``tests/test_paged_decode.py``); ``--check`` enforces the
-acceptance bar: more live requests than the slab bound, parity, zero
-cold compiles.
+shared executable-cache counter.  Every point STREAMS its tokens
+(``StreamFuture.on_tokens``), so rows carry the client-observed
+``ttft_p50``/``ttft_p99``/``itl_p50`` SLO columns next to throughput.
+One JSON row per point (contract pinned by
+``tests/test_paged_decode.py``); ``--check`` enforces the acceptance
+bar: more live requests than the slab bound, parity (streamed chunks
+included), zero cold compiles, and TTFT p50 below the e2e p50 on a
+long-generation point.
 
 Router (``--replicas N``, N > 1): the same offered-load sweep through a
 :class:`ReplicaPool` — N engine replicas behind the SLO router — with
@@ -352,17 +356,21 @@ def bench_decode(args):
 
 
 def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
-                     compiles) -> dict:
+                     compiles, stream=None) -> dict:
     """The pinned JSON contract for one ``--decode-sweep`` point:
     throughput per live slot plus the paging/prefix/speculation/quant
-    counters that explain it.  ``tests/test_paged_decode.py`` keeps
-    this shape honest."""
+    counters that explain it, and the streaming SLO columns
+    (``ttft_p50``/``ttft_p99``/``itl_p50``, milliseconds,
+    client-observed through ``StreamFuture.on_tokens`` — None when the
+    point did not stream, so old parsers keep working).
+    ``tests/test_paged_decode.py`` keeps this shape honest."""
     live = dec_stats.get("live_hwm") or dec_stats["slots"]
     pool = dec_stats.get("pool") or {}
     prefix = dec_stats.get("prefix") or {}
     rate = tokens / wall_s if wall_s else 0.0
     pool_tokens = pool["pages"] * pool["page_size"] if pool else None
     bpt = dec_stats.get("kv_bytes_per_token")
+    stream = stream or {}
     return {"model": "transformer", "mode": "decode_sweep", "impl": impl,
             "offered": offered, "tokens": tokens, "wall_s": wall_s,
             "tok_per_s": rate,
@@ -380,6 +388,10 @@ def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
             "accept_mean": dec_stats.get("accept_mean"),
             "accept_p50": dec_stats.get("accept_p50"),
             "prefix_hits": prefix.get("hits", 0),
+            "ttft_p50": stream.get("ttft_p50"),
+            "ttft_p99": stream.get("ttft_p99"),
+            "itl_p50": stream.get("itl_p50"),
+            "e2e_p50": stream.get("e2e_p50"),
             "compiles": compiles}
 
 
@@ -414,11 +426,50 @@ def bench_decode_sweep(args):
         dec = ContinuousDecoder(model, n_pos=n_pos,
                                 sync_interval=args.decode_sync, **kw)
         c0 = xcache.get().stats()["compiles"]
+        # every point streams: per-request token-arrival stamps give
+        # the client-observed TTFT/ITL columns, and the chunk-sum
+        # parity check below holds the streamed sequence to the
+        # all-at-once result (zero compiled-program cost — delivery is
+        # host bookkeeping on the boundary's existing materialization)
+        arrivals = [[] for _ in seeds]
+        sub_at = [0.0] * len(seeds)
+        done_at = [None] * len(seeds)
         t0 = time.perf_counter()
-        futs = [dec.submit(s, n_words) for s in seeds]
+        futs = []
+        for i, s in enumerate(seeds):
+            sub_at[i] = time.perf_counter()
+            f = dec.submit(s, n_words)
+            f.on_tokens(lambda toks, i=i: arrivals[i].append(
+                (time.perf_counter(), len(toks))))
+            f.add_done_callback(lambda _f, i=i: done_at.__setitem__(
+                i, time.perf_counter()))
+            futs.append(f)
         dec.run()
         wall = time.perf_counter() - t0
         rows = [f.result() for f in futs]
+        t_spin = time.perf_counter()
+        while any(d is None for d in done_at):   # callbacks race result()
+            if time.perf_counter() - t_spin > 5.0:
+                raise RuntimeError("latency stamps missing after 5s")
+            time.sleep(0.001)
+        streamed = [f.streamed() for f in futs]
+        stream_parity = all(
+            st == list(r[len(s):])
+            for st, r, s in zip(streamed, rows, seeds))
+        ttfts = [a[0][0] - sub_at[i]
+                 for i, a in enumerate(arrivals) if a]
+        itls = []
+        for a in arrivals:
+            for (t1, _n1), (t2, n2) in zip(a, a[1:]):
+                itls += [(t2 - t1) / n2] * n2
+        e2e = [d - s for d, s in zip(done_at, sub_at)]
+
+        def pct(vals, q):
+            return (float(np.percentile(np.asarray(vals), q)) * 1e3
+                    if vals else None)
+
+        stream = {"ttft_p50": pct(ttfts, 50), "ttft_p99": pct(ttfts, 99),
+                  "itl_p50": pct(itls, 50), "e2e_p50": pct(e2e, 50)}
         # per-token agreement with the serial fp oracle over the
         # GENERATED tail: 1.0 on every fp point (exact parity contract);
         # quantized-KV points may drift within the declared budget
@@ -426,8 +477,10 @@ def bench_decode_sweep(args):
             np.mean(np.asarray(r[len(s):]) == np.asarray(o[len(s):]))
             for r, o, s in zip(rows, oracle, seeds)]))
         row = decode_sweep_row(impl, offered, toks, wall, dec.stats(),
-                               xcache.get().stats()["compiles"] - c0)
+                               xcache.get().stats()["compiles"] - c0,
+                               stream=stream)
         row["parity"] = rows == oracle
+        row["stream_parity"] = stream_parity
         row["agreement"] = agree
         dec.close()
         print(f"bench_serve: {json.dumps(row)}")
@@ -476,12 +529,17 @@ def bench_decode_sweep(args):
           + (f"; kv_quant={kv_quant}" if kv_quant != "off" else "")
           + "):")
     for pt in points:
+        ttft = pt.get("ttft_p50")
         print(f"  {pt['impl']:<12} offered {pt['offered']:>3}: "
               f"{pt['live_max']:>3} live max, "
               f"{pt['tok_per_s']:8.1f} tok/s "
               f"({pt['tok_per_s_per_slot']:.1f}/slot), "
               f"agreement {pt['agreement']:.3f}, "
               f"cold compiles {pt['compiles']}"
+              + (f", ttft p50 {ttft:.1f} ms / itl p50 "
+                 + (f"{pt['itl_p50']:.2f} ms" if pt["itl_p50"]
+                    is not None else "-")
+                 if ttft is not None else "")
               + (f", accept mean {pt['accept_mean']:.2f}"
                  if pt["spec_k"] else ""))
     scaled = [p for p in points if p["impl"] == "paged"
@@ -506,6 +564,19 @@ def bench_decode_sweep(args):
         fp_points = [p for p in points if p["kv_quant"] == "off"]
         if not all(p["parity"] for p in fp_points):
             raise SystemExit("decode sweep lost token parity")
+        if not all(p["stream_parity"] for p in points):
+            raise SystemExit("streamed chunks diverged from the "
+                             "all-at-once rows")
+        # the streaming SLO point: on a long generation (n_words spans
+        # several sync boundaries) the first token must land well
+        # before retire — TTFT below the e2e completion latency
+        lp = points[1]     # paged @ offered == slots: uncontended
+        if (lp["ttft_p50"] is not None and lp["e2e_p50"] is not None
+                and lp["ttft_p50"] >= lp["e2e_p50"]):
+            raise SystemExit(
+                f"streaming ttft p50 {lp['ttft_p50']:.1f} ms did not "
+                f"beat the e2e p50 {lp['e2e_p50']:.1f} ms on a "
+                f"long-generation point")
         if best_live <= slab["live_max"]:
             raise SystemExit(
                 f"paged concurrency {best_live} did not scale past the "

@@ -11,8 +11,10 @@ trajectory (bucketed), tap trends, phase breakdown, skip/straggler
 summary, fault/watchdog/preemption timeline, the elastic recovery
 timeline (``recover`` events), the serving section
 (rollout timeline, shed/error/replica-death counts, decode summary,
-and a per-hop latency waterfall for the slowest traced requests —
-``--waterfall N``), the performance ledger (top executables by flops,
+a per-request TOKEN waterfall for streamed decode requests — admit →
+first token → per-boundary counts → retire, from the ``stream``
+events — and a per-hop latency waterfall for the slowest traced
+requests — ``--waterfall N``), the performance ledger (top executables by flops,
 HBM tenant breakdown, device-memory timeline), the alert timeline
 (``alert`` firing/resolved transitions), crash bundles.
 
@@ -149,6 +151,16 @@ def _serving_section(events, waterfall=5):
                 line += (f", prefix hit-rate {hits / (hits + misses):.0%}"
                          f" ({hits}/{hits + misses})")
             out.append(line)
+        streamed = [e for e in decodes if e.get("streaming")]
+        if streamed:
+            n = sum(int(e.get("streams", 0)) for e in streamed)
+            bounds = sum(int(e.get("stream_boundaries", 0))
+                         for e in streamed)
+            ttft = sum(float(e.get("first_token_ms", 0.0))
+                       * int(e.get("streams", 0)) for e in streamed)
+            out.append(f"- streaming: {n} streamed request(s) over "
+                       f"{bounds} delivery boundaries, mean ttft "
+                       f"{ttft / n if n else 0.0:.2f} ms")
         specs = [e for e in decodes if e.get("spec_k")]
         if specs:
             wins = sum(int(e.get("spec_windows", 0)) for e in specs)
@@ -190,6 +202,32 @@ def _serving_section(events, waterfall=5):
                 line += (f"; prefill shipped {shipped}, colocated "
                          f"fallback {fallback}")
             out.append(line)
+        out.append("")
+
+    streams = [e for e in serves if e["kind"] == "stream"]
+    if streams and waterfall > 0:
+        # per-request token waterfall: admit → first token → retire
+        # with the per-boundary token counts (the `stream` events the
+        # decoder emits at retire — docs/observability.md "Streaming
+        # telemetry"); slowest first-token latencies first
+        n_tok = sum(int(e.get("tokens", 0)) for e in streams)
+        ttfts = sorted(float(e["ttft_ms"]) for e in streams)
+        p50 = ttfts[len(ttfts) // 2]
+        out.append(f"### Token waterfall (slowest {waterfall} of "
+                   f"{len(streams)} streamed requests; {n_tok} tokens, "
+                   f"ttft p50 {p50:.2f} ms)")
+        out += ["", "| request | admit ms | ttft ms | retire ms | "
+                "tokens | per-boundary |", "|---|---|---|---|---|---|"]
+        slowest = sorted(streams, key=lambda e: -float(e["ttft_ms"]))
+        for e in slowest[:waterfall]:
+            tl = " ".join(f"+{n}@{t:.1f}" for t, n in e["timeline"])
+            admit = e.get("admit_ms")
+            out.append(
+                f"| `{e.get('request', '?')}` | "
+                f"{'-' if admit is None else f'{admit:.2f}'} | "
+                f"{float(e['ttft_ms']):.2f} | "
+                f"{float(e.get('retire_ms', 0.0)):.2f} | "
+                f"{e.get('tokens', '?')} | {tl} |")
         out.append("")
 
     if traces and waterfall > 0:

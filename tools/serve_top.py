@@ -11,7 +11,10 @@ renders, per engine and fleet-wide:
 plus, when a paged continuous decoder is exporting, one trailing
 ``decode:`` line with KV page-pool occupancy, the prefix-cache
 hit-rate and the speculative acceptance p50 (docs/serving.md "Paged
-KV + speculative decode"), and — when an alert engine is exporting
+KV + speculative decode"), a ``stream:`` line with the windowed
+TTFT/ITL quantiles and streamed-token rate when streaming delivery is
+live (docs/observability.md "Streaming telemetry"), and — when an
+alert engine is exporting
 ``alert_active`` gauges (``obs/alerts.py``) — one ``alerts:`` line
 naming every firing rule (``alerts: none`` when quiet).
 
@@ -96,22 +99,14 @@ def _rate(cur, prev, dt, name, **match):
 
 def _window_quantiles(cur, prev, name, **match):
     """p50/p95/p99 of the observations that landed BETWEEN the two
-    snapshots: bucket counts are monotonic per series, so the window's
-    histogram is the element-wise count difference (clamped at 0 to
-    absorb a restart mid-window, like ``_rate``).  Falls back to the
+    snapshots (``metrics.windowed_counts`` — the one windowing rule
+    this dashboard and the alert engine share).  Falls back to the
     lifetime histogram when there is no prev snapshot or the window
-    saw no observations."""
-    lifetime = metrics.histogram_quantiles(cur, name, **match)
-    agg_cur = metrics.merged_histogram(cur, name, **match)
-    agg_prev = metrics.merged_histogram(prev, name, **match) \
-        if prev is not None else None
-    if agg_cur is None or agg_prev is None \
-            or list(agg_prev[0]) != list(agg_cur[0]):
-        return lifetime
-    bounds, counts_cur = agg_cur[0], agg_cur[1]
-    counts = [max(a - b, 0) for a, b in zip(counts_cur, agg_prev[1])]
-    if sum(counts) == 0:
-        return lifetime
+    saw no observations (last known latency beats a blank column)."""
+    wc = metrics.windowed_counts(cur, prev, name, **match)
+    if wc is None or prev is None or sum(wc[1]) == 0:
+        return metrics.histogram_quantiles(cur, name, **match)
+    bounds, counts = wc
     return {f"p{q}": metrics.quantile(bounds, counts, q)
             for q in (50, 95, 99)}
 
@@ -267,6 +262,32 @@ def decode_line(cur: dict, prev: dict | None, dt: float) -> str | None:
             + (f"{accept:.1f}" if accept is not None else "-"))
 
 
+def stream_line(cur: dict, prev: dict | None, dt: float) -> str | None:
+    """One trailing line of streaming-decode SLO telemetry when any
+    decoder is exporting the TTFT/ITL histograms: windowed TTFT
+    p50/p99, windowed ITL p50/p99 (the finer ``ITL_BUCKETS`` scale —
+    rendered in ms) and the streamed-token rate.  Windowing is the
+    engine-row math (bucket-count deltas, lifetime fallback on an idle
+    window).  None when no streaming series are present."""
+    if "decode_ttft_seconds" not in cur:
+        return None
+    tq = _window_quantiles(cur, prev, "decode_ttft_seconds")
+    iq = _window_quantiles(cur, prev, "decode_itl_seconds")
+    # the first frame has no window to rate over — render "-" like the
+    # quantile fallbacks (lifetime-total / interval would inflate the
+    # rate by however long the fleet has been up)
+    toks = (None if prev is None
+            else _rate(cur, prev, dt, "decode_stream_tokens_total"))
+
+    def ms(v):
+        return "-" if v is None else f"{v * 1e3:.2f}"
+
+    return (f"stream: ttft p50/p99 {ms(tq['p50'])}/{ms(tq['p99'])} ms   "
+            f"itl p50/p99 {ms(iq['p50'])}/{ms(iq['p99'])} ms   "
+            + ("-" if toks is None else f"{toks:.1f}")
+            + " tok/s streamed")
+
+
 def alerts_line(cur: dict) -> str | None:
     """One trailing ``alerts:`` line from the ``alert_active`` gauges
     the declarative alert engine exports (``obs/alerts.py`` — rides the
@@ -288,6 +309,7 @@ def _ms(v):
 
 def render(rows: list, source: str, dt: float,
            decode: str | None = None,
+           stream: str | None = None,
            fleet: str | None = None,
            alerts: str | None = None) -> str:
     out = [f"serve_top — {source}  (window {dt:.1f}s)", "",
@@ -303,7 +325,7 @@ def render(rows: list, source: str, dt: float,
             f"{marker}{name:<11} {r['rows_s']:8.1f} {r['queue']:6d} "
             f"{r['inflight']:6d} {r['shed_s']:7.1f} {_ms(r['p50_ms'])} "
             f"{_ms(r['p95_ms'])} {_ms(r['p99_ms'])} {r['burn']:6.2f}")
-    for line in (decode, fleet, alerts):
+    for line in (decode, stream, fleet, alerts):
         if line:
             out += ["", line]
     return "\n".join(out)
@@ -331,6 +353,8 @@ def main(argv=None) -> int:
                           budget=args.budget)
         frame = render(rows, args.source, dt,
                        decode=decode_line(cur, prev[1] if prev else None,
+                                          dt),
+                       stream=stream_line(cur, prev[1] if prev else None,
                                           dt),
                        fleet=fleet_line(cur, prev[1] if prev else None,
                                         dt),
