@@ -413,7 +413,9 @@ def default_rules(budget: float = 0.01, queue_depth: float = 64.0,
                   itl_slo_ms: float | None = None) -> list:
     """The shipped rule set (docs/observability.md has the table):
     SLO burn (multiwindow), shed rate, queue depth, train step-time
-    regression vs a rolling self-baseline, HBM headroom, plus the
+    regression vs a rolling self-baseline, HBM headroom,
+    ``fleet_scale_frozen`` (the autoscaler's spawn circuit breaker —
+    fires the moment the gauge goes 1), plus the
     per-token streaming pair — ``ttft_burn`` (windowed TTFT p95 above
     the first-token SLO budget; ``ttft_slo_ms`` defaults to
     ``BIGDL_SERVE_SLO_TTFT_MS``, falling back to 500 ms when no class
@@ -472,6 +474,11 @@ def default_rules(budget: float = 0.01, queue_depth: float = 64.0,
              for_n=2, clear_n=2,
              description="windowed inter-token latency p50 above "
                          f"{itl_factor}x its rolling median"),
+        Rule("fleet_scale_frozen", "threshold",
+             metric="fleet_scale_frozen", threshold=0.5,
+             description="the autoscaler's spawn circuit breaker is "
+                         "open: repeated replica spawn failure — the "
+                         "fleet cannot grow (serve/autoscale.py)"),
     ] + extra
 
 

@@ -39,8 +39,11 @@ logger = logging.getLogger("bigdl_tpu.obs")
 #: required fields (LEDGER_KINDS / ALERT_KINDS).  v4: the `stream`
 #: serve kind landed (one streamed decode request's token timeline),
 #: and `decode` events that report streaming (``streaming: true``)
-#: must carry `first_token_ms` + `stream_boundaries`.
-SCHEMA_VERSION = 4
+#: must carry `first_token_ms` + `stream_boundaries`.  v5: the `scale`
+#: type landed (autoscaler/dynamic-membership decisions, SCALE_KINDS)
+#: plus the `replica_added`/`replica_draining`/`replica_removed`
+#: serve kinds the router emits on membership changes.
+SCHEMA_VERSION = 5
 
 ENV_OBS = "BIGDL_OBS"
 ENV_DIR = "BIGDL_OBS_DIR"
@@ -85,6 +88,11 @@ EVENT_TYPES = {
     # declarative alert transitions (obs/alerts.py): firing/resolved
     # with the rule name + the value/threshold that judged it
     "alert": ("kind", "rule"),
+    # autoscaler / dynamic-membership decisions (serve/autoscale.py,
+    # ReplicaPool.add_replica/remove_replica): kind-specific required
+    # fields in SCALE_KINDS — the scale/recovery timeline obs_report
+    # renders and the capstone chaos drill asserts on
+    "scale": ("kind",),
 }
 
 #: per-kind REQUIRED fields for `serve` events (v2).  An unknown kind is
@@ -108,6 +116,11 @@ SERVE_KINDS = {
     "router_start": ("replicas",),
     "router_stop": (),
     "replica_dead": ("replica",),
+    # dynamic membership (schema v5): a replica joining the dispatch
+    # set, entering drain-only state, or leaving the pool entirely
+    "replica_added": ("replica",),
+    "replica_draining": ("replica",),
+    "replica_removed": ("replica",),
     "fleet_start": ("replicas",),
     "fleet_stop": ("replicas",),
     "rollout_begin": ("version",),
@@ -150,10 +163,26 @@ ALERT_KINDS = {
     "resolved": ("value", "threshold"),
 }
 
+#: per-kind REQUIRED fields for `scale` events (schema v5, the
+#: SERVE_KINDS contract): an unknown kind is a validation error.  `up`
+#: and `down` are committed membership changes and carry the replica
+#: plus the POLICY REASON that drove the decision (the audit trail the
+#: capstone drill reads back); `spawn_failed` is one failed spawn
+#: attempt inside the retry/backoff loop, `frozen`/`unfrozen` the
+#: circuit-breaker transitions that stop a crash loop.
+SCALE_KINDS = {
+    "up": ("replica", "reason"),
+    "down": ("replica", "reason"),
+    "spawn_failed": ("error", "attempt"),
+    "frozen": ("failures",),
+    "unfrozen": (),
+}
+
 _COMMON = ("v", "ts", "proc", "type")
 
 _KINDED = {"serve": SERVE_KINDS, "recover": RECOVER_KINDS,
-           "ledger": LEDGER_KINDS, "alert": ALERT_KINDS}
+           "ledger": LEDGER_KINDS, "alert": ALERT_KINDS,
+           "scale": SCALE_KINDS}
 
 
 def validate_event(event: dict) -> dict:

@@ -67,16 +67,23 @@ Flags: ``BIGDL_SERVE_MAX_BATCH`` (default 64), ``BIGDL_SERVE_MAX_WAIT_MS``
 dispatch, default on), ``BIGDL_SERVE_PREFILL_REPLICAS`` (dedicated
 prefill replicas, default 0), ``BIGDL_SERVE_KV_HOST_MB`` (host-RAM KV
 tier budget per decode replica, default 0 = off),
-``BIGDL_OBS_TRACE_SAMPLE`` (request-trace sample rate, default 0) and
+``BIGDL_OBS_TRACE_SAMPLE`` (request-trace sample rate, default 0),
 ``BIGDL_SERVE_EXPORT_PORT`` (metrics pull exporter —
-docs/observability.md "Serving telemetry").
+docs/observability.md "Serving telemetry") and the autoscaler loop
+(``serve/autoscale.py``, docs/serving.md "Autoscaling"):
+``BIGDL_SERVE_AUTOSCALE`` (default off),
+``BIGDL_SERVE_MIN_REPLICAS`` / ``BIGDL_SERVE_MAX_REPLICAS`` (bounds,
+default 1/8), ``BIGDL_SERVE_SCALE_INTERVAL`` (cadence seconds,
+default 2).
 """
 from bigdl_tpu.serve import bucketing, xcache  # noqa: F401
+from bigdl_tpu.serve.autoscale import Autoscaler  # noqa: F401
 from bigdl_tpu.serve.bucketing import (  # noqa: F401
     bucket_for, bucket_sizes, pad_rows, trim, valid_mask,
 )
 from bigdl_tpu.serve.cluster import (  # noqa: F401
-    LocalReplica, ProcessReplica, ReplicaPool, RolloutError, WeightStore,
+    LocalReplica, ProcessReplica, ReplicaPool, ReplicaSpawnError,
+    RolloutError, WeightStore,
 )
 from bigdl_tpu.serve.decode import (  # noqa: F401
     ContinuousDecoder, continuous_decode,
@@ -107,7 +114,8 @@ __all__ = [
     "DTypePolicyDriftError",
     "SheddedError", "ContinuousDecoder", "continuous_decode", "Router",
     "DeadReplicaError", "ReplicaPool", "LocalReplica", "ProcessReplica",
-    "WeightStore", "RolloutError", "PagePool", "PrefixCache",
+    "WeightStore", "RolloutError", "ReplicaSpawnError", "Autoscaler",
+    "PagePool", "PrefixCache",
     "RequestTooLongError", "chain_keys", "DecodeFleet", "FleetRouter",
     "AffinityIndex", "DecodeReplica", "PrefillReplica",
     "ProcessDecodeReplica", "ProcessPrefillReplica", "HostKVTier",

@@ -1,0 +1,480 @@
+"""SLO-driven autoscaler: the closed loop over the serving fleet's
+merged telemetry (docs/serving.md "Autoscaling").
+
+Every mechanism a production fleet needs already exists — the SLO
+router (shed/requeue), fleet telemetry (``ReplicaPool.merged_registry``
+— true registry merge, pooled quantiles), two-phase weight rollout,
+alert rules with hysteresis — but the control loop was a human:
+``BIGDL_SERVE_REPLICAS`` pinned the replica count at construction.
+:class:`Autoscaler` closes the loop:
+
+- **watch**: on a cadence, pull one merged-registry snapshot and
+  compute the overload signals with EXACTLY the windowed-delta
+  arithmetic ``serve_top``/``obs/alerts.py`` use — windowed p99
+  (``metrics.windowed_counts`` bucket deltas), queue depth
+  (point-in-time gauge totals), shed rate (counter deltas over the
+  window, router admission-stage sheds folded in once), and SLO burn
+  (``alerts.slo_burn`` — (shed+failed)/offered over the window,
+  divided by the error budget);
+- **decide**: breach any up-signal for ``up_n`` consecutive ticks →
+  scale up; fully idle (zero queue, zero sheds, offered rate per
+  replica under the floor) for ``down_n`` consecutive ticks → scale
+  down.  Asymmetric hysteresis (fast up, slow down) plus a cooldown
+  after every committed action keep a value dancing on the bound from
+  flapping the fleet;
+- **act**: ``pool.add_replica()`` — which spawns, warms through the
+  xcache and the WeightStore's COMMITTED version, and only then joins
+  the dispatch set — or ``pool.remove_replica()`` — drain-only mark,
+  wait to zero backlog, close; zero dropped futures — inside the
+  ``[min_replicas, max_replicas]`` bounds.
+
+Spawn failure is survived, not crash-looped: each scale-up cycle
+retries ``spawn_retries`` times with jittered exponential backoff
+(seeded — drills replay byte-identically), and ``breaker_n``
+consecutive failed cycles open a circuit breaker: the
+``fleet_scale_frozen`` gauge goes 1 (a default alert rule fires on
+it), a ``scale``/``frozen`` event lands in the log, and no further
+spawns are attempted until ``breaker_reset_s`` passes (then ONE
+half-open attempt; success closes the breaker and emits
+``unfrozen``).
+
+Every committed decision emits a schema-validated ``scale`` obs event
+(``obs/events.SCALE_KINDS``), so the whole scale/recovery timeline
+renders in ``tools/obs_report.py`` and the capstone chaos drill can
+assert on it.
+
+The Autoscaler is duck-typed over any pool exposing
+``merged_registry() / membership() / add_replica(reason=) /
+remove_replica(reason=, timeout=)`` — :class:`~bigdl_tpu.serve.cluster.
+ReplicaPool` and :class:`~bigdl_tpu.serve.fleet.DecodeFleet` both do.
+
+Flags: ``BIGDL_SERVE_AUTOSCALE`` (auto-start at pool construction,
+default off), ``BIGDL_SERVE_MIN_REPLICAS`` / ``BIGDL_SERVE_MAX_REPLICAS``
+(bounds, default 1/8), ``BIGDL_SERVE_SCALE_INTERVAL`` (cadence seconds,
+default 2).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+
+from bigdl_tpu.obs import alerts as obs_alerts
+from bigdl_tpu.obs import metrics as obs_metrics
+
+logger = logging.getLogger("bigdl_tpu.serve")
+
+ENV_AUTOSCALE = "BIGDL_SERVE_AUTOSCALE"
+ENV_MIN_REPLICAS = "BIGDL_SERVE_MIN_REPLICAS"
+ENV_MAX_REPLICAS = "BIGDL_SERVE_MAX_REPLICAS"
+ENV_INTERVAL = "BIGDL_SERVE_SCALE_INTERVAL"
+
+DEFAULT_MIN_REPLICAS = 1
+DEFAULT_MAX_REPLICAS = 8
+DEFAULT_INTERVAL_S = 2.0
+
+
+def autoscale_default() -> bool:
+    return os.environ.get(ENV_AUTOSCALE, "0") != "0"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def min_replicas_default() -> int:
+    return max(1, _env_int(ENV_MIN_REPLICAS, DEFAULT_MIN_REPLICAS))
+
+
+def max_replicas_default() -> int:
+    return max(1, _env_int(ENV_MAX_REPLICAS, DEFAULT_MAX_REPLICAS))
+
+
+def interval_default() -> float:
+    return max(0.05, _env_float(ENV_INTERVAL, DEFAULT_INTERVAL_S))
+
+
+class Autoscaler:
+    """Watch → decide → act over a replica pool's merged registry.
+
+    ``evaluate_once(snapshot=, now=)`` is the testable core: one tick
+    with injectable snapshot/clock, returning the computed signals, the
+    decision and whether an action committed.  ``start()`` runs it on a
+    cadence thread; ``close()`` stops and joins it (the sampler/Router
+    lifecycle contract).
+
+    Up-signal thresholds (any breach counts): ``up_queue_depth``
+    (queue depth per live replica), ``up_shed_per_s`` (windowed shed
+    rate), ``up_burn`` (multikind SLO burn — the serve_top column
+    math), ``up_p99_ms`` (windowed fleet p99; 0 disables).  Down:
+    ``down_idle_rps`` — windowed offered rate per live replica below
+    this with zero queue and zero sheds counts one idle tick."""
+
+    def __init__(self, pool, min_replicas: int | None = None,
+                 max_replicas: int | None = None,
+                 interval: float | None = None, window_s: float = 10.0,
+                 budget: float = 0.01, up_queue_depth: float = 8.0,
+                 up_shed_per_s: float = 0.5, up_burn: float = 1.0,
+                 up_p99_ms: float = 0.0, down_idle_rps: float = 0.5,
+                 up_n: int = 1, down_n: int = 5,
+                 cooldown_s: float | None = None,
+                 drain_timeout: float = 120.0, spawn_retries: int = 3,
+                 backoff_s: float = 0.25, backoff_jitter: float = 0.5,
+                 breaker_n: int = 3, breaker_reset_s: float = 60.0,
+                 seed: int = 0, emit_events: bool = True):
+        self.pool = pool
+        self.min_replicas = (min_replicas_default() if min_replicas is None
+                             else max(1, int(min_replicas)))
+        self.max_replicas = (max_replicas_default() if max_replicas is None
+                             else max(1, int(max_replicas)))
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(f"max_replicas {self.max_replicas} < "
+                             f"min_replicas {self.min_replicas}")
+        self.interval = (interval_default() if interval is None
+                         else max(0.05, float(interval)))
+        self.window_s = float(window_s)
+        self.budget = float(budget)
+        self.up_queue_depth = float(up_queue_depth)
+        self.up_shed_per_s = float(up_shed_per_s)
+        self.up_burn = float(up_burn)
+        self.up_p99_ms = float(up_p99_ms)
+        self.down_idle_rps = float(down_idle_rps)
+        self.up_n = max(1, int(up_n))
+        self.down_n = max(1, int(down_n))
+        #: post-action quiet period: the signal window must refill with
+        #: post-change traffic before the next decision can commit
+        self.cooldown_s = (3.0 * self.interval if cooldown_s is None
+                           else max(0.0, float(cooldown_s)))
+        self.drain_timeout = float(drain_timeout)
+        self.spawn_retries = max(1, int(spawn_retries))
+        self.backoff_s = max(0.0, float(backoff_s))
+        self.backoff_jitter = max(0.0, float(backoff_jitter))
+        self.breaker_n = max(1, int(breaker_n))
+        self.breaker_reset_s = max(0.0, float(breaker_reset_s))
+        self._rng = random.Random(seed)
+        self._emit_events = emit_events
+
+        self._lock = threading.Lock()
+        self._hist: deque = deque()       # (now, snapshot)
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_at: float | None = None
+        self._spawn_failures = 0          # consecutive failed up-cycles
+        self._frozen_until: float | None = None
+        self._stop = threading.Event()
+        self._thread = None
+        self.evaluations = 0              # cadence audit hook
+        self.scale_ups = 0
+        self.scale_downs = 0
+
+        pool_name = getattr(pool, "name", "pool")
+        reg = obs_metrics.get()
+        self._m_failures = reg.counter(
+            "fleet_scale_failures_total",
+            "failed replica spawn attempts (autoscaler retry loop)",
+            pool=pool_name)
+        # declared at 0 up front (the alert_active precedent): serve_top
+        # and the default fleet_scale_frozen alert rule can read "not
+        # frozen" instead of "no autoscaler"
+        self._m_frozen = reg.gauge(
+            "fleet_scale_frozen",
+            "1 while the spawn circuit breaker is open", agg="max",
+            pool=pool_name)
+        self._m_frozen.set(0.0)
+
+    # -- signals ------------------------------------------------------------
+    def _window_snap(self, now: float):
+        """Oldest retained snapshot inside the window (fallback: the
+        oldest held — a shorter window biases rates toward firing
+        later, never spuriously; the alert engine's rule)."""
+        chosen = None
+        for ts, snap in self._hist:
+            if ts >= now - self.window_s:
+                chosen = (ts, snap)
+                break
+        if chosen is None and self._hist:
+            chosen = self._hist[0]
+        return chosen
+
+    @staticmethod
+    def _shed_total(snap) -> float:
+        """Engine sheds + router ADMISSION-stage sheds (the disjoint
+        stages contract: replica-stage sheds already live in the engine
+        counters — serve_top's fold-once rule)."""
+        if not snap:
+            return 0.0
+        return (obs_metrics.family_total(snap, "serve_requests_total",
+                                         outcome="shed")
+                + obs_metrics.family_total(snap, "router_requests_total",
+                                           outcome="shed",
+                                           stage="admission"))
+
+    def signals(self, cur: dict, now: float, membership: dict) -> dict:
+        """The decision inputs for one tick, computed from the current
+        merged snapshot against the windowed reference — serve_top's
+        exact column math (pure given (snapshot, now, membership):
+        drills feed synthetic registries through it)."""
+        ref = self._window_snap(now)
+        prev, dt = (None, 0.0) if ref is None else (ref[1], now - ref[0])
+        live = max(1, int(membership.get("live", 1)))
+        queue = (obs_metrics.family_total(cur, "serve_queue_depth")
+                 + obs_metrics.family_total(cur, "router_queue_depth"))
+
+        def delta(name, **match):
+            d = obs_metrics.family_total(cur, name, **match) - (
+                obs_metrics.family_total(prev, name, **match)
+                if prev else 0.0)
+            return max(d, 0.0)
+
+        shed_per_s = ((self._shed_total(cur) - self._shed_total(prev))
+                      / dt if prev is not None and dt > 0 else 0.0)
+        shed_per_s = max(shed_per_s, 0.0)
+        offered = (delta("serve_requests_total", outcome="accepted")
+                   + (self._shed_total(cur) - self._shed_total(prev)
+                      if prev is not None else 0.0)) \
+            if prev is not None else 0.0
+        offered_per_s = offered / dt if dt > 0 else 0.0
+        burn = (obs_alerts.slo_burn(cur, prev, self.budget)
+                if prev is not None else None)
+        # p99 only once a window EXISTS: windowed_counts falls back to
+        # the lifetime histogram with no prev, which is the right call
+        # for a dashboard column but would let stale pre-loop latencies
+        # trigger a scale-up on the very first tick
+        p99 = None
+        if prev is not None:
+            wc = obs_metrics.windowed_counts(cur, prev,
+                                             "serve_latency_seconds")
+            if wc is not None and sum(wc[1]) > 0:
+                p99 = obs_metrics.quantile(wc[0], wc[1], 99)
+        return {
+            "queue": queue,
+            "queue_per_replica": queue / live,
+            "shed_per_s": shed_per_s,
+            "burn": burn,
+            "p99_ms": None if p99 is None else p99 * 1e3,
+            "offered_per_s": offered_per_s,
+            "offered_per_replica": offered_per_s / live,
+            "live": live,
+            "window_s": dt,
+        }
+
+    # -- decision -----------------------------------------------------------
+    def frozen(self, now: float | None = None) -> bool:
+        """True while the spawn circuit breaker is open (scale-ups are
+        suppressed; after ``breaker_reset_s`` one half-open attempt is
+        allowed)."""
+        with self._lock:
+            until = self._frozen_until
+        if until is None:
+            return False
+        return (time.monotonic() if now is None else now) < until
+
+    def _breach_reasons(self, sig: dict) -> list:
+        reasons = []
+        if sig["queue_per_replica"] > self.up_queue_depth:
+            reasons.append(f"queue/replica {sig['queue_per_replica']:.1f}"
+                           f" > {self.up_queue_depth:g}")
+        if sig["shed_per_s"] > self.up_shed_per_s:
+            reasons.append(f"shed rate {sig['shed_per_s']:.2f}/s > "
+                           f"{self.up_shed_per_s:g}/s")
+        if sig["burn"] is not None and sig["burn"] > self.up_burn:
+            reasons.append(f"slo burn {sig['burn']:.2f} > "
+                           f"{self.up_burn:g}")
+        if (self.up_p99_ms > 0 and sig["p99_ms"] is not None
+                and sig["p99_ms"] > self.up_p99_ms):
+            reasons.append(f"p99 {sig['p99_ms']:.1f} ms > "
+                           f"{self.up_p99_ms:g} ms")
+        return reasons
+
+    def decide(self, sig: dict, membership: dict,
+               now: float) -> tuple:
+        """``("up"|"down"|None, reason)`` — hysteresis, cooldown and
+        bounds applied; no side effects beyond the streak counters."""
+        in_cooldown = (self._last_action_at is not None
+                       and now - self._last_action_at < self.cooldown_s)
+        reasons = self._breach_reasons(sig)
+        if reasons:
+            self._down_streak = 0
+            self._up_streak += 1
+            if self._up_streak < self.up_n or in_cooldown:
+                return None, None
+            total = (membership.get("live", 0)
+                     + membership.get("warming", 0))
+            if total >= self.max_replicas:
+                return None, f"at max_replicas {self.max_replicas}"
+            return "up", "; ".join(reasons)
+        self._up_streak = 0
+        idle = (sig["queue"] == 0 and sig["shed_per_s"] == 0
+                and sig["offered_per_replica"] < self.down_idle_rps)
+        if not idle:
+            self._down_streak = 0
+            return None, None
+        self._down_streak += 1
+        if self._down_streak < self.down_n or in_cooldown:
+            return None, None
+        if membership.get("live", 0) <= self.min_replicas:
+            return None, f"at min_replicas {self.min_replicas}"
+        return "down", (f"idle {self._down_streak} ticks: "
+                        f"offered/replica "
+                        f"{sig['offered_per_replica']:.2f}/s < "
+                        f"{self.down_idle_rps:g}/s, queue 0")
+
+    # -- actions ------------------------------------------------------------
+    def _emit(self, kind: str, **fields):
+        if not self._emit_events:
+            return
+        try:
+            from bigdl_tpu.obs import events
+            events.emit("scale", kind=kind, **fields)
+        except Exception:   # pragma: no cover - telemetry must not kill
+            logger.warning("scale event emit failed", exc_info=True)
+
+    def scale_up(self, reason: str, now: float | None = None) -> bool:
+        """One scale-up cycle: ``spawn_retries`` attempts with jittered
+        exponential backoff; exhausting them counts one breaker strike.
+        ``breaker_n`` strikes open the breaker (``fleet_scale_frozen``
+        gauge + ``frozen`` event) — degraded to an alert, never a crash
+        loop.  Success closes an open breaker (``unfrozen``)."""
+        now = time.monotonic() if now is None else now
+        err = None
+        for attempt in range(1, self.spawn_retries + 1):
+            try:
+                replica = self.pool.add_replica(reason=reason)
+            except Exception as e:
+                err = e
+                self._m_failures.inc()
+                self._emit("spawn_failed", attempt=attempt,
+                           error=f"{type(e).__name__}: {e}")
+                logger.warning("autoscaler: replica spawn attempt "
+                               "%d/%d failed: %s", attempt,
+                               self.spawn_retries, e)
+                if attempt < self.spawn_retries and self.backoff_s:
+                    delay = (self.backoff_s * (2 ** (attempt - 1))
+                             * (1.0 + self.backoff_jitter
+                                * self._rng.random()))
+                    time.sleep(delay)
+                continue
+            with self._lock:
+                self._spawn_failures = 0
+                was_frozen = self._frozen_until is not None
+                self._frozen_until = None
+            if was_frozen:
+                self._m_frozen.set(0.0)
+                self._emit("unfrozen")
+            self.scale_ups += 1
+            self._last_action_at = now
+            logger.info("autoscaler: scaled up (+%s): %s",
+                        getattr(replica, "name", replica), reason)
+            return True
+        with self._lock:
+            self._spawn_failures += 1
+            failures = self._spawn_failures
+            trip = (failures >= self.breaker_n
+                    and self._frozen_until is None)
+            if trip or self._frozen_until is not None:
+                self._frozen_until = now + self.breaker_reset_s
+        if trip:
+            self._m_frozen.set(1.0)
+            self._emit("frozen", failures=failures,
+                       error=f"{type(err).__name__}: {err}",
+                       reset_s=self.breaker_reset_s)
+            logger.error("autoscaler: spawn circuit breaker OPEN after "
+                         "%d consecutive failed cycles (last: %s); "
+                         "fleet_scale_frozen raised", failures, err)
+        return False
+
+    def scale_down(self, reason: str, now: float | None = None) -> bool:
+        now = time.monotonic() if now is None else now
+        try:
+            self.pool.remove_replica(reason=reason,
+                                     timeout=self.drain_timeout)
+        except (ValueError, TimeoutError) as e:
+            logger.warning("autoscaler: scale-down skipped: %s", e)
+            return False
+        self.scale_downs += 1
+        self._last_action_at = now
+        logger.info("autoscaler: scaled down: %s", reason)
+        return True
+
+    # -- the tick -----------------------------------------------------------
+    def evaluate_once(self, snapshot=None, now=None) -> dict:
+        """One watch→decide→act tick.  ``snapshot``/``now`` injectable
+        (drills feed synthetic registries and a logical clock); returns
+        ``{"signals", "decision", "reason", "acted"}``."""
+        if now is None:
+            now = time.monotonic()
+        if snapshot is None:
+            try:
+                snapshot = self.pool.merged_registry()
+            except Exception as e:  # pragma: no cover - racing close
+                logger.warning("autoscaler snapshot pull failed: %s", e)
+                return {"signals": None, "decision": None,
+                        "reason": None, "acted": False}
+        membership = self.pool.membership()
+        sig = self.signals(snapshot, now, membership)
+        decision, reason = self.decide(sig, membership, now)
+        acted = False
+        if decision == "up":
+            if not self.frozen(now):
+                acted = self.scale_up(reason, now)
+            else:
+                decision, reason = None, "breaker open (frozen)"
+        elif decision == "down":
+            acted = self.scale_down(reason, now)
+        if acted:
+            self._up_streak = self._down_streak = 0
+        # history AFTER evaluation: windowed signals difference the
+        # current snapshot against strictly older ones
+        self._hist.append((now, snapshot))
+        horizon = self.window_s * 1.25 + self.interval
+        while len(self._hist) > 2 and self._hist[0][0] < now - horizon:
+            self._hist.popleft()
+        self.evaluations += 1
+        return {"signals": sig, "decision": decision, "reason": reason,
+                "acted": acted}
+
+    # -- cadence thread -----------------------------------------------------
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.evaluate_once()
+            except Exception:   # pragma: no cover - defensive
+                logger.warning("autoscaler tick failed", exc_info=True)
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True,
+                name="bigdl-serve-autoscale")
+            self._thread.start()
+        return self
+
+    def close(self, timeout: float = None):
+        """Stop-event + bounded join (the sampler/Router lifecycle
+        contract) — idempotent.  The join bound covers a tick that is
+        mid-drain on a scale-down."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=(self.drain_timeout + 10.0
+                            if timeout is None else timeout))
+        self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()

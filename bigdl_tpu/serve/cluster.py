@@ -51,6 +51,7 @@ serve/router.py).
 """
 from __future__ import annotations
 
+import itertools
 import logging
 import os
 import pickle
@@ -75,6 +76,8 @@ logger = logging.getLogger("bigdl_tpu.serve")
 
 _LEN = struct.Struct(">Q")
 
+_POOL_SEQ = itertools.count()
+
 #: bounded per-replica stderr ring (lines); the tail is what a
 #: postmortem actually needs — the jax traceback right before death
 _STDERR_LINES = 256
@@ -96,6 +99,26 @@ _EXC_TYPES = {
 class RolloutError(RuntimeError):
     """A two-phase weight rollout failed and was rolled back; every
     replica is serving the PREVIOUS version."""
+
+
+class ReplicaSpawnError(RuntimeError):
+    """A replica child died (or timed out) during the spawn/warmup
+    handshake — before it ever took traffic.  Carries the child's
+    stderr ring tail (``stderr_tail``) so the jax traceback that killed
+    the warmup is IN the exception, not lost to a raw frame error.
+    The autoscaler's retry/backoff + circuit breaker key on this type
+    (``serve/autoscale.py``)."""
+
+    def __init__(self, message: str, stderr_tail=None):
+        super().__init__(message)
+        self.stderr_tail = list(stderr_tail or [])
+
+
+#: deterministic spawn-failure chaos knob: a replica worker started
+#: with BIGDL_SERVE_SPAWN_FAIL=1 in its env exits during the warmup
+#: handshake (after the init frame, before `ready`) — the drill site
+#: behind the ReplicaSpawnError and circuit-breaker regression tests
+ENV_SPAWN_FAIL = "BIGDL_SERVE_SPAWN_FAIL"
 
 
 # ---------------------------------------------------------------------------
@@ -299,21 +322,29 @@ class ProcessReplica:
             target=self._stderr_loop, daemon=True,
             name=f"bigdl-serve-{name}-stderr")
         self._stderr_reader.start()
-        _write_frame(self.proc.stdin,
-                     self._init_frame(model, engine_kwargs), self._wlock)
+        try:
+            _write_frame(self.proc.stdin,
+                         self._init_frame(model, engine_kwargs),
+                         self._wlock)
+        except (OSError, ValueError) as e:
+            # the child died before reading its init frame (EPIPE): a
+            # raw pipe error carries nothing — raise the typed spawn
+            # error with whatever the child said on stderr
+            raise self._spawn_error(
+                f"replica {name} rejected the init frame: "
+                f"{type(e).__name__}: {e}") from e
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True,
                                         name=f"bigdl-serve-{name}-reader")
         self._ready = threading.Event()
         self._reader.start()
         if not self._ready.wait(spawn_timeout):
-            self.proc.kill()
-            raise TimeoutError(f"replica {name} did not come up in "
-                               f"{spawn_timeout}s")
+            raise self._spawn_error(
+                f"replica {name} did not come up in {spawn_timeout}s")
         if self._dead:
-            raise RuntimeError(
+            raise self._spawn_error(
                 f"replica {name} died during startup (exit code "
-                f"{self.proc.poll()}){self._tail_suffix()}")
+                f"{self.proc.poll()})")
 
     # -- wire ---------------------------------------------------------------
     def _read_loop(self):
@@ -395,6 +426,24 @@ class ProcessReplica:
         return DeadReplicaError(
             f"replica {self.name} (pid {self.proc.pid}) died"
             f"{self._tail_suffix()}")
+
+    def _spawn_error(self, message: str) -> ReplicaSpawnError:
+        """Constructor-failure epilogue: kill the child (idempotent),
+        drain its stderr to EOF so the tail is complete, and return the
+        typed error with the tail attached — a spawn failure must leak
+        neither the subprocess nor the reason it died."""
+        self._closing = True     # death past this point is expected
+        try:
+            self.proc.kill()
+        except OSError:   # pragma: no cover - already gone
+            pass
+        try:
+            self.proc.wait(timeout=5.0)
+        except Exception:   # pragma: no cover - still exiting
+            pass
+        self._stderr_reader.join(timeout=2.0)
+        return ReplicaSpawnError(message + self._tail_suffix(),
+                                 stderr_tail=self.stderr_tail())
 
     def _forward_event(self, event):
         if not isinstance(event, dict):
@@ -542,11 +591,175 @@ class ProcessReplica:
             self._delivery = None
 
 
+def wait_drained(router, victim, timeout: float):
+    """Block until a drain-marked replica's backlog (router-outstanding
+    + its own inflight) resolves; a victim dying mid-drain counts as
+    drained — its orphans ride the requeue-on-death path.  Raises
+    TimeoutError (nothing dropped, victim left draining) on expiry.
+    Shared by ``ReplicaPool.remove_replica`` and
+    ``DecodeFleet.remove_replica``."""
+    t0 = time.monotonic()
+    while True:
+        pending = router.pending_for(victim)
+        try:
+            if victim.alive():
+                pending += victim.inflight()
+        except Exception:   # pragma: no cover - racing a death
+            pass
+        if pending == 0:
+            return
+        if time.monotonic() - t0 > timeout:
+            raise TimeoutError(
+                f"replica {getattr(victim, 'name', victim)} did not "
+                f"drain in {timeout}s ({pending} pending); left "
+                f"draining, nothing dropped")
+        time.sleep(0.005)
+
+
+class DynamicMembership:
+    """The shared dynamic-membership surface (docs/serving.md
+    "Autoscaling"): membership gauges, the drain-to-zero
+    ``remove_replica`` contract, and the autoscaler hookup —
+    :class:`ReplicaPool` and :class:`~bigdl_tpu.serve.fleet.DecodeFleet`
+    both mix this in so the drain/accounting logic cannot diverge.
+
+    Host-class requirements: ``name``, ``replicas``, ``router``,
+    ``_scale_lock`` (RLock) and ``_warming`` exist before
+    :meth:`_init_membership` is called; ``add_replica(reason=)`` is
+    host-specific (the warm bar differs: weight versions for engine
+    pools, compile-only for decode fleets)."""
+
+    def _init_membership(self):
+        from bigdl_tpu.obs import metrics as obs_metrics
+        self.autoscaler = None
+        reg = obs_metrics.get()
+        self._m_members = {
+            state: reg.gauge(
+                "fleet_replicas",
+                "pool membership by state (live/warming/draining)",
+                state=state, pool=self.name)
+            for state in ("live", "warming", "draining")}
+        self._m_scale = {
+            d: reg.counter("fleet_scale_events_total",
+                           "committed scale actions by direction",
+                           direction=d, pool=self.name)
+            for d in ("up", "down")}
+        self._update_membership()
+
+    def membership(self) -> dict:
+        """``{"live": n, "warming": n, "draining": n}`` — the counts
+        behind the ``fleet_replicas`` gauges and serve_top's ``fleet:``
+        line (live excludes draining; dead replicas count nowhere)."""
+        live = draining = 0
+        for r in list(self.replicas):
+            try:
+                ok = r.alive()
+            except Exception:
+                ok = False
+            if not ok:
+                continue
+            if self.router.is_draining(r):
+                draining += 1
+            else:
+                live += 1
+        with self._scale_lock:
+            warming = self._warming
+        return {"live": live, "warming": warming, "draining": draining}
+
+    def _update_membership(self) -> dict:
+        m = self.membership()
+        try:
+            for state, gauge in self._m_members.items():
+                gauge.set(m[state])
+        except Exception:   # pragma: no cover - registry mid-teardown
+            pass
+        return m
+
+    def _resolve_victim(self, replica):
+        """An instance, a name, or None (→ the newest non-draining
+        live replica: scale-down unwinds scale-up, LIFO)."""
+        if replica is None:
+            for r in reversed(self.replicas):
+                try:
+                    if r.alive() and not self.router.is_draining(r):
+                        return r
+                except Exception:
+                    continue
+            return None
+        if isinstance(replica, str):
+            return next((r for r in self.replicas
+                         if getattr(r, "name", None) == replica), None)
+        return replica if replica in self.replicas else None
+
+    def remove_replica(self, replica=None, reason: str = "manual",
+                       timeout: float = 120.0):
+        """Drain one replica out of the pool with ZERO dropped futures
+        (the hot-swap bar): mark it drain-only in the router (dispatch
+        skips it, its queued/in-flight requests still complete), wait
+        for its backlog to resolve, then detach and close it.  A victim
+        dying mid-drain rides the normal requeue-on-death path.
+        ``replica`` may be an instance, a name, or None (newest live
+        replica).  Raises TimeoutError — replica left draining, nothing
+        dropped — if the backlog does not resolve in ``timeout``."""
+        from bigdl_tpu.obs import events
+        with self._scale_lock:
+            victim = self._resolve_victim(replica)
+            if victim is None:
+                raise ValueError(f"no such live replica: {replica!r}")
+            live = [r for r in self.replicas
+                    if r is not victim and r.alive()
+                    and not self.router.is_draining(r)]
+            if not live:
+                raise ValueError(
+                    "refusing to drain the last live replica")
+            self.router.mark_draining(victim)
+        self._update_membership()
+        try:
+            wait_drained(self.router, victim, timeout)
+        except TimeoutError:
+            self._update_membership()
+            raise
+        with self._scale_lock:
+            self.router.remove_replica(victim)
+            if victim in self.replicas:
+                self.replicas.remove(victim)
+        try:
+            victim.close(drain=True)
+        except Exception:   # pragma: no cover - died mid-drain
+            pass
+        self._update_membership()
+        self._m_scale["down"].inc()
+        events.emit("scale", kind="down",
+                    replica=getattr(victim, "name", repr(victim)),
+                    reason=reason, replicas=len(self.replicas))
+        return victim
+
+    def start_autoscaler(self, **kwargs):
+        """Start the SLO-driven autoscaler loop (``serve/autoscale.py``)
+        over ``merged_registry()`` and the membership verbs
+        (``BIGDL_SERVE_AUTOSCALE=1`` auto-starts one at construction).
+        Closed with the pool; idempotent — but kwargs passed to an
+        ALREADY-RUNNING autoscaler (e.g. one the env auto-started) are
+        a config conflict and logged loudly rather than silently
+        dropped."""
+        if self.autoscaler is not None:
+            if kwargs:
+                logger.warning(
+                    "start_autoscaler(%s): an autoscaler is already "
+                    "running (BIGDL_SERVE_AUTOSCALE auto-start?); the "
+                    "new settings are IGNORED — close() it first to "
+                    "reconfigure", ", ".join(sorted(kwargs)))
+            return self.autoscaler
+        from bigdl_tpu.serve import autoscale as autoscale_mod
+        self.autoscaler = autoscale_mod.Autoscaler(self, **kwargs).start()
+        return self.autoscaler
+
+
 # ---------------------------------------------------------------------------
 # the pool
 # ---------------------------------------------------------------------------
 
-class ReplicaPool:
+class ReplicaPool(DynamicMembership):
     """N replicas + router + weight store: the serving control plane.
 
     ``ReplicaPool(model, n_replicas=4)`` builds in-process replicas
@@ -554,36 +767,69 @@ class ReplicaPool:
     shared xcache, so N replicas of one architecture compile each
     bucket ONCE); ``process=True`` spawns subprocess replicas instead.
     ``replicas=[...]`` injects pre-built replicas (tests, heterogeneous
-    pools).  Requests flow ``pool.submit(x, priority=, slo_ms=)`` →
-    router admission → least-loaded replica."""
+    pools) and ``replica_factory=fn(name)`` overrides how NEW replicas
+    are built (tests, custom spawn env).  Requests flow
+    ``pool.submit(x, priority=, slo_ms=)`` → router admission →
+    least-loaded replica.
+
+    Membership is DYNAMIC (docs/serving.md "Autoscaling"):
+    :meth:`add_replica` spawns and warms a replica — through the xcache
+    and the fleet's COMMITTED weight version — before the router may
+    dispatch to it, and :meth:`remove_replica` drains a victim to zero
+    backlog before closing it.  ``BIGDL_SERVE_AUTOSCALE=1`` arms the
+    closed loop (``serve/autoscale.py``) over these verbs."""
 
     def __init__(self, model=None, n_replicas: int | None = None,
                  process: bool = False, replicas=None,
                  slo_ms: float | None = None, shed: bool | None = None,
                  est_ms: float = 50.0, store: WeightStore | None = None,
-                 trace_sample: float | None = None, **engine_kwargs):
+                 trace_sample: float | None = None,
+                 name: str | None = None, replica_factory=None,
+                 **engine_kwargs):
+        self.name = name or f"pool{next(_POOL_SEQ)}"
+        self._model = model
+        self._process = bool(process)
+        self._engine_kwargs = dict(engine_kwargs)
+        self._replica_factory = replica_factory
+        #: serializes membership changes against rollouts: a replica
+        #: added mid-rollout must land on the COMMITTED version, never
+        #: the staged one (the two-phase-rollout bar)
+        self._scale_lock = threading.RLock()
+        #: last version a rollout COMMITTED fleet-wide (None = the
+        #: construction weights; a late spawn then captures the model's
+        #: current weights, the documented engine semantic)
+        self._served_version: int | None = None
+        self._warming = 0
+        self._next_replica = 0
         if replicas is None:
-            if model is None:
-                raise ValueError("ReplicaPool needs a model or replicas")
+            if model is None and replica_factory is None:
+                raise ValueError(
+                    "ReplicaPool needs a model, replicas, or a "
+                    "replica_factory")
             n = replicas_default() if n_replicas is None else int(n_replicas)
-            if process:
-                replicas = [ProcessReplica(model, name=f"proc{i}",
-                                           **engine_kwargs)
-                            for i in range(n)]
-            else:
-                # engine name == replica name, so registry series are
-                # attributable per replica and never collide
-                replicas = [LocalReplica(ServeEngine(model,
-                                                     name=f"local{i}",
-                                                     **engine_kwargs),
-                                         name=f"local{i}")
-                            for i in range(n)]
+            replicas = []
+            try:
+                for _ in range(n):
+                    replicas.append(self._spawn_replica(
+                        self._next_name()))
+            except Exception:
+                # one bad replica fails construction CLEANLY: the
+                # already-spawned good ones are closed, no subprocess
+                # leaks past the raise (the ReplicaSpawnError contract)
+                for r in replicas:
+                    try:
+                        r.close(drain=False)
+                    except Exception:   # pragma: no cover - teardown
+                        pass
+                raise
         self.replicas = list(replicas)
+        self._next_replica = max(self._next_replica, len(self.replicas))
         self.router = Router(self.replicas, slo_ms=slo_ms, shed=shed,
                              est_ms=est_ms, trace_sample=trace_sample)
         self.store = store if store is not None else WeightStore()
         self.exporter = None
         self.alerts = None
+        self._init_membership()
         try:
             # BIGDL_OBS_HBM_SAMPLE=<s>: cadence HBM sampler for the
             # serving process (process-wide, started once)
@@ -603,6 +849,13 @@ class ReplicaPool:
                 # the pool — serve without the exporter instead
                 logger.warning("exporter auto-start on port %d failed "
                                "(%s); pool runs without one", port, e)
+        from bigdl_tpu.serve import autoscale as autoscale_mod
+        if autoscale_mod.autoscale_default():
+            # BIGDL_SERVE_AUTOSCALE=1: close the loop — the SLO-driven
+            # autoscaler watches merged_registry() and drives
+            # add_replica/remove_replica against the env-declared
+            # min/max bounds and cadence
+            self.start_autoscaler()
 
     # -- request path -------------------------------------------------------
     def submit(self, x, priority: int = 1,
@@ -618,6 +871,99 @@ class ReplicaPool:
         futs = self.submit_many(np.asarray(features))
         return np.stack([f.result() for f in futs])
 
+    # -- dynamic membership (docs/serving.md "Autoscaling") -----------------
+    def _next_name(self) -> str:
+        n = self._next_replica
+        self._next_replica += 1
+        return f"{'proc' if self._process else 'local'}{n}"
+
+    def _spawn_replica(self, name: str, env=None, **overrides):
+        """Build one replica the way this pool was configured
+        (``replica_factory`` > subprocess > in-process engine).
+        Construction IS the xcache warmup: the engine compiles every
+        bucket before this returns."""
+        if self._replica_factory is not None:
+            return self._replica_factory(name)
+        if self._model is None:
+            raise RuntimeError(
+                "dynamic membership needs the pool's model (this pool "
+                "was built from pre-built replicas; pass "
+                "replica_factory= to scale it)")
+        kw = dict(self._engine_kwargs)
+        kw.update(overrides)
+        if self._process:
+            # a pool-level env={...} (chaos plans, worker platform)
+            # lives in engine_kwargs for back-compat with the old
+            # inline-construction path; the per-call env= wins
+            if env is None:
+                env = kw.pop("env", None)
+            else:
+                kw.pop("env", None)
+            return ProcessReplica(self._model, name=name, env=env, **kw)
+        return LocalReplica(ServeEngine(self._model, name=name, **kw),
+                            name=name)
+
+    def add_replica(self, name: str | None = None,
+                    reason: str = "manual", env=None, **overrides):
+        """Spawn, WARM, then register one replica.  The warmup bar: the
+        replica compiles its executables at construction (through the
+        shared xcache — an identical architecture costs zero new
+        compiles) and is rolled to the fleet's COMMITTED weight version
+        before the router may dispatch to it.  A rollout racing this
+        call wins: the warm loop re-stages until the version it warmed
+        to is still the committed one at registration time, so a
+        scale-up mid-rollout can never serve a staged-but-uncommitted
+        version.  Emits a schema-validated ``scale``/``up`` event;
+        spawn/warm failure closes the half-built replica and re-raises
+        (the autoscaler's retry/backoff + circuit breaker sit above
+        this)."""
+        from bigdl_tpu.obs import events
+        if name is None:
+            with self._scale_lock:
+                name = self._next_name()
+        with self._scale_lock:
+            self._warming += 1
+        self._update_membership()
+        try:
+            replica = self._spawn_replica(name, env=env, **overrides)
+        except Exception:
+            with self._scale_lock:
+                self._warming -= 1
+            self._update_membership()
+            raise
+        try:
+            while True:
+                with self._scale_lock:
+                    version = self._served_version
+                if (version is not None
+                        and replica.weights_version() != version):
+                    params, state = self.store.get(version)
+                    replica.stage_weights(params, state, version)
+                    replica.commit_weights()
+                with self._scale_lock:
+                    if self._served_version == version:
+                        # still the committed version: take traffic
+                        self.replicas.append(replica)
+                        self.router.add_replica(replica)
+                        self._warming -= 1
+                        break
+                # a rollout committed while we warmed — re-warm to the
+                # new served version before touching the dispatch set
+        except Exception:
+            with self._scale_lock:
+                self._warming -= 1
+            self._update_membership()
+            try:
+                replica.close(drain=False)
+            except Exception:   # pragma: no cover - already dead
+                pass
+            raise
+        self._update_membership()
+        self._m_scale["up"].inc()
+        events.emit("scale", kind="up", replica=name, reason=reason,
+                    replicas=len(self.replicas))
+        return replica
+
     # -- rollout ------------------------------------------------------------
     def rollout(self, params=None, state=None,
                 version: int | None = None) -> int:
@@ -625,7 +971,18 @@ class ReplicaPool:
         Pass (params, state) to publish new weights, or ``version`` to
         roll the fleet to/back to a stored version.  Returns the served
         version; raises :class:`RolloutError` (after converging every
-        replica back to the prior version) when any replica fails."""
+        replica back to the prior version) when any replica fails.
+
+        Serialized against dynamic membership (``_scale_lock``): a
+        replica being ADDED during the stage→commit window warms to the
+        version this rollout commits before it may take traffic, and a
+        DRAINING replica is excluded from the target set — its backlog
+        finishes on the version it already has, and its mid-drain close
+        can never fail the commit."""
+        with self._scale_lock:
+            return self._rollout_locked(params, state, version)
+
+    def _rollout_locked(self, params, state, version) -> int:
         from bigdl_tpu.obs import events
 
         if params is not None:
@@ -635,7 +992,7 @@ class ReplicaPool:
             if version is None:
                 raise ValueError("rollout with an empty WeightStore")
         params, state = self.store.get(version)
-        reps = self.router.live_replicas()
+        reps = self.router.live_replicas(draining=False)
         if not reps:
             raise RolloutError("no live replica to roll out to")
         events.emit("serve", kind="rollout_begin", version=version,
@@ -681,9 +1038,18 @@ class ReplicaPool:
             raise RolloutError(
                 f"commit phase failed; fleet reverted: {e}") from e
 
+        self._served_version = version
         events.emit("serve", kind="rollout_commit", version=version,
                     replicas=len(committed))
         return version
+
+    @property
+    def served_version(self) -> int | None:
+        """The last version a rollout committed fleet-wide (None until
+        the first rollout: replicas serve their construction capture).
+        The warm bar :meth:`add_replica` rolls a new replica to."""
+        with self._scale_lock:
+            return self._served_version
 
     # -- telemetry / lifecycle ----------------------------------------------
     def merged_registry(self) -> dict:
@@ -703,7 +1069,7 @@ class ReplicaPool:
         registry."""
         from bigdl_tpu.obs import metrics as obs_metrics
         snaps = [obs_metrics.get().snapshot()]
-        for r in self.replicas:
+        for r in list(self.replicas):   # membership may change under us
             try:
                 snaps.append(r.registry_snapshot())
             except Exception:  # pragma: no cover - racing a death
@@ -755,7 +1121,7 @@ class ReplicaPool:
         from bigdl_tpu.obs import metrics as obs_metrics
         out = {"router": self.router.stats(), "replicas": []}
         snaps = [obs_metrics.get().snapshot()]
-        for r in self.replicas:
+        for r in list(self.replicas):
             entry = {"name": getattr(r, "name", repr(r)),
                      "alive": False}
             try:
@@ -785,6 +1151,10 @@ class ReplicaPool:
         return self
 
     def close(self, drain: bool = True):
+        if self.autoscaler is not None:
+            # first: a scale decision must not race the teardown
+            self.autoscaler.close()
+            self.autoscaler = None
         if drain:
             try:
                 self.router.drain()
@@ -797,11 +1167,18 @@ class ReplicaPool:
             self.exporter.close()
             self.exporter = None
         self.router.close()
-        for r in self.replicas:
+        for r in list(self.replicas):
             try:
                 r.close(drain=drain)
             except Exception:  # pragma: no cover
                 pass
+        try:
+            # uniquely-labelled, possibly short-lived membership/scale
+            # series die with the pool (the decoder/tier precedent)
+            from bigdl_tpu.obs import metrics as obs_metrics
+            obs_metrics.get().drop_series(pool=self.name)
+        except Exception:   # pragma: no cover - registry mid-teardown
+            pass
 
     def __enter__(self):
         return self
@@ -839,6 +1216,15 @@ def replica_main(stdin=None, stdout=None):
     init = _read_frame(stdin)
     if init is None or init.get("op") != "init":
         return 2
+    if os.environ.get(ENV_SPAWN_FAIL, "0") != "0":
+        # deterministic spawn-failure chaos: die during the warmup
+        # handshake (init consumed, `ready` never sent) — the parent
+        # must surface a typed ReplicaSpawnError with this line in the
+        # stderr tail, and the autoscaler's circuit breaker must trip
+        # instead of crash-looping
+        print(f"induced spawn failure ({ENV_SPAWN_FAIL}): replica pid "
+              f"{os.getpid()} exiting", file=sys.stderr, flush=True)
+        return 7
     from bigdl_tpu.obs import events as obs_events
     from bigdl_tpu.obs import metrics as obs_metrics
     from bigdl_tpu.obs import trace as obs_trace

@@ -65,7 +65,9 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from bigdl_tpu.serve.cluster import ProcessReplica, _read_frame, _write_frame
+from bigdl_tpu.serve.cluster import (ENV_SPAWN_FAIL, DynamicMembership,
+                                     ProcessReplica, _read_frame,
+                                     _write_frame)
 from bigdl_tpu.serve.decode import (DEFAULT_PAGE_SIZE, ENV_PAGE_SIZE,
                                     ContinuousDecoder, _env_int)
 from bigdl_tpu.serve.kvtier import HostKVTier, host_mb_default
@@ -650,7 +652,9 @@ class FleetRouter(Router):
         keys = self._seed_keys(req)
         best, best_match = None, 0
         if keys:
-            for r in self.live_replicas():
+            # drain-marked replicas are not affinity candidates: a
+            # scale-down victim only finishes what it already holds
+            for r in self.live_replicas(draining=False):
                 m = self.index.match_len(getattr(r, "name", ""), keys)
                 if m > best_match:
                     best, best_match = r, m
@@ -696,6 +700,33 @@ class FleetRouter(Router):
     def _mark_dead(self, replica):
         self.index.forget(getattr(replica, "name", ""))
         super()._mark_dead(replica)
+
+    def _role_gauge(self, replica, present: bool, role: str = "decode"):
+        from bigdl_tpu.obs import metrics as obs_metrics
+        obs_metrics.get().gauge(
+            "serve_replica_role", "replica role (1 = present)",
+            role=role, replica=getattr(replica, "name", "?"),
+            router=self.name).set(1 if present else 0)
+
+    def add_replica(self, replica):
+        super().add_replica(replica)
+        self._role_gauge(replica, True)
+        return replica
+
+    def remove_replica(self, replica):
+        super().remove_replica(replica)
+        self.index.forget(getattr(replica, "name", ""))
+        # drop the role series entirely (not just zero it): serve_top
+        # derives the replica set from the series LABELS, and a fleet
+        # under autoscale churn would otherwise accumulate one stale
+        # series per ever-lived replica
+        try:
+            from bigdl_tpu.obs import metrics as obs_metrics
+            obs_metrics.get().drop_series(
+                replica=getattr(replica, "name", "?"), role="decode",
+                router=self.name)
+        except Exception:   # pragma: no cover - registry mid-teardown
+            pass
 
     # -- the prefill hop ----------------------------------------------------
     def _pick_prefill(self):
@@ -832,7 +863,7 @@ class FleetRouter(Router):
 # the fleet facade
 # ---------------------------------------------------------------------------
 
-class DecodeFleet:
+class DecodeFleet(DynamicMembership):
     """N decode replicas (+ optional prefill replicas) behind one
     :class:`FleetRouter` — the disaggregated-serving entry point.
 
@@ -857,27 +888,44 @@ class DecodeFleet:
                  slo_ms: float | None = None, shed: bool | None = None,
                  est_ms: float = 50.0, trace_sample: float | None = None,
                  max_seed_pages: int = 8, decode_env=None,
-                 prefill_env=None, **decoder_kwargs):
+                 prefill_env=None, name: str | None = None,
+                 replica_factory=None, **decoder_kwargs):
         ps = _page_size_default(decoder_kwargs)
         decoder_kwargs["page_size"] = ps
         kv_quant = decoder_kwargs.get("kv_quant")
+        self.name = name or f"fleet{next(_FLEET_SEQ)}"
+        self._model = model
+        self._process = bool(process)
+        self._decoder_kwargs = dict(decoder_kwargs)
+        self._host_mb = host_mb
+        self._decode_env = decode_env
+        self._replica_factory = replica_factory
+        self._scale_lock = threading.RLock()
+        self._warming = 0
+        self._next_decode = 0
         if replicas is None:
-            if model is None:
-                raise ValueError("DecodeFleet needs a model or replicas")
+            if model is None and replica_factory is None:
+                raise ValueError("DecodeFleet needs a model, replicas, "
+                                 "or a replica_factory")
             n = (replicas_default() if n_decode is None
                  else max(1, int(n_decode)))
-            if process:
-                replicas = [
-                    ProcessDecodeReplica(model, name=f"decode{i}",
-                                         env=decode_env, host_mb=host_mb,
-                                         **decoder_kwargs)
-                    for i in range(n)]
-            else:
-                replicas = [
-                    DecodeReplica(model, name=f"decode{i}",
-                                  host_mb=host_mb, **decoder_kwargs)
-                    for i in range(n)]
+            replicas = []
+            try:
+                for _ in range(n):
+                    replicas.append(
+                        self._spawn_replica(self._next_name()))
+            except Exception:
+                # one bad replica fails construction cleanly: close the
+                # good ones, leak no subprocess (the ReplicaPool /
+                # ReplicaSpawnError contract)
+                for r in replicas:
+                    try:
+                        r.close(drain=False)
+                    except Exception:   # pragma: no cover - teardown
+                        pass
+                raise
         self.replicas = list(replicas)
+        self._next_decode = max(self._next_decode, len(self.replicas))
         if prefill is None:
             m = (prefill_replicas_default() if n_prefill is None
                  else max(0, int(n_prefill)))
@@ -902,12 +950,77 @@ class DecodeFleet:
             self.replicas, prefill=self.prefill_replicas,
             affinity=affinity, page_size=ps, slo_ms=slo_ms, shed=shed,
             est_ms=est_ms, trace_sample=trace_sample)
+        self._init_membership()
         from bigdl_tpu.obs import events
         events.emit("serve", kind="fleet_start",
                     replicas=len(self.replicas),
                     prefill_replicas=len(self.prefill_replicas),
                     affinity=self.router.affinity_enabled,
                     page_size=ps)
+        from bigdl_tpu.serve import autoscale as autoscale_mod
+        if autoscale_mod.autoscale_default():
+            self.start_autoscaler()
+
+    # -- dynamic membership (docs/serving.md "Autoscaling") -----------------
+    def _next_name(self) -> str:
+        n = self._next_decode
+        self._next_decode += 1
+        return f"decode{n}"
+
+    def _spawn_replica(self, name: str, env=None):
+        """Build one decode replica the way this fleet was configured.
+        Construction IS the warmup: the decoder pre-compiles its
+        step/admit/retire programs through the xcache (an identical
+        configuration costs zero new compiles) before the router may
+        dispatch to it."""
+        if self._replica_factory is not None:
+            return self._replica_factory(name)
+        if self._model is None:
+            raise RuntimeError(
+                "dynamic membership needs the fleet's model (this "
+                "fleet was built from pre-built replicas; pass "
+                "replica_factory= to scale it)")
+        if self._process:
+            return ProcessDecodeReplica(
+                self._model, name=name,
+                env=env if env is not None else self._decode_env,
+                host_mb=self._host_mb, **self._decoder_kwargs)
+        return DecodeReplica(self._model, name=name,
+                             host_mb=self._host_mb,
+                             **self._decoder_kwargs)
+
+    # membership()/_update_membership()/remove_replica()/
+    # start_autoscaler() come from DynamicMembership — only the decode
+    # replicas scale (prefill replicas are not autoscaled)
+
+    def add_replica(self, name: str | None = None,
+                    reason: str = "manual", env=None):
+        """Spawn and warm one decode replica, then register it with the
+        affinity router (``scale``/``up`` event; the ReplicaPool
+        contract — decode replicas carry no weight versions, so warmup
+        is the construction compile pass alone)."""
+        from bigdl_tpu.obs import events
+        with self._scale_lock:
+            if name is None:
+                name = self._next_name()
+            self._warming += 1
+        self._update_membership()
+        try:
+            replica = self._spawn_replica(name, env=env)
+        except Exception:
+            with self._scale_lock:
+                self._warming -= 1
+            self._update_membership()
+            raise
+        with self._scale_lock:
+            self.replicas.append(replica)
+            self.router.add_replica(replica)
+            self._warming -= 1
+        self._update_membership()
+        self._m_scale["up"].inc()
+        events.emit("scale", kind="up", replica=name, reason=reason,
+                    replicas=len(self.replicas))
+        return replica
 
     # -- request path -------------------------------------------------------
     def submit(self, seed, n_words: int, priority: int = 1,
@@ -969,6 +1082,9 @@ class DecodeFleet:
         return self
 
     def close(self, drain: bool = True):
+        if self.autoscaler is not None:
+            self.autoscaler.close()
+            self.autoscaler = None
         if drain:
             try:
                 self.router.drain()
@@ -989,6 +1105,11 @@ class DecodeFleet:
                     affinity_misses=rstats.get("affinity_misses", 0),
                     prefill_shipped=rstats.get("prefill_shipped", 0),
                     prefill_fallback=rstats.get("prefill_fallback", 0))
+        try:
+            from bigdl_tpu.obs import metrics as obs_metrics
+            obs_metrics.get().drop_series(pool=self.name)
+        except Exception:   # pragma: no cover - registry mid-teardown
+            pass
 
     def __enter__(self):
         return self
@@ -1026,6 +1147,13 @@ def fleet_main(stdin=None, stdout=None):
     init = _read_frame(stdin)
     if init is None or init.get("op") != "init":
         return 2
+    if os.environ.get(ENV_SPAWN_FAIL, "0") != "0":
+        # deterministic spawn-failure chaos (cluster.replica_main's
+        # site): die during the warmup handshake so the parent raises a
+        # typed ReplicaSpawnError with this tail
+        print(f"induced spawn failure ({ENV_SPAWN_FAIL}): fleet replica "
+              f"pid {os.getpid()} exiting", file=sys.stderr, flush=True)
+        return 7
     from bigdl_tpu.obs import events as obs_events
     from bigdl_tpu.obs import metrics as obs_metrics
     from bigdl_tpu.obs import trace as obs_trace

@@ -178,6 +178,11 @@ class Router:
         self._outstanding: dict = {id(r): {} for r in self.replicas}
         self._dispatching = 0   # popped from the heap, not yet routed
         self._dead: set = set()
+        #: drain-only replicas (scale-down victims): dispatch skips
+        #: them, but their in-flight/queued requests still complete and
+        #: requeue-on-death still covers them — the zero-dropped-futures
+        #: drain contract (docs/serving.md "Autoscaling")
+        self._draining: set = set()
         self._closed = False
 
         # monotonic counters (stats(); never reset — see engine.stats),
@@ -433,12 +438,18 @@ class Router:
     def _pick(self):
         """Least-loaded live replica (outstanding count through this
         router + the replica's own inflight signal); returns
-        ``(replica, load)`` or ``(None, 0)``."""
+        ``(replica, load)`` or ``(None, 0)``.  Drain-marked replicas
+        are skipped while any other live replica exists — they only
+        finish what they already hold — but remain the fallback when
+        the whole pool is draining (a request must never fail while a
+        live replica could serve it)."""
         best, best_load = None, None
+        drain_best, drain_load = None, None
         with self._lock:
             dead = set(self._dead)
+            draining = set(self._draining)
             outs = {k: len(v) for k, v in self._outstanding.items()}
-        for r in self.replicas:
+        for r in list(self.replicas):
             if id(r) in dead:
                 continue
             try:
@@ -449,8 +460,14 @@ class Router:
             except Exception:
                 self._mark_dead(r)
                 continue
+            if id(r) in draining:
+                if drain_load is None or load < drain_load:
+                    drain_best, drain_load = r, load
+                continue
             if best_load is None or load < best_load:
                 best, best_load = r, load
+        if best is None and drain_best is not None:
+            return drain_best, (drain_load or 0)
         return best, (best_load or 0)
 
     def _on_done(self, replica, req, inner):
@@ -582,10 +599,95 @@ class Router:
             if self._stop_health.wait(timeout=interval):
                 return
 
-    def live_replicas(self) -> list:
+    def live_replicas(self, draining: bool = True) -> list:
+        """Replicas not marked dead; ``draining=False`` additionally
+        excludes drain-only replicas (rollouts target this set — a
+        scale-down victim finishes its backlog on the version it has)."""
         with self._lock:
             dead = set(self._dead)
-        return [r for r in self.replicas if id(r) not in dead]
+            drain = set() if draining else set(self._draining)
+        return [r for r in self.replicas
+                if id(r) not in dead and id(r) not in drain]
+
+    # -- dynamic membership (serve/autoscale.py, docs/serving.md) -----------
+    def add_replica(self, replica):
+        """Register a (warmed) replica with the dispatch set.  The
+        caller owns the warmup contract: by the time a replica is added
+        here it must already serve the fleet's committed weight version
+        with its executables compiled (``ReplicaPool.add_replica``)."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("Router is closed")
+            if replica in self.replicas:
+                return replica
+            self.replicas.append(replica)
+            self._outstanding.setdefault(id(replica), {})
+            # a replica object reused after a previous drain/removal
+            # re-enters clean
+            self._dead.discard(id(replica))
+            self._draining.discard(id(replica))
+            self._cv.notify()
+        self._emit("replica_added",
+                   replica=getattr(replica, "name", repr(replica)),
+                   replicas=len(self.replicas))
+        return replica
+
+    def mark_draining(self, replica, draining: bool = True):
+        """Flip a replica's drain-only state: dispatch skips it (while
+        another live replica exists) but its queued/in-flight requests
+        run to completion, and requeue-on-death still covers it."""
+        with self._lock:
+            if draining:
+                self._draining.add(id(replica))
+            else:
+                self._draining.discard(id(replica))
+        if draining:
+            self._emit("replica_draining",
+                       replica=getattr(replica, "name", repr(replica)))
+
+    def is_draining(self, replica) -> bool:
+        with self._lock:
+            return id(replica) in self._draining
+
+    def pending_for(self, replica) -> int:
+        """Requests this router has dispatched to ``replica`` that have
+        not resolved yet (the drain-wait signal)."""
+        with self._lock:
+            return len(self._outstanding.get(id(replica), {}))
+
+    def remove_replica(self, replica):
+        """Detach a replica from the router.  The caller must have
+        drained it first (``mark_draining`` + wait on ``pending_for``);
+        any request still outstanding is requeued like a death sweep —
+        removal NEVER drops a future."""
+        with self._lock:
+            try:
+                self.replicas.remove(replica)
+            except ValueError:
+                return
+            orphans = list(
+                self._outstanding.pop(id(replica), {}).values())
+            self._dead.discard(id(replica))
+            self._draining.discard(id(replica))
+        for req in orphans:   # a caller that skipped the drain wait
+            if req.future.done() or req.queued:
+                continue
+            # same budget as the death sweep: removal must not grant a
+            # request more retries than a death would
+            if req.attempts >= self.max_requeues:
+                self._fail(req, DeadReplicaError(
+                    "replica removed and requeue budget is exhausted"))
+                continue
+            req.attempts += 1
+            with self._cv:
+                if self._push(req):
+                    self._m_req["requeued"].inc()
+                    if req.trace is not None:
+                        req.trace.stamp("requeue")
+                    self._cv.notify()
+        self._emit("replica_removed",
+                   replica=getattr(replica, "name", repr(replica)),
+                   replicas=len(self.replicas))
 
     # -- telemetry / lifecycle ----------------------------------------------
     def _emit(self, kind: str, **fields):
@@ -601,6 +703,7 @@ class Router:
             est_ms = self._est_s * 1e3
             est_ttft_ms = self._est_ttft_s * 1e3
             dead = len(self._dead)
+            draining = len(self._draining)
         return {
             "accepted": self.accepted,
             "shed": self.shed,
@@ -613,6 +716,7 @@ class Router:
             "ttft_slo_ms": self.ttft_slo_s * 1e3,
             "replicas": len(self.replicas),
             "dead_replicas": dead,
+            "draining_replicas": draining,
         }
 
     def drain(self, timeout: float = 60.0):

@@ -24,4 +24,12 @@ echo "== chaos drill: 4-proc kill -> recover -> converge (elastic, slow) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_multiprocess.py -q \
     -m "elastic and slow" -p no:cacheprovider "$@"
 
+echo "== chaos drill: serving capstone (burst + serve_kill + rollout + autoscale) =="
+# the self-healing-fleet drill (docs/serving.md "Autoscaling"): both
+# the fast in-process variant and the slow subprocess serve_kill
+# variant; scripts/serve_smoke.sh runs the same pair on the serving
+# side — one drill, two entry points
+JAX_PLATFORMS=cpu python -m pytest tests/test_autoscale.py -q \
+    -k "CapstoneChaosDrill" -p no:cacheprovider "$@"
+
 echo "chaos drill: all green"

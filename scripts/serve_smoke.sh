@@ -32,7 +32,17 @@
 #     pages + a calibrated int8-weight engine — greedy drift within
 #     the declared budget, prefix hit-rate and spec acceptance equal
 #     to the fp run within tolerance, zero cold compiles — plus
-#     tools/quant_check.py --strict pinning top1/top5 within budget.
+#     tools/quant_check.py --strict pinning top1/top5 within budget;
+#   - CAPSTONE CHAOS DRILL (docs/serving.md "Autoscaling"): seeded
+#     bursty traffic + a mid-burst replica kill + a hot weight rollout
+#     + an SLO-driven autoscale-up — every future resolves exactly
+#     once (completed+shed+failed == accepted), sheds stay inside the
+#     declared overload window, the scale-up replica warms through the
+#     xcache + committed weights before taking traffic (zero cold
+#     compiles once serving), and the scale/recovery timeline renders
+#     in obs_report.  The fast in-process variant runs here directly;
+#     the subprocess serve_kill variant is the slow+chaos-marked
+#     pytest drill (scripts/chaos_drill.sh runs it too).
 #
 #   scripts/serve_smoke.sh              # full set + drills
 #   scripts/serve_smoke.sh -k deadline  # narrow (skips the drills)
@@ -40,10 +50,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-python -m pytest -q -m "(serve or quant or stream) and not slow" \
+python -m pytest -q -m "(serve or quant or stream or autoscale) and not slow" \
     -p no:cacheprovider -p no:randomly \
     tests/test_serve.py tests/test_serve_cluster.py tests/test_quant.py \
-    tests/test_streaming.py \
+    tests/test_streaming.py tests/test_autoscale.py \
     "$@"
 
 # The narrowed form is a targeted check; the drill needs the full run.
@@ -538,4 +548,19 @@ PY
 python tools/obs_report.py "$OBSRUN" --strict -o "$OBSRUN/report.md"
 grep -q "Trace waterfall" "$OBSRUN/report.md"
 echo "OK: trace waterfall rendered ($OBSRUN/report.md)"
+
+echo "== serve smoke: capstone chaos drill (burst + kill + rollout + autoscale) =="
+# fast in-process variant (the tier-1 drill, run end to end here)
+python -m pytest -q -p no:cacheprovider -p no:randomly \
+    tests/test_autoscale.py::TestCapstoneChaosDrill
+# subprocess variant: serve_kill chaos mid-burst, 2 ProcessReplicas +
+# an autoscale-up whose replica warms its own xcache before traffic
+python -m pytest -q -p no:cacheprovider -p no:randomly \
+    tests/test_autoscale.py::TestCapstoneChaosDrillSubprocess
+# the seeded bursty traffic generator holds its accounting contract
+# (accepted == completed + shed + failed) on a live 2-replica pool
+python tools/bench_serve.py --traffic --model lenet --requests 120 \
+    --replicas 2 --base-rps 60 --burst-factor 6 --burst-start-s 0.5 \
+    --burst-len-s 0.5 --slo-ms 150 --check
+echo "OK: capstone chaos drill green"
 echo "serve smoke: all green"

@@ -1,0 +1,1215 @@
+"""Self-healing fleet suite (docs/serving.md "Autoscaling", markers
+``serve`` + ``autoscale``).
+
+Covers the PR's tentpole contracts:
+
+- the Router's DRAIN-ONLY replica state: dispatch skips drain-marked
+  replicas (falling back to them only when nothing else lives), their
+  queued/in-flight requests still complete, and requeue-on-death still
+  covers them while a drain is pending;
+- ``ReplicaPool`` dynamic membership: ``add_replica`` warms through the
+  xcache and the WeightStore's COMMITTED version before taking traffic
+  (a scale-up mid-rollout lands on the committed version — the
+  two-phase bar), ``remove_replica`` drains to zero backlog with zero
+  dropped futures, and a removal pending mid-rollout never blocks the
+  commit;
+- spawn hardening: a child dying during the warmup handshake surfaces
+  as a typed :class:`ReplicaSpawnError` carrying the stderr tail, and
+  pool construction with one bad replica closes the good ones (no
+  leaked subprocesses);
+- the :class:`Autoscaler` policy: windowed signals computed with the
+  serve_top/alerts arithmetic, asymmetric hysteresis, cooldown, bounds,
+  and the spawn circuit breaker (jittered retry/backoff degrading to a
+  ``fleet_scale_frozen`` alert instead of a crash loop);
+- the seeded traffic generator (``tools/bench_serve.py --traffic``):
+  deterministic Poisson arrivals, burst/diurnal envelopes, priority
+  mixes, and the pinned ``traffic`` JSON row contract;
+- the capstone chaos drill (fast in-process variant; the subprocess
+  variant is slow+chaos): bursty load + a mid-burst replica kill + a
+  hot weight rollout + an autoscale-up — every future resolves exactly
+  once, sheds stay inside the declared overload window, and the whole
+  scale/recovery timeline renders in obs_report.
+"""
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.obs import alerts as obs_alerts
+from bigdl_tpu.obs import metrics
+from bigdl_tpu.obs.events import read_events, validate_event
+from bigdl_tpu.serve import (Autoscaler, DeadReplicaError, ReplicaPool,
+                             ReplicaSpawnError, Router)
+from bigdl_tpu.serve.autoscale import (interval_default,
+                                       max_replicas_default,
+                                       min_replicas_default)
+
+pytestmark = [pytest.mark.serve, pytest.mark.autoscale]
+
+
+# ---------------------------------------------------------------------------
+# fakes: deterministic replicas wearing the full rollout surface
+# ---------------------------------------------------------------------------
+
+class ScalableFake:
+    """Deterministic replica with the FULL pool surface (submit +
+    rollout verbs + kill): resolves each submit on a worker thread
+    after ``service_s``; output = input x the committed version's
+    multiplier (version-discriminating, like the hot-swap drill)."""
+
+    def __init__(self, name="fake", service_s=0.0):
+        self.name = name
+        self.service_s = service_s
+        self.submitted = 0
+        self.closed = False
+        self._alive = True
+        self._lock = threading.Lock()
+        self._n_inflight = 0
+        self._version = 0
+        self._mult = 1.0
+        self._staged = None
+        self._prev = None
+        self.stage_began = threading.Event()
+
+    def submit(self, x):
+        with self._lock:
+            self.submitted += 1
+            self._n_inflight += 1
+        fut = Future()
+
+        def work():
+            if self.service_s:
+                time.sleep(self.service_s)
+            with self._lock:
+                self._n_inflight -= 1
+                alive, mult = self._alive, self._mult
+            if not alive:
+                fut.set_exception(DeadReplicaError(self.name))
+            else:
+                fut.set_result(np.asarray(x, np.float64) * mult)
+
+        threading.Thread(target=work, daemon=True).start()
+        return fut
+
+    def inflight(self):
+        with self._lock:
+            return self._n_inflight
+
+    def alive(self):
+        return self._alive
+
+    def stats(self):
+        return {"name": self.name, "submitted": self.submitted}
+
+    def registry_snapshot(self):
+        return None
+
+    # -- rollout verbs ------------------------------------------------------
+    def weights_version(self):
+        return self._version
+
+    def stage_weights(self, params, state, version=None):
+        self.stage_began.set()
+        self._staged = (params, version)
+
+    def commit_weights(self):
+        params, version = self._staged
+        self._prev = (self._version, self._mult)
+        self._version = (version if version is not None
+                         else self._version + 1)
+        if isinstance(params, dict) and "mult" in params:
+            self._mult = float(np.asarray(params["mult"]))
+        self._staged = None
+        return self._version
+
+    def rollback_weights(self):
+        self._staged = None
+
+    def revert_weights(self):
+        self._version, self._mult = self._prev
+        return self._version
+
+    # -- chaos --------------------------------------------------------------
+    def kill(self):
+        with self._lock:
+            self._alive = False
+
+    def close(self, drain=True):
+        self.closed = True
+        self._alive = False
+
+
+class SlowStageFake(ScalableFake):
+    """Stage phase sleeps — holds a rollout open so a concurrent
+    add_replica provably lands AFTER the commit."""
+
+    def __init__(self, *a, stage_s=0.3, **kw):
+        super().__init__(*a, **kw)
+        self.stage_s = stage_s
+
+    def stage_weights(self, params, state, version=None):
+        self.stage_began.set()
+        time.sleep(self.stage_s)
+        super().stage_weights(params, state, version)
+
+
+def _fake_pool(n=2, service_s=0.0, cls=ScalableFake, **pool_kwargs):
+    made = []
+
+    def factory(name):
+        r = cls(name, service_s=service_s)
+        made.append(r)
+        return r
+
+    pool = ReplicaPool(n_replicas=n, replica_factory=factory,
+                       shed=pool_kwargs.pop("shed", False),
+                       **pool_kwargs)
+    return pool, made
+
+
+# ---------------------------------------------------------------------------
+# router: drain-only state
+# ---------------------------------------------------------------------------
+
+class TestRouterDrain:
+    def test_dispatch_skips_draining_replica(self):
+        a, b = ScalableFake("a", 0.005), ScalableFake("b", 0.005)
+        with Router([a, b], shed=False) as router:
+            router.mark_draining(a)
+            assert router.is_draining(a) and not router.is_draining(b)
+            futs = [router.submit(np.full((2,), i, np.float64))
+                    for i in range(12)]
+            for f in futs:
+                f.result(timeout=10)
+            assert a.submitted == 0, "dispatch reached a draining replica"
+            assert b.submitted == 12
+            assert router.stats()["draining_replicas"] == 1
+
+    def test_draining_inflight_completes(self):
+        """A request already ON the victim when the drain lands still
+        completes there — drain-only, not kill."""
+        a, b = ScalableFake("a", 0.2), ScalableFake("b", 0.0)
+        with Router([a, b], shed=False) as router:
+            f0 = router.submit(np.full((2,), 7, np.float64))
+            t0 = time.time()
+            while a.submitted == 0 and time.time() - t0 < 5:
+                time.sleep(0.001)
+            assert a.submitted == 1
+            router.mark_draining(a)
+            assert np.array_equal(f0.result(timeout=10),
+                                  np.full((2,), 7.0))
+            assert router.stats()["failed"] == 0
+
+    def test_requeue_on_death_while_drain_pending(self):
+        """The satellite regression: a draining replica DYING with work
+        in flight still requeues onto a survivor — zero lost futures,
+        and the shed/requeue semantics hold mid-drain."""
+        victim = ScalableFake("victim", 0.15)
+        healthy = ScalableFake("healthy", 0.0)
+        with Router([victim, healthy], shed=False) as router:
+            f0 = router.submit(np.full((2,), 3, np.float64))
+            t0 = time.time()
+            while victim.submitted == 0 and time.time() - t0 < 5:
+                time.sleep(0.001)
+            router.mark_draining(victim)
+            victim.kill()          # dies mid-drain, request in flight
+            futs = [router.submit(np.full((2,), i, np.float64))
+                    for i in range(5)]
+            assert np.array_equal(f0.result(timeout=10),
+                                  np.full((2,), 3.0))
+            for i, f in enumerate(futs):
+                assert np.array_equal(f.result(timeout=10),
+                                      np.full((2,), float(i)))
+        s = router.stats()
+        assert s["failed"] == 0 and s["shed"] == 0
+        assert s["requeued"] >= 1
+        assert s["completed"] == 6
+
+    def test_all_draining_falls_back(self):
+        """Marking the whole pool draining must not strand requests:
+        drain-only replicas are the dispatch fallback of last resort."""
+        a = ScalableFake("a", 0.0)
+        with Router([a], shed=False) as router:
+            router.mark_draining(a)
+            f = router.submit(np.full((2,), 5, np.float64))
+            assert np.array_equal(f.result(timeout=10),
+                                  np.full((2,), 5.0))
+        assert a.submitted == 1
+
+    def test_remove_replica_respects_requeue_budget(self):
+        """Removal grants no more retries than a death would: a request
+        whose requeue budget is exhausted fails deterministically
+        instead of bouncing through membership churn forever."""
+        a, b = ScalableFake("a", 0.3), ScalableFake("b", 0.0)
+        with Router([a, b], shed=False, max_requeues=0) as router:
+            f = router.submit(np.full((2,), 1, np.float64))
+            t0 = time.time()
+            while a.submitted == 0 and time.time() - t0 < 5:
+                time.sleep(0.001)
+            a.kill()
+            router.remove_replica(a)
+            with pytest.raises(DeadReplicaError):
+                f.result(timeout=10)
+
+    def test_remove_replica_requeues_leftovers(self):
+        """remove_replica without a prior drain wait requeues the
+        victim's outstanding work like a death sweep — removal can
+        never drop a future."""
+        a, b = ScalableFake("a", 0.25), ScalableFake("b", 0.0)
+        with Router([a, b], shed=False) as router:
+            f = router.submit(np.full((2,), 9, np.float64))
+            t0 = time.time()
+            while a.submitted == 0 and time.time() - t0 < 5:
+                time.sleep(0.001)
+            a.kill()        # its in-flight resolution would be a death
+            router.remove_replica(a)
+            assert np.array_equal(f.result(timeout=10),
+                                  np.full((2,), 9.0))
+            assert router.stats()["failed"] == 0
+            assert len(router.replicas) == 1
+
+
+# ---------------------------------------------------------------------------
+# pool: dynamic membership x rollout
+# ---------------------------------------------------------------------------
+
+class TestPoolMembership:
+    def test_remove_under_load_zero_dropped_futures(self):
+        pool, made = _fake_pool(3, service_s=0.005)
+        futs, stop = [], threading.Event()
+
+        def load():
+            for i in range(120):
+                futs.append(pool.submit(np.full((2,), i, np.float64)))
+                time.sleep(0.001)
+            stop.set()
+
+        t = threading.Thread(target=load)
+        t.start()
+        time.sleep(0.03)
+        victim = pool.remove_replica(reason="test")
+        t.join(30)
+        assert stop.is_set()
+        for i, f in enumerate(futs):
+            assert np.array_equal(f.result(timeout=30),
+                                  np.full((2,), float(i)))
+        s = pool.router.stats()
+        assert s["failed"] == 0 and s["shed"] == 0
+        assert victim.closed and victim not in pool.replicas
+        assert len(pool.replicas) == 2
+        assert pool.membership() == {"live": 2, "warming": 0,
+                                     "draining": 0}
+        pool.close()
+
+    def test_remove_refuses_last_live_replica(self):
+        pool, _ = _fake_pool(1)
+        with pytest.raises(ValueError):
+            pool.remove_replica()
+        pool.close()
+
+    def test_add_mid_rollout_lands_on_committed_version(self):
+        """THE two-phase bar: a replica added while a rollout is
+        between stage and commit must come up on the version the
+        rollout COMMITS — never the staged-uncommitted one, never the
+        stale one."""
+        pool, made = _fake_pool(2, cls=SlowStageFake)
+        err = []
+
+        def roll():
+            try:
+                pool.rollout({"mult": np.float64(2.0)}, {})
+            except Exception as e:   # pragma: no cover - assertion aid
+                err.append(e)
+
+        t = threading.Thread(target=roll)
+        t.start()
+        assert made[0].stage_began.wait(5)   # rollout holds the lock
+        added = pool.add_replica(reason="mid-rollout")
+        t.join(30)
+        assert not err
+        assert pool.served_version == 1
+        assert added.weights_version() == 1
+        assert added._mult == 2.0
+        # and traffic through the pool serves only v1 now
+        out = [f.result(timeout=10)
+               for f in [pool.submit(np.full((2,), 3, np.float64))
+                         for _ in range(6)]]
+        for o in out:
+            assert np.array_equal(o, np.full((2,), 6.0))
+        pool.close()
+
+    def test_add_after_stage_before_commit_serves_committed(self):
+        """Weights staged directly on the replicas (no commit) are
+        invisible to a scale-up: the new replica serves the committed
+        version."""
+        pool, made = _fake_pool(2)
+        v = pool.store.put({"mult": np.float64(5.0)}, {})
+        for r in made:
+            r.stage_weights(*pool.store.get(v), v)
+        added = pool.add_replica(reason="staged-not-committed")
+        assert added.weights_version() == 0
+        assert added._staged is None
+        f = pool.submit(np.full((2,), 4, np.float64))
+        assert np.array_equal(f.result(timeout=10), np.full((2,), 4.0))
+        pool.close()
+
+    def test_remove_mid_rollout_does_not_block_commit(self):
+        """A drain pending on a victim with slow in-flight work must
+        not stall the rollout: the commit targets non-draining replicas
+        and returns while the victim is still draining."""
+        pool, made = _fake_pool(2, service_s=0.0)
+        made[0].service_s = 0.6          # the victim's slow request
+        f_slow = pool.submit(np.full((2,), 2, np.float64))
+        t0 = time.time()
+        while made[0].submitted == 0 and time.time() - t0 < 5:
+            time.sleep(0.001)
+        done = {}
+
+        def remove():
+            pool.remove_replica(made[0], reason="test", timeout=30)
+            done["removed_at"] = time.time()
+
+        t = threading.Thread(target=remove)
+        t.start()
+        t0 = time.time()
+        while not pool.router.is_draining(made[0]) \
+                and time.time() - t0 < 5:
+            time.sleep(0.001)
+        version = pool.rollout({"mult": np.float64(2.0)}, {})
+        rolled_at = time.time()
+        t.join(30)
+        assert version == 1
+        assert done["removed_at"] >= rolled_at, (
+            "rollout should not have waited for the drain")
+        # the victim was excluded: it finished its backlog on v0
+        assert np.array_equal(f_slow.result(timeout=10),
+                              np.full((2,), 2.0))
+        assert made[0].weights_version() == 0
+        assert made[1].weights_version() == 1
+        assert pool.router.stats()["failed"] == 0
+        pool.close()
+
+    def test_membership_events_validate(self, obs_run_dir):
+        from bigdl_tpu.obs import events as obs_events
+        pool, _ = _fake_pool(2)
+        pool.add_replica(reason="drill")
+        pool.remove_replica(reason="drill")
+        pool.close()
+        events = read_events(obs_events.get().path)
+        for e in events:
+            validate_event(e)
+        kinds = [(e["type"], e.get("kind")) for e in events]
+        assert ("scale", "up") in kinds
+        assert ("scale", "down") in kinds
+        assert ("serve", "replica_added") in kinds
+        assert ("serve", "replica_draining") in kinds
+        assert ("serve", "replica_removed") in kinds
+        up = next(e for e in events if e["type"] == "scale"
+                  and e["kind"] == "up")
+        assert up["reason"] == "drill" and up["replica"]
+
+    def test_membership_gauges_track_states(self):
+        pool, made = _fake_pool(2)
+        snap = metrics.get().snapshot()
+        assert metrics.family_total(snap, "fleet_replicas",
+                                    state="live") == 2
+        pool.add_replica()
+        snap = metrics.get().snapshot()
+        assert metrics.family_total(snap, "fleet_replicas",
+                                    state="live") == 3
+        assert int(pool._m_scale["up"].value) == 1
+        pool.close()
+        # the pool's uniquely-labelled series die with it
+        snap = metrics.get().snapshot()
+        assert metrics.family_total(snap, "fleet_replicas") == 0
+
+
+# ---------------------------------------------------------------------------
+# spawn hardening
+# ---------------------------------------------------------------------------
+
+class TestSpawnHardening:
+    def test_pool_construction_one_bad_replica_closes_good_ones(self):
+        made = []
+
+        def factory(name):
+            if len(made) == 1:
+                raise RuntimeError("induced factory failure")
+            r = ScalableFake(name)
+            made.append(r)
+            return r
+
+        with pytest.raises(RuntimeError, match="induced factory"):
+            ReplicaPool(n_replicas=3, replica_factory=factory)
+        assert len(made) == 1
+        assert made[0].closed, "the good replica leaked"
+
+    def test_pool_env_kwarg_reaches_spawned_process_replicas(
+            self, monkeypatch):
+        """The pre-PR path `ReplicaPool(process=True, env={...})`
+        shipped env through engine_kwargs; the factored _spawn_replica
+        must keep routing it to ProcessReplica (no duplicate-kwarg
+        TypeError), with a per-call env= override winning."""
+        import bigdl_tpu.serve.cluster as cluster
+        captured = {}
+
+        class FakeProc:
+            def __init__(self, model, name=None, env=None, **kw):
+                captured.update(name=name, env=env, kw=kw)
+
+        monkeypatch.setattr(cluster, "ProcessReplica", FakeProc)
+        pool = ReplicaPool(replicas=[ScalableFake("a")])
+        try:
+            pool._model = object()
+            pool._process = True
+            pool._engine_kwargs = {"env": {"BIGDL_FAULTS": "x"},
+                                   "max_batch": 4}
+            pool._spawn_replica("procX")
+            assert captured["env"] == {"BIGDL_FAULTS": "x"}
+            assert "env" not in captured["kw"]
+            assert captured["kw"] == {"max_batch": 4}
+            pool._spawn_replica("procY", env={"OTHER": "1"})
+            assert captured["env"] == {"OTHER": "1"}
+            assert "env" not in captured["kw"]
+        finally:
+            pool.close()
+
+    @pytest.mark.slow
+    def test_process_spawn_failure_is_typed_with_stderr_tail(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.serve import ProcessReplica
+        from bigdl_tpu.utils.random import set_seed
+        set_seed(1)
+        model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+        with pytest.raises(ReplicaSpawnError) as ei:
+            ProcessReplica(model, name="doomed", spawn_timeout=60.0,
+                           env={"BIGDL_SERVE_SPAWN_FAIL": "1"},
+                           max_batch=4, max_wait_ms=1, input_shape=(4,))
+        err = ei.value
+        assert "induced spawn failure" in str(err)
+        assert any("induced spawn failure" in line
+                   for line in err.stderr_tail)
+
+    @pytest.mark.slow
+    def test_process_pool_bad_replica_no_leaked_subprocesses(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.serve import ProcessReplica
+        from bigdl_tpu.utils.random import set_seed
+        set_seed(1)
+        model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+        made = []
+
+        def factory(name):
+            env = ({"BIGDL_SERVE_SPAWN_FAIL": "1"}
+                   if name.endswith("1") else None)
+            r = ProcessReplica(model, name=name, env=env, max_batch=4,
+                               max_wait_ms=1, input_shape=(4,))
+            made.append(r)
+            return r
+
+        with pytest.raises(ReplicaSpawnError):
+            ReplicaPool(n_replicas=2, replica_factory=factory)
+        assert len(made) == 1      # the good one spawned first...
+        t0 = time.time()
+        while made[0].proc.poll() is None and time.time() - t0 < 30:
+            time.sleep(0.05)
+        assert made[0].proc.poll() is not None, "subprocess leaked"
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler policy (synthetic snapshots: serve_top's exact math)
+# ---------------------------------------------------------------------------
+
+class FakeScalablePool:
+    """The duck-typed pool surface the Autoscaler drives, with
+    countable spawn attempts and injectable spawn failure."""
+
+    def __init__(self, n=2):
+        self.name = "fakepool"
+        self.replicas = [f"r{i}" for i in range(n)]
+        self.spawn_attempts = 0
+        self.removes = 0
+        self.fail_spawn = False
+
+    def merged_registry(self):
+        return metrics.get().snapshot()
+
+    def membership(self):
+        return {"live": len(self.replicas), "warming": 0, "draining": 0}
+
+    def add_replica(self, reason="?"):
+        self.spawn_attempts += 1
+        if self.fail_spawn:
+            raise ReplicaSpawnError(f"induced ({reason})")
+        self.replicas.append(f"r{len(self.replicas)}")
+        return self.replicas[-1]
+
+    def remove_replica(self, reason="?", timeout=0.0):
+        if len(self.replicas) <= 1:
+            raise ValueError("last replica")
+        self.removes += 1
+        return self.replicas.pop()
+
+
+def _snap(queue=0.0, accepted=0, shed=0, failed=0, admission_shed=0,
+          lat_obs=()):
+    """A synthetic merged-registry snapshot in the real wire format."""
+    reg = metrics.Registry()
+    reg.gauge("serve_queue_depth", engine="e").set(queue)
+    for outcome, n in (("accepted", accepted), ("shed", shed),
+                       ("failed", failed)):
+        reg.counter("serve_requests_total", outcome=outcome,
+                    engine="e").inc(n)
+    reg.counter("router_requests_total", outcome="shed",
+                stage="admission", router="r").inc(admission_shed)
+    h = reg.histogram("serve_latency_seconds", engine="e")
+    for v in lat_obs:
+        h.observe(v)
+    return reg.snapshot()
+
+
+class TestAutoscalerPolicy:
+    def _scaler(self, pool, **kw):
+        kw.setdefault("interval", 1.0)
+        kw.setdefault("window_s", 10.0)
+        kw.setdefault("cooldown_s", 0.0)
+        kw.setdefault("up_n", 1)
+        kw.setdefault("down_n", 3)
+        kw.setdefault("min_replicas", 1)
+        kw.setdefault("max_replicas", 4)
+        kw.setdefault("backoff_s", 0.0)
+        kw.setdefault("emit_events", False)
+        return Autoscaler(pool, **kw)
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("BIGDL_SERVE_MIN_REPLICAS", "2")
+        monkeypatch.setenv("BIGDL_SERVE_MAX_REPLICAS", "6")
+        monkeypatch.setenv("BIGDL_SERVE_SCALE_INTERVAL", "0.7")
+        assert min_replicas_default() == 2
+        assert max_replicas_default() == 6
+        assert interval_default() == pytest.approx(0.7)
+        monkeypatch.setenv("BIGDL_SERVE_MAX_REPLICAS", "junk")
+        assert max_replicas_default() == 8
+
+    def test_scale_up_on_queue_depth(self):
+        pool = FakeScalablePool(2)
+        sc = self._scaler(pool, up_queue_depth=8.0)
+        out = sc.evaluate_once(snapshot=_snap(queue=40), now=0.0)
+        assert out["decision"] == "up" and out["acted"]
+        assert "queue/replica 20.0" in out["reason"]
+        assert len(pool.replicas) == 3
+        assert sc.scale_ups == 1
+
+    def test_up_respects_hysteresis_and_cooldown(self):
+        pool = FakeScalablePool(2)
+        sc = self._scaler(pool, up_n=2, cooldown_s=5.0)
+        assert not sc.evaluate_once(snapshot=_snap(queue=40),
+                                    now=0.0)["acted"]
+        assert sc.evaluate_once(snapshot=_snap(queue=40),
+                                now=1.0)["acted"]
+        # inside the cooldown: breaches accumulate but nothing commits
+        assert not sc.evaluate_once(snapshot=_snap(queue=40),
+                                    now=2.0)["acted"]
+        assert sc.evaluate_once(snapshot=_snap(queue=40),
+                                now=20.0)["acted"]
+        assert len(pool.replicas) == 4
+
+    def test_up_bounded_by_max_replicas(self):
+        pool = FakeScalablePool(4)
+        sc = self._scaler(pool, max_replicas=4)
+        out = sc.evaluate_once(snapshot=_snap(queue=100), now=0.0)
+        assert out["decision"] is None
+        assert "at max_replicas" in out["reason"]
+        assert pool.spawn_attempts == 0
+
+    def test_windowed_shed_and_burn_match_alert_arithmetic(self):
+        """The tentpole wiring: the scaler's shed-rate and burn signals
+        are the EXACT windowed-delta numbers serve_top/obs.alerts
+        compute from the same snapshot pair."""
+        pool = FakeScalablePool(2)
+        sc = self._scaler(pool, up_shed_per_s=0.5, budget=0.01)
+        s0 = _snap(accepted=100)
+        sc.evaluate_once(snapshot=s0, now=0.0)     # builds history
+        s1 = _snap(accepted=140, shed=20, admission_shed=10)
+        out = sc.evaluate_once(snapshot=s1, now=10.0)
+        sig = out["signals"]
+        assert sig["shed_per_s"] == pytest.approx(3.0)   # 30 over 10 s
+        assert sig["burn"] == pytest.approx(
+            obs_alerts.slo_burn(s1, s0, 0.01))
+        assert sig["burn"] == pytest.approx((30 / 70) / 0.01)
+        assert out["decision"] == "up"
+        assert "shed rate" in out["reason"]
+
+    def test_windowed_p99_signal(self):
+        pool = FakeScalablePool(2)
+        sc = self._scaler(pool, up_p99_ms=100.0, up_queue_depth=1e9,
+                          up_shed_per_s=1e9, up_burn=1e9)
+        s0 = _snap(lat_obs=[0.001] * 50)
+        sc.evaluate_once(snapshot=s0, now=0.0)
+        # the WINDOW's p99 regressed even though lifetime is dominated
+        # by fast observations — the windowed_counts bucket-delta rule
+        s1 = _snap(lat_obs=[0.001] * 50 + [0.8] * 20)
+        out = sc.evaluate_once(snapshot=s1, now=5.0)
+        assert out["signals"]["p99_ms"] is not None
+        assert out["signals"]["p99_ms"] > 100.0
+        assert out["decision"] == "up" and "p99" in out["reason"]
+
+    def test_scale_down_after_sustained_idle_respects_min(self):
+        pool = FakeScalablePool(3)
+        sc = self._scaler(pool, down_n=3, down_idle_rps=0.5,
+                          min_replicas=2)
+        idle = _snap(accepted=100)
+        outs = [sc.evaluate_once(snapshot=idle, now=float(i))
+                for i in range(6)]
+        downs = [o for o in outs if o["decision"] == "down"]
+        assert len(downs) == 1 and pool.removes == 1
+        assert "idle" in downs[0]["reason"]
+        # at min now: sustained idle never goes below the floor
+        for i in range(6, 12):
+            sc.evaluate_once(snapshot=idle, now=float(i))
+        assert len(pool.replicas) == 2
+
+    def test_traffic_resets_idle_streak(self):
+        pool = FakeScalablePool(2)
+        sc = self._scaler(pool, down_n=3, down_idle_rps=0.5)
+        acc = 100
+        sc.evaluate_once(snapshot=_snap(accepted=acc), now=0.0)
+        sc.evaluate_once(snapshot=_snap(accepted=acc), now=1.0)
+        acc += 50      # a burst of offered traffic lands
+        sc.evaluate_once(snapshot=_snap(accepted=acc), now=2.0)
+        out = sc.evaluate_once(snapshot=_snap(accepted=acc), now=3.0)
+        assert pool.removes == 0 and out["decision"] is None
+
+    def test_spawn_breaker_freezes_then_recovers(self, obs_run_dir):
+        """Repeated spawn failure: jittered retries, then the breaker
+        opens — fleet_scale_frozen gauge + event, NO further spawn
+        attempts while frozen — and a half-open success closes it."""
+        pool = FakeScalablePool(2)
+        pool.fail_spawn = True
+        sc = Autoscaler(pool, interval=1.0, cooldown_s=0.0, up_n=1,
+                        min_replicas=1, max_replicas=4,
+                        spawn_retries=2, backoff_s=0.0, breaker_n=2,
+                        breaker_reset_s=100.0, emit_events=True)
+        hot = _snap(queue=40)
+        sc.evaluate_once(snapshot=hot, now=0.0)     # cycle 1 fails x2
+        assert pool.spawn_attempts == 2 and not sc.frozen(now=0.0)
+        sc.evaluate_once(snapshot=hot, now=1.0)     # cycle 2 -> trips
+        assert pool.spawn_attempts == 4
+        assert sc.frozen(now=1.0)
+        snap = metrics.get().snapshot()
+        assert metrics.family_total(snap, "fleet_scale_frozen") == 1.0
+        assert metrics.family_total(
+            snap, "fleet_scale_failures_total") == 4
+        # frozen: breaches no longer attempt spawns (no crash loop)
+        out = sc.evaluate_once(snapshot=hot, now=2.0)
+        assert pool.spawn_attempts == 4
+        assert out["reason"] == "breaker open (frozen)"
+        # past the reset window: one half-open attempt, which heals
+        pool.fail_spawn = False
+        out = sc.evaluate_once(snapshot=hot, now=500.0)
+        assert out["acted"] and len(pool.replicas) == 3
+        assert not sc.frozen(now=500.0)
+        assert metrics.family_total(metrics.get().snapshot(),
+                                    "fleet_scale_frozen") == 0.0
+        from bigdl_tpu.obs import events as obs_events
+        evs = read_events(obs_events.get().path)
+        for e in evs:
+            validate_event(e)
+        kinds = [e["kind"] for e in evs if e["type"] == "scale"]
+        assert "spawn_failed" in kinds
+        assert "frozen" in kinds and "unfrozen" in kinds
+        frozen_ev = next(e for e in evs if e["type"] == "scale"
+                         and e["kind"] == "frozen")
+        assert frozen_ev["failures"] == 2
+
+    def test_default_alert_rule_fires_on_frozen_gauge(self):
+        metrics.get().gauge("fleet_scale_frozen",
+                            "breaker", agg="max",
+                            pool="p").set(1.0)
+        eng = obs_alerts.AlertEngine(
+            lambda: metrics.get().snapshot(),
+            obs_alerts.default_rules(), emit_events=False)
+        fired = eng.evaluate_once()
+        assert ("fleet_scale_frozen", "firing", 1.0) in fired
+
+    def test_backoff_is_seeded_and_jittered(self):
+        sleeps = []
+        pool = FakeScalablePool(2)
+        pool.fail_spawn = True
+        sc = Autoscaler(pool, spawn_retries=3, backoff_s=0.01,
+                        backoff_jitter=0.5, breaker_n=99, seed=7,
+                        emit_events=False)
+        orig = time.sleep
+        try:
+            time.sleep = lambda s: sleeps.append(s)
+            sc.scale_up("test", now=0.0)
+        finally:
+            time.sleep = orig
+        assert len(sleeps) == 2                  # retries - 1 backoffs
+        assert sleeps[1] > sleeps[0] >= 0.01     # exponential + jitter
+        # seeded: a second scaler with the same seed replays the delays
+        sleeps2 = []
+        sc2 = Autoscaler(FakeScalablePool(2), spawn_retries=3,
+                         backoff_s=0.01, backoff_jitter=0.5,
+                         breaker_n=99, seed=7, emit_events=False)
+        sc2.pool.fail_spawn = True
+        try:
+            time.sleep = lambda s: sleeps2.append(s)
+            sc2.scale_up("test", now=0.0)
+        finally:
+            time.sleep = orig
+        assert sleeps == sleeps2
+
+
+# ---------------------------------------------------------------------------
+# traffic generator + row contract
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def bench_serve():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "tools", "bench_serve.py")
+    spec = importlib.util.spec_from_file_location("bench_serve", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTrafficGenerator:
+    def test_arrivals_seeded_deterministic(self, bench_serve):
+        a = bench_serve.traffic_arrivals(np.random.RandomState(3), 200,
+                                         50.0, burst_factor=4.0,
+                                         burst_start_s=1.0,
+                                         burst_len_s=1.0)
+        b = bench_serve.traffic_arrivals(np.random.RandomState(3), 200,
+                                         50.0, burst_factor=4.0,
+                                         burst_start_s=1.0,
+                                         burst_len_s=1.0)
+        c = bench_serve.traffic_arrivals(np.random.RandomState(4), 200,
+                                         50.0, burst_factor=4.0,
+                                         burst_start_s=1.0,
+                                         burst_len_s=1.0)
+        assert a == b and a != c
+        assert all(t2 > t1 for t1, t2 in zip(a, a[1:]))
+
+    def test_burst_window_concentrates_arrivals(self, bench_serve):
+        rng = np.random.RandomState(0)
+        arr = bench_serve.traffic_arrivals(
+            rng, 600, 50.0, burst_factor=10.0, burst_start_s=1.0,
+            burst_len_s=1.0)
+        in_burst = sum(1 for t in arr if 1.0 <= t < 2.0)
+        pre = sum(1 for t in arr if 0.0 <= t < 1.0)
+        # ~50 arrivals/s outside, ~500/s inside: the burst dominates
+        assert in_burst > 5 * max(pre, 1)
+
+    def test_diurnal_envelope_modulates_rate(self, bench_serve):
+        env = bench_serve.traffic_envelope
+        kw = dict(diurnal_amp=0.5, diurnal_period_s=40.0)
+        assert env(10.0, 100.0, **kw) == pytest.approx(150.0)
+        assert env(30.0, 100.0, **kw) == pytest.approx(50.0)
+        # burst multiplies ON TOP of the diurnal swing
+        assert env(10.0, 100.0, burst_factor=3.0, burst_start_s=5.0,
+                   burst_len_s=10.0, **kw) == pytest.approx(450.0)
+
+    def test_priority_mix_parses_and_draws(self, bench_serve):
+        mix = bench_serve.parse_priority_mix("0:1,2:3")
+        assert mix == [(0, 0.25), (2, 0.75)]
+        pris = bench_serve.traffic_priorities(
+            np.random.RandomState(0), 1000, mix)
+        frac0 = pris.count(0) / 1000
+        assert 0.2 < frac0 < 0.3
+        assert set(pris) == {0, 2}
+        with pytest.raises(ValueError):
+            bench_serve.parse_priority_mix("")
+
+    def test_traffic_row_contract(self, bench_serve):
+        import json
+        spec = {"requests": 10, "seed": 0, "base_rps": 50.0,
+                "burst_factor": 8.0, "burst_start_s": 1.0,
+                "burst_len_s": 1.0, "diurnal_amp": 0.0,
+                "diurnal_period_s": 60.0, "priority_mix": "0:0.2,2:0.8"}
+        outcome = {"requests": 10, "wall_s": 0.5, "offered_rps": 20.0,
+                   "accepted": 10, "completed": 8, "shed": 2,
+                   "failed": 0, "throughput_rps": 16.0,
+                   "shed_rate": 0.2, "shed_in_window": 2,
+                   "shed_outside_window": 0, "p50_ms": 3.0,
+                   "p95_ms": 9.0, "p99_ms": 11.0,
+                   "per_priority": [{"priority": 0, "requests": 2,
+                                     "completed": 2, "shed": 0,
+                                     "failed": 0}]}
+        row = bench_serve.traffic_row(
+            "lenet", spec, outcome,
+            autoscale={"scale_ups": 1, "scale_downs": 0,
+                       "replicas_start": 2, "replicas_final": 3})
+        d = json.loads(json.dumps(row))
+        for key in ("model", "mode", "requests", "seed", "base_rps",
+                    "burst_factor", "burst_start_s", "burst_len_s",
+                    "diurnal_amp", "diurnal_period_s", "priority_mix",
+                    "families", "wall_s", "offered_rps", "accepted",
+                    "completed", "shed", "failed", "throughput_rps",
+                    "shed_rate", "shed_in_window",
+                    "shed_outside_window", "p50_ms", "p95_ms",
+                    "p99_ms", "per_priority", "autoscale", "scale_ups",
+                    "scale_downs", "replicas_start", "replicas_final"):
+            assert key in d, key
+        assert d["mode"] == "traffic" and d["autoscale"] is True
+        assert d["scale_ups"] == 1 and d["replicas_final"] == 3
+        # no autoscaler: the columns stay with None/0 defaults so
+        # downstream parsers never break
+        bare = bench_serve.traffic_row("lenet", spec, outcome)
+        assert bare["autoscale"] is False
+        assert bare["replicas_final"] is None and bare["scale_ups"] == 0
+
+
+# ---------------------------------------------------------------------------
+# capstone chaos drill — fast in-process variant
+# ---------------------------------------------------------------------------
+
+class TestCapstoneChaosDrill:
+    def test_burst_kill_rollout_scaleup_drill(self, bench_serve,
+                                              obs_run_dir):
+        """The acceptance drill, in-process: seeded bursty traffic,
+        a mid-burst replica kill, a hot weight rollout and an
+        autoscale-up — every submitted future resolves EXACTLY once
+        (completed + failed + shed == accepted), admission sheds only
+        inside the declared overload window, scale decisions land as
+        schema-valid ``scale`` events, and the recovery timeline
+        renders in obs_report."""
+        from bigdl_tpu.serve import SheddedError, xcache
+
+        pool, made = _fake_pool(2, service_s=0.01, shed=True)
+        scaler = Autoscaler(pool, interval=0.2, window_s=5.0,
+                            cooldown_s=0.0, up_n=1, down_n=10 ** 6,
+                            up_shed_per_s=0.5, min_replicas=2,
+                            max_replicas=4, backoff_s=0.0)
+        rng = np.random.RandomState(0)
+        burst_start, burst_len, margin = 0.35, 0.25, 2.0
+        arrivals = bench_serve.traffic_arrivals(
+            rng, 300, 50.0, burst_factor=20.0,
+            burst_start_s=burst_start, burst_len_s=burst_len)
+        priorities = bench_serve.traffic_priorities(
+            rng, 300, bench_serve.parse_priority_mix("0:0.2,2:0.8"))
+        window = (burst_start, burst_start + burst_len + margin)
+        c0 = xcache.get().stats()["compiles"]
+
+        resolutions = [0] * len(arrivals)
+        futs = []
+        killed = rolled = False
+        scaler.evaluate_once(now=time.monotonic())   # seed history
+        t0 = time.perf_counter()
+        for i, (off, p) in enumerate(zip(arrivals, priorities)):
+            delay = t0 + off - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            f = pool.submit(np.full((2,), i, np.float64), priority=p,
+                            slo_ms=100.0)
+            f.add_done_callback(
+                lambda _f, i=i: resolutions.__setitem__(
+                    i, resolutions[i] + 1))
+            futs.append((f, off))
+            now_off = time.perf_counter() - t0
+            if not killed and now_off > burst_start + burst_len / 2:
+                made[0].kill()                  # serve_kill, in-process
+                killed = True
+                scaler.evaluate_once(now=time.monotonic())  # mid-burst
+            if not rolled and now_off > burst_start + burst_len:
+                pool.rollout({"mult": np.float64(2.0)}, {})
+                rolled = True
+        scaler.evaluate_once(now=time.monotonic())
+
+        completed = shed = failed = 0
+        for i, (f, off) in enumerate(futs):
+            try:
+                out = f.result(timeout=60)
+            except SheddedError:
+                shed += 1
+                assert window[0] <= off <= window[1], (
+                    f"shed outside the declared overload window: "
+                    f"t={off:.3f}s, window={window}")
+                continue
+            except Exception as e:   # pragma: no cover - assertion aid
+                failed += 1
+                raise AssertionError(f"lost future at t={off:.3f}: "
+                                     f"{e}") from e
+            completed += 1
+            # exactly one version's oracle: x*1 (pre-commit) or x*2
+            x = float(i)
+            assert (np.array_equal(out, np.full((2,), x))
+                    or np.array_equal(out, np.full((2,), 2 * x))), out
+
+        # every future resolved EXACTLY once
+        time.sleep(0.05)      # let the last done-callbacks land
+        assert all(r == 1 for r in resolutions), (
+            "a future resolved zero or multiple times")
+        s = pool.router.stats()
+        assert killed and rolled
+        assert shed > 0, "the burst never overloaded the pool"
+        assert completed + shed + failed == len(futs) == s["accepted"]
+        assert s["failed"] == 0              # deaths requeued, not lost
+        assert s["requeued"] >= 0
+        assert scaler.scale_ups >= 1, "the autoscaler never scaled up"
+        assert len(pool.replicas) >= 3
+        # the scale-up replica took traffic with ZERO new compiled
+        # programs (fakes share no jax, so the process-truthful xcache
+        # counter must not have moved at all)
+        assert xcache.get().stats()["compiles"] == c0
+        pool.close()
+
+        # the whole recovery timeline is in the event log and renders
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "tools", "obs_report.py")
+        spec = importlib.util.spec_from_file_location("obs_report", path)
+        obs_report = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(obs_report)
+        events, bad, bundles = obs_report.load_run(obs_run_dir)
+        assert bad == [], bad
+        kinds = {(e["type"], e.get("kind")) for e in events}
+        assert ("scale", "up") in kinds
+        assert ("serve", "rollout_commit") in kinds
+        assert ("serve", "replica_dead") in kinds
+        md = obs_report.render(events, bad, bundles)
+        assert "## Scale timeline (autoscaler)" in md
+        assert "Rollout timeline" in md
+
+
+# ---------------------------------------------------------------------------
+# capstone chaos drill — subprocess variant (slow + chaos)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestCapstoneChaosDrillSubprocess:
+    def test_subprocess_drill_with_serve_kill(self, bench_serve,
+                                              obs_run_dir):
+        """The full-fat capstone: 2 subprocess replicas under seeded
+        bursty traffic, ``serve_kill`` chaos mid-burst, a hot rollout,
+        and an autoscale-up whose replica warms through its OWN xcache
+        before taking traffic (zero cold compiles once serving — the
+        child registry's compile counter pins it)."""
+        import jax
+
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.serve import (ProcessReplica, RolloutError,
+                                     SheddedError)
+        from bigdl_tpu.utils.random import set_seed
+        set_seed(1)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(),
+                              nn.Linear(8, 3), nn.LogSoftMax())
+
+        def factory(name):
+            # the FIRST replica carries the chaos site: its 15th
+            # submitted request kills it early in the burst
+            env = ({"BIGDL_FAULTS": "serve_kill@at=15"}
+                   if name == "proc0" else None)
+            return ProcessReplica(model, name=name, env=env,
+                                  max_batch=8, max_wait_ms=2,
+                                  input_shape=(4,))
+
+        pool = ReplicaPool(n_replicas=2, process=True,
+                           replica_factory=factory, shed=True,
+                           name="drillpool")
+        # manually-driven scaler (deterministic): windowed p99 with a
+        # floor-level bound — any real traffic in the window breaches,
+        # so the scale-up decision is forced by the drill's OWN load
+        scaler = Autoscaler(pool, interval=60.0, window_s=600.0,
+                            cooldown_s=0.0, up_n=1, down_n=10 ** 6,
+                            up_p99_ms=0.001, min_replicas=2,
+                            max_replicas=3, backoff_s=0.1)
+        rng = np.random.RandomState(0)
+        n = 160
+        burst_start, burst_len = 1.0, 1.0
+        arrivals = bench_serve.traffic_arrivals(
+            rng, n, 25.0, burst_factor=8.0, burst_start_s=burst_start,
+            burst_len_s=burst_len)
+        priorities = bench_serve.traffic_priorities(
+            rng, n, bench_serve.parse_priority_mix("0:0.2,2:0.8"))
+        rows = rng.rand(n, 4).astype(np.float32)
+        for f in pool.router.submit_many(rows[:8], slo_ms=0):
+            f.result(timeout=120)            # warm outside the policy
+        a0 = pool.router.stats()["accepted"]
+        scaler.evaluate_once()               # pre-traffic reference
+
+        futs, rolled = [], False
+        p2 = jax.tree_util.tree_map(lambda a: np.asarray(a) * 1.5,
+                                    model.params())
+        t0 = time.perf_counter()
+        for i, (off, p) in enumerate(zip(arrivals, priorities)):
+            delay = t0 + off - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            futs.append(pool.submit(rows[i], priority=p, slo_ms=400.0))
+            if (not rolled
+                    and time.perf_counter() - t0 > burst_start + 0.3):
+                # hot swap under load, after the kill site fired; a
+                # stage racing the dying replica converges back — the
+                # retry lands on the survivors
+                try:
+                    pool.rollout(p2, model.state())
+                except RolloutError:
+                    pool.rollout(p2, model.state())
+                rolled = True
+        completed = shed = 0
+        for f in futs:
+            try:
+                f.result(timeout=180)
+                completed += 1
+            except SheddedError:
+                shed += 1
+        s = pool.router.stats()
+        assert rolled
+        assert completed + shed == n == s["accepted"] - a0
+        assert s["failed"] == 0, "a future was lost to the kill"
+        assert s["requeued"] >= 1, "the chaos kill never fired"
+        assert s["dead_replicas"] >= 1
+
+        # the autoscale-up: the drill's own latency window breaches
+        # the bound, and the committed replica warms through its OWN
+        # xcache — serving more traffic must add zero compiled
+        # programs to its process-local compile counter
+        out = scaler.evaluate_once()
+        assert out["decision"] == "up" and out["acted"], out
+        assert scaler.scale_ups == 1
+        new = pool.replicas[-1]
+        assert new.name == "proc2"
+        # (a RolloutError retry re-puts the weights, so the committed
+        # version is 1 or 2 — what matters is the new replica warmed
+        # to exactly the version the fleet serves)
+        assert pool.served_version in (1, 2)
+        assert new.weights_version() == pool.served_version, (
+            "the scale-up replica did not warm to the committed "
+            "version")
+        pool.router.drain(60)
+        snap1 = new.registry_snapshot()
+        c1 = metrics.family_total(snap1, "xcache_compiles_total")
+        assert c1 > 0                      # it DID warm at construction
+        for f in pool.router.submit_many(rows[:32], slo_ms=0):
+            f.result(timeout=120)
+        snap2 = new.registry_snapshot()
+        assert metrics.family_total(snap2, "xcache_compiles_total") \
+            == c1, "the scale-up replica cold-compiled while serving"
+        pool.close()
+
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "tools", "obs_report.py")
+        spec = importlib.util.spec_from_file_location("obs_report", path)
+        obs_report = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(obs_report)
+        events, bad, bundles = obs_report.load_run(obs_run_dir)
+        assert bad == [], bad
+        kinds = {(e["type"], e.get("kind")) for e in events}
+        assert ("scale", "up") in kinds
+        assert ("serve", "rollout_commit") in kinds
+        md = obs_report.render(events, bad, bundles)
+        assert "## Scale timeline (autoscaler)" in md
+
+
+# ---------------------------------------------------------------------------
+# serve_top: the membership line
+# ---------------------------------------------------------------------------
+
+class TestServeTopMembership:
+    @pytest.fixture()
+    def serve_top(self):
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "tools", "serve_top.py")
+        spec = importlib.util.spec_from_file_location("serve_top", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _snap(self, live=2, warming=1, draining=1, ups=0, downs=0,
+              frozen=0.0):
+        reg = metrics.Registry()
+        for state, v in (("live", live), ("warming", warming),
+                         ("draining", draining)):
+            reg.gauge("fleet_replicas", state=state, pool="p").set(v)
+        reg.counter("fleet_scale_events_total", direction="up",
+                    pool="p").inc(ups)
+        reg.counter("fleet_scale_events_total", direction="down",
+                    pool="p").inc(downs)
+        reg.gauge("fleet_scale_frozen", agg="max", pool="p").set(frozen)
+        return reg.snapshot()
+
+    def test_membership_line_renders(self, serve_top):
+        line = serve_top.fleet_line(self._snap(), None, 1.0)
+        assert line.startswith("fleet: ")
+        assert "n=2 (+1/-1)" in line
+        assert "FROZEN" not in line
+
+    def test_membership_windowed_scale_counts(self, serve_top):
+        prev = self._snap(ups=1, downs=0)
+        cur = self._snap(live=3, ups=3, downs=1)
+        part = serve_top.membership_part(cur, prev)
+        assert "n=3" in part
+        assert "scaled +2/-1" in part
+        # first frame: lifetime totals (the engine rows' fallback rule)
+        part0 = serve_top.membership_part(cur, None)
+        assert "scaled +3/-1" in part0
+
+    def test_frozen_marker(self, serve_top):
+        line = serve_top.fleet_line(self._snap(frozen=1.0), None, 1.0)
+        assert "SCALE FROZEN" in line
+
+    def test_absent_without_membership_gauges(self, serve_top):
+        reg = metrics.Registry()
+        reg.counter("serve_requests_total", outcome="accepted",
+                    engine="e").inc(3)
+        assert serve_top.membership_part(reg.snapshot(), None) is None
+        assert serve_top.fleet_line(reg.snapshot(), None, 1.0) is None
+
+
+# ---------------------------------------------------------------------------
+# DecodeFleet membership (real decoder path)
+# ---------------------------------------------------------------------------
+
+class TestFleetMembership:
+    def test_fleet_add_remove_replica_parity(self):
+        from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+        from bigdl_tpu.serve.fleet import DecodeFleet
+        from bigdl_tpu.utils.random import set_seed
+        set_seed(1)
+        model = TransformerLM(vocab_size=64, d_model=32, n_heads=2,
+                              n_layers=2, hidden=64)
+        rng = np.random.RandomState(0)
+        seeds = [rng.randint(1, 64, rng.randint(2, 5)).tolist()
+                 for _ in range(8)]
+        n_words = 6
+        n_pos = max(len(s) for s in seeds) + n_words - 1
+        for length in sorted({len(s) for s in seeds}):
+            lm_decode(model, [1] * length, n_words)
+        oracle = [lm_decode(model, s, n_words) for s in seeds]
+
+        fleet = DecodeFleet(model, n_decode=1, max_slots=4, n_pos=n_pos,
+                            page_size=4, sync_interval=2)
+        try:
+            added = fleet.add_replica(reason="test")
+            assert len(fleet.replicas) == 2
+            assert fleet.membership()["live"] == 2
+            futs = fleet.submit_many(seeds, n_words)
+            rows = [f.result(timeout=300) for f in futs]
+            assert rows == oracle
+            victim = fleet.remove_replica(added, reason="test")
+            assert victim is added and len(fleet.replicas) == 1
+            # the removed replica's role series is DROPPED (serve_top
+            # derives the roster from series labels — churn must not
+            # accumulate stale decode rows)
+            fam = metrics.get().snapshot().get("serve_replica_role",
+                                               {"series": []})
+            names = [s["labels"].get("replica") for s in fam["series"]]
+            assert added.name not in names
+            # zero drops and the survivor still serves parity
+            futs = fleet.submit_many(seeds[:3], n_words)
+            assert [f.result(timeout=300) for f in futs] == oracle[:3]
+            assert fleet.router.stats()["failed"] == 0
+        finally:
+            fleet.close()

@@ -65,7 +65,8 @@ class TestRuleValidation:
         names = [r.name for r in rules]
         assert names == ["slo_burn", "shed_rate", "queue_depth",
                          "step_time_regression", "hbm_headroom",
-                         "itl_regression", "ttft_burn"]
+                         "itl_regression", "fleet_scale_frozen",
+                         "ttft_burn"]
         # evaluate them against an empty snapshot: nothing fires,
         # nothing crashes (the no-data contract)
         eng = obs_alerts.AlertEngine(lambda: {}, rules)

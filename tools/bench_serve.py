@@ -32,6 +32,19 @@ bar: more live requests than the slab bound, parity (streamed chunks
 included), zero cold compiles, and TTFT p50 below the e2e p50 on a
 long-generation point.
 
+Traffic (``--traffic``): seeded OPEN-LOOP bursty/diurnal load — Poisson
+arrivals whose instantaneous rate follows a declared burst window
+(``--burst-factor/--burst-start-s/--burst-len-s``) and an optional
+sinusoidal diurnal envelope, mixed priority classes
+(``--priority-mix``), and shared-prefix request families when the
+target is a decode fleet (``--model transformer``).  The run resolves
+every submitted future exactly once (completed + shed + failed ==
+accepted — the capstone accounting ``--check`` enforces), splits sheds
+into inside/outside the declared overload window, and with
+``--autoscale`` closes the loop through ``serve/autoscale.py``
+(replica counts + scale actions land in the row).  One JSON row per
+run (contract pinned by ``tests/test_autoscale.py``).
+
 Router (``--replicas N``, N > 1): the same offered-load sweep through a
 :class:`ReplicaPool` — N engine replicas behind the SLO router — with
 per-replica and aggregate rows/s plus the shed rate per point
@@ -47,6 +60,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os as _os
 import sys as _sys
 import time
@@ -616,6 +630,289 @@ def bench_decode_sweep(args):
     return points
 
 
+# ---------------------------------------------------------------------------
+# open-loop traffic generator (--traffic; docs/serving.md "Autoscaling")
+# ---------------------------------------------------------------------------
+
+def traffic_envelope(t: float, base_rps: float, burst_factor: float = 1.0,
+                     burst_start_s: float = 0.0, burst_len_s: float = 0.0,
+                     diurnal_amp: float = 0.0,
+                     diurnal_period_s: float = 60.0) -> float:
+    """Offered rate (req/s) at offset ``t``: the base rate modulated by
+    a sinusoidal diurnal envelope (``amp`` in [0, 1) scales the swing)
+    and multiplied by ``burst_factor`` inside the declared burst window
+    ``[burst_start_s, burst_start_s + burst_len_s)`` — the overload
+    window the chaos drill asserts sheds stay inside."""
+    rate = base_rps
+    if diurnal_amp:
+        rate *= 1.0 + diurnal_amp * math.sin(
+            2.0 * math.pi * t / max(diurnal_period_s, 1e-9))
+    if burst_len_s > 0 and burst_start_s <= t < burst_start_s + burst_len_s:
+        rate *= burst_factor
+    return max(rate, 1e-9)
+
+
+def traffic_arrivals(rng, n: int, base_rps: float, **envelope) -> list:
+    """``n`` seeded open-loop arrival offsets (seconds from start):
+    Poisson arrivals whose instantaneous rate follows
+    :func:`traffic_envelope` (each inter-arrival gap drawn at the rate
+    in effect at the PREVIOUS arrival — piecewise approximation of the
+    inhomogeneous process, deterministic under a seeded ``rng``)."""
+    t, out = 0.0, []
+    for _ in range(int(n)):
+        t += rng.exponential(1.0 / traffic_envelope(t, base_rps,
+                                                    **envelope))
+        out.append(t)
+    return out
+
+
+def parse_priority_mix(s: str) -> list:
+    """``"0:0.2,2:0.8"`` → normalized ``[(class, weight), ...]`` —
+    the mixed-priority-class contract of the ``--traffic`` flag."""
+    out = []
+    for tok in str(s).split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        cls, w = tok.split(":")
+        out.append((int(cls), float(w)))
+    if not out:
+        raise ValueError(f"empty priority mix: {s!r}")
+    total = sum(w for _, w in out)
+    if total <= 0:
+        raise ValueError(f"priority mix weights sum to {total}: {s!r}")
+    return [(c, w / total) for c, w in out]
+
+
+def traffic_priorities(rng, n: int, mix) -> list:
+    """``n`` seeded priority classes drawn from a normalized mix."""
+    classes = [c for c, _ in mix]
+    probs = [w for _, w in mix]
+    return [int(c) for c in rng.choice(classes, size=int(n), p=probs)]
+
+
+def traffic_row(model_name, spec: dict, outcome: dict,
+                autoscale: dict | None = None,
+                families: int | None = None) -> dict:
+    """The pinned JSON contract for one ``--traffic`` run: the seeded
+    traffic spec (replayable), the resolution accounting (accepted ==
+    completed + failed + shed — every future resolves exactly once),
+    the shed split against the DECLARED overload window, per-priority
+    outcomes, and the autoscaler's actions when one ran.
+    ``tests/test_autoscale.py::TestBenchTrafficContract`` keeps this
+    shape honest."""
+    row = {"model": model_name, "mode": "traffic", "families": families,
+           **spec, **outcome}
+    scale = autoscale or {}
+    row.update(autoscale=bool(autoscale),
+               scale_ups=scale.get("scale_ups", 0),
+               scale_downs=scale.get("scale_downs", 0),
+               replicas_start=scale.get("replicas_start"),
+               replicas_final=scale.get("replicas_final"))
+    return row
+
+
+def run_traffic(submit, rows, arrivals, priorities, burst_window,
+                timeout: float = 300.0) -> dict:
+    """Drive one open-loop traffic schedule: ``submit(row, priority)``
+    at each arrival offset, resolve every future, and account each
+    exactly once (completed / shed / failed — the capstone bar).
+    ``burst_window = (t0, t1)`` splits sheds into in-window vs outside
+    (the declared-overload assertion)."""
+    from bigdl_tpu.serve import SheddedError
+
+    done_at = [None] * len(rows)
+
+    def _stamp(i):
+        def cb(_f):
+            done_at[i] = time.perf_counter()
+        return cb
+
+    futs = []
+    t0 = time.perf_counter()
+    for i, (r, off, p) in enumerate(zip(rows, arrivals, priorities)):
+        delay = t0 + off - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t_sub = time.perf_counter()
+        f = submit(r, p)
+        f.add_done_callback(_stamp(i))
+        futs.append((f, t_sub, off))
+    lats, shed_in, shed_out = [], 0, 0
+    per: dict = {}
+    completed = failed = shed = 0
+    for i, ((f, t_sub, off), p) in enumerate(zip(futs, priorities)):
+        d = per.setdefault(p, {"priority": p, "requests": 0,
+                               "completed": 0, "shed": 0, "failed": 0})
+        d["requests"] += 1
+        try:
+            f.result(timeout=timeout)
+        except SheddedError:
+            shed += 1
+            d["shed"] += 1
+            if burst_window[0] <= off <= burst_window[1]:
+                shed_in += 1
+            else:
+                shed_out += 1
+            continue
+        except Exception:
+            failed += 1
+            d["failed"] += 1
+            continue
+        completed += 1
+        d["completed"] += 1
+        t_spin = time.perf_counter()
+        while done_at[i] is None:    # callbacks race result()
+            if time.perf_counter() - t_spin > 5.0:
+                raise RuntimeError("latency stamp missing after 5s")
+            time.sleep(0.0005)
+        lats.append(done_at[i] - t_sub)
+    wall = time.perf_counter() - t0
+    n = len(rows)
+    return {"requests": n, "wall_s": wall,
+            "offered_rps": n / arrivals[-1] if arrivals[-1] else None,
+            "accepted": n, "completed": completed, "shed": shed,
+            "failed": failed,
+            "throughput_rps": completed / wall if wall else 0.0,
+            "shed_rate": shed / n if n else 0.0,
+            "shed_in_window": shed_in, "shed_outside_window": shed_out,
+            **(_quantiles(lats) if lats
+               else {"p50_ms": None, "p95_ms": None, "p99_ms": None}),
+            "per_priority": [per[k] for k in sorted(per)]}
+
+
+def bench_traffic(args):
+    """``--traffic``: seeded bursty/diurnal open-loop load — mixed
+    priority classes, Poisson arrivals, the declared overload window —
+    through a ReplicaPool (scoring models) or a DecodeFleet with
+    shared-prefix families (``--model transformer``), optionally with
+    the SLO-driven autoscaler closed-loop (``--autoscale``)."""
+    spec = {"requests": args.requests, "seed": args.traffic_seed,
+            "base_rps": args.base_rps, "burst_factor": args.burst_factor,
+            "burst_start_s": args.burst_start_s,
+            "burst_len_s": args.burst_len_s,
+            "diurnal_amp": args.diurnal_amp,
+            "diurnal_period_s": args.diurnal_period_s,
+            "priority_mix": args.priority_mix}
+    envelope = dict(burst_factor=args.burst_factor,
+                    burst_start_s=args.burst_start_s,
+                    burst_len_s=args.burst_len_s,
+                    diurnal_amp=args.diurnal_amp,
+                    diurnal_period_s=args.diurnal_period_s)
+    rng = np.random.RandomState(args.traffic_seed)
+    arrivals = traffic_arrivals(rng, args.requests, args.base_rps,
+                                **envelope)
+    priorities = traffic_priorities(
+        rng, args.requests, parse_priority_mix(args.priority_mix))
+    burst_window = (args.burst_start_s,
+                    args.burst_start_s + args.burst_len_s
+                    + args.burst_margin_s)
+
+    def autoscale_of(target):
+        if not args.autoscale:
+            return None, None
+        scaler = target.start_autoscaler(
+            min_replicas=args.min_replicas or args.replicas,
+            max_replicas=args.max_replicas,
+            interval=args.scale_interval, window_s=args.scale_interval * 4)
+        return scaler, len(target.replicas)
+
+    families = None
+    if args.model == "transformer":
+        from bigdl_tpu.models.transformer import TransformerLM
+        from bigdl_tpu.serve.fleet import DecodeFleet
+        from bigdl_tpu.utils.random import set_seed
+        set_seed(1)
+        model = TransformerLM(vocab_size=128, d_model=64, n_heads=4,
+                              n_layers=2, hidden=128)
+        families = args.families
+        seeds, _f = fleet_families(rng, args.families, args.requests,
+                                   args.zipf_a, args.prefix_pages,
+                                   args.page_size, 128)
+        n_pos = max(len(s) for s in seeds) + args.decode_words - 1
+        fleet = DecodeFleet(model, n_decode=args.replicas,
+                            slo_ms=args.slo_ms or None,
+                            max_slots=args.decode_slots, n_pos=n_pos,
+                            page_size=args.page_size,
+                            sync_interval=args.decode_sync)
+        scaler, start = autoscale_of(fleet)
+        try:
+            outcome = run_traffic(
+                lambda s, p: fleet.submit(s, args.decode_words,
+                                          priority=p,
+                                          slo_ms=args.slo_ms or None),
+                seeds, arrivals, priorities, burst_window)
+            rstats = fleet.router.stats()
+            scale = None if scaler is None else {
+                "scale_ups": scaler.scale_ups,
+                "scale_downs": scaler.scale_downs,
+                "replicas_start": start,
+                "replicas_final": len(fleet.replicas)}
+        finally:
+            fleet.close()
+    else:
+        from bigdl_tpu.serve import ReplicaPool
+        model, shape = _build(args.model)
+        rows = rng.rand(args.requests, *shape).astype(np.float32)
+        pool = ReplicaPool(model, n_replicas=args.replicas,
+                           max_batch=args.max_batch,
+                           max_wait_ms=args.max_wait_ms,
+                           input_shape=shape,
+                           slo_ms=args.slo_ms or None, quant=args.quant)
+        scaler, start = autoscale_of(pool)
+        try:
+            # warm every bucket OUTSIDE the SLO policy (slo_ms=0 = no
+            # deadline): a cold-compile warmup burst must not shed
+            for f in pool.submit_many(rows[:args.max_batch], slo_ms=0):
+                f.result(timeout=300)
+            outcome = run_traffic(
+                lambda r, p: pool.submit(r, priority=p,
+                                         slo_ms=args.slo_ms or None),
+                rows, arrivals, priorities, burst_window)
+            rstats = pool.router.stats()
+            scale = None if scaler is None else {
+                "scale_ups": scaler.scale_ups,
+                "scale_downs": scaler.scale_downs,
+                "replicas_start": start,
+                "replicas_final": len(pool.replicas)}
+        finally:
+            pool.close()
+
+    row = traffic_row(args.model, spec, outcome, autoscale=scale,
+                      families=families)
+    print(f"bench_serve: {json.dumps(row)}")
+    print(f"\n{args.model} traffic ({args.requests} req, base "
+          f"{args.base_rps:g} rps, burst x{args.burst_factor:g} @ "
+          f"[{args.burst_start_s:g}, "
+          f"{args.burst_start_s + args.burst_len_s:g}]s):")
+    print(f"  {outcome['throughput_rps']:.1f} req/s served; "
+          f"completed {outcome['completed']}, shed {outcome['shed']} "
+          f"({outcome['shed_in_window']} in window / "
+          f"{outcome['shed_outside_window']} outside), failed "
+          f"{outcome['failed']}")
+    if outcome["p95_ms"] is not None:
+        print(f"  p50 {outcome['p50_ms']:.2f} / p95 "
+              f"{outcome['p95_ms']:.2f} / p99 "
+              f"{outcome['p99_ms']:.2f} ms")
+    if scale:
+        print(f"  autoscale: +{scale['scale_ups']}/"
+              f"-{scale['scale_downs']} "
+              f"({scale['replicas_start']} → "
+              f"{scale['replicas_final']} replicas)")
+    if args.check:
+        total = (outcome["completed"] + outcome["shed"]
+                 + outcome["failed"])
+        if total != outcome["accepted"]:
+            raise SystemExit(
+                f"resolution accounting broken: completed+shed+failed "
+                f"{total} != accepted {outcome['accepted']}")
+        if rstats["failed"] != outcome["failed"]:
+            raise SystemExit(
+                f"router failed count {rstats['failed']} != observed "
+                f"{outcome['failed']}")
+    return row
+
+
 def fleet_families(rng, n_families: int, n_requests: int, zipf_a: float,
                    prefix_pages: int, page_size: int, vocab: int,
                    suffix_max: int = 3):
@@ -814,6 +1111,45 @@ def main():
     ap.add_argument("--host-mb", type=int, default=0,
                     help="per-replica host-RAM KV tier budget (MiB) "
                          "for the fleet sweep (0 = off)")
+    ap.add_argument("--traffic", action="store_true",
+                    help="open-loop bursty/diurnal traffic run: seeded "
+                         "Poisson arrivals with a declared burst "
+                         "window, mixed priority classes and (for "
+                         "--model transformer) shared-prefix families "
+                         "(docs/serving.md 'Autoscaling')")
+    ap.add_argument("--base-rps", type=float, default=50.0,
+                    help="traffic: baseline offered rate (req/s)")
+    ap.add_argument("--burst-factor", type=float, default=8.0,
+                    help="traffic: rate multiplier inside the burst "
+                         "window")
+    ap.add_argument("--burst-start-s", type=float, default=1.0,
+                    help="traffic: burst window start offset (s)")
+    ap.add_argument("--burst-len-s", type=float, default=1.0,
+                    help="traffic: burst window length (s; 0 = none)")
+    ap.add_argument("--burst-margin-s", type=float, default=1.0,
+                    help="traffic: drain margin appended to the "
+                         "declared overload window when splitting "
+                         "sheds into in/out of window")
+    ap.add_argument("--diurnal-amp", type=float, default=0.0,
+                    help="traffic: sinusoidal diurnal amplitude in "
+                         "[0, 1) over the base rate")
+    ap.add_argument("--diurnal-period-s", type=float, default=60.0,
+                    help="traffic: diurnal period (s)")
+    ap.add_argument("--priority-mix", default="0:0.2,2:0.8",
+                    help="traffic: 'class:weight,...' request mix "
+                         "(lower class = more urgent)")
+    ap.add_argument("--traffic-seed", type=int, default=0,
+                    help="traffic: RNG seed (arrivals, priorities and "
+                         "families replay byte-identically)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="traffic: arm the SLO-driven autoscaler over "
+                         "the pool/fleet (serve/autoscale.py)")
+    ap.add_argument("--min-replicas", type=int, default=0,
+                    help="autoscale lower bound (0 = --replicas)")
+    ap.add_argument("--max-replicas", type=int, default=8,
+                    help="autoscale upper bound")
+    ap.add_argument("--scale-interval", type=float, default=0.5,
+                    help="autoscale cadence seconds for the traffic run")
     ap.add_argument("--replicas", type=int, default=1,
                     help="> 1 sweeps a ReplicaPool behind the SLO "
                          "router instead of one engine (also the fleet "
@@ -831,7 +1167,10 @@ def main():
     if args.kv_quant is None:
         args.kv_quant = _quant.kv_mode_default()
 
-    if args.fleet_sweep:
+    if args.traffic:
+        args.replicas = max(2, args.replicas)
+        bench_traffic(args)
+    elif args.fleet_sweep:
         args.replicas = max(2, args.replicas)
         bench_fleet(args)
     elif args.decode_sweep:

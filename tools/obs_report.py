@@ -14,9 +14,12 @@ timeline (``recover`` events), the serving section
 a per-request TOKEN waterfall for streamed decode requests — admit →
 first token → per-boundary counts → retire, from the ``stream``
 events — and a per-hop latency waterfall for the slowest traced
-requests — ``--waterfall N``), the performance ledger (top executables by flops,
-HBM tenant breakdown, device-memory timeline), the alert timeline
-(``alert`` firing/resolved transitions), crash bundles.
+requests — ``--waterfall N``), the scale timeline (``scale`` events:
+autoscaler up/down decisions with reasons, spawn failures, circuit
+breaker — docs/serving.md "Autoscaling"), the performance ledger
+(top executables by flops, HBM tenant breakdown, device-memory
+timeline), the alert timeline (``alert`` firing/resolved transitions),
+crash bundles.
 
 Lines that fail schema validation are counted and quoted, not fatal —
 a postmortem tool that dies on the interesting input is useless.
@@ -332,6 +335,45 @@ def _ledger_section(events):
     return out
 
 
+def _scale_section(events):
+    """Markdown lines for the ``scale`` event type (serve/autoscale.py,
+    dynamic membership): the scale/recovery timeline — every committed
+    up/down with its policy reason, spawn failures, and the circuit
+    breaker's frozen/unfrozen transitions."""
+    scales = _by_type(events, "scale")
+    if not scales:
+        return []
+    out = ["## Scale timeline (autoscaler)", ""]
+    ups = sum(1 for e in scales if e["kind"] == "up")
+    downs = sum(1 for e in scales if e["kind"] == "down")
+    fails = sum(1 for e in scales if e["kind"] == "spawn_failed")
+    line = f"- scale actions: **+{ups} / -{downs}**"
+    if fails:
+        line += f"; spawn attempts failed: **{fails}**"
+    frozen = any(e["kind"] == "frozen" for e in scales)
+    if frozen:
+        still = True
+        for e in scales:
+            if e["kind"] == "frozen":
+                still = True
+            elif e["kind"] == "unfrozen":
+                still = False
+        line += ("; spawn circuit breaker tripped"
+                 + (" — **still frozen at end of log**" if still
+                    else " (recovered)"))
+    out.append(line)
+    out += ["", "| t (s) | kind | replica | detail |", "|---|---|---|---|"]
+    t0 = scales[0]["ts"]
+    for e in scales:
+        detail = ", ".join(
+            f"{k}={_fmt(v)}" for k, v in sorted(e.items())
+            if k not in ("v", "ts", "proc", "type", "kind", "replica"))
+        out.append(f"| {e['ts'] - t0:+.3f} | {e['kind']} | "
+                   f"{e.get('replica', '-')} | {detail or '-'} |")
+    out.append("")
+    return out
+
+
 def _alerts_section(events):
     """Markdown lines for the ``alert`` event type (obs/alerts.py):
     the firing/resolved transition timeline plus the rules still
@@ -451,6 +493,7 @@ def render(events, bad, bundles, title="obs run report",
         out.append("")
 
     out.extend(_serving_section(events, waterfall))
+    out.extend(_scale_section(events))
     out.extend(_ledger_section(events))
     out.extend(_alerts_section(events))
     out.extend(_recovery_section(events))

@@ -13,8 +13,13 @@ plus, when a paged continuous decoder is exporting, one trailing
 hit-rate and the speculative acceptance p50 (docs/serving.md "Paged
 KV + speculative decode"), a ``stream:`` line with the windowed
 TTFT/ITL quantiles and streamed-token rate when streaming delivery is
-live (docs/observability.md "Streaming telemetry"), and — when an
-alert engine is exporting
+live (docs/observability.md "Streaming telemetry"), a ``fleet:`` line
+with the dynamic-membership counts (``n=<live>
+(+<warming>/-<draining>)`` from the ``fleet_replicas`` gauges, windowed
+scale-action counts, and a ``SCALE FROZEN`` marker while the
+autoscaler's spawn circuit breaker is open — docs/serving.md
+"Autoscaling") plus the affinity/prefill/host-tier telemetry, and —
+when an alert engine is exporting
 ``alert_active`` gauges (``obs/alerts.py``) — one ``alerts:`` line
 naming every firing rule (``alerts: none`` when quiet).
 
@@ -185,24 +190,62 @@ def frame_rows(cur: dict, prev: dict | None, dt: float,
 def replica_roles(snapshot: dict) -> dict:
     """``replica name -> role`` from the fleet's ``serve_replica_role``
     gauges (prefill/decode disaggregation, docs/serving.md
-    "Disaggregated fleet"); empty for non-fleet snapshots."""
+    "Disaggregated fleet"); empty for non-fleet snapshots.  Only
+    series with value > 0 count — a replica drained out by the
+    autoscaler sets (or drops) its gauge and must leave the roster."""
     fam = snapshot.get("serve_replica_role", {"series": []})
     return {row["labels"].get("replica"): row["labels"].get("role")
             for row in fam["series"]
-            if row["labels"].get("replica")}
+            if row["labels"].get("replica") and row.get("value")}
+
+
+def membership_part(cur: dict, prev: dict | None) -> str | None:
+    """``n=<live> (+<warming>/-<draining>)`` from the ``fleet_replicas``
+    membership gauges (dynamic membership / autoscaler —
+    docs/serving.md "Autoscaling"), with the windowed scale-action
+    counts when any landed in the window (the lifetime totals on the
+    first frame — the engine rows' fallback rule) and a ``SCALE
+    FROZEN`` marker while the spawn circuit breaker is open.  None when
+    no membership gauges are exported."""
+    if "fleet_replicas" not in cur:
+        return None
+
+    def state(s):
+        return int(metrics.family_total(cur, "fleet_replicas", state=s))
+
+    part = (f"n={state('live')} "
+            f"(+{state('warming')}/-{state('draining')})")
+    ups = metrics.family_total(cur, "fleet_scale_events_total",
+                               direction="up")
+    downs = metrics.family_total(cur, "fleet_scale_events_total",
+                                 direction="down")
+    if prev is not None:
+        ups -= metrics.family_total(prev, "fleet_scale_events_total",
+                                    direction="up")
+        downs -= metrics.family_total(prev, "fleet_scale_events_total",
+                                      direction="down")
+    if ups or downs:
+        part += f"  scaled +{int(ups)}/-{int(downs)}"
+    if metrics.family_total(cur, "fleet_scale_frozen") > 0:
+        part += "  SCALE FROZEN"
+    return part
 
 
 def fleet_line(cur: dict, prev: dict | None, dt: float) -> str | None:
-    """One trailing line of disaggregated-fleet telemetry when a fleet
-    router / host KV tier is exporting: affinity hit-rate (windowed
-    like the engine rates), prefill ship/skip/fallback counts, and the
-    host tier's resident bytes + spill/re-admit counters.  None when no
-    fleet series are present."""
+    """One trailing line of fleet telemetry when a fleet router / host
+    KV tier / dynamic-membership pool is exporting: the membership
+    counts (``n=<live> (+<warming>/-<draining>)``), affinity hit-rate
+    (windowed like the engine rates), prefill ship/skip/fallback
+    counts, and the host tier's resident bytes + spill/re-admit
+    counters.  None when no fleet series are present."""
+    member = membership_part(cur, prev)
     has_aff = "fleet_affinity_hits_total" in cur
     has_tier = "kv_host_bytes" in cur
-    if not has_aff and not has_tier:
+    if not has_aff and not has_tier and member is None:
         return None
     parts = []
+    if member is not None:
+        parts.append(member)
     roles = replica_roles(cur)
     if roles:
         n_dec = sum(1 for r in roles.values() if r == "decode")
