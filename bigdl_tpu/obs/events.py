@@ -42,8 +42,12 @@ logger = logging.getLogger("bigdl_tpu.obs")
 #: must carry `first_token_ms` + `stream_boundaries`.  v5: the `scale`
 #: type landed (autoscaler/dynamic-membership decisions, SCALE_KINDS)
 #: plus the `replica_added`/`replica_draining`/`replica_removed`
-#: serve kinds the router emits on membership changes.
-SCHEMA_VERSION = 5
+#: serve kinds the router emits on membership changes.  v6: the
+#: `remote` type landed (cross-host TCP replica lifecycle,
+#: REMOTE_KINDS: connect/blip/reattach/partition/death — the
+#: blip-vs-death audit trail docs/serving.md "Cross-host fleet"
+#: documents).
+SCHEMA_VERSION = 6
 
 ENV_OBS = "BIGDL_OBS"
 ENV_DIR = "BIGDL_OBS_DIR"
@@ -93,6 +97,12 @@ EVENT_TYPES = {
     # fields in SCALE_KINDS — the scale/recovery timeline obs_report
     # renders and the capstone chaos drill asserts on
     "scale": ("kind",),
+    # cross-host replica transport lifecycle (serve/remote.py,
+    # tools/replica_agent.py): kind-specific required fields in
+    # REMOTE_KINDS — connect/blip/reattach/partition/death, the trail
+    # that distinguishes a survived network blip (reattach, zero
+    # requeues) from a real death (requeue-exactly-once)
+    "remote": ("kind",),
 }
 
 #: per-kind REQUIRED fields for `serve` events (v2).  An unknown kind is
@@ -178,11 +188,25 @@ SCALE_KINDS = {
     "unfrozen": (),
 }
 
+#: per-kind REQUIRED fields for `remote` events (v6) — the cross-host
+#: transport lifecycle.  `blip` marks a lost connection still inside
+#: the liveness budget (reconnect in progress), `reattach` the
+#: successful resume of the SAME session (carries the measured outage),
+#: `partition` the agent-side chaos injection, `death` the client-side
+#: conversion to DeadReplicaError after the budget expired.
+REMOTE_KINDS = {
+    "connect": ("replica", "address"),
+    "blip": ("replica",),
+    "reattach": ("replica", "blip_s"),
+    "partition": ("len_s",),
+    "death": ("replica",),
+}
+
 _COMMON = ("v", "ts", "proc", "type")
 
 _KINDED = {"serve": SERVE_KINDS, "recover": RECOVER_KINDS,
            "ledger": LEDGER_KINDS, "alert": ALERT_KINDS,
-           "scale": SCALE_KINDS}
+           "scale": SCALE_KINDS, "remote": REMOTE_KINDS}
 
 
 def validate_event(event: dict) -> dict:

@@ -26,7 +26,8 @@ Keys: ``at`` (fire at these steps, ``|``-separated), ``every`` (fire
 when ``step % every == 0``, step > 0), ``p`` (probability per query,
 hashed deterministically), ``proc`` (only on this process index),
 ``delay`` (seconds, ``slow_worker``), ``seed`` (decorrelates ``p``
-clauses).  Sites and where they are threaded:
+clauses), ``len_s`` (partition length in seconds,
+``serve_partition``).  Sites and where they are threaded:
 
 ====================  ====================================================
 ``record_corrupt``    dataset/seqfile.py — flip a byte of a record payload
@@ -48,6 +49,11 @@ clauses).  Sites and where they are threaded:
 ``serve_kill``        serve/cluster.py replica worker — os._exit(1) at
                       the Nth submitted request (the router must requeue
                       the dead replica's outstanding work on survivors)
+``serve_partition``   tools/replica_agent.py — drop the TCP session and
+                      black-hole new connections for ``len_s`` seconds
+                      at the Nth submitted request (a network partition,
+                      NOT a death: a blip under the client's liveness
+                      budget must re-attach with zero requeues)
 ====================  ====================================================
 """
 from __future__ import annotations
@@ -63,7 +69,7 @@ SITES = (
     "record_corrupt", "record_truncate",
     "nan_grad", "inf_grad", "slow_worker",
     "ckpt_write_fail", "ckpt_partial", "ckpt_bitflip",
-    "proc_kill", "serve_h2d", "serve_kill",
+    "proc_kill", "serve_h2d", "serve_kill", "serve_partition",
 )
 
 ENV_VAR = "BIGDL_FAULTS"
@@ -72,10 +78,11 @@ ENV_VAR = "BIGDL_FAULTS"
 class FaultSpec:
     """One parsed clause of a fault plan."""
 
-    __slots__ = ("site", "at", "every", "p", "proc", "delay", "seed")
+    __slots__ = ("site", "at", "every", "p", "proc", "delay", "seed",
+                 "len_s")
 
     def __init__(self, site, at=None, every=None, p=None, proc=None,
-                 delay=0.05, seed=0):
+                 delay=0.05, seed=0, len_s=0.5):
         if site not in SITES:
             raise ValueError(
                 f"unknown fault site {site!r}; known sites: {SITES}")
@@ -89,6 +96,7 @@ class FaultSpec:
         self.proc = int(proc) if proc is not None else None
         self.delay = float(delay)
         self.seed = int(seed)
+        self.len_s = float(len_s)
 
     def fires(self, step: int, process_index: int) -> bool:
         if self.proc is not None and process_index != self.proc:
@@ -139,7 +147,8 @@ def parse_faults(spec: str):
                 k = k.strip()
                 if k == "at":
                     kwargs["at"] = [int(x) for x in v.split("|")]
-                elif k in ("every", "p", "proc", "delay", "seed"):
+                elif k in ("every", "p", "proc", "delay", "seed",
+                           "len_s"):
                     kwargs[k] = v
                 else:
                     raise ValueError(

@@ -41,7 +41,19 @@ counterpart, reusing the training stack's pipeline idioms:
   matching chain), dedicated prefill replicas shipping seed KV pages
   over the replica frames (colocated-prefill fallback on death), and a
   per-replica host-RAM KV tier (:class:`HostKVTier`) that spills
-  evicted prefix pages D2H and re-admits them on chain-hash hit.
+  evicted prefix pages D2H and re-admits them on chain-hash hit;
+- :mod:`bigdl_tpu.serve.frames` / :mod:`bigdl_tpu.serve.remote` — the
+  hardened frame codec both transports share (magic + version prefix,
+  size bound, per-frame CRC32; malformation raises a typed
+  :class:`FrameProtocolError` instead of reaching ``pickle.loads``)
+  and the cross-host fleet (docs/serving.md "Cross-host fleet"):
+  :class:`RemoteReplica` speaks the stdio op set over TCP to a
+  ``tools/replica_agent.py`` per host, distinguishing a network blip
+  (reconnect + same-session re-attach inside ``BIGDL_SERVE_LIVENESS_S``
+  — zero requeues, zero duplicate token chunks) from replica death
+  (the existing DeadReplicaError → requeue-exactly-once path), with
+  :class:`HostInventory` leasing ``BIGDL_SERVE_HOSTS`` addresses to
+  the pool/fleet/autoscaler.
 
 Quantized serving (``bigdl_tpu/quant``, docs/serving.md "Quantized
 serving"): ``BIGDL_SERVE_QUANT`` serves per-channel int8/fp8 weights
@@ -74,7 +86,14 @@ docs/observability.md "Serving telemetry") and the autoscaler loop
 ``BIGDL_SERVE_AUTOSCALE`` (default off),
 ``BIGDL_SERVE_MIN_REPLICAS`` / ``BIGDL_SERVE_MAX_REPLICAS`` (bounds,
 default 1/8), ``BIGDL_SERVE_SCALE_INTERVAL`` (cadence seconds,
-default 2).
+default 2); the cross-host fleet (``serve/remote.py``,
+docs/serving.md "Cross-host fleet"): ``BIGDL_SERVE_HOSTS``
+(replica-agent inventory, ``host:port,host:port``),
+``BIGDL_SERVE_TOKEN`` (shared handshake secret),
+``BIGDL_SERVE_LIVENESS_S`` (blip-vs-death budget, default 2),
+``BIGDL_SERVE_SESSION_TTL_S`` (agent-side detached-session reap,
+default 30) and ``BIGDL_SERVE_MAX_FRAME_MB`` (frame-size bound,
+default 4096).
 """
 from bigdl_tpu.serve import bucketing, xcache  # noqa: F401
 from bigdl_tpu.serve.autoscale import Autoscaler  # noqa: F401
@@ -96,7 +115,12 @@ from bigdl_tpu.serve.fleet import (  # noqa: F401
     AffinityIndex, DecodeFleet, DecodeReplica, FleetRouter,
     PrefillReplica, ProcessDecodeReplica, ProcessPrefillReplica,
 )
+from bigdl_tpu.serve.frames import FrameProtocolError  # noqa: F401
 from bigdl_tpu.serve.kvtier import HostKVTier  # noqa: F401
+from bigdl_tpu.serve.remote import (  # noqa: F401
+    HostInventory, RemoteDecodeReplica, RemotePrefillReplica,
+    RemoteReplica, spawn_agent,
+)
 from bigdl_tpu.serve.paging import (  # noqa: F401
     PagePool, RequestTooLongError,
 )
@@ -120,4 +144,6 @@ __all__ = [
     "AffinityIndex", "DecodeReplica", "PrefillReplica",
     "ProcessDecodeReplica", "ProcessPrefillReplica", "HostKVTier",
     "SafeFuture", "StreamFuture", "TokenDelivery",
+    "FrameProtocolError", "RemoteReplica", "RemoteDecodeReplica",
+    "RemotePrefillReplica", "HostInventory", "spawn_agent",
 ]

@@ -28,6 +28,14 @@ alert rules with hysteresis — but the control loop was a human:
   wait to zero backlog, close; zero dropped futures — inside the
   ``[min_replicas, max_replicas]`` bounds.
 
+Cross-host fleets scale through the same two calls: with
+``BIGDL_SERVE_HOSTS`` set, ``add_replica`` leases the next agent
+address from the :class:`~bigdl_tpu.serve.remote.HostInventory` and
+``remove_replica``/death releases it; an exhausted inventory raises
+``ReplicaSpawnError`` — the same typed failure local spawn uses — so
+the breaker below freezes scaling instead of crash-looping when the
+machine pool is spent (docs/serving.md "Cross-host fleet").
+
 Spawn failure is survived, not crash-looped: each scale-up cycle
 retries ``spawn_retries`` times with jittered exponential backoff
 (seeded — drills replay byte-identically), and ``breaker_n``

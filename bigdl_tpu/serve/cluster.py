@@ -47,7 +47,10 @@ job queue).  Here that layer is explicit and TPU-shaped:
 
 Flags: ``BIGDL_SERVE_REPLICAS`` (pool size default),
 ``BIGDL_SERVE_SLO_MS`` / ``BIGDL_SERVE_SHED`` (router admission —
-serve/router.py).
+serve/router.py), ``BIGDL_SERVE_HOSTS`` / ``BIGDL_SERVE_TOKEN`` /
+``BIGDL_SERVE_LIVENESS_S`` (cross-host fleet over TCP replica agents —
+serve/remote.py), ``BIGDL_SERVE_MAX_FRAME_MB`` (frame-size bound —
+serve/frames.py).
 """
 from __future__ import annotations
 
@@ -55,7 +58,6 @@ import itertools
 import logging
 import os
 import pickle
-import struct
 import subprocess
 import sys
 import threading
@@ -67,14 +69,15 @@ import numpy as np
 
 from bigdl_tpu.serve.engine import (PoisonedRequestError, ServeEngine,
                                     SheddedError)
+from bigdl_tpu.serve.frames import FrameProtocolError
+from bigdl_tpu.serve.frames import read_frame as _read_frame
+from bigdl_tpu.serve.frames import write_frame as _write_frame
 from bigdl_tpu.serve.paging import RequestTooLongError
 from bigdl_tpu.serve.router import (DeadReplicaError, Router,
                                     replicas_default)
 from bigdl_tpu.serve.streaming import StreamFuture, TokenDelivery
 
 logger = logging.getLogger("bigdl_tpu.serve")
-
-_LEN = struct.Struct(">Q")
 
 _POOL_SEQ = itertools.count()
 
@@ -90,6 +93,7 @@ _EXC_TYPES = {
     "SheddedError": SheddedError,
     "DeadReplicaError": DeadReplicaError,
     "RequestTooLongError": RequestTooLongError,
+    "FrameProtocolError": FrameProtocolError,
     "ValueError": ValueError,
     "RuntimeError": RuntimeError,
     "OSError": OSError,
@@ -230,32 +234,6 @@ class LocalReplica:
         self.engine.close(drain=drain)
 
 
-def _write_frame(fh, obj, lock=None):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    if lock is not None:
-        lock.acquire()
-    try:
-        fh.write(_LEN.pack(len(payload)) + payload)
-        fh.flush()
-    finally:
-        if lock is not None:
-            lock.release()
-
-
-def _read_frame(fh):
-    header = fh.read(_LEN.size)
-    if len(header) < _LEN.size:
-        return None
-    (n,) = _LEN.unpack(header)
-    payload = b""
-    while len(payload) < n:
-        chunk = fh.read(n - len(payload))
-        if not chunk:
-            return None
-        payload += chunk
-    return pickle.loads(payload)
-
-
 class ProcessReplica:
     """A serve replica in its own OS process (its own jax runtime /
     chip slice).  The parent ships the model once at spawn; requests and
@@ -351,6 +329,13 @@ class ProcessReplica:
         while True:
             try:
                 msg = _read_frame(self.proc.stdout)
+            except FrameProtocolError as e:
+                # a malformed/corrupt/desynced frame from the child is
+                # indistinguishable from death for recovery purposes,
+                # but the POSTMORTEM must name the protocol violation
+                logger.warning("replica %s: %s; treating as death",
+                               self.name, e)
+                msg = None
             except (OSError, ValueError, EOFError, pickle.PickleError):
                 msg = None
             if msg is None:
@@ -522,6 +507,12 @@ class ProcessReplica:
         try:
             _write_frame(self.proc.stdin,
                          dict(fields, op=op, id=rid), self._wlock)
+        except FrameProtocolError as e:
+            # an over-bound payload fails ONLY this rpc — nothing was
+            # written, the stream stays frame-aligned, the replica lives
+            with self._lock:
+                self._futures.pop(rid, None)
+            fut.set_exception(e)
         except (OSError, ValueError):
             self._on_death()
         return fut
@@ -785,12 +776,27 @@ class ReplicaPool(DynamicMembership):
                  est_ms: float = 50.0, store: WeightStore | None = None,
                  trace_sample: float | None = None,
                  name: str | None = None, replica_factory=None,
+                 remote: bool | None = None, hosts=None, token=None,
                  **engine_kwargs):
         self.name = name or f"pool{next(_POOL_SEQ)}"
         self._model = model
         self._process = bool(process)
         self._engine_kwargs = dict(engine_kwargs)
         self._replica_factory = replica_factory
+        # cross-host fleet (docs/serving.md "Cross-host fleet"):
+        # remote=True (or hosts=/BIGDL_SERVE_HOSTS) leases replica-agent
+        # addresses from a HostInventory and speaks TCP instead of
+        # spawning local children — the autoscaler then scales across
+        # the inventory, and exhaustion surfaces as ReplicaSpawnError
+        # (the same circuit-breaker type as a local spawn failure)
+        self._inventory = None
+        if remote or (remote is None and hosts is not None):
+            from bigdl_tpu.serve import remote as remote_mod
+            self._inventory = remote_mod.HostInventory(hosts, token=token)
+        elif remote is None and hosts is None and token is None:
+            from bigdl_tpu.serve import remote as remote_mod
+            if remote_mod.hosts_default():
+                self._inventory = remote_mod.HostInventory()
         #: serializes membership changes against rollouts: a replica
         #: added mid-rollout must land on the COMMITTED version, never
         #: the staged one (the two-phase-rollout bar)
@@ -875,13 +881,15 @@ class ReplicaPool(DynamicMembership):
     def _next_name(self) -> str:
         n = self._next_replica
         self._next_replica += 1
+        if self._inventory is not None:
+            return f"remote{n}"
         return f"{'proc' if self._process else 'local'}{n}"
 
     def _spawn_replica(self, name: str, env=None, **overrides):
         """Build one replica the way this pool was configured
-        (``replica_factory`` > subprocess > in-process engine).
-        Construction IS the xcache warmup: the engine compiles every
-        bucket before this returns."""
+        (``replica_factory`` > remote lease > subprocess > in-process
+        engine).  Construction IS the xcache warmup: the engine
+        compiles every bucket before this returns."""
         if self._replica_factory is not None:
             return self._replica_factory(name)
         if self._model is None:
@@ -891,6 +899,20 @@ class ReplicaPool(DynamicMembership):
                 "replica_factory= to scale it)")
         kw = dict(self._engine_kwargs)
         kw.update(overrides)
+        if self._inventory is not None:
+            from bigdl_tpu.serve import remote as remote_mod
+            kw.pop("env", None)
+            addr = self._inventory.lease()
+            try:
+                return remote_mod.RemoteReplica(
+                    addr, self._model, name=name,
+                    token=self._inventory.token,
+                    on_release=self._inventory.release, **kw)
+            except Exception:
+                # failed spawns hand the host back: the autoscaler's
+                # retry may succeed once the agent is reachable again
+                self._inventory.release(addr)
+                raise
         if self._process:
             # a pool-level env={...} (chaos plans, worker platform)
             # lives in engine_kwargs for back-compat with the old
@@ -1188,18 +1210,171 @@ class ReplicaPool(DynamicMembership):
 
 
 # ---------------------------------------------------------------------------
+# transport-agnostic worker op dispatch
+# ---------------------------------------------------------------------------
+
+class WorkerOps:
+    """Transport-agnostic op dispatch for one replica worker.
+
+    The SAME handler instance answers frames whether they arrived over
+    a ProcessReplica's stdio pipe (:func:`worker_main`) or a
+    :class:`~tools.replica_agent.ReplicaAgent` TCP session — the op-code
+    set cannot diverge between transports because there is exactly one
+    implementation of it.  ``send(msg)`` is the transport's reply
+    channel (frame writer or session outbox); :meth:`handle` returns
+    False when the worker should shut down (the ``close`` op).
+
+    Subclasses own a ``target`` (engine / decode replica / prefill
+    replica) and extend :meth:`_handle_role` with role-specific ops."""
+
+    role = "worker"
+
+    def __init__(self, send):
+        from bigdl_tpu.resilience import faults
+        self.send = send
+        self.injector = faults.get()
+        self.target = None
+
+    # -- reply plumbing -----------------------------------------------------
+    def _ok(self, rid, out):
+        self.send({"id": rid, "ok": True, "out": out})
+
+    def _err(self, rid, exc):
+        self.send({"id": rid, "ok": False, "etype": type(exc).__name__,
+                   "error": str(exc)})
+
+    def _reply(self, rid, fut, tr=None):
+        try:
+            out = fut.result()
+            msg = {"id": rid, "ok": True, "out": out}
+            if tr is not None:
+                # only the hops stamped on THIS side of the wire; the
+                # parent extends its original context with them
+                msg["hops"] = tr.new_hops()
+            self.send(msg)
+        except BaseException as e:
+            self._err(rid, e)
+
+    def _chaos_kill(self):
+        """``BIGDL_FAULTS=serve_kill@at=N``: die at the Nth submitted
+        request — the requeue-on-replica-death chaos site.  For a TCP
+        agent this kills the whole agent process (real death, not a
+        blip — ``serve_partition`` is the blip site)."""
+        inj = self.injector
+        if (inj is not None and inj.armed("serve_kill")
+                and inj.fires("serve_kill")):
+            # last words on stderr: the parent's ring captures them and
+            # the kill drill asserts the tail survives into
+            # DeadReplicaError + the crash bundle
+            print(f"serve_kill chaos fired: {self.role} replica pid "
+                  f"{os.getpid()} exiting", file=sys.stderr, flush=True)
+            sys.stdout.flush()
+            os._exit(1)   # induced replica death (chaos drill)
+
+    # -- dispatch -----------------------------------------------------------
+    def handle(self, msg) -> bool:
+        """Answer one frame; False = close requested (worker exits)."""
+        op, rid = msg.get("op"), msg.get("id")
+        try:
+            if op == "ping":
+                # connection-liveness probe (RemoteReplica's heartbeat;
+                # harmless no-op over stdio)
+                self._ok(rid, {"pong": True, "role": self.role})
+            elif op == "stats":
+                self._ok(rid, self.target.stats())
+            elif op == "telemetry":
+                from bigdl_tpu.obs import metrics as obs_metrics
+                self._ok(rid, {"stats": self.target.stats(),
+                               "registry": obs_metrics.get().snapshot()})
+            elif op == "close":
+                self.target.close(drain=msg.get("drain", True))
+                self._ok(rid, None)
+                return False
+            else:
+                return self._handle_role(op, rid, msg)
+        except BaseException as e:
+            self._err(rid, e)
+        return True
+
+    def _handle_role(self, op, rid, msg) -> bool:
+        self.send({"id": rid, "ok": False, "etype": "ValueError",
+                   "error": f"unknown op {op!r} for role "
+                            f"{self.role!r}"})
+        return True
+
+    def close_abrupt(self):
+        """EOF/protocol-death epilogue: close the target undrained."""
+        if self.target is not None:
+            self.target.close(drain=False)
+
+
+class EngineOps(WorkerOps):
+    """The serve-engine worker ops (submit + stats/telemetry + the
+    two-phase rollout verbs) — :func:`replica_main`'s historical op set,
+    now shared verbatim with the TCP agent."""
+
+    role = "engine"
+
+    def __init__(self, init, send):
+        super().__init__(send)
+        self.target = ServeEngine(init["model"], **init.get("engine", {}))
+
+    def _handle_role(self, op, rid, msg) -> bool:
+        engine = self.target
+        if op == "submit":
+            self._chaos_kill()
+            from bigdl_tpu.obs import trace as obs_trace
+            tr = (obs_trace.Trace.from_wire(msg["trace"])
+                  if msg.get("trace") else None)
+            fut = engine.submit(msg["x"], trace=tr)
+            fut.add_done_callback(
+                lambda f, r=rid, t=tr: self._reply(r, f, t))
+        elif op == "version":
+            self._ok(rid, engine.weights_version)
+        elif op == "stage":
+            engine.stage_weights(msg["params"], msg["state"],
+                                 msg.get("version"))
+            self._ok(rid, None)
+        elif op == "commit":
+            self._ok(rid, engine.commit_weights())
+        elif op == "rollback":
+            engine.rollback_weights()
+            self._ok(rid, None)
+        elif op == "revert":
+            self._ok(rid, engine.revert_weights())
+        else:
+            return super()._handle_role(op, rid, msg)
+        return True
+
+
+def build_worker_ops(init, send) -> WorkerOps:
+    """The ops handler for one ``init`` frame: engine by default, the
+    fleet roles (decode/prefill) when the frame names one.  Shared by
+    :func:`worker_main` (stdio) and the TCP replica agent."""
+    role = init.get("role", "engine")
+    if role == "engine":
+        return EngineOps(init, send)
+    from bigdl_tpu.serve import fleet as fleet_mod
+    return fleet_mod.build_fleet_ops(init, send)
+
+
+# ---------------------------------------------------------------------------
 # subprocess replica worker
 # ---------------------------------------------------------------------------
 
-def replica_main(stdin=None, stdout=None):
-    """Entry point of a ProcessReplica child: host one ServeEngine and
-    answer frames until EOF/close.  Runs with its own jax runtime
-    (platform via ``BIGDL_SERVE_WORKER_PLATFORM``, default cpu — on a
-    real fleet each replica process owns its accelerator slice).
+def worker_main(stdin=None, stdout=None):
+    """Entry point of a ProcessReplica child: build the ops handler the
+    init frame names (engine / decode / prefill) and answer frames
+    until EOF/close.  Runs with its own jax runtime (platform via
+    ``BIGDL_SERVE_WORKER_PLATFORM``, default cpu — on a real fleet each
+    replica process owns its accelerator slice).
 
     ``BIGDL_FAULTS=serve_kill@at=N[,proc=...]`` kills this process at
     the Nth submitted request (``os._exit``) — the chaos drill for the
-    router's requeue-on-replica-death path."""
+    router's requeue-on-replica-death path.  A malformed frame on stdin
+    (:class:`~bigdl_tpu.serve.frames.FrameProtocolError`) is fatal for
+    the worker: it logs the violation to stderr and exits rather than
+    resynchronizing against a corrupt stream."""
     stdin = stdin or sys.stdin.buffer
     stdout = stdout or sys.stdout.buffer
 
@@ -1226,11 +1401,10 @@ def replica_main(stdin=None, stdout=None):
               f"{os.getpid()} exiting", file=sys.stderr, flush=True)
         return 7
     from bigdl_tpu.obs import events as obs_events
-    from bigdl_tpu.obs import metrics as obs_metrics
-    from bigdl_tpu.obs import trace as obs_trace
-    from bigdl_tpu.resilience import faults
-    injector = faults.get()
     wlock = threading.Lock()
+
+    def send(msg):
+        _write_frame(stdout, msg, wlock)
 
     # stream THIS process's obs events to the parent as they happen —
     # the sink is registered before the engine exists so even its
@@ -1238,98 +1412,31 @@ def replica_main(stdin=None, stdout=None):
     # by add_sink's contract (a dying pipe must not kill the emitter).
     log = obs_events.get()
     if log is not None:
-        log.add_sink(lambda ev: _write_frame(
-            stdout, {"op": "event", "event": ev}, wlock))
+        log.add_sink(lambda ev: send({"op": "event", "event": ev}))
 
-    engine = ServeEngine(init["model"], **init.get("engine", {}))
-    _write_frame(stdout, {"op": "ready", "pid": os.getpid()}, wlock)
-
-    def reply(rid, fut, tr=None):
-        try:
-            out = fut.result()
-            msg = {"id": rid, "ok": True, "out": out}
-            if tr is not None:
-                # only the hops stamped on THIS side of the wire; the
-                # parent extends its original context with them
-                msg["hops"] = tr.new_hops()
-            _write_frame(stdout, msg, wlock)
-        except BaseException as e:
-            _write_frame(stdout, {"id": rid, "ok": False,
-                                  "etype": type(e).__name__,
-                                  "error": str(e)}, wlock)
+    ops = build_worker_ops(init, send)
+    send({"op": "ready", "pid": os.getpid()})
 
     while True:
-        msg = _read_frame(stdin)
+        try:
+            msg = _read_frame(stdin)
+        except FrameProtocolError as e:
+            print(f"frame protocol error on stdin: {e}; worker exiting",
+                  file=sys.stderr, flush=True)
+            break
         if msg is None:
             break
-        op, rid = msg.get("op"), msg.get("id")
-        try:
-            if op == "submit":
-                # chaos site keyed by the per-site query counter: the
-                # Nth submitted request kills this replica mid-stream
-                if (injector is not None and injector.armed("serve_kill")
-                        and injector.fires("serve_kill")):
-                    # last words on stderr: the parent's ring captures
-                    # them and the kill drill asserts the tail survives
-                    # into DeadReplicaError + the crash bundle
-                    print(f"serve_kill chaos fired: replica pid "
-                          f"{os.getpid()} exiting", file=sys.stderr,
-                          flush=True)
-                    sys.stdout.flush()
-                    os._exit(1)   # induced replica death (chaos drill)
-                tr = (obs_trace.Trace.from_wire(msg["trace"])
-                      if msg.get("trace") else None)
-                fut = engine.submit(msg["x"], trace=tr)
-                fut.add_done_callback(
-                    lambda f, r=rid, t=tr: reply(r, f, t))
-            elif op == "stats":
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": engine.stats()}, wlock)
-            elif op == "telemetry":
-                _write_frame(
-                    stdout,
-                    {"id": rid, "ok": True,
-                     "out": {"stats": engine.stats(),
-                             "registry": obs_metrics.get().snapshot()}},
-                    wlock)
-            elif op == "version":
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": engine.weights_version},
-                             wlock)
-            elif op == "stage":
-                engine.stage_weights(msg["params"], msg["state"],
-                                     msg.get("version"))
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": None}, wlock)
-            elif op == "commit":
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": engine.commit_weights()},
-                             wlock)
-            elif op == "rollback":
-                engine.rollback_weights()
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": None}, wlock)
-            elif op == "revert":
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": engine.revert_weights()},
-                             wlock)
-            elif op == "close":
-                engine.close(drain=msg.get("drain", True))
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": None}, wlock)
-                return 0
-            else:
-                _write_frame(stdout, {"id": rid, "ok": False,
-                                      "etype": "ValueError",
-                                      "error": f"unknown op {op!r}"},
-                             wlock)
-        except BaseException as e:
-            _write_frame(stdout, {"id": rid, "ok": False,
-                                  "etype": type(e).__name__,
-                                  "error": str(e)}, wlock)
-    engine.close(drain=False)
+        if not ops.handle(msg):
+            return 0
+    ops.close_abrupt()
     return 0
 
 
+def replica_main(stdin=None, stdout=None):
+    """Back-compat alias: the engine worker entry point (init frames
+    without a ``role`` build an :class:`EngineOps`)."""
+    return worker_main(stdin, stdout)
+
+
 if __name__ == "__main__":
-    sys.exit(replica_main())
+    sys.exit(worker_main())

@@ -65,6 +65,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from bigdl_tpu.serve import cluster as cluster_ops
 from bigdl_tpu.serve.cluster import (ENV_SPAWN_FAIL, DynamicMembership,
                                      ProcessReplica, _read_frame,
                                      _write_frame)
@@ -336,6 +337,36 @@ class DecodeReplica:
             self._tier.close()
 
 
+def pages_nbytes(pages) -> int:
+    """Wire weight (bytes) of one shipped KV page payload list — the
+    numpy buffers only, the measure behind ``fleet_ship_bytes_total``
+    (int8 pages carry value+scale and land near 3.2x tokens/byte vs
+    float32; bench_serve's ``ship_bytes_per_s`` column reads this)."""
+    total = 0
+    for page in pages or ():
+        for arr in (page if isinstance(page, (tuple, list)) else (page,)):
+            nb = getattr(arr, "nbytes", None)
+            if nb is not None:
+                total += int(nb)
+    return total
+
+
+def _note_ship_bytes(replica: str, transport: str, pages):
+    """Count one prefill→decode page shipment's bytes onto
+    ``fleet_ship_bytes_total{transport,replica}``."""
+    if not pages:
+        return
+    try:
+        from bigdl_tpu.obs import metrics as obs_metrics
+        obs_metrics.get().counter(
+            "fleet_ship_bytes_total",
+            "KV page payload bytes shipped prefill→decode, by wire",
+            transport=transport, replica=replica,
+        ).inc(pages_nbytes(pages))
+    except Exception:   # pragma: no cover - registry mid-teardown
+        pass
+
+
 class ProcessDecodeReplica(ProcessReplica):
     """A decode replica in its own OS process (its own jax runtime /
     chip slice), speaking the cluster frame protocol with a fleet
@@ -350,6 +381,7 @@ class ProcessDecodeReplica(ProcessReplica):
                 "decoder": worker_kwargs}
 
     def submit(self, x, trace=None) -> Future:
+        _note_ship_bytes(self.name, "stdio", x.get("pages"))
         return self._send(
             "submit", _trace=trace,
             seed=[int(t) for t in x["seed"]],
@@ -889,7 +921,8 @@ class DecodeFleet(DynamicMembership):
                  est_ms: float = 50.0, trace_sample: float | None = None,
                  max_seed_pages: int = 8, decode_env=None,
                  prefill_env=None, name: str | None = None,
-                 replica_factory=None, **decoder_kwargs):
+                 replica_factory=None, remote: bool | None = None,
+                 hosts=None, token=None, **decoder_kwargs):
         ps = _page_size_default(decoder_kwargs)
         decoder_kwargs["page_size"] = ps
         kv_quant = decoder_kwargs.get("kv_quant")
@@ -900,6 +933,14 @@ class DecodeFleet(DynamicMembership):
         self._host_mb = host_mb
         self._decode_env = decode_env
         self._replica_factory = replica_factory
+        # cross-host decode fleet: lease replica-agent addresses instead
+        # of spawning local children (docs/serving.md "Cross-host
+        # fleet"); prefill replicas stay local — pages ship to the
+        # remote decoders over TCP (fleet_ship_bytes_total{transport})
+        self._inventory = None
+        if remote or (remote is None and hosts is not None):
+            from bigdl_tpu.serve import remote as remote_mod
+            self._inventory = remote_mod.HostInventory(hosts, token=token)
         self._scale_lock = threading.RLock()
         self._warming = 0
         self._next_decode = 0
@@ -968,7 +1009,8 @@ class DecodeFleet(DynamicMembership):
         return f"decode{n}"
 
     def _spawn_replica(self, name: str, env=None):
-        """Build one decode replica the way this fleet was configured.
+        """Build one decode replica the way this fleet was configured
+        (``replica_factory`` > remote lease > subprocess > in-process).
         Construction IS the warmup: the decoder pre-compiles its
         step/admit/retire programs through the xcache (an identical
         configuration costs zero new compiles) before the router may
@@ -980,6 +1022,18 @@ class DecodeFleet(DynamicMembership):
                 "dynamic membership needs the fleet's model (this "
                 "fleet was built from pre-built replicas; pass "
                 "replica_factory= to scale it)")
+        if self._inventory is not None:
+            from bigdl_tpu.serve import remote as remote_mod
+            addr = self._inventory.lease()
+            try:
+                return remote_mod.RemoteDecodeReplica(
+                    addr, self._model, name=name,
+                    token=self._inventory.token,
+                    on_release=self._inventory.release,
+                    host_mb=self._host_mb, **self._decoder_kwargs)
+            except Exception:
+                self._inventory.release(addr)
+                raise
         if self._process:
             return ProcessDecodeReplica(
                 self._model, name=name,
@@ -1122,143 +1176,84 @@ class DecodeFleet(DynamicMembership):
 # subprocess fleet worker
 # ---------------------------------------------------------------------------
 
+class DecodeOps(cluster_ops.WorkerOps):
+    """Fleet decode-worker ops: ``submit`` with optional shipped pages
+    and incremental token frames (each chunk crosses the wire with its
+    absolute start index, so the parent-side StreamFuture dedup holds
+    across the process/TCP hop)."""
+
+    role = "decode"
+
+    def __init__(self, init, send):
+        super().__init__(send)
+        self.target = DecodeReplica(init["model"],
+                                    **init.get("decoder", {}))
+
+    def _handle_role(self, op, rid, msg) -> bool:
+        if op != "submit":
+            return super()._handle_role(op, rid, msg)
+        self._chaos_kill()
+        from bigdl_tpu.obs import trace as obs_trace
+        x = {"seed": msg["seed"], "n_words": msg["n_words"]}
+        if msg.get("pages"):
+            x["pages"] = msg["pages"]
+        if msg.get("stream"):
+            x["stream"] = True
+        tr = (obs_trace.Trace.from_wire(msg["trace"])
+              if msg.get("trace") else None)
+        fut = self.target.submit(x, trace=tr)
+        if msg.get("stream"):
+            fut.on_tokens_indexed(
+                lambda toks, start, r=rid: self.send(
+                    {"op": "tokens", "id": r, "tokens": toks,
+                     "start": start}))
+        fut.add_done_callback(
+            lambda f, r=rid, t=tr: self._reply(r, f, t))
+        return True
+
+
+class PrefillOps(cluster_ops.WorkerOps):
+    """Fleet prefill-worker ops: ``prefill`` resolving to the seed's
+    shippable KV page payloads."""
+
+    role = "prefill"
+
+    def __init__(self, init, send):
+        super().__init__(send)
+        self.target = PrefillReplica(init["model"],
+                                     **init.get("prefill", {}))
+
+    def _handle_role(self, op, rid, msg) -> bool:
+        if op != "prefill":
+            return super()._handle_role(op, rid, msg)
+        self._chaos_kill()
+        fut = self.target.prefill_async(msg["seed"])
+        fut.add_done_callback(lambda f, r=rid: self._reply(r, f))
+        return True
+
+
+def build_fleet_ops(init, send):
+    """The fleet-role dispatcher behind
+    :func:`bigdl_tpu.serve.cluster.build_worker_ops` — decode and
+    prefill workers share the base op set with the engine workers."""
+    role = init.get("role")
+    if role == "decode":
+        return DecodeOps(init, send)
+    if role == "prefill":
+        return PrefillOps(init, send)
+    raise ValueError(f"unknown fleet worker role {init.get('role')!r}")
+
+
 def fleet_main(stdin=None, stdout=None):
     """Entry point of a fleet ProcessReplica child: host one decode or
     prefill replica (the init frame's ``role``) and answer frames until
-    EOF/close — :func:`bigdl_tpu.serve.cluster.replica_main`'s protocol
-    with fleet ops.
+    EOF/close — :func:`bigdl_tpu.serve.cluster.worker_main` with the
+    fleet ops (:class:`DecodeOps` / :class:`PrefillOps`).
 
     ``BIGDL_FAULTS=serve_kill@at=N`` kills this process at the Nth
     submitted request / prefill — the chaos site behind the fleet
     drill's prefill-death and decode-requeue assertions."""
-    stdin = stdin or sys.stdin.buffer
-    stdout = stdout or sys.stdout.buffer
-
-    import jax
-    platform = os.environ.get("BIGDL_SERVE_WORKER_PLATFORM", "cpu")
-    jax.config.update("jax_platforms", platform)
-    if platform == "cpu":
-        from bigdl_tpu.utils.engine import set_cpu_device_count
-        set_cpu_device_count(
-            int(os.environ.get("BIGDL_SERVE_WORKER_DEVICES", "1")))
-        jax.config.update("jax_default_matmul_precision", "highest")
-    os.environ.setdefault("BIGDL_CHECK_SINGLETON", "0")
-
-    init = _read_frame(stdin)
-    if init is None or init.get("op") != "init":
-        return 2
-    if os.environ.get(ENV_SPAWN_FAIL, "0") != "0":
-        # deterministic spawn-failure chaos (cluster.replica_main's
-        # site): die during the warmup handshake so the parent raises a
-        # typed ReplicaSpawnError with this tail
-        print(f"induced spawn failure ({ENV_SPAWN_FAIL}): fleet replica "
-              f"pid {os.getpid()} exiting", file=sys.stderr, flush=True)
-        return 7
-    from bigdl_tpu.obs import events as obs_events
-    from bigdl_tpu.obs import metrics as obs_metrics
-    from bigdl_tpu.obs import trace as obs_trace
-    from bigdl_tpu.resilience import faults
-    injector = faults.get()
-    wlock = threading.Lock()
-
-    log = obs_events.get()
-    if log is not None:
-        log.add_sink(lambda ev: _write_frame(
-            stdout, {"op": "event", "event": ev}, wlock))
-
-    role = init.get("role")
-    if role == "decode":
-        replica = DecodeReplica(init["model"],
-                                **init.get("decoder", {}))
-    elif role == "prefill":
-        replica = PrefillReplica(init["model"],
-                                 **init.get("prefill", {}))
-    else:
-        return 2
-    _write_frame(stdout, {"op": "ready", "pid": os.getpid()}, wlock)
-
-    def reply(rid, fut, tr=None):
-        try:
-            out = fut.result()
-            msg = {"id": rid, "ok": True, "out": out}
-            if tr is not None:
-                # only the hops stamped on THIS side of the wire; the
-                # parent extends its original context with them
-                # (replica_main's contract, cluster.py)
-                msg["hops"] = tr.new_hops()
-            _write_frame(stdout, msg, wlock)
-        except BaseException as e:
-            _write_frame(stdout, {"id": rid, "ok": False,
-                                  "etype": type(e).__name__,
-                                  "error": str(e)}, wlock)
-
-    def chaos():
-        if (injector is not None and injector.armed("serve_kill")
-                and injector.fires("serve_kill")):
-            print(f"serve_kill chaos fired: fleet {role} replica pid "
-                  f"{os.getpid()} exiting", file=sys.stderr, flush=True)
-            sys.stdout.flush()
-            os._exit(1)
-
-    while True:
-        msg = _read_frame(stdin)
-        if msg is None:
-            break
-        op, rid = msg.get("op"), msg.get("id")
-        try:
-            if op == "submit" and role == "decode":
-                chaos()
-                x = {"seed": msg["seed"], "n_words": msg["n_words"]}
-                if msg.get("pages"):
-                    x["pages"] = msg["pages"]
-                if msg.get("stream"):
-                    x["stream"] = True
-                tr = (obs_trace.Trace.from_wire(msg["trace"])
-                      if msg.get("trace") else None)
-                fut = replica.submit(x, trace=tr)
-                if msg.get("stream"):
-                    # incremental token frames: each chunk crosses the
-                    # wire with its absolute start index, so the
-                    # parent-side StreamFuture dedup holds across the
-                    # process hop (runs on the delivery thread; wlock
-                    # keeps frames atomic vs replies/events)
-                    fut.on_tokens_indexed(
-                        lambda toks, start, r=rid: _write_frame(
-                            stdout, {"op": "tokens", "id": r,
-                                     "tokens": toks, "start": start},
-                            wlock))
-                fut.add_done_callback(
-                    lambda f, r=rid, t=tr: reply(r, f, t))
-            elif op == "prefill" and role == "prefill":
-                chaos()
-                fut = replica.prefill_async(msg["seed"])
-                fut.add_done_callback(lambda f, r=rid: reply(r, f))
-            elif op == "stats":
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": replica.stats()}, wlock)
-            elif op == "telemetry":
-                _write_frame(
-                    stdout,
-                    {"id": rid, "ok": True,
-                     "out": {"stats": replica.stats(),
-                             "registry": obs_metrics.get().snapshot()}},
-                    wlock)
-            elif op == "close":
-                replica.close(drain=msg.get("drain", True))
-                _write_frame(stdout, {"id": rid, "ok": True,
-                                      "out": None}, wlock)
-                return 0
-            else:
-                _write_frame(stdout, {"id": rid, "ok": False,
-                                      "etype": "ValueError",
-                                      "error": f"unknown op {op!r} for "
-                                               f"role {role!r}"}, wlock)
-        except BaseException as e:
-            _write_frame(stdout, {"id": rid, "ok": False,
-                                  "etype": type(e).__name__,
-                                  "error": str(e)}, wlock)
-    replica.close(drain=False)
-    return 0
+    return cluster_ops.worker_main(stdin, stdout)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,144 @@
+"""Hardened frame codec shared by every replica transport
+(docs/serving.md "Cross-host fleet").
+
+One frame = one pickled message.  The original stdio protocol was a
+bare ``u64 length + pickle`` pair, which was fine between a parent and
+the child IT spawned, but the same frames now also cross TCP between
+hosts (``serve/remote.py`` / ``tools/replica_agent.py``), where the
+reader must assume the peer can be wrong, stale, or hostile:
+
+- a **magic + protocol-version prefix** rejects a desynchronized or
+  foreign byte stream before anything reaches ``pickle.loads``;
+- a **max-frame-size bound** (``BIGDL_SERVE_MAX_FRAME_MB``) stops a
+  corrupt length word from hanging the reader on a multi-terabyte
+  ``read`` (the default is generous — ``stage`` frames legitimately
+  carry full model params);
+- a **per-frame CRC32** catches payload corruption, so garbage bytes
+  fail loudly with the offending CRC instead of being fed to
+  ``pickle.loads`` (which would execute attacker-shaped opcodes);
+- **truncation is typed**: a stream that dies mid-frame raises
+  :class:`FrameProtocolError` with the got/want byte counts, while a
+  clean EOF at a frame boundary returns ``None`` (the normal
+  worker-death signal the reader loops already handle).
+
+Wire layout (big-endian, 16-byte header)::
+
+    +----+----+-------+---------+------------+---------------+
+    | 'B'| 'F'| ver u8| flags u8| crc32  u32 | length u64    | payload...
+    +----+----+-------+---------+------------+---------------+
+
+Both transports — the stdio pipes of :class:`ProcessReplica` and the
+TCP sockets of :class:`RemoteReplica` — speak exactly this framing;
+``serve/cluster.py`` re-exports :func:`read_frame`/:func:`write_frame`
+under its historical ``_read_frame``/``_write_frame`` names.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+
+MAGIC = b"BF"
+PROTOCOL_VERSION = 1
+
+#: magic(2) + version(1) + flags(1) + crc32(4) + length(8)
+_HDR = struct.Struct(">2sBBIQ")
+
+ENV_MAX_FRAME_MB = "BIGDL_SERVE_MAX_FRAME_MB"
+#: default bound: big enough for a stage frame shipping full model
+#: params, small enough that a corrupt length word cannot wedge the
+#: reader allocating terabytes
+DEFAULT_MAX_FRAME_MB = 4096
+
+
+class FrameProtocolError(RuntimeError):
+    """A frame failed validation (bad magic, version mismatch, length
+    over the bound, truncation mid-frame, or CRC mismatch).  Reader
+    loops treat it as peer death/desync — the payload is NEVER handed
+    to ``pickle.loads``."""
+
+
+def max_frame_bytes() -> int:
+    """The frame-size bound (bytes) from ``BIGDL_SERVE_MAX_FRAME_MB``."""
+    try:
+        mb = float(os.environ.get(ENV_MAX_FRAME_MB, "") or
+                   DEFAULT_MAX_FRAME_MB)
+    except ValueError:
+        mb = DEFAULT_MAX_FRAME_MB
+    return max(1, int(mb * (1 << 20)))
+
+
+def write_frame(fh, obj, lock=None, max_bytes: int | None = None):
+    """Serialize ``obj`` as one frame onto ``fh`` (atomic under
+    ``lock`` when given).  An over-bound payload raises
+    :class:`FrameProtocolError` BEFORE any byte is written, so the
+    stream stays frame-aligned and only the offending message fails."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    bound = max_frame_bytes() if max_bytes is None else int(max_bytes)
+    if len(payload) > bound:
+        raise FrameProtocolError(
+            f"refusing to write a {len(payload)}-byte frame: over the "
+            f"{bound}-byte bound ({ENV_MAX_FRAME_MB} raises it)")
+    header = _HDR.pack(MAGIC, PROTOCOL_VERSION, 0,
+                       zlib.crc32(payload), len(payload))
+    if lock is not None:
+        lock.acquire()
+    try:
+        fh.write(header + payload)
+        fh.flush()
+    finally:
+        if lock is not None:
+            lock.release()
+
+
+def _read_exact(fh, n: int, what: str):
+    """Read exactly ``n`` bytes.  Zero bytes at the start is a clean
+    EOF (returns None); anything in between is a typed truncation."""
+    buf = b""
+    while len(buf) < n:
+        chunk = fh.read(n - len(buf))
+        if not chunk:
+            if not buf:
+                return None
+            raise FrameProtocolError(
+                f"truncated frame {what}: got {len(buf)} of {n} bytes "
+                f"before EOF")
+        buf += chunk
+    return buf
+
+
+def read_frame(fh, max_bytes: int | None = None):
+    """Read and validate one frame from ``fh``.  Returns the decoded
+    object, or ``None`` on a clean EOF at a frame boundary.  Any
+    malformation — bad magic, version mismatch, over-bound length,
+    truncation, CRC mismatch — raises :class:`FrameProtocolError`
+    naming the offending value."""
+    header = _read_exact(fh, _HDR.size, "header")
+    if header is None:
+        return None
+    magic, version, _flags, crc, n = _HDR.unpack(header)
+    if magic != MAGIC:
+        raise FrameProtocolError(
+            f"bad frame magic {magic!r} (want {MAGIC!r}): stream is "
+            f"desynchronized or not a bigdl frame stream")
+    if version != PROTOCOL_VERSION:
+        raise FrameProtocolError(
+            f"frame protocol version {version} does not match this "
+            f"reader (v{PROTOCOL_VERSION}); upgrade the older peer")
+    bound = max_frame_bytes() if max_bytes is None else int(max_bytes)
+    if n > bound:
+        raise FrameProtocolError(
+            f"frame length {n} exceeds the {bound}-byte bound "
+            f"({ENV_MAX_FRAME_MB} raises it); likely a corrupt length "
+            f"word")
+    payload = _read_exact(fh, n, "payload")
+    if payload is None:
+        raise FrameProtocolError(
+            f"truncated frame payload: got 0 of {n} bytes before EOF")
+    actual = zlib.crc32(payload)
+    if actual != crc:
+        raise FrameProtocolError(
+            f"frame CRC mismatch over {n} bytes: header says "
+            f"0x{crc:08x}, payload hashes to 0x{actual:08x}")
+    return pickle.loads(payload)

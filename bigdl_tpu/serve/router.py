@@ -35,7 +35,13 @@ has:
 
 The router never touches jax: replicas are anything with the small
 ``submit/stats/inflight/alive`` surface (``serve/cluster.py`` provides
-in-process and subprocess implementations).
+in-process and subprocess implementations; ``serve/remote.py`` puts
+the same surface on TCP).  Cross-host note: a ``RemoteReplica``
+reports ``alive() == True`` through a network blip shorter than its
+liveness budget — the health monitor therefore does NOT requeue on a
+transient partition; requeue-exactly-once happens only when the blip
+budget is spent and the replica fails typed with
+:class:`DeadReplicaError` (docs/serving.md "Cross-host fleet").
 
 Telemetry: the admission counters live in the mergeable metrics
 registry (``obs/metrics.py``, labelled ``router=<name>``), and the

@@ -32,4 +32,12 @@ echo "== chaos drill: serving capstone (burst + serve_kill + rollout + autoscale
 JAX_PLATFORMS=cpu python -m pytest tests/test_autoscale.py -q \
     -k "CapstoneChaosDrill" -p no:cacheprovider "$@"
 
+echo "== chaos drill: cross-host partition (serve_partition, TCP loopback) =="
+# blip-vs-death over real sockets: the fast in-process-agent matrix
+# (blip re-attach / sustained-partition requeue-exactly-once) plus the
+# slow real-agent-subprocess drill with env-armed serve_partition chaos
+JAX_PLATFORMS=cpu python -m pytest tests/test_remote.py -q \
+    -k "BlipVsDeath or PartitionDrillFleet or RealAgent" \
+    -p no:cacheprovider "$@"
+
 echo "chaos drill: all green"

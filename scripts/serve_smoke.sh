@@ -33,6 +33,12 @@
 #     the declared budget, prefix hit-rate and spec acceptance equal
 #     to the fp run within tolerance, zero cold compiles — plus
 #     tools/quant_check.py --strict pinning top1/top5 within budget;
+#   - cross-host fleet drill (docs/serving.md "Cross-host fleet"): 2
+#     remote decode replicas behind TCP replica agents, a mid-burst
+#     partition under the liveness budget (zero dropped futures, zero
+#     requeues, zero cold compiles after warmup) and a sustained one
+#     (requeue-exactly-once) — in-process agents fast, REAL agent
+#     subprocesses in the slow variant;
 #   - CAPSTONE CHAOS DRILL (docs/serving.md "Autoscaling"): seeded
 #     bursty traffic + a mid-burst replica kill + a hot weight rollout
 #     + an SLO-driven autoscale-up — every future resolves exactly
@@ -53,7 +59,7 @@ export JAX_PLATFORMS=cpu
 python -m pytest -q -m "(serve or quant or stream or autoscale) and not slow" \
     -p no:cacheprovider -p no:randomly \
     tests/test_serve.py tests/test_serve_cluster.py tests/test_quant.py \
-    tests/test_streaming.py tests/test_autoscale.py \
+    tests/test_streaming.py tests/test_autoscale.py tests/test_remote.py \
     "$@"
 
 # The narrowed form is a targeted check; the drill needs the full run.
@@ -548,6 +554,20 @@ PY
 python tools/obs_report.py "$OBSRUN" --strict -o "$OBSRUN/report.md"
 grep -q "Trace waterfall" "$OBSRUN/report.md"
 echo "OK: trace waterfall rendered ($OBSRUN/report.md)"
+
+echo "== serve smoke: cross-host fleet drill (TCP loopback) =="
+# 2 remote decode replicas behind replica agents: a mid-burst network
+# partition under the liveness budget re-attaches the same sessions
+# (zero dropped futures, zero requeues, zero cold compiles after
+# warmup), a sustained one converts to requeue-exactly-once.  Fast
+# variant drives in-process agents; the slow variant spawns REAL
+# tools/replica_agent.py subprocesses and partitions over real sockets
+# (docs/serving.md "Cross-host fleet").
+python -m pytest -q -p no:cacheprovider -p no:randomly \
+    tests/test_remote.py -k "BlipVsDeath or PartitionDrillFleet"
+python -m pytest -q -p no:cacheprovider -p no:randomly -m slow \
+    tests/test_remote.py -k "RealAgent"
+echo "OK: cross-host fleet drill green"
 
 echo "== serve smoke: capstone chaos drill (burst + kill + rollout + autoscale) =="
 # fast in-process variant (the tier-1 drill, run end to end here)

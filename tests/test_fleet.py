@@ -567,10 +567,20 @@ class TestBenchFleetContract:
             "families", "zipf_a", "requests", "tokens", "wall_s",
             "tok_per_s", "hit_rate", "affinity_hits", "affinity_misses",
             "prefill_shipped", "prefill_fallback", "prefill_skipped",
-            "kv_host_readmitted", "per_replica"}
+            "kv_host_readmitted", "per_replica", "transport",
+            "ship_bytes_per_s"}
         assert row["mode"] == "fleet_sweep"
         assert row["hit_rate"] == pytest.approx(4 / 6)
         assert row["kv_host_readmitted"] == 1
+        # contract extension rides on defaults: old callers that never
+        # pass transport/ship still produce a well-formed row
+        assert row["transport"] == "inproc"
+        assert row["ship_bytes_per_s"] == 0.0
+        tcp = bench.fleet_row("affinity", 2, 1, 6, 1.1, 8, 32, 0.5,
+                              router, replicas, transport="tcp",
+                              ship_bytes_per_s=123.5)
+        assert tcp["transport"] == "tcp"
+        assert tcp["ship_bytes_per_s"] == pytest.approx(123.5)
         roles = {p["name"]: p["role"] for p in row["per_replica"]}
         assert roles == {"decode0": "decode", "prefill0": "prefill"}
         assert row["per_replica"][1]["pages_shipped"] == 6

@@ -1,0 +1,585 @@
+"""Cross-host fleet suite (docs/serving.md "Cross-host fleet", marker
+``serve``): frame hardening, the host inventory, and the RemoteReplica
+blip-vs-death matrix.
+
+The PR-16 tentpole contracts:
+
+- the hardened frame codec rejects truncated, corrupt, oversized and
+  version-mismatched frames with a typed :class:`FrameProtocolError`
+  naming the offending value, on BOTH transports (in-memory pipe bytes
+  and a real socket pair) — garbage never reaches ``pickle.loads``;
+- a network blip shorter than the liveness budget re-attaches to the
+  SAME agent session: session epoch unchanged, zero router requeues,
+  the streamed chunk chain byte-identical to the uninterrupted decode;
+- a sustained partition (or agent death) converts to the existing
+  :class:`DeadReplicaError` path — every future resolves exactly once,
+  requeue-exactly-once onto survivors through the fleet router;
+- a rollout issued mid-blip lands on the committed version once the
+  link re-attaches (the pending-frame replay + rid dedup);
+- the host inventory caps scale-up with the autoscaler's
+  circuit-breaker type (:class:`ReplicaSpawnError`) and re-leases a
+  released address;
+- slow variants run the same drills against a REAL
+  ``tools/replica_agent.py`` subprocess over TCP loopback.
+"""
+import importlib.util
+import io
+import os
+import socket
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.nn.module import Context
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.serve import frames
+from bigdl_tpu.serve.cluster import ReplicaPool, ReplicaSpawnError
+from bigdl_tpu.serve.fleet import DecodeFleet
+from bigdl_tpu.serve.frames import (FrameProtocolError, read_frame,
+                                    write_frame)
+from bigdl_tpu.serve.remote import (HostInventory, RemoteDecodeReplica,
+                                    RemoteReplica, parse_hosts,
+                                    spawn_agent)
+from bigdl_tpu.serve.router import DeadReplicaError
+from bigdl_tpu.utils.random import set_seed
+
+pytestmark = pytest.mark.serve
+
+
+def _tool(name):
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ra = _tool("replica_agent")
+
+TOKEN = "sesame"
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    yield
+    faults.clear()
+
+
+def _agent(**kw):
+    kw.setdefault("token", TOKEN)
+    return ra.ReplicaAgent(port=0, **kw).start()
+
+
+def _small_model():
+    set_seed(1)
+    return nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+
+
+def _oracle(model, params=None, state=None):
+    p = model.params() if params is None else params
+    s = model.state() if state is None else state
+
+    @jax.jit
+    def fwd(x):
+        out, _ = model.apply(p, x, s,
+                             Context(training=False,
+                                     key=jax.random.PRNGKey(0)))
+        return out
+
+    return lambda x: np.asarray(fwd(np.atleast_2d(x)))
+
+
+def _lm():
+    set_seed(1)
+    return TransformerLM(vocab_size=11, d_model=16, n_heads=2,
+                         n_layers=2, hidden=32)
+
+
+def _counter_value(name, **labels):
+    fam = obs_metrics.get().snapshot().get(name) or {"series": []}
+    for row in fam["series"]:
+        if all(row["labels"].get(k) == v for k, v in labels.items()):
+            return row.get("value", 0.0)
+    return 0.0
+
+
+def _remote_kinds():
+    log = obs_events.get()
+    if log is None:
+        return []
+    return [e.get("kind") for e in log.ring_events()
+            if e.get("type") == "remote"]
+
+
+# ---------------------------------------------------------------------------
+# frame-protocol hardening (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestFrameHardening:
+    def test_roundtrip_both_transports(self):
+        msg = {"op": "submit", "id": 7, "x": list(range(20))}
+        # pipe bytes
+        buf = io.BytesIO()
+        write_frame(buf, msg)
+        assert read_frame(io.BytesIO(buf.getvalue())) == msg
+        # real socket
+        a, b = socket.socketpair()
+        try:
+            wf, rf = a.makefile("wb"), b.makefile("rb")
+            write_frame(wf, msg)
+            write_frame(wf, {"op": "close"})
+            assert read_frame(rf) == msg
+            assert read_frame(rf) == {"op": "close"}
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_eof_is_none_not_error(self):
+        assert read_frame(io.BytesIO(b"")) is None
+
+    def test_truncated_header_both_transports(self):
+        buf = io.BytesIO()
+        write_frame(buf, {"op": "ping"})
+        cut = buf.getvalue()[:frames._HDR.size - 3]
+        with pytest.raises(FrameProtocolError, match="truncated frame "
+                                                     "header"):
+            read_frame(io.BytesIO(cut))
+        a, b = socket.socketpair()
+        try:
+            a.sendall(cut)
+            a.shutdown(socket.SHUT_WR)
+            with pytest.raises(FrameProtocolError, match="truncated"):
+                read_frame(b.makefile("rb"))
+        finally:
+            a.close()
+            b.close()
+
+    def test_truncated_payload_names_counts(self):
+        buf = io.BytesIO()
+        write_frame(buf, {"op": "ping", "pad": "x" * 100})
+        cut = buf.getvalue()[:-10]
+        with pytest.raises(FrameProtocolError) as ei:
+            read_frame(io.BytesIO(cut))
+        assert "payload" in str(ei.value) and "bytes" in str(ei.value)
+
+    def test_corrupt_payload_fails_crc_with_hashes(self):
+        buf = io.BytesIO()
+        write_frame(buf, {"op": "stats", "id": 3})
+        raw = bytearray(buf.getvalue())
+        raw[-1] ^= 0xFF
+        with pytest.raises(FrameProtocolError, match="CRC mismatch") as ei:
+            read_frame(io.BytesIO(bytes(raw)))
+        assert "0x" in str(ei.value)        # both hashes named
+        # and over a socket too
+        a, b = socket.socketpair()
+        try:
+            a.sendall(bytes(raw))
+            a.shutdown(socket.SHUT_WR)
+            with pytest.raises(FrameProtocolError, match="CRC mismatch"):
+                read_frame(b.makefile("rb"))
+        finally:
+            a.close()
+            b.close()
+
+    def test_bad_magic_rejected_before_pickle(self):
+        buf = io.BytesIO()
+        write_frame(buf, {"op": "ping"})
+        raw = b"ZZ" + buf.getvalue()[2:]
+        with pytest.raises(FrameProtocolError, match="bad frame magic"):
+            read_frame(io.BytesIO(raw))
+
+    def test_version_mismatch_names_both_versions(self):
+        import pickle
+        payload = pickle.dumps({"op": "ping"})
+        hdr = frames._HDR.pack(frames.MAGIC,
+                               frames.PROTOCOL_VERSION + 1, 0,
+                               zlib.crc32(payload), len(payload))
+        with pytest.raises(FrameProtocolError, match="version") as ei:
+            read_frame(io.BytesIO(hdr + payload))
+        assert str(frames.PROTOCOL_VERSION) in str(ei.value)
+
+    def test_oversize_write_raises_before_any_byte(self):
+        buf = io.BytesIO()
+        with pytest.raises(FrameProtocolError, match="bound"):
+            write_frame(buf, {"blob": b"x" * 4096}, max_bytes=64)
+        assert buf.getvalue() == b""        # stream stays frame-aligned
+
+    def test_oversize_length_word_rejected_on_read(self):
+        buf = io.BytesIO()
+        write_frame(buf, {"blob": b"x" * 4096})
+        with pytest.raises(FrameProtocolError, match="exceeds") as ei:
+            read_frame(io.BytesIO(buf.getvalue()), max_bytes=64)
+        assert frames.ENV_MAX_FRAME_MB in str(ei.value)
+
+    def test_stdio_transport_shares_the_codec(self):
+        # the cluster pipes re-export EXACTLY these functions — the
+        # hardening cannot diverge between transports
+        from bigdl_tpu.serve import cluster
+        assert cluster._read_frame is read_frame
+        assert cluster._write_frame is write_frame
+
+
+# ---------------------------------------------------------------------------
+# host inventory
+# ---------------------------------------------------------------------------
+
+class TestHostInventory:
+    def test_parse_hosts_forms(self):
+        assert parse_hosts("h1:7070, h2:7071") == [("h1", 7070),
+                                                   ("h2", 7071)]
+        assert parse_hosts([("h1", 7070), "h2:7071"]) == [("h1", 7070),
+                                                          ("h2", 7071)]
+        assert parse_hosts(None) == []
+        with pytest.raises(ValueError, match="host:port"):
+            parse_hosts("7070")
+
+    def test_lease_exhaust_release_cycle(self):
+        inv = HostInventory("h1:1,h2:2", token="t")
+        a, b = inv.lease(), inv.lease()
+        assert {a, b} == {("h1", 1), ("h2", 2)}
+        with pytest.raises(ReplicaSpawnError, match="inventory exhausted"):
+            inv.lease()
+        inv.release(a)
+        inv.release(a)                      # idempotent
+        assert inv.stats() == {"free": 1, "leased": 1}
+        assert inv.lease() == a
+
+    def test_empty_inventory_is_a_config_error(self):
+        with pytest.raises(ValueError, match="BIGDL_SERVE_HOSTS"):
+            HostInventory("")
+
+
+# ---------------------------------------------------------------------------
+# RemoteReplica basics against an in-process agent
+# ---------------------------------------------------------------------------
+
+class TestRemoteReplicaBasics:
+    def test_engine_parity_stats_and_session(self):
+        model = _small_model()
+        ref = _oracle(model)
+        agent = _agent()
+        try:
+            r = RemoteReplica((agent.host, agent.port), model,
+                              name="r0", token=TOKEN, max_batch=4,
+                              max_wait_ms=2, input_shape=(4,))
+            try:
+                assert r.alive() and r.session_epoch == 1
+                x = np.random.RandomState(0).randn(5, 4).astype(
+                    np.float32)
+                futs = [r.submit(row) for row in x]
+                for row, f in zip(x, futs):
+                    assert np.allclose(f.result(timeout=60),
+                                       ref(row)[0], rtol=1e-5,
+                                       atol=1e-6)
+                assert r.weights_version() == 0   # v0: construction
+                assert isinstance(r.stats(), dict)
+                tel = r.telemetry()
+                assert "stats" in tel and "registry" in tel
+                assert _counter_value("remote_sessions",
+                                      replica="r0") == 1
+            finally:
+                r.close()
+            assert "connect" in _remote_kinds()
+        finally:
+            agent.close()
+
+    def test_bad_token_is_a_typed_spawn_refusal(self):
+        agent = _agent()
+        try:
+            with pytest.raises(ReplicaSpawnError, match="bad token"):
+                RemoteReplica((agent.host, agent.port), _small_model(),
+                              name="r0", token="wrong", max_batch=4,
+                              max_wait_ms=2, input_shape=(4,))
+        finally:
+            agent.close()
+
+    def test_pool_integration_and_inventory_cap(self):
+        model = _small_model()
+        ref = _oracle(model)
+        a1, a2 = _agent(), _agent()
+        try:
+            pool = ReplicaPool(
+                model, n_replicas=2, token=TOKEN,
+                hosts=[(a1.host, a1.port), (a2.host, a2.port)],
+                max_batch=4, max_wait_ms=2, input_shape=(4,))
+            try:
+                x = np.random.RandomState(0).randn(6, 4).astype(
+                    np.float32)
+                assert np.allclose(pool.predict(x), ref(x), rtol=1e-5,
+                                   atol=1e-6)
+                names = {e["name"] for e in pool.stats()["replicas"]}
+                assert names == {"remote0", "remote1"}
+                # scale-up past the inventory trips the autoscaler's
+                # circuit-breaker type instead of crash-looping
+                with pytest.raises(ReplicaSpawnError,
+                                   match="inventory exhausted"):
+                    pool.add_replica()
+                # drain one out: its lease returns, add works again
+                pool.remove_replica(reason="scale_down")
+                pool.add_replica(reason="scale_up")
+                assert np.allclose(pool.predict(x), ref(x), rtol=1e-5,
+                                   atol=1e-6)
+            finally:
+                pool.close()
+        finally:
+            a1.close()
+            a2.close()
+
+
+# ---------------------------------------------------------------------------
+# the blip-vs-death matrix (tentpole)
+# ---------------------------------------------------------------------------
+
+class TestBlipVsDeath:
+    def test_blip_reattaches_same_session_stream_identical(self):
+        lm = _lm()
+        oracle = [lm_decode(lm, [1, 2, 3, 4, 5], 6),
+                  lm_decode(lm, [1, 2, 3, 7, 8], 6),
+                  lm_decode(lm, [2, 2, 3, 4, 5], 6)]
+        seeds = [[1, 2, 3, 4, 5], [1, 2, 3, 7, 8], [2, 2, 3, 4, 5]] * 2
+        expect = (oracle + oracle)
+        # the 2nd submit fires a 0.2s black-hole — well under the
+        # 1.5s liveness budget, so this MUST be a blip, not a death
+        faults.configure("serve_partition@at=2,len_s=0.2")
+        agent = _agent()
+        try:
+            r = RemoteDecodeReplica(
+                (agent.host, agent.port), lm, name="d0", token=TOKEN,
+                liveness_s=1.5, max_slots=2, n_pos=16, page_size=4,
+                sync_interval=2)
+            try:
+                epoch0 = r.session_epoch
+                chunks = [[] for _ in seeds]
+                futs = []
+                for i, s in enumerate(seeds):
+                    f = r.submit({"seed": s, "n_words": 6,
+                                  "stream": True})
+                    f.on_tokens(lambda t, i=i: chunks[i].append(list(t)))
+                    futs.append(f)
+                rows = [f.result(timeout=120) for f in futs]
+                assert rows == expect               # full-token parity
+                for f, row, s in zip(futs, rows, seeds):
+                    # chunk chain byte-identical, zero duplicate tokens
+                    assert f.streamed() == row[len(s):]
+                    assert f.tokens_streamed() == 6
+                assert r.session_epoch == epoch0    # same session
+                assert r.alive()
+                assert _counter_value("remote_reconnects_total",
+                                      replica="d0") == 1
+                kinds = _remote_kinds()
+                assert "blip" in kinds and "reattach" in kinds
+                assert "death" not in kinds
+            finally:
+                r.close()
+        finally:
+            agent.close()
+
+    def test_sustained_partition_is_death_every_future_fails_once(self):
+        lm = _lm()
+        # black-hole for far longer than the 0.4s budget: a death
+        faults.configure("serve_partition@at=1,len_s=5.0")
+        agent = _agent()
+        try:
+            r = RemoteDecodeReplica(
+                (agent.host, agent.port), lm, name="d0", token=TOKEN,
+                liveness_s=0.4, max_slots=2, n_pos=16, page_size=4,
+                sync_interval=2)
+            try:
+                resolved = []
+                futs = [r.submit({"seed": [1, 2, 3, 4, 5],
+                                  "n_words": 4}) for _ in range(3)]
+                for f in futs:
+                    f.add_done_callback(lambda f_: resolved.append(f_))
+                for f in futs:
+                    with pytest.raises(DeadReplicaError):
+                        f.result(timeout=60)
+                assert not r.alive()
+                assert len(resolved) == len(futs)   # exactly once each
+                assert "death" in _remote_kinds()
+            finally:
+                r.close()
+        finally:
+            agent.close()
+
+    def test_rollout_during_blip_lands_on_committed_version(self):
+        model = _small_model()
+        agent = _agent()
+        try:
+            r = RemoteReplica((agent.host, agent.port), model,
+                              name="r0", token=TOKEN, liveness_s=2.0,
+                              max_batch=4, max_wait_ms=2,
+                              input_shape=(4,))
+            try:
+                epoch0 = r.session_epoch
+                p2 = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a) * 2.0, model.params())
+                # cut the link, then roll out INTO the blip: the
+                # stage/commit frames pend and replay on re-attach
+                r._conn.force_drop()
+                r.stage_weights(p2, model.state(), version=2)
+                assert r.commit_weights() == 2
+                assert r.weights_version() == 2
+                ref2 = _oracle(model, params=p2)
+                x = np.random.RandomState(0).randn(4).astype(np.float32)
+                assert np.allclose(r.submit(x).result(timeout=60),
+                                   ref2(x)[0], rtol=1e-5, atol=1e-6)
+                assert r.session_epoch == epoch0
+                assert r.alive()
+            finally:
+                r.close()
+        finally:
+            agent.close()
+
+
+# ---------------------------------------------------------------------------
+# the partition chaos drill through the fleet router (fast variant)
+# ---------------------------------------------------------------------------
+
+class TestPartitionDrillFleet:
+    def _fleet(self, lm, agents, monkeypatch, liveness):
+        monkeypatch.setenv("BIGDL_SERVE_LIVENESS_S", str(liveness))
+        return DecodeFleet(
+            lm, n_decode=len(agents), token=TOKEN,
+            hosts=[(a.host, a.port) for a in agents],
+            max_slots=2, n_pos=16, page_size=4, sync_interval=2)
+
+    def test_mid_burst_blip_zero_requeues(self, monkeypatch):
+        lm = _lm()
+        seeds = [[1, 2, 3, 4, 5], [1, 2, 3, 7, 8],
+                 [2, 2, 3, 4, 5]] * 4
+        oracle = {tuple(s): lm_decode(lm, s, 4) for s in set(
+            map(tuple, seeds))}
+        faults.configure("serve_partition@at=4,len_s=0.2")
+        agents = [_agent(), _agent()]
+        fleet = None
+        try:
+            fleet = self._fleet(lm, agents, monkeypatch, liveness=2.0)
+            from bigdl_tpu.serve import xcache
+            warm = xcache.get().stats()["compiles"]
+            futs = fleet.submit_many(seeds, 4)
+            rows = [f.result(timeout=120) for f in futs]
+            # the blip re-attaches the SAME replicas: no respawn, no
+            # cold compile anywhere in the burst
+            assert xcache.get().stats()["compiles"] == warm
+            assert rows == [oracle[tuple(s)] for s in seeds]
+            st = fleet.stats()["router"]
+            assert st["requeued"] == 0          # a blip, not a death
+            assert st["failed"] == 0
+            assert st["completed"] == st["accepted"] == len(seeds)
+            fam = obs_metrics.get().snapshot().get(
+                "remote_reconnects_total") or {"series": []}
+            assert sum(r["value"] for r in fam["series"]) >= 1
+        finally:
+            if fleet is not None:
+                fleet.close()
+            for a in agents:
+                a.close()
+
+    def test_sustained_partition_requeues_exactly_once(self, monkeypatch):
+        lm = _lm()
+        seeds = [[1, 2, 3, 4, 5], [1, 2, 3, 7, 8],
+                 [2, 2, 3, 4, 5]] * 4
+        oracle = {tuple(s): lm_decode(lm, s, 4) for s in set(
+            map(tuple, seeds))}
+        faults.configure("serve_partition@at=3,len_s=6.0")
+        agents = [_agent(), _agent()]
+        fleet = None
+        try:
+            fleet = self._fleet(lm, agents, monkeypatch, liveness=0.4)
+            futs = fleet.submit_many(seeds, 4)
+            rows = [f.result(timeout=120) for f in futs]
+            # zero lost futures: the dead replica's work requeued onto
+            # the survivor and every stream still matches the oracle
+            assert rows == [oracle[tuple(s)] for s in seeds]
+            st = fleet.stats()["router"]
+            assert st["requeued"] >= 1
+            assert st["failed"] == 0
+            assert st["completed"] == st["accepted"] == len(seeds)
+            assert "death" in _remote_kinds()
+        finally:
+            if fleet is not None:
+                fleet.close()
+            for a in agents:
+                a.close()
+
+
+# ---------------------------------------------------------------------------
+# the real thing: a spawned agent subprocess over TCP loopback (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestRealAgent:
+    def test_spawned_agent_parity_then_kill_mid_stream(self):
+        model = _small_model()
+        ref = _oracle(model)
+        handle = spawn_agent(token=TOKEN)
+        try:
+            r = RemoteReplica(handle.addr, model, name="r0",
+                              token=TOKEN, liveness_s=1.0,
+                              agent=handle, spawn_timeout=180.0,
+                              max_batch=4, max_wait_ms=2,
+                              input_shape=(4,))
+            try:
+                x = np.random.RandomState(0).randn(4, 4).astype(
+                    np.float32)
+                futs = [r.submit(row) for row in x]
+                for row, f in zip(x, futs):
+                    assert np.allclose(f.result(timeout=120),
+                                       ref(row)[0], rtol=1e-5,
+                                       atol=1e-6)
+                # real death: kill the agent with requests in flight
+                futs = [r.submit(row) for row in x]
+                handle.kill()
+                for f in futs:
+                    with pytest.raises(DeadReplicaError) as ei:
+                        f.result(timeout=60)
+                    # the agent's stderr ring rides the error message
+                    assert "agent stderr tail" in str(ei.value)
+                assert not r.alive()
+            finally:
+                r.close()
+        finally:
+            handle.close()
+
+    def test_real_tcp_partition_drill_zero_requeues(self, monkeypatch):
+        """The capstone over real sockets: 2 agent subprocesses, a
+        mid-burst partition in each (env-armed chaos), zero dropped
+        futures, zero requeues, the blip announced on the agent's
+        stderr ring."""
+        lm = _lm()
+        seeds = [[1, 2, 3, 4, 5], [1, 2, 3, 7, 8],
+                 [2, 2, 3, 4, 5]] * 4
+        oracle = {tuple(s): lm_decode(lm, s, 4) for s in set(
+            map(tuple, seeds))}
+        monkeypatch.setenv("BIGDL_SERVE_LIVENESS_S", "3.0")
+        env = {"BIGDL_FAULTS": "serve_partition@at=3,len_s=0.3"}
+        handles = [spawn_agent(token=TOKEN, env=env) for _ in range(2)]
+        fleet = None
+        try:
+            fleet = DecodeFleet(
+                lm, n_decode=2, token=TOKEN,
+                hosts=[h.addr for h in handles],
+                max_slots=2, n_pos=16, page_size=4, sync_interval=2)
+            futs = fleet.submit_many(seeds, 4)
+            rows = [f.result(timeout=300) for f in futs]
+            assert rows == [oracle[tuple(s)] for s in seeds]
+            st = fleet.stats()["router"]
+            assert st["requeued"] == 0
+            assert st["failed"] == 0
+            assert st["completed"] == st["accepted"] == len(seeds)
+            assert any("serve_partition chaos fired" in line
+                       for h in handles
+                       for line in h.stderr_tail())
+        finally:
+            if fleet is not None:
+                fleet.close()
+            for h in handles:
+                h.close()

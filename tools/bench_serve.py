@@ -935,11 +935,16 @@ def fleet_families(rng, n_families: int, n_requests: int, zipf_a: float,
 
 def fleet_row(impl, replicas, prefill_replicas, families, zipf_a,
               requests, tokens, wall_s, router_stats,
-              replica_stats) -> dict:
+              replica_stats, transport: str = "inproc",
+              ship_bytes_per_s: float = 0.0) -> dict:
     """The pinned JSON contract for one ``--fleet-sweep`` point:
     fleet-aggregate throughput plus the affinity/prefill/host-tier
     counters that explain it and a per-replica breakdown (role-labelled
     — prefill replicas ride along with their ship counts).
+    ``transport`` names the replica wire (inproc/stdio/tcp) and
+    ``ship_bytes_per_s`` the prefill→decode KV-page payload rate over
+    it (0.0 without prefill replicas) — both default-valued so parsers
+    of the pre-transport contract keep working.
     ``tests/test_fleet.py::TestBenchFleetContract`` keeps this shape
     honest."""
     per_replica, hits, misses, readmitted = [], 0, 0, 0
@@ -971,6 +976,8 @@ def fleet_row(impl, replicas, prefill_replicas, families, zipf_a,
             "prefill_fallback": router_stats.get("prefill_fallback", 0),
             "prefill_skipped": router_stats.get("prefill_skipped", 0),
             "kv_host_readmitted": readmitted,
+            "transport": transport,
+            "ship_bytes_per_s": float(ship_bytes_per_s),
             "per_replica": per_replica}
 
 
@@ -998,26 +1005,58 @@ def bench_fleet(args):
         lm_decode(model, [1] * length, n_words)
     oracle = [lm_decode(model, s, n_words) for s in seeds]
 
+    transport = getattr(args, "transport", "inproc")
+
+    def ship_bytes_total():
+        from bigdl_tpu.obs import metrics as obs_metrics
+        fam = obs_metrics.get().snapshot().get("fleet_ship_bytes_total")
+        return sum(r.get("value", 0.0) for r in (fam or {}).get(
+            "series", []))
+
     def run_point(impl, affinity):
-        fleet = DecodeFleet(
-            model, n_decode=args.replicas,
-            n_prefill=args.prefill_replicas, affinity=affinity,
-            host_mb=args.host_mb or None, max_slots=args.decode_slots,
-            n_pos=n_pos, page_size=ps, sync_interval=args.decode_sync,
-            kv_quant=args.kv_quant)
+        kw = {}
+        agents = []
+        if transport == "stdio":
+            kw["process"] = True
+        elif transport == "tcp":
+            from bigdl_tpu.serve.remote import spawn_agent
+            agents = [spawn_agent(token="bench")
+                      for _ in range(args.replicas
+                                     + args.prefill_replicas)]
+            kw.update(hosts=[a.addr for a in agents], token="bench")
+        try:
+            fleet = DecodeFleet(
+                model, n_decode=args.replicas,
+                n_prefill=args.prefill_replicas, affinity=affinity,
+                host_mb=args.host_mb or None,
+                max_slots=args.decode_slots,
+                n_pos=n_pos, page_size=ps,
+                sync_interval=args.decode_sync,
+                kv_quant=args.kv_quant, **kw)
+        except Exception:
+            for a in agents:
+                a.close()
+            raise
+        ship0 = ship_bytes_total()
         t0 = time.perf_counter()
         futs = fleet.submit_many(seeds, n_words)
         rows = [f.result(timeout=600) for f in futs]
         wall = time.perf_counter() - t0
+        shipped_b = ship_bytes_total() - ship0
         st = fleet.stats()
         row = fleet_row(impl, args.replicas, args.prefill_replicas,
                         args.families, args.zipf_a, len(seeds), toks,
-                        wall, st["router"], st["replicas"])
+                        wall, st["router"], st["replicas"],
+                        transport=transport,
+                        ship_bytes_per_s=(shipped_b / wall if wall
+                                          else 0.0))
         row["parity"] = rows == oracle if args.kv_quant == "off" else None
         row["agreement"] = float(np.mean([
             np.mean(np.asarray(r[len(s):]) == np.asarray(o[len(s):]))
             for r, o, s in zip(rows, oracle, seeds)]))
         fleet.close()
+        for a in agents:
+            a.close()
         print(f"bench_serve: {json.dumps(row)}")
         return row
 
@@ -1025,14 +1064,17 @@ def bench_fleet(args):
     aff = run_point("affinity", affinity=True)
 
     print(f"\ntransformer fleet sweep ({args.replicas} decode + "
-          f"{args.prefill_replicas} prefill; {args.families} families, "
+          f"{args.prefill_replicas} prefill over {transport}; "
+          f"{args.families} families, "
           f"zipf {args.zipf_a}, {len(seeds)} requests):")
     for pt in (base, aff):
+        ship = (f", ship {pt['ship_bytes_per_s'] / 1e6:.2f} MB/s"
+                if pt["ship_bytes_per_s"] else "")
         print(f"  {pt['impl']:<13} {pt['tok_per_s']:8.1f} tok/s, "
               f"prefix hit-rate {pt['hit_rate']:.0%}, affinity "
               f"{pt['affinity_hits']}/{pt['affinity_hits'] + pt['affinity_misses']}, "
               f"shipped {pt['prefill_shipped']}, agreement "
-              f"{pt['agreement']:.3f}")
+              f"{pt['agreement']:.3f}{ship}")
     if args.prefill_replicas:
         # shipped pages equalize the ADMISSION hit rate (every request
         # adopts its chain), so affinity's win shows as prefill work
@@ -1111,6 +1153,12 @@ def main():
     ap.add_argument("--host-mb", type=int, default=0,
                     help="per-replica host-RAM KV tier budget (MiB) "
                          "for the fleet sweep (0 = off)")
+    ap.add_argument("--transport", default="inproc",
+                    choices=("inproc", "stdio", "tcp"),
+                    help="fleet replica wire for the fleet sweep: "
+                         "in-process threads, stdio subprocess "
+                         "workers, or TCP-loopback replica agents "
+                         "(docs/serving.md 'Cross-host fleet')")
     ap.add_argument("--traffic", action="store_true",
                     help="open-loop bursty/diurnal traffic run: seeded "
                          "Poisson arrivals with a declared burst "
