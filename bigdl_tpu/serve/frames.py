@@ -5,7 +5,7 @@ One frame = one pickled message.  The original stdio protocol was a
 bare ``u64 length + pickle`` pair, which was fine between a parent and
 the child IT spawned, but the same frames now also cross TCP between
 hosts (``serve/remote.py`` / ``tools/replica_agent.py``), where the
-reader must assume the peer can be wrong, stale, or hostile:
+reader must assume the peer can be wrong, stale, or corrupt:
 
 - a **magic + protocol-version prefix** rejects a desynchronized or
   foreign byte stream before anything reaches ``pickle.loads``;
@@ -15,11 +15,25 @@ reader must assume the peer can be wrong, stale, or hostile:
   carry full model params);
 - a **per-frame CRC32** catches payload corruption, so garbage bytes
   fail loudly with the offending CRC instead of being fed to
-  ``pickle.loads`` (which would execute attacker-shaped opcodes);
+  ``pickle.loads``;
 - **truncation is typed**: a stream that dies mid-frame raises
   :class:`FrameProtocolError` with the got/want byte counts, while a
   clean EOF at a frame boundary returns ``None`` (the normal
   worker-death signal the reader loops already handle).
+
+**What this codec does NOT defend against: a hostile peer.**  CRC32
+is a checksum, not a MAC — anyone who can reach the socket can craft
+a frame with valid magic/version/CRC around an arbitrary pickle
+payload, and unpickling attacker bytes is remote code execution.
+Keeping attackers away from ``pickle.loads`` is the transport layer's
+job, not the codec's: pickled frames are only ever exchanged between
+a parent and the subprocess it spawned (stdio), or between TCP peers
+AFTER the replica agent's authentication handshake.  That handshake
+is deliberately pickle-free — :func:`read_hello` /
+:func:`read_welcome` below parse a fixed binary layout with bounded
+fields, so an unauthenticated peer's bytes are never deserialized —
+and the agent binds loopback by default, refusing a non-loopback
+bind with an empty token (``tools/replica_agent.py``).
 
 Wire layout (big-endian, 16-byte header)::
 
@@ -142,3 +156,158 @@ def read_frame(fh, max_bytes: int | None = None):
             f"frame CRC mismatch over {n} bytes: header says "
             f"0x{crc:08x}, payload hashes to 0x{actual:08x}")
     return pickle.loads(payload)
+
+
+# ---------------------------------------------------------------------------
+# handshake codec: fixed layout, NO pickle
+# ---------------------------------------------------------------------------
+#
+# The TCP handshake runs before either peer has proven anything, so
+# neither side may unpickle the other's bytes yet (see the module
+# docstring: CRC32 is not a MAC).  The hello and welcome are therefore
+# fixed binary layouts with tightly bounded string fields — parseable
+# with struct + utf-8 decode only, every violation a typed
+# FrameProtocolError.
+#
+#   hello   (client → agent):
+#     'B' 'H' | ver u8 | flags u8 | acked u64 | token_len u16 |
+#     session_len u16 | name_len u16 | token | session | name
+#   welcome (agent → client):
+#     'B' 'W' | ver u8 | flags u8 | epoch u64 | pid u64 |
+#     session_len u16 | error_len u16 | session | error
+
+HELLO_MAGIC = b"BH"
+WELCOME_MAGIC = b"BW"
+_HELLO_HDR = struct.Struct(">2sBBQHHH")
+_WELCOME_HDR = struct.Struct(">2sBBQQHH")
+
+#: bound on each handshake string field — a real hello/welcome is tens
+#: of bytes; anything bigger is garbage or an attack
+HANDSHAKE_FIELD_MAX = 1024
+
+_HELLO_HAS_SESSION = 0x01
+_WELCOME_RESUMED = 0x01
+_WELCOME_REFUSED = 0x02
+
+
+def _handshake_field(value, what: str) -> bytes:
+    data = ("" if value is None else str(value)).encode("utf-8")
+    if len(data) > HANDSHAKE_FIELD_MAX:
+        raise FrameProtocolError(
+            f"handshake {what} is {len(data)} bytes (bound "
+            f"{HANDSHAKE_FIELD_MAX})")
+    return data
+
+
+def _decode_field(data: bytes, what: str) -> str:
+    try:
+        return data.decode("utf-8")
+    except UnicodeDecodeError as e:
+        raise FrameProtocolError(
+            f"undecodable handshake {what}: {e}") from None
+
+
+def _read_handshake(fh, hdr, magic, what: str):
+    """Common header read/validation for both handshake directions.
+    Returns the unpacked header tuple (without magic/version), or
+    ``None`` on a clean EOF."""
+    raw = _read_exact(fh, hdr.size, f"{what} header")
+    if raw is None:
+        return None
+    fields = hdr.unpack(raw)
+    if fields[0] != magic:
+        raise FrameProtocolError(
+            f"bad {what} magic {fields[0]!r} (want {magic!r}): peer is "
+            f"not speaking the bigdl handshake")
+    if fields[1] != PROTOCOL_VERSION:
+        raise FrameProtocolError(
+            f"{what} protocol version {fields[1]} does not match this "
+            f"reader (v{PROTOCOL_VERSION}); upgrade the older peer")
+    return fields[2:]
+
+
+def write_hello(fh, token="", session=None, acked: int = 0,
+                name: str = ""):
+    """Write the client→agent hello in the fixed pickle-free layout.
+    ``session=None`` asks for a fresh session; a string re-attaches."""
+    tok = _handshake_field(token, "token")
+    ses = _handshake_field(session, "session id")
+    nam = _handshake_field(name, "name")
+    flags = _HELLO_HAS_SESSION if session is not None else 0
+    fh.write(_HELLO_HDR.pack(HELLO_MAGIC, PROTOCOL_VERSION, flags,
+                             int(acked), len(tok), len(ses), len(nam))
+             + tok + ses + nam)
+    fh.flush()
+
+
+def read_hello(fh):
+    """Parse a hello WITHOUT pickle.  Returns ``{"token", "session",
+    "acked", "name"}`` (session ``None`` = fresh), ``None`` on clean
+    EOF; any malformation raises :class:`FrameProtocolError`."""
+    fields = _read_handshake(fh, _HELLO_HDR, HELLO_MAGIC, "hello")
+    if fields is None:
+        return None
+    flags, acked, n_tok, n_ses, n_nam = fields
+    for n, what in ((n_tok, "token"), (n_ses, "session id"),
+                    (n_nam, "name")):
+        if n > HANDSHAKE_FIELD_MAX:
+            raise FrameProtocolError(
+                f"hello {what} length {n} exceeds the "
+                f"{HANDSHAKE_FIELD_MAX}-byte bound")
+    body = _read_exact(fh, n_tok + n_ses + n_nam, "hello body")
+    if body is None:
+        raise FrameProtocolError("truncated hello: header without body")
+    token = _decode_field(body[:n_tok], "token")
+    session = _decode_field(body[n_tok:n_tok + n_ses], "session id")
+    name = _decode_field(body[n_tok + n_ses:], "name")
+    return {"token": token,
+            "session": session if flags & _HELLO_HAS_SESSION else None,
+            "acked": int(acked), "name": name}
+
+
+def write_welcome(fh, session, epoch: int, resumed: bool, pid: int):
+    """Write the agent→client session acceptance (pickle-free)."""
+    ses = _handshake_field(session, "session id")
+    flags = _WELCOME_RESUMED if resumed else 0
+    fh.write(_WELCOME_HDR.pack(WELCOME_MAGIC, PROTOCOL_VERSION, flags,
+                               int(epoch), int(pid), len(ses), 0) + ses)
+    fh.flush()
+
+
+def write_refusal(fh, error: str):
+    """Write a typed agent→client handshake refusal (pickle-free)."""
+    msg = str(error).encode("utf-8")[:HANDSHAKE_FIELD_MAX]
+    # re-encode so a truncation cannot split a multibyte character
+    msg = msg.decode("utf-8", errors="ignore").encode("utf-8")
+    fh.write(_WELCOME_HDR.pack(WELCOME_MAGIC, PROTOCOL_VERSION,
+                               _WELCOME_REFUSED, 0, 0, 0, len(msg))
+             + msg)
+    fh.flush()
+
+
+def read_welcome(fh):
+    """Parse a welcome/refusal WITHOUT pickle.  Returns
+    ``{"op": "welcome", "session", "epoch", "resumed", "pid"}`` or
+    ``{"op": "error", "error"}``; ``None`` on clean EOF; any
+    malformation raises :class:`FrameProtocolError`."""
+    fields = _read_handshake(fh, _WELCOME_HDR, WELCOME_MAGIC, "welcome")
+    if fields is None:
+        return None
+    flags, epoch, pid, n_ses, n_err = fields
+    for n, what in ((n_ses, "session id"), (n_err, "error")):
+        if n > HANDSHAKE_FIELD_MAX:
+            raise FrameProtocolError(
+                f"welcome {what} length {n} exceeds the "
+                f"{HANDSHAKE_FIELD_MAX}-byte bound")
+    body = _read_exact(fh, n_ses + n_err, "welcome body") \
+        if n_ses + n_err else b""
+    if body is None:
+        raise FrameProtocolError(
+            "truncated welcome: header without body")
+    if flags & _WELCOME_REFUSED:
+        return {"op": "error",
+                "error": _decode_field(body[n_ses:], "error")}
+    return {"op": "welcome",
+            "session": _decode_field(body[:n_ses], "session id"),
+            "epoch": int(epoch), "resumed": bool(flags & _WELCOME_RESUMED),
+            "pid": int(pid)}

@@ -68,6 +68,7 @@ from bigdl_tpu.serve.cluster import (_EXC_TYPES, _STDERR_LINES,
                                      ReplicaSpawnError)
 from bigdl_tpu.serve.frames import FrameProtocolError
 from bigdl_tpu.serve.frames import read_frame as _read_frame
+from bigdl_tpu.serve.frames import read_welcome, write_hello
 from bigdl_tpu.serve.frames import write_frame as _write_frame
 from bigdl_tpu.serve.router import DeadReplicaError
 from bigdl_tpu.serve.streaming import StreamFuture, TokenDelivery
@@ -319,25 +320,24 @@ class RemoteReplica:
     def _dial(self, resume: bool):
         """Connect + authenticate.  Returns ``(conn, welcome)``; raises
         OSError-family on transient failure (the partition may still
-        heal) or :class:`_HandshakeRefused` on a typed refusal."""
+        heal) or :class:`_HandshakeRefused` on a typed refusal.  The
+        hello/welcome exchange is the fixed pickle-free handshake
+        layout (``serve/frames.py``) — neither peer unpickles anything
+        before the token check passes."""
         timeout = max(2.0, self.liveness_s)
         sock = socket.create_connection(self.addr, timeout=timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = _Conn(sock)
         try:
-            _write_frame(conn.wfile, {
-                "op": "hello", "token": self.token,
-                "session": self._session if resume else None,
-                "acked": self._acked, "name": self.name})
-            welcome = _read_frame(conn.rfile)
+            write_hello(conn.wfile, token=self.token,
+                        session=self._session if resume else None,
+                        acked=self._acked, name=self.name)
+            welcome = read_welcome(conn.rfile)
             if welcome is None:
                 raise OSError("agent closed the connection mid-handshake")
             if welcome.get("op") == "error":
                 raise _HandshakeRefused(
                     welcome.get("error", "agent refused the handshake"))
-            if welcome.get("op") != "welcome":
-                raise _HandshakeRefused(
-                    f"unexpected handshake reply {welcome.get('op')!r}")
             if resume and not welcome.get("resumed"):
                 raise _HandshakeRefused(
                     "agent did not resume the session")
@@ -384,7 +384,20 @@ class RemoteReplica:
                     # before the blip — the downstream dedup belt
                     continue
                 self._acked = seq
-            self._handle(msg)
+            try:
+                self._handle(msg)
+            except Exception:
+                # a reply-handling bug (double-resolve, delivery
+                # failure, ...) must not silently kill the only thread
+                # that resolves futures — alive() would stay True and
+                # the router would keep dispatching to a wedged
+                # replica.  Convert it to the death path: orphans fail
+                # typed and the router requeues.
+                logger.exception(
+                    "replica %s: reply handling failed; converting to "
+                    "replica death", self.name)
+                self._on_death()
+                return
 
     def _handle(self, msg):
         op = msg.get("op")

@@ -8,6 +8,11 @@ The PR-16 tentpole contracts:
   version-mismatched frames with a typed :class:`FrameProtocolError`
   naming the offending value, on BOTH transports (in-memory pipe bytes
   and a real socket pair) — garbage never reaches ``pickle.loads``;
+- the TCP handshake authenticates BEFORE deserializing: the
+  hello/welcome exchange is a fixed pickle-free layout, a crafted
+  valid-CRC pickle frame from an unauthenticated peer is never
+  unpickled (CRC32 is a checksum, not a MAC), and the agent refuses a
+  non-loopback bind with an empty token;
 - a network blip shorter than the liveness budget re-attaches to the
   SAME agent session: session epoch unchanged, zero router requeues,
   the streamed chunk chain byte-identical to the uninterrupted decode;
@@ -25,7 +30,9 @@ The PR-16 tentpole contracts:
 import importlib.util
 import io
 import os
+import pickle
 import socket
+import time
 import zlib
 
 import numpy as np
@@ -197,7 +204,6 @@ class TestFrameHardening:
             read_frame(io.BytesIO(raw))
 
     def test_version_mismatch_names_both_versions(self):
-        import pickle
         payload = pickle.dumps({"op": "ping"})
         hdr = frames._HDR.pack(frames.MAGIC,
                                frames.PROTOCOL_VERSION + 1, 0,
@@ -225,6 +231,100 @@ class TestFrameHardening:
         from bigdl_tpu.serve import cluster
         assert cluster._read_frame is read_frame
         assert cluster._write_frame is write_frame
+
+
+# ---------------------------------------------------------------------------
+# handshake hardening: authenticate BEFORE deserializing
+# ---------------------------------------------------------------------------
+
+def _touch(path):
+    with open(path, "w") as fh:
+        fh.write("pwned")
+    return path
+
+
+class _PickleBomb:
+    """Pickles to a payload whose UNpickling writes a sentinel file —
+    the stand-in for an attacker's arbitrary-code payload."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __reduce__(self):
+        return (_touch, (self.path,))
+
+
+class TestHandshakeHardening:
+    def test_hello_roundtrip_fixed_layout(self):
+        buf = io.BytesIO()
+        frames.write_hello(buf, token="sesame", session="s7", acked=9,
+                           name="r0")
+        raw = buf.getvalue()
+        assert raw.startswith(frames.HELLO_MAGIC)   # not a pickle frame
+        assert frames.read_hello(io.BytesIO(raw)) == {
+            "token": "sesame", "session": "s7", "acked": 9,
+            "name": "r0"}
+        fresh = io.BytesIO()
+        frames.write_hello(fresh, token="t")
+        parsed = frames.read_hello(io.BytesIO(fresh.getvalue()))
+        assert parsed["session"] is None            # fresh-session form
+        assert frames.read_hello(io.BytesIO(b"")) is None
+
+    def test_hello_garbage_and_oversize_fields_fail_typed(self):
+        with pytest.raises(FrameProtocolError, match="hello magic"):
+            frames.read_hello(io.BytesIO(b"ZZ" + b"\x00" * 64))
+        with pytest.raises(FrameProtocolError, match="bound"):
+            frames.write_hello(io.BytesIO(), token="x" * 4096)
+        # a crafted header advertising an over-bound token length
+        hdr = frames._HELLO_HDR.pack(frames.HELLO_MAGIC,
+                                     frames.PROTOCOL_VERSION, 0, 0,
+                                     60000, 0, 0)
+        with pytest.raises(FrameProtocolError, match="exceeds"):
+            frames.read_hello(io.BytesIO(hdr + b"x" * 100))
+
+    def test_welcome_roundtrip_and_refusal(self):
+        buf = io.BytesIO()
+        frames.write_welcome(buf, session="s1", epoch=3, resumed=True,
+                             pid=42)
+        assert frames.read_welcome(io.BytesIO(buf.getvalue())) == {
+            "op": "welcome", "session": "s1", "epoch": 3,
+            "resumed": True, "pid": 42}
+        ref = io.BytesIO()
+        frames.write_refusal(ref, "bad token: nope")
+        w = frames.read_welcome(io.BytesIO(ref.getvalue()))
+        assert w["op"] == "error" and "bad token" in w["error"]
+
+    def test_unauthenticated_bytes_are_never_unpickled(self, tmp_path):
+        # CRC32 is a checksum, not a MAC: an attacker who can reach
+        # the port can frame an arbitrary pickle payload with fully
+        # valid magic/version/CRC.  The agent must reject it on the
+        # pickle-free hello layout, never unpickling a byte.
+        sentinel = tmp_path / "rce"
+        payload = pickle.dumps(_PickleBomb(str(sentinel)))
+        hdr = frames._HDR.pack(frames.MAGIC, frames.PROTOCOL_VERSION,
+                               0, zlib.crc32(payload), len(payload))
+        agent = _agent()
+        try:
+            with socket.create_connection((agent.host, agent.port),
+                                          timeout=10) as sock:
+                sock.settimeout(10)
+                sock.sendall(hdr + payload)
+                sock.shutdown(socket.SHUT_WR)
+                # dropped without a reply byte...
+                assert sock.recv(1) == b""
+        finally:
+            agent.close()
+        # ...the payload never ran and no session was opened
+        assert not sentinel.exists()
+        assert agent._sessions == {}
+
+    def test_nonloopback_bind_with_empty_token_refused(self):
+        with pytest.raises(ValueError, match="non-loopback"):
+            ra.ReplicaAgent(host="0.0.0.0", port=0, token="").start()
+        # the same bind WITH a token is allowed
+        agent = ra.ReplicaAgent(host="0.0.0.0", port=0,
+                                token="t").start()
+        agent.close()
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +398,53 @@ class TestRemoteReplicaBasics:
                 RemoteReplica((agent.host, agent.port), _small_model(),
                               name="r0", token="wrong", max_batch=4,
                               max_wait_ms=2, input_shape=(4,))
+        finally:
+            agent.close()
+
+    def test_reader_converts_handle_bug_to_death(self):
+        # an unexpected exception out of reply handling must not kill
+        # the reader thread silently (alive() forever-True, futures
+        # never resolving): it converts to the death path
+        agent = _agent()
+        try:
+            r = RemoteReplica((agent.host, agent.port), _small_model(),
+                              name="r0", token=TOKEN, max_batch=4,
+                              max_wait_ms=2, input_shape=(4,))
+            try:
+                def boom(msg):
+                    raise RuntimeError("reply-handler bug")
+                r._handle = boom
+                fut = r._send("stats")
+                with pytest.raises(DeadReplicaError):
+                    fut.result(timeout=30)
+                assert not r.alive()
+            finally:
+                r.close()
+        finally:
+            agent.close()
+            # the induced death emitted a remote `death` event: drop it
+            # so later tests' event-ring assertions see a clean slate
+            obs_events.reset()
+
+    def test_keepalive_pings_do_not_accumulate_rids(self):
+        # pings fire every liveness/4 and are exempt from the agent's
+        # replay-dedup set — a long-lived session must not leak an rid
+        # entry per heartbeat
+        agent = _agent()
+        try:
+            r = RemoteReplica((agent.host, agent.port), _small_model(),
+                              name="r0", token=TOKEN, liveness_s=0.4,
+                              max_batch=4, max_wait_ms=2,
+                              input_shape=(4,))
+            try:
+                session = next(iter(agent._sessions.values()))
+                time.sleep(1.2)             # ~12 keepalive pings
+                # pongs flowed (each takes a fresh outbox seq)...
+                assert session.next_seq > 5
+                # ...but the dedup set holds only real requests
+                assert len(session.seen_rids) <= 2
+            finally:
+                r.close()
         finally:
             agent.close()
 

@@ -11,13 +11,17 @@ host, then ``BIGDL_SERVE_HOSTS=h1:7070,h2:7070`` on the pool side.
 
 Session protocol (what TCP adds over a pipe):
 
-- **hello/welcome handshake**: the first client frame is ``hello``
-  with the shared token (``BIGDL_SERVE_TOKEN``, compared
-  constant-time); ``session: null`` opens a fresh session (superseding
-  any previous one — an agent is one replica slot), ``session: <id>``
+- **hello/welcome handshake**: the first client bytes are a ``hello``
+  in a FIXED pickle-free layout (``frames.read_hello`` — the op
+  frames are pickle, and unpickling an unauthenticated peer's bytes
+  would be remote code execution, so nothing is deserialized before
+  the shared token (``BIGDL_SERVE_TOKEN``, compared constant-time)
+  checks out).  A null session id opens a fresh session (superseding
+  any previous one — an agent is one replica slot), a non-null one
   re-attaches after a blip.  The ``welcome`` carries the session id +
-  epoch; a bad token or unknown session gets a typed ``error`` frame
-  and a closed connection.
+  epoch; a bad token or unknown session gets a typed refusal and a
+  closed connection.  The agent binds 127.0.0.1 by default and
+  REFUSES to listen on a non-loopback interface with an empty token.
 - **sequenced outbox**: every session frame the agent sends (ready,
   events, token chunks, replies) carries a contiguous ``seq`` and is
   retained until the client acks it (the ``acked`` watermark
@@ -48,16 +52,23 @@ import itertools
 import os
 import pickle
 import socket
+import struct
 import sys
 import threading
 import time
 from collections import deque
 
 from bigdl_tpu.serve.frames import (FrameProtocolError, read_frame,
-                                    write_frame)
+                                    read_hello, write_frame,
+                                    write_refusal, write_welcome)
 
 ENV_SESSION_TTL = "BIGDL_SERVE_SESSION_TTL_S"
 DEFAULT_SESSION_TTL_S = 30.0
+ENV_TOKEN = "BIGDL_SERVE_TOKEN"
+
+
+def _loopback(host: str) -> bool:
+    return host in ("localhost", "::1", "") or host.startswith("127.")
 
 
 def session_ttl_default() -> float:
@@ -112,7 +123,8 @@ class Session:
         self.outbox = deque()       # (seq, frame), pruned by client acks
         #: executed request ids (replay dedup).  Grows with request
         #: count — acceptable for a slot that lives as long as one
-        #: replica lease
+        #: replica lease.  Pings are exempt (idempotent, never
+        #: replayed), so the keepalive cadence does not leak into it
         self.seen_rids = set()
         self.ops = None
         self.conn = None
@@ -197,6 +209,12 @@ class ReplicaAgent:
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
+        if not self.token and not _loopback(self.host):
+            raise ValueError(
+                f"refusing to listen on non-loopback {self.host!r} "
+                f"with an empty token: any peer that can reach the "
+                f"port could lease the replica slot.  Set {ENV_TOKEN} "
+                f"(or --token), or bind 127.0.0.1")
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         sock.bind((self.host, self.port))
@@ -268,6 +286,16 @@ class ReplicaAgent:
 
     def _serve_conn(self, sock):
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # bounded sends: Session.send/attach write while holding
+        # session.lock, and a black-holed peer (packets dropped, no
+        # RST) would otherwise block a full kernel send buffer for the
+        # TCP timeout — stalling rid dedup, close() and the TTL reaper
+        # behind that lock.  A timed-out write just detaches this
+        # connection; the frame replays on the next attach.
+        send_s = max(1.0, min(10.0, self.session_ttl_s / 4.0))
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+            struct.pack("ll", int(send_s), int((send_s % 1.0) * 1e6)))
         conn = _Conn(sock)
         session = None
         try:
@@ -291,19 +319,21 @@ class ReplicaAgent:
             conn.close()
 
     def _handshake(self, conn):
-        hello = read_frame(conn.rfile)
-        if not isinstance(hello, dict) or hello.get("op") != "hello":
-            write_frame(conn.wfile, {
-                "op": "error",
-                "error": "handshake must start with a hello frame"})
+        """Authenticate BEFORE deserializing anything: the hello is a
+        fixed pickle-free layout (``frames.read_hello``), so an
+        unauthenticated peer's bytes never reach ``pickle.loads`` —
+        garbage fails typed on magic/version/field bounds, and only a
+        token-bearing client gets the pickled op stream."""
+        hello = read_hello(conn.rfile)
+        if hello is None:
             return None
-        if not hmac.compare_digest(str(hello.get("token") or ""),
-                                   str(self.token or "")):
+        if not hmac.compare_digest(
+                str(hello.get("token") or "").encode("utf-8"),
+                str(self.token or "").encode("utf-8")):
             print(f"agent {self.host}:{self.port}: rejected connection "
                   f"(bad token)", file=sys.stderr, flush=True)
-            write_frame(conn.wfile, {
-                "op": "error", "error": "bad token: agent and client "
-                "must share BIGDL_SERVE_TOKEN"})
+            write_refusal(conn.wfile, "bad token: agent and client "
+                          "must share BIGDL_SERVE_TOKEN")
             return None
         sid = hello.get("session")
         if sid is None:
@@ -313,17 +343,15 @@ class ReplicaAgent:
             with self._lock:
                 session = self._sessions.get(sid)
             if session is None or session.closed:
-                write_frame(conn.wfile, {
-                    "op": "error",
-                    "error": f"unknown session {sid!r}: agent restarted "
-                             f"or the session expired "
-                             f"({ENV_SESSION_TTL}={self.session_ttl_s})"})
+                write_refusal(
+                    conn.wfile,
+                    f"unknown session {sid!r}: agent restarted "
+                    f"or the session expired "
+                    f"({ENV_SESSION_TTL}={self.session_ttl_s})")
                 return None
             resumed = True
-        write_frame(conn.wfile, {
-            "op": "welcome", "session": session.sid,
-            "epoch": session.epoch, "resumed": resumed,
-            "pid": os.getpid()})
+        write_welcome(conn.wfile, session.sid, session.epoch, resumed,
+                      os.getpid())
         session.attach(conn, int(hello.get("acked") or 0))
         return session
 
@@ -357,7 +385,11 @@ class ReplicaAgent:
             if op in ("hello", "ack"):
                 continue
             rid = msg.get("id")
-            if rid is not None:
+            if rid is not None and op != "ping":
+                # pings skip the dedup set: they are idempotent and the
+                # client never replays them, and at the liveness/4
+                # cadence they would otherwise leak an rid entry every
+                # ~0.5s for the whole session lifetime
                 with session.lock:
                     if rid in session.seen_rids:
                         # a replayed request this slot already executed:
@@ -430,7 +462,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="bigdl_tpu replica agent: lease this host's "
                     "replica slot over TCP")
-    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="bind interface (default loopback; a "
+                             "non-loopback bind requires a token)")
     parser.add_argument("--port", type=int, default=0,
                         help="0 = ephemeral (printed as AGENT_PORT=)")
     parser.add_argument("--token", default=None,
@@ -450,9 +484,13 @@ def main(argv=None) -> int:
         jax.config.update("jax_default_matmul_precision", "highest")
     os.environ.setdefault("BIGDL_CHECK_SINGLETON", "0")
 
-    agent = ReplicaAgent(host=args.host, port=args.port,
-                         token=args.token, once=args.once,
-                         forward_events=True).start()
+    try:
+        agent = ReplicaAgent(host=args.host, port=args.port,
+                             token=args.token, once=args.once,
+                             forward_events=True).start()
+    except ValueError as e:
+        print(f"replica agent: {e}", file=sys.stderr, flush=True)
+        return 2
     # the machine-readable banner spawn_agent() waits for
     print(f"AGENT_PORT={agent.port}", flush=True)
     print(f"replica agent listening on {args.host}:{agent.port} "
