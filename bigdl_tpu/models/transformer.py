@@ -18,6 +18,21 @@ import collections
 
 import bigdl_tpu.nn as nn
 
+# Round-7 Mosaic paged-attention kernels (ops/pallas_kernels.py
+# paged_attention / paged_spec_verify): walk the slot→page table
+# in-kernel with an online softmax and the int8 dequantize fused into
+# the QK/PV loops, instead of materializing the gathered `pool[ptab]`
+# view (and a separate dequantize pass) in HBM each decode step.
+# `_PALLAS_PAGED_ATTN` gates the S == 1 continuous-decode step,
+# `_PALLAS_SPEC_VERIFY` the speculative (k+1)-query verify window.
+# PR-2 adoption discipline: no chip verdict yet → both default OFF;
+# True adopts on TPU, "interpret" forces the Pallas interpreter
+# (CPU equivalence tests and the perf_smoke drill).  The staged A/Bs
+# live in tools/ab_device_clock.py and `tools/bench_serve.py
+# --decode-sweep --attn-kernel`.
+_PALLAS_PAGED_ATTN = False
+_PALLAS_SPEC_VERIFY = False
+
 
 def _residual(branch: nn.Module) -> nn.Module:
     return nn.Sequential(nn.ConcatTable(nn.Identity(), branch),
@@ -161,7 +176,7 @@ def _lm_handles(model):
 
 
 def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
-                       tp_axis=None):
+                       tp_axis=None, view_pages=None):
     """Paged multi-position forward: token ids (B, S) at per-row
     positions ``i`` (B, S) against block-paged KV pools.
 
@@ -196,7 +211,23 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
 
     ``tp_axis`` has `_lm_forward_one`'s Megatron semantics: handles
     carry LOCAL shards, the pools (and scale arrays) shard on their
-    head dim, one psum merges each branch's output projection."""
+    head dim, one psum merges each branch's output projection.
+
+    ``view_pages`` (static int) bounds the attention view to the first
+    that many page-table columns — the caller promises every live
+    position in this window sits below ``view_pages * page_size``
+    (serve/decode.py tracks the fleet-wide live page horizon), so the
+    gather, mask and softmax shrink from the full reservation to the
+    pages actually in use.  Scatter coordinates are unaffected: a valid
+    position's logical page is < ``view_pages`` by the same promise,
+    and invalid positions were already routed out of bounds.
+
+    When `_PALLAS_PAGED_ATTN` (S == 1) or `_PALLAS_SPEC_VERIFY`
+    (S > 1) is set, the gather + dequantize + attention stack is
+    replaced by the fused Mosaic page-walk kernel
+    (ops/pallas_kernels.py paged_attention); the K/V scatter is
+    unchanged.  Flag value "interpret" forces the Pallas interpreter
+    off-TPU."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -205,6 +236,8 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
 
     h_ = handles
     ptab, page_size = pages
+    if view_pages is not None:
+        ptab = ptab[:, :view_pages]
     quantized = len(caches) == 4
     if quantized:
         kpool, vpool, kscale, vscale = caches
@@ -221,6 +254,10 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
     # positions target page id n_pool_pages (out of bounds -> dropped)
     phys = jnp.where(valid, ptab[rows, i // page_size], n_pool_pages)
     off = i % page_size
+    use_kernel = _PALLAS_SPEC_VERIFY if S > 1 else _PALLAS_PAGED_ATTN
+    if use_kernel:
+        from bigdl_tpu.ops import pallas_kernels as pk
+        kernel_interp = (use_kernel == "interpret") or not pk._on_tpu()
     mask = (jnp.arange(n_view)[None, None, None, :]
             <= i[:, None, :, None])                      # (B, 1, S, T)
 
@@ -246,24 +283,36 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
             vpool = vpool.at[li, phys, off].set(qv)
             kscale = kscale.at[li, phys, off].set(sk)
             vscale = vscale.at[li, phys, off].set(sv)
-            kview = kvq.dequantize_view(kpool[li][ptab],
-                                        kscale[li][ptab])
-            vview = kvq.dequantize_view(vpool[li][ptab],
-                                        vscale[li][ptab])
-            kview = kview.reshape(bsz, n_view, h_.n_heads, h_.hd)
-            vview = vview.reshape(bsz, n_view, h_.n_heads, h_.hd)
         else:
             kpool = kpool.at[li, phys, off].set(k)
             vpool = vpool.at[li, phys, off].set(v)
-            kview = kpool[li][ptab].reshape(bsz, n_view, h_.n_heads,
-                                            h_.hd)
-            vview = vpool[li][ptab].reshape(bsz, n_view, h_.n_heads,
-                                            h_.hd)
-        s = jnp.einsum("bshd,bthd->bhst", q, kview) * scale
-        s = jnp.where(mask, s, -jnp.inf)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhst,bthd->bshd", p,
-                       vview).reshape(bsz, S, h_.n_heads * h_.hd)
+        if use_kernel:
+            # fused page-walk attention: no gathered view, no HBM
+            # dequantize pass — scatter above is unchanged.
+            o = pk.paged_attention(
+                q, kpool[li], vpool[li], ptab, i,
+                kscale[li] if quantized else None,
+                vscale[li] if quantized else None,
+                interpret=kernel_interp,
+            ).reshape(bsz, S, h_.n_heads * h_.hd)
+        else:
+            if quantized:
+                kview = kvq.dequantize_view(kpool[li][ptab],
+                                            kscale[li][ptab])
+                vview = kvq.dequantize_view(vpool[li][ptab],
+                                            vscale[li][ptab])
+                kview = kview.reshape(bsz, n_view, h_.n_heads, h_.hd)
+                vview = vview.reshape(bsz, n_view, h_.n_heads, h_.hd)
+            else:
+                kview = kpool[li][ptab].reshape(bsz, n_view, h_.n_heads,
+                                                h_.hd)
+                vview = vpool[li][ptab].reshape(bsz, n_view, h_.n_heads,
+                                                h_.hd)
+            s = jnp.einsum("bshd,bthd->bhst", q, kview) * scale
+            s = jnp.where(mask, s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhst,bthd->bshd", p,
+                           vview).reshape(bsz, S, h_.n_heads * h_.hd)
         x = x + merge(o @ m["wo"]) + m["bo"]
         a2 = layernorm(x, ln2, h_.block_eps[li][1])
         h = jax.nn.relu(a2 @ lin1["weight"].T + lin1["bias"])
@@ -278,7 +327,7 @@ def _lm_forward_window(tok, i, caches, handles, pe, pages, valid=None,
 
 
 def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None,
-                    pages=None, valid=None):
+                    pages=None, valid=None, view_pages=None):
     """One decode position for all rows: token ids (B,) at position i
     with per-layer KV caches (layers, B, n_pos, H, hd) -> (log-probs
     (B, vocab), updated caches).  The shared inner body of lm_decode,
@@ -317,7 +366,7 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None,
         v = None if valid is None else valid[:, None]
         logp, caches = _lm_forward_window(
             tok[:, None], i[:, None], caches, handles, pe, pages,
-            valid=v, tp_axis=tp_axis)
+            valid=v, tp_axis=tp_axis, view_pages=view_pages)
         return logp[:, 0], caches
 
     h_ = handles

@@ -1266,3 +1266,160 @@ def mosaic_maxpool2d(x, window, strides, pads, interpret=False):
     return _mosaic_maxpool(x, tuple(window), tuple(strides),
                            (tuple(pads[0]), tuple(pads[1])),
                            tuple(x.shape), interpret)
+
+
+# ---------------------------------------------------------------------------
+# Paged attention: in-kernel page-table walk + online softmax (round 7).
+#
+# The decode hot path (`models/transformer.py _lm_forward_window`)
+# materializes a per-row gathered K/V view `kpool[li][ptab]` in HBM —
+# and under int8 KV runs a separate `kvq.dequantize_view` pass — before
+# plain-XLA attention.  This kernel is the vLLM PagedAttention design
+# (Kwon et al., SOSP 2023) fused with FlashAttention streaming (Dao et
+# al., 2022): the grid's innermost dimension IS the page walk, the
+# slot→page table rides scalar prefetch so each page's BlockSpec index
+# map resolves `phys = ptab[b, p]` before the DMA is issued (Mosaic
+# double-buffers the HBM→VMEM page stream for free), and the softmax is
+# the online running-max/denominator form so no (B, n_view) score or
+# dequantized K/V tensor ever exists in HBM.  The int8 variant folds
+# `kvq.dequantize_view` (q.astype(f32) * scale[..., None], scales
+# indexed by the SAME phys coordinates as quant/kv.py) into the QK and
+# PV loops.  A multi-query S = k+1 window is the same kernel — that is
+# the speculative verify pass (`_PALLAS_SPEC_VERIFY`).
+#
+# Adoption gate (PR-2 discipline): default OFF via
+# `models/transformer.py _PALLAS_PAGED_ATTN / _PALLAS_SPEC_VERIFY`; no
+# chip verdict yet → the staged A/B lives in tools/ab_device_clock.py
+# and `tools/bench_serve.py --decode-sweep --attn-kernel`.  Equivalence
+# vs the gathered-view reference is pinned in interpreter mode by
+# tests/test_paged_attention.py.
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(ptab_ref, *refs, page_size, scale, quantized):
+    """One (batch row b, head h, page p) grid step.
+
+    Page p's K/V block (and scale rows when quantized) land in VMEM via
+    the scalar-prefetch index map; scratch carries the flash-attention
+    running state (m: row max, l: denominator, acc: unnormalized PV)
+    across the sequential page walk.  Page 0 always holds position 0 and
+    `pos >= 0`, so m is finite from the first page and the
+    `exp(-inf - finite) = 0` identities keep the recurrence exact for
+    fully-masked later pages (reserved-but-unwritten tail pages).
+    """
+    if quantized:
+        (pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         m_ref, l_ref, acc_ref) = refs
+    else:
+        pos_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, -jnp.inf, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    q = q_ref[0, :, 0, :].astype(jnp.float32)          # (S, hd)
+    k = k_ref[0, :, 0, :].astype(jnp.float32)          # (page_size, hd)
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    if quantized:
+        # kvq.dequantize_view fused in-loop: int8 * per-(page-row, head)
+        # scale, indexed by the same phys page the K/V DMA used.
+        k = k * ks_ref[0, :, 0][:, None]
+        v = v * vs_ref[0, :, 0][:, None]
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    t = p * page_size + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    pos = pos_ref[0, :]                                # (S,)
+    s = jnp.where(t <= pos[:, None], s, -jnp.inf)
+    m_prev = m_ref[...]                                # (S, 1)
+    m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    w = jnp.exp(s - m_new)                             # (S, page_size)
+    l_ref[...] = l_ref[...] * alpha + w.sum(axis=1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + lax.dot_general(
+        w, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(p == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0, :, 0, :] = acc_ref[...] / l_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_attention_call(q, kpool, vpool, ptab, pos, kscale, vscale,
+                          interpret):
+    bsz, ws, n_heads, hd = q.shape
+    n_ptab_pages = ptab.shape[1]
+    page_size = kpool.shape[1]
+    quantized = kscale is not None
+    scale = 1.0 / (hd ** 0.5)
+    kvspec = pl.BlockSpec((1, page_size, 1, hd),
+                          lambda b, h, p, pt: (pt[b, p], 0, h, 0))
+    sspec = pl.BlockSpec((1, page_size, 1),
+                         lambda b, h, p, pt: (pt[b, p], 0, h))
+    in_specs = [
+        pl.BlockSpec((1, ws), lambda b, h, p, pt: (b, 0)),          # pos
+        pl.BlockSpec((1, ws, 1, hd), lambda b, h, p, pt: (b, 0, h, 0)),
+        kvspec, kvspec,
+    ]
+    operands = [pos.astype(jnp.int32), q, kpool, vpool]
+    if quantized:
+        in_specs += [sspec, sspec]
+        operands += [kscale, vscale]
+    return pl.pallas_call(
+        functools.partial(_paged_attn_kernel, page_size=page_size,
+                          scale=scale, quantized=quantized),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bsz, n_heads, n_ptab_pages),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, ws, 1, hd),
+                                   lambda b, h, p, pt: (b, 0, h, 0)),
+            scratch_shapes=[pltpu.VMEM((ws, 1), jnp.float32),
+                            pltpu.VMEM((ws, 1), jnp.float32),
+                            pltpu.VMEM((ws, hd), jnp.float32)]),
+        out_shape=jax.ShapeDtypeStruct((bsz, ws, n_heads, hd),
+                                       jnp.float32),
+        interpret=interpret,
+    )(ptab.astype(jnp.int32), *operands)
+
+
+def paged_attention(q, kpool, vpool, ptab, pos, kscale=None, vscale=None,
+                    interpret=None):
+    """Causal paged attention over a page-pooled KV cache, one layer.
+
+    ``q`` (B, S, H, hd) f32 queries at absolute positions ``pos``
+    (B, S) int32; ``kpool``/``vpool`` (n_pages, page_size, H, hd) the
+    layer's physical page pool (f32/f16 slabs or int8 with
+    ``kscale``/``vscale`` (n_pages, page_size, H) per-row/per-head
+    scales from quant/kv.py); ``ptab`` (B, P) int32 the slot→page
+    table.  Logical position t of row b lives at
+    ``pool[ptab[b, t // page_size], t % page_size]``; keys with
+    ``t <= pos`` attend (the gathered-view reference's causal mask).
+    Rows whose window entry is dead must be masked by the CALLER (the
+    decode step gates on ``valid`` downstream) — the kernel computes
+    every (b, s) row.  Returns (B, S, H, hd) f32.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _paged_attention_call(q, kpool, vpool, ptab, pos, kscale,
+                                 vscale, interpret)
+
+
+def paged_spec_verify(q, kpool, vpool, ptab, pos, kscale=None, vscale=None,
+                      interpret=None):
+    """Speculative (k+1)-window verify pass: ``paged_attention`` with a
+    multi-query window S = k+1 (draft tokens verified in one shot).  The
+    window positions ``pos[:, j]`` are consecutive per row, so the page
+    walk streams each page ONCE for all k+1 queries instead of rerunning
+    gathered-view attention per window — the `_PALLAS_SPEC_VERIFY` hot
+    path.  Same contract as ``paged_attention``.
+    """
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _paged_attention_call(q, kpool, vpool, ptab, pos, kscale,
+                                 vscale, interpret)

@@ -178,6 +178,19 @@ def _tp_weight_specs(handles, ax: str):
             "ln_f": rep(handles.ln_f), "head": rep(handles.head)}
 
 
+def _pages_needed(steps: int, page_size: int) -> int:
+    """Pages a request's full lifetime reserves: ``ceil(steps /
+    page_size)``, and nothing more.  The ONE authoritative spot for the
+    reservation math (``submit()``'s too-long check and
+    ``_try_admit_paged``'s allocation share it) so the two can never
+    drift.  In particular speculative decode adds NO page headroom: the
+    (k+1)-window's writes past a slot's capacity are valid-gated out
+    (``spec_step_body``), so a seed + budget that exactly fills its
+    last page admits without a speculative extra page — pinned at the
+    boundary by ``tests/test_paged_attention.py``."""
+    return -(-steps // page_size)
+
+
 class _DecodeReq:
     __slots__ = ("seed", "n_words", "future", "slot", "steps_needed",
                  "steps_run", "start_pos", "pages", "rid", "trace",
@@ -354,14 +367,15 @@ class ContinuousDecoder:
 
         def paged_step_body(local_handles, caches, ptab, pos, prev,
                             active, seeds, seed_len, cap, gen,
-                            tp_axis=None):
+                            tp_axis=None, view_pages=None):
             rows = jnp.arange(B)
             live = active & (pos < cap)
             wp = jnp.clip(pos, 0, cap - 1)
             tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
             logp, caches = _lm_forward_one(
                 tok.astype(jnp.int32), wp, caches, local_handles,
-                n_view, pe, tp_axis=tp_axis, pages=(ptab, ps), valid=live)
+                n_view, pe, tp_axis=tp_axis, pages=(ptab, ps), valid=live,
+                view_pages=view_pages)
             nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
             # frozen rows route their token write out of bounds (dropped)
             gen = gen.at[rows, jnp.where(live, wp, n_view)].set(nxt)
@@ -371,7 +385,7 @@ class ContinuousDecoder:
 
         def spec_step_body(local_full, local_draft, caches, ptab,
                            pos, prev, active, seeds, seed_len, cap, gen,
-                           acc_hist, tp_axis=None):
+                           acc_hist, tp_axis=None, view_pages=None):
             rows = jnp.arange(B)
             live = active & (pos < cap)
             # -- draft k tokens with the shallow pass (window position 0
@@ -385,7 +399,8 @@ class ContinuousDecoder:
                 dlogp, caches = _lm_forward_one(
                     d_tok, jnp.clip(d_pos, 0, cap - 1), caches,
                     local_draft, n_view, pe, tp_axis=tp_axis,
-                    pages=(ptab, ps), valid=d_valid)
+                    pages=(ptab, ps), valid=d_valid,
+                    view_pages=view_pages)
                 d_arg = jnp.argmax(dlogp, axis=-1).astype(jnp.int32)
                 d_pos = d_pos + 1
                 d_tok = jnp.where(
@@ -400,7 +415,7 @@ class ContinuousDecoder:
             # the draft's shallow K/V at the same positions)
             logp, caches = _lm_forward_window(
                 W, wp, caches, local_full, pe, (ptab, ps),
-                valid=valid, tp_axis=tp_axis)
+                valid=valid, tp_axis=tp_axis, view_pages=view_pages)
             g = jnp.argmax(logp, axis=-1).astype(jnp.int32)  # (B, k+1)
             # -- longest accepted prefix: drafted token j+1 survives iff
             # it equals the verify argmax at position j (seed-forced
@@ -493,46 +508,86 @@ class ContinuousDecoder:
                     mods=None, emb=W["emb"], blocks=W["blocks"],
                     ln_f=W["ln_f"], head=W["head"], n_heads=H_local)
 
-            if k:
-                def step_tp(W, *st):
-                    local = _local(W)
-                    return spec_step_body(local, _draft_of(local), *st,
-                                          tp_axis=ax)
-                n_rep_in, n_rep_out = 9, 4
-            elif self.paged:
-                def step_tp(W, *st):
-                    return paged_step_body(_local(W), *st, tp_axis=ax)
-                n_rep_in, n_rep_out = 8, 3
-            else:
-                def step_tp(W, *st):
-                    return slab_step_body(_local(W), *st, tp_axis=ax)
-                n_rep_in, n_rep_out = 6, 3
-
-            sharded = compat.shard_map(
-                step_tp, mesh=mesh,
-                in_specs=(wspec, cspec) + (rep,) * n_rep_in,
-                out_specs=(cspec,) + (rep,) * n_rep_out)
-            self._step = xcache.tracked_jit(
-                sharded,
-                ("decode_step_" + kind, fp, B, n_pos) + key_tail
-                + ("tp%d" % self.tp,),
-                mesh=mesh)
         else:
             self._W = None
 
+        # ---- step-program cache -------------------------------------------
+        # Paged decoders hold ONE step program per (view-horizon bucket,
+        # attention-kernel flag state) instead of a single program:
+        #
+        # * View-horizon buckets (the pure-XLA micro-opt): the gathered
+        #   attention view only needs the pages the CURRENT live set can
+        #   reach (max in-use ptab run), not every reserved page — but
+        #   the gather width is a static shape, so the horizon is
+        #   bucketed to a short pow2 ladder ending at the full
+        #   reservation and each bucket gets its own program.  All
+        #   buckets are warmed at construction (zero-cold-compile).
+        # * Attention-kernel flag state: `transformer._PALLAS_PAGED_ATTN`
+        #   / `_PALLAS_SPEC_VERIFY` are read at TRACE time, so a flip on
+        #   a warm decoder must select a DIFFERENT program — flag state
+        #   rides the fn_key and programs for non-default states build
+        #   lazily at the first boundary that needs them (exactly the
+        #   expected new compiles once, zero on later waves — pinned by
+        #   the jit-trap audit in tests/test_paged_attention.py).
+        if self.paged:
+            # two-point ladder {1, full}: the single-page bucket owns
+            # the common low-latency case (short live set on a big
+            # reservation) and every bucket costs one warm step compile
+            # per decoder, so the ladder stays deliberately short
+            self._view_buckets = sorted({1, self.pages_per_slot})
+        else:
+            self._view_buckets = [None]
+
+        base_key = ("decode_step_" + kind, fp, B, n_pos) + key_tail
+
+        def _build_step(view_w, flag_state):
+            key = base_key
+            if view_w is not None and view_w != self.pages_per_slot:
+                key = key + ("view%d" % view_w,)
+            if any(f != "False" for f in flag_state):
+                key = key + ("attn:" + "/".join(flag_state),)
+            if self.tp > 1:
+                if k:
+                    def step_tp(W, *st):
+                        local = _local(W)
+                        return spec_step_body(local, _draft_of(local),
+                                              *st, tp_axis=ax,
+                                              view_pages=view_w)
+                    n_rep_in, n_rep_out = 9, 4
+                elif self.paged:
+                    def step_tp(W, *st):
+                        return paged_step_body(_local(W), *st,
+                                               tp_axis=ax,
+                                               view_pages=view_w)
+                    n_rep_in, n_rep_out = 8, 3
+                else:
+                    def step_tp(W, *st):
+                        return slab_step_body(_local(W), *st, tp_axis=ax)
+                    n_rep_in, n_rep_out = 6, 3
+                sharded = compat.shard_map(
+                    step_tp, mesh=mesh,
+                    in_specs=(wspec, cspec) + (rep,) * n_rep_in,
+                    out_specs=(cspec,) + (rep,) * n_rep_out)
+                return xcache.tracked_jit(
+                    sharded, key + ("tp%d" % self.tp,), mesh=mesh)
             if k:
                 def step(*st):
                     return spec_step_body(handles, _draft_of(handles),
-                                          *st)
+                                          *st, view_pages=view_w)
             elif self.paged:
                 def step(*st):
-                    return paged_step_body(handles, *st)
+                    return paged_step_body(handles, *st,
+                                           view_pages=view_w)
             else:
                 def step(*st):
                     return slab_step_body(handles, *st)
+            return xcache.tracked_jit(step, key)
 
-            self._step = xcache.tracked_jit(
-                step, ("decode_step_" + kind, fp, B, n_pos) + key_tail)
+        self._build_step = _build_step
+        self._step_programs = {}
+        # the full-reservation default-flag program: the flops-ledger
+        # anchor for decode_model_flops_util, and the widest warm step
+        self._step = self._step_program(self._view_buckets[-1])
 
         if self.paged:
             def admit(ptab, pos, active, seeds, seed_len, cap, gen, slot,
@@ -782,7 +837,44 @@ class ContinuousDecoder:
             decoder=self.name, paged=self.paged, kv_quant=self.kv_quant)
 
     # -- compiled-program drivers -------------------------------------------
-    def _run_step(self):
+    def _attn_flag_state(self):
+        """Current attention-kernel flag state, as the fn_key fragment
+        that selects a step program.  Slab decoders never page, so the
+        flags cannot affect their program; spec decoders contain both
+        the S=1 draft steps and the S=k+1 verify window, so both flags
+        select."""
+        if not self.paged:
+            return ()
+        from bigdl_tpu.models import transformer as _tf
+        if self.spec_k:
+            return (str(_tf._PALLAS_PAGED_ATTN),
+                    str(_tf._PALLAS_SPEC_VERIFY))
+        return (str(_tf._PALLAS_PAGED_ATTN),)
+
+    def _view_horizon_bucket(self):
+        """Smallest warmed view bucket covering every live slot's page
+        reservation (the max in-use ptab run).  Idle decoders step at
+        the cheapest bucket."""
+        live = max((len(r.pages) for r in self._slots if r is not None),
+                   default=1)
+        for w in self._view_buckets:
+            if w >= live:
+                return w
+        return self._view_buckets[-1]
+
+    def _step_program(self, view_w=None):
+        if view_w is None:
+            view_w = (self._view_horizon_bucket() if self.paged
+                      else self._view_buckets[-1])
+        flag_state = self._attn_flag_state()
+        sel = (view_w, flag_state)
+        prog = self._step_programs.get(sel)
+        if prog is None:
+            prog = self._build_step(view_w, flag_state)
+            self._step_programs[sel] = prog
+        return prog
+
+    def _run_step(self, view_w=None):
         if self.paged:
             args = (self._caches, self._ptab, self._pos,
                     self._prev, self._active, self._seeds,
@@ -794,7 +886,7 @@ class ContinuousDecoder:
             args = args + (self._acc_hist,)
         if self._W is not None:
             args = (self._W,) + args
-        out = self._step(*args)
+        out = self._step_program(view_w)(*args)
         if self.spec_k:
             (self._caches, self._pos, self._prev, self._gen,
              self._acc_hist) = out
@@ -846,7 +938,11 @@ class ContinuousDecoder:
         masked-in read."""
         warm = _DecodeReq([0], 1)
         warm.pages = [0] if self.paged else []
-        self._run_step()
+        # every view-horizon bucket compiles here (widest first — the
+        # fresh host-placed state combo — then the rest on the carried
+        # device state, the only placement serving ever feeds them)
+        for w in reversed(self._view_buckets):
+            self._run_step(view_w=w)
         for _ in range(2):
             # twice: the first admission's carries are the fresh
             # host-placed state, every later admission's are program
@@ -995,7 +1091,7 @@ class ContinuousDecoder:
         req.rid = next(self._req_seq)
         too_long = req.steps_needed > self.n_pos
         if self.paged and not too_long:
-            too_long = (-(-req.steps_needed // self.page_size)
+            too_long = (_pages_needed(req.steps_needed, self.page_size)
                         > self._pool.n_pages)
         if too_long:
             req.future.set_exception(RequestTooLongError(
@@ -1027,7 +1123,7 @@ class ContinuousDecoder:
             # a failed admission leaves tier re-admits in the prefix
             # cache (content already written) — the retry matches them
             self._extend_from_tier(req.seed, shared)
-        total = -(-req.steps_needed // self.page_size)
+        total = _pages_needed(req.steps_needed, self.page_size)
         fresh = self._alloc_pages(total - len(shared))
         if fresh is None:
             for pid in shared:

@@ -20,7 +20,7 @@ export JAX_PLATFORMS=cpu
 python -m pytest -q -m perf \
     -p no:cacheprovider -p no:randomly \
     tests/test_pallas_ops.py tests/test_recurrent.py tests/test_training.py \
-    tests/test_prefetch.py \
+    tests/test_prefetch.py tests/test_paged_attention.py \
     "$@"
 
 # The narrowed form (-k ...) is a targeted kernel check; the loop drill
@@ -71,5 +71,39 @@ assert list(opt._taps_monitor.materialized_steps) == [2, 4, 5], \
     list(opt._taps_monitor.materialized_steps)
 print("OK: 1 jitted dispatch; host sync only at cadence boundaries "
       f"{list(opt._window.flush_steps)} with prefetch on")
+PY
+
+echo "== perf smoke: 12-request paged+spec decode drill (Mosaic kernels on, interpret) =="
+python - <<'PY'
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.models import transformer as tfm
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.serve import continuous_decode
+from bigdl_tpu.utils.random import set_seed
+
+set_seed(1)
+lm = TransformerLM(vocab_size=11, d_model=16, n_heads=2, n_layers=2,
+                   hidden=32)
+rng = np.random.RandomState(5)
+seeds = [rng.randint(1, 11, size=rng.randint(1, 5)).tolist()
+         for _ in range(12)]
+kw = dict(max_slots=3, n_pos=9, sync_interval=3, page_size=4, spec_k=2)
+base = continuous_decode(lm, seeds, 5, **kw)
+
+# both round-7 kernel flags forced through the Pallas interpreter: the
+# fused page-walk attention and the (k+1)-window spec verify must be
+# token-for-token the plain-XLA decode
+tfm._PALLAS_PAGED_ATTN = tfm._PALLAS_SPEC_VERIFY = "interpret"
+try:
+    kern = continuous_decode(lm, seeds, 5, **kw)
+finally:
+    tfm._PALLAS_PAGED_ATTN = tfm._PALLAS_SPEC_VERIFY = False
+
+assert kern == base, "paged+spec kernel decode diverged from XLA path"
+print(f"OK: {len(seeds)} requests, paged+spec Mosaic kernels "
+      "token-identical to the gathered-view decoder")
 PY
 echo "perf smoke: all green"

@@ -462,7 +462,8 @@ class TestBenchDecodeSweepContract:
                     "live_max", "slots", "pool_tokens", "spec_k",
                     "accept_mean", "accept_p50", "prefix_hits",
                     "compiles", "quant", "kv_quant", "pool_bytes",
-                    "ttft_p50", "ttft_p99", "itl_p50", "e2e_p50"):
+                    "ttft_p50", "ttft_p99", "itl_p50", "e2e_p50",
+                    "attn_kernel"):
             assert key in d, key
         assert d["mode"] == "decode_sweep" and d["impl"] == "paged"
         assert d["tok_per_s"] == pytest.approx(240.0)

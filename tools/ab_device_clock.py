@@ -13,10 +13,28 @@ Variants:
   pallas_winops pallas_pool + pallas_lrn together (the Inception case)
   blockt4/blockt8
                 multi-timestep recurrence blocking (recurrent._BLOCK_T)
+  paged_attn    round-7 Mosaic paged-attention decode kernel
+                (models/transformer._PALLAS_PAGED_ATTN — in-kernel
+                page walk + online softmax + fused int8 dequant)
+  spec_verify   round-7 fused speculative (k+1)-window verify kernel
+                (transformer._PALLAS_SPEC_VERIFY)
+  paged_decode  paged_attn + spec_verify together
 The round-6 adoption A/Bs (run when a chip is attached):
   python tools/ab_device_clock.py inception 128 base pallas_pool \
       pallas_lrn pallas_winops
   python tools/ab_device_clock.py bilstm 128 base blockt4 blockt8
+The round-7 decode-kernel A/Bs live on the DECODE harness — this
+chunk-step instrument never runs the paged decode path, so the
+device-clock comparison is the sweep's wall clock and
+decode_model_flops_util gauge with the kernel column flipped:
+  python tools/bench_serve.py --decode-sweep --kv-quant int8 --check
+  python tools/bench_serve.py --decode-sweep --kv-quant int8 --check \
+      --attn-kernel paged
+  python tools/bench_serve.py --decode-sweep --kv-quant int8 --check \
+      --attn-kernel paged+spec
+(the `paged_attn`/`spec_verify`/`paged_decode` variants above flip the
+same flags for any harness that drives serve/decode.py through this
+module)
 
 The ISSUE-4 host-pipeline change (prefetch-to-device + cadenced sync) is
 invisible to this device-clock instrument by construction — its staged
@@ -107,19 +125,26 @@ def device_us_per_step(step, st, n=8, dispatches=4):
 def _apply_variant(name):
     """Set the module flags for ``name``; returns an undo callable."""
     from bigdl_tpu import nn
+    from bigdl_tpu.models import transformer
     from bigdl_tpu.nn import pooling, recurrent
     old = (pooling._PALLAS_POOL, nn.SpatialCrossMapLRN._PALLAS,
-           recurrent._BLOCK_T)
+           recurrent._BLOCK_T, transformer._PALLAS_PAGED_ATTN,
+           transformer._PALLAS_SPEC_VERIFY)
     if name in ("pallas_pool", "pallas_winops"):
         pooling._PALLAS_POOL = True
     if name in ("pallas_lrn", "pallas_winops"):
         nn.SpatialCrossMapLRN._PALLAS = True
     if name.startswith("blockt"):
         recurrent._BLOCK_T = int(name[len("blockt"):])
+    if name in ("paged_attn", "paged_decode"):
+        transformer._PALLAS_PAGED_ATTN = True
+    if name in ("spec_verify", "paged_decode"):
+        transformer._PALLAS_SPEC_VERIFY = True
 
     def undo():
         (pooling._PALLAS_POOL, nn.SpatialCrossMapLRN._PALLAS,
-         recurrent._BLOCK_T) = old
+         recurrent._BLOCK_T, transformer._PALLAS_PAGED_ATTN,
+         transformer._PALLAS_SPEC_VERIFY) = old
     return undo
 
 

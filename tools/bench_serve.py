@@ -370,14 +370,17 @@ def bench_decode(args):
 
 
 def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
-                     compiles, stream=None) -> dict:
+                     compiles, stream=None, attn_kernel=None) -> dict:
     """The pinned JSON contract for one ``--decode-sweep`` point:
     throughput per live slot plus the paging/prefix/speculation/quant
     counters that explain it, and the streaming SLO columns
     (``ttft_p50``/``ttft_p99``/``itl_p50``, milliseconds,
     client-observed through ``StreamFuture.on_tokens`` — None when the
     point did not stream, so old parsers keep working).
-    ``tests/test_paged_decode.py`` keeps this shape honest."""
+    ``attn_kernel`` names the Mosaic decode kernel active for the point
+    (``--attn-kernel``; None — the default XLA gathered view — keeps
+    old parsers working).  ``tests/test_paged_decode.py`` keeps this
+    shape honest."""
     live = dec_stats.get("live_hwm") or dec_stats["slots"]
     pool = dec_stats.get("pool") or {}
     prefix = dec_stats.get("prefix") or {}
@@ -406,6 +409,7 @@ def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
             "ttft_p99": stream.get("ttft_p99"),
             "itl_p50": stream.get("itl_p50"),
             "e2e_p50": stream.get("e2e_p50"),
+            "attn_kernel": attn_kernel,
             "compiles": compiles}
 
 
@@ -435,6 +439,30 @@ def bench_decode_sweep(args):
     for length in {len(s) for s in seeds}:
         lm_decode(model, [1] * length, n_words)
     oracle = [lm_decode(model, s, n_words) for s in seeds]
+
+    # --attn-kernel: flip the Mosaic decode-kernel flags for the sweep's
+    # paged points (interpreter off-TPU, the staged on-chip A/B runs the
+    # same command with a chip attached); each row's attn_kernel column
+    # records what was ACTIVE for that point, None = XLA gathered view
+    from bigdl_tpu.models import transformer as _tf
+    from bigdl_tpu.ops import pallas_kernels as _pk
+    attn_mode = getattr(args, "attn_kernel", "off")
+    _flags_prev = (_tf._PALLAS_PAGED_ATTN, _tf._PALLAS_SPEC_VERIFY)
+    if attn_mode != "off":
+        on = True if _pk._on_tpu() else "interpret"
+        if attn_mode in ("paged", "paged+spec"):
+            _tf._PALLAS_PAGED_ATTN = on
+        if attn_mode in ("spec", "paged+spec"):
+            _tf._PALLAS_SPEC_VERIFY = on
+
+    def _active_attn_kernel(kw):
+        parts = []
+        if kw.get("page_size") is not None:
+            if _tf._PALLAS_PAGED_ATTN:
+                parts.append("paged")
+            if kw.get("spec_k") and _tf._PALLAS_SPEC_VERIFY:
+                parts.append("spec")
+        return "+".join(parts) or None
 
     def run_point(impl, offered, **kw):
         dec = ContinuousDecoder(model, n_pos=n_pos,
@@ -492,7 +520,8 @@ def bench_decode_sweep(args):
             for r, o, s in zip(rows, oracle, seeds)]))
         row = decode_sweep_row(impl, offered, toks, wall, dec.stats(),
                                xcache.get().stats()["compiles"] - c0,
-                               stream=stream)
+                               stream=stream,
+                               attn_kernel=_active_attn_kernel(kw))
         row["parity"] = rows == oracle
         row["stream_parity"] = stream_parity
         row["agreement"] = agree
@@ -500,42 +529,46 @@ def bench_decode_sweep(args):
         print(f"bench_serve: {json.dumps(row)}")
         return row
 
-    points = [run_point("slab", slab_slots, max_slots=slab_slots,
-                        paged=False)]
-    for offered in (slab_slots, 2 * slab_slots, 4 * slab_slots):
-        points.append(run_point(
-            "paged", offered, max_slots=offered, page_size=ps,
-            n_pages=pool_pages, prefix_cache=False))
-    spec = run_point("paged+spec", 2 * slab_slots,
-                     max_slots=2 * slab_slots, page_size=ps,
-                     n_pages=pool_pages, prefix_cache=True,
-                     spec_k=args.spec_k)
-    points.append(spec)
+    try:
+        points = [run_point("slab", slab_slots, max_slots=slab_slots,
+                            paged=False)]
+        for offered in (slab_slots, 2 * slab_slots, 4 * slab_slots):
+            points.append(run_point(
+                "paged", offered, max_slots=offered, page_size=ps,
+                n_pages=pool_pages, prefix_cache=False))
+        spec = run_point("paged+spec", 2 * slab_slots,
+                         max_slots=2 * slab_slots, page_size=ps,
+                         n_pages=pool_pages, prefix_cache=True,
+                         spec_k=args.spec_k)
+        points.append(spec)
 
-    qpoints = []
-    qspec = None
-    if kv_quant != "off":
-        # int8 KV points at the SAME pooled-token HBM BUDGET: the fp
-        # pool's bytes re-divided by the quantized bytes/token (scales
-        # included), so extra live concurrency is pure density win
-        from bigdl_tpu.models.transformer import _lm_handles
-        h = _lm_handles(model)
-        budget_bytes = pool_pages * ps * kvq.bytes_per_token(
-            h.n_layers, h.n_heads, h.hd, "off")
-        pages_q = budget_bytes // (ps * kvq.bytes_per_token(
-            h.n_layers, h.n_heads, h.hd, kv_quant))
-        for offered in (2 * slab_slots, 4 * slab_slots,
-                        8 * slab_slots):
-            qpoints.append(run_point(
-                f"paged[{kv_quant}]", offered, max_slots=offered,
-                page_size=ps, n_pages=pages_q, prefix_cache=False,
-                kv_quant=kv_quant))
-        qspec = run_point(f"paged+spec[{kv_quant}]", 4 * slab_slots,
-                          max_slots=4 * slab_slots, page_size=ps,
-                          n_pages=pages_q, prefix_cache=True,
-                          spec_k=args.spec_k, kv_quant=kv_quant)
-        qpoints.append(qspec)
-        points += qpoints
+        qpoints = []
+        qspec = None
+        if kv_quant != "off":
+            # int8 KV points at the SAME pooled-token HBM BUDGET: the
+            # fp pool's bytes re-divided by the quantized bytes/token
+            # (scales included), so extra live concurrency is pure
+            # density win
+            from bigdl_tpu.models.transformer import _lm_handles
+            h = _lm_handles(model)
+            budget_bytes = pool_pages * ps * kvq.bytes_per_token(
+                h.n_layers, h.n_heads, h.hd, "off")
+            pages_q = budget_bytes // (ps * kvq.bytes_per_token(
+                h.n_layers, h.n_heads, h.hd, kv_quant))
+            for offered in (2 * slab_slots, 4 * slab_slots,
+                            8 * slab_slots):
+                qpoints.append(run_point(
+                    f"paged[{kv_quant}]", offered, max_slots=offered,
+                    page_size=ps, n_pages=pages_q, prefix_cache=False,
+                    kv_quant=kv_quant))
+            qspec = run_point(f"paged+spec[{kv_quant}]", 4 * slab_slots,
+                              max_slots=4 * slab_slots, page_size=ps,
+                              n_pages=pages_q, prefix_cache=True,
+                              spec_k=args.spec_k, kv_quant=kv_quant)
+            qpoints.append(qspec)
+            points += qpoints
+    finally:
+        (_tf._PALLAS_PAGED_ATTN, _tf._PALLAS_SPEC_VERIFY) = _flags_prev
 
     slab = points[0]
     print(f"\ntransformer decode sweep (pool {pool_pages} pages x {ps} "
@@ -1128,6 +1161,14 @@ def main():
                     help="KV page size (tokens) for the sweep")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft length for the speculative sweep point")
+    ap.add_argument("--attn-kernel", default="off",
+                    choices=("off", "paged", "spec", "paged+spec"),
+                    help="run the sweep's paged points through the "
+                         "Mosaic paged-attention / spec-verify kernels "
+                         "(transformer._PALLAS_PAGED_ATTN / "
+                         "_PALLAS_SPEC_VERIFY; interpreter off-TPU) — "
+                         "the rows' attn_kernel column records what "
+                         "was active")
     ap.add_argument("--quant", default=None,
                     choices=("off", "int8", "fp8"),
                     help="weight quantization for the scoring/router "
