@@ -22,6 +22,10 @@ resilience layer:
   the pull endpoint, ``tools/serve_top.py`` the terminal dashboard);
 - ``trace``: sampled per-request trace contexts for the serving stack
   (``BIGDL_OBS_TRACE_SAMPLE``), emitted as ``trace`` events;
+- ``recorder``: the always-on per-request flight recorder
+  (``BIGDL_OBS_RECORDER``) — tail-based trace retention plus schema-v7
+  ``forensic`` bundles for anomalous requests, the records
+  ``tools/request_replay.py`` re-executes deterministically;
 - ``ledger``: the compile-time cost/memory ledger (flops, bytes,
   peak HBM per compiled executable, captured at the executable-cache
   chokepoint), live ``train_mfu``/``decode_model_flops_util`` truth,
@@ -40,7 +44,8 @@ off; ``BIGDL_OBS_TAPS=0`` removes the taps from the compiled step.
 # otherwise pay at import time; its consumers (serve/cluster.py, the
 # exporter tests) import it lazily.
 from bigdl_tpu.obs import (  # noqa: F401
-    alerts, diagnostics, events, ledger, metrics, spans, taps, trace,
+    alerts, diagnostics, events, ledger, metrics, recorder, spans, taps,
+    trace,
 )
 from bigdl_tpu.obs.diagnostics import dump_crash_bundle  # noqa: F401
 from bigdl_tpu.obs.events import (  # noqa: F401

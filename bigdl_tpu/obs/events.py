@@ -46,8 +46,11 @@ logger = logging.getLogger("bigdl_tpu.obs")
 #: `remote` type landed (cross-host TCP replica lifecycle,
 #: REMOTE_KINDS: connect/blip/reattach/partition/death — the
 #: blip-vs-death audit trail docs/serving.md "Cross-host fleet"
-#: documents).
-SCHEMA_VERSION = 6
+#: documents).  v7: the `forensic` type landed (obs/recorder.py
+#: tail-based request forensics, FORENSIC_KINDS: one anomalous
+#: request's full flight-recorder record + ring-neighbor context —
+#: the non-fatal analog of the crash bundle).
+SCHEMA_VERSION = 7
 
 ENV_OBS = "BIGDL_OBS"
 ENV_DIR = "BIGDL_OBS_DIR"
@@ -103,6 +106,11 @@ EVENT_TYPES = {
     # that distinguishes a survived network blip (reattach, zero
     # requeues) from a real death (requeue-exactly-once)
     "remote": ("kind",),
+    # one anomalous request's forensic bundle (obs/recorder.py, schema
+    # v7): the FlightRecorder's full per-request record plus the ring's
+    # neighboring-request context, emitted at the anomalous terminal
+    # state — kind-specific required fields in FORENSIC_KINDS
+    "forensic": ("kind", "trace_id", "record"),
 }
 
 #: per-kind REQUIRED fields for `serve` events (v2).  An unknown kind is
@@ -202,11 +210,30 @@ REMOTE_KINDS = {
     "death": ("replica",),
 }
 
+#: per-kind REQUIRED fields for `forensic` events (schema v7, the
+#: SERVE_KINDS contract): an unknown kind is a validation error.  Each
+#: kind is one way a request ends anomalous; the `record` field carries
+#: the FlightRecorder's full per-request record (obs/recorder.py) and
+#: `context` the ring's neighboring-request summaries.  `slo_miss`
+#: names which budget was blown (`slo` in {deadline, ttft, e2e});
+#: `slow` carries the latency and the tail bound that judged it;
+#: `partition` marks a request in flight across a RemoteReplica blip.
+FORENSIC_KINDS = {
+    "error": ("error",),
+    "shed": ("stage",),
+    "requeue": ("attempts",),
+    "slo_miss": ("slo",),
+    "slow": ("e2e_ms", "bound_ms"),
+    "replica_death": ("replica",),
+    "partition": ("replica",),
+}
+
 _COMMON = ("v", "ts", "proc", "type")
 
 _KINDED = {"serve": SERVE_KINDS, "recover": RECOVER_KINDS,
            "ledger": LEDGER_KINDS, "alert": ALERT_KINDS,
-           "scale": SCALE_KINDS, "remote": REMOTE_KINDS}
+           "scale": SCALE_KINDS, "remote": REMOTE_KINDS,
+           "forensic": FORENSIC_KINDS}
 
 
 def validate_event(event: dict) -> dict:

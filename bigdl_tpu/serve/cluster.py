@@ -191,6 +191,9 @@ class LocalReplica:
     """One in-process ServeEngine wearing the replica surface the
     router expects (submit/inflight/alive/stats + the rollout verbs)."""
 
+    #: flight-recorder transport attribution (obs/recorder.py)
+    transport = "inproc"
+
     def __init__(self, engine: ServeEngine, name: str = "local"):
         self.engine = engine
         self.name = name
@@ -249,6 +252,9 @@ class ProcessReplica:
 
     #: ``python -m <module>`` entry point of the child worker
     _WORKER_MODULE = "bigdl_tpu.serve.cluster"
+
+    #: flight-recorder transport attribution (obs/recorder.py)
+    transport = "stdio"
 
     def _init_frame(self, model, worker_kwargs) -> dict:
         """The first frame shipped to the child (the spawn handshake)."""
@@ -377,6 +383,11 @@ class ProcessReplica:
                 if tr is not None:
                     # hops the child stamped after the wire crossing
                     tr.extend(msg.get("hops") or ())
+                    if msg.get("rec"):
+                        # the child's flight-recorder notes merge into
+                        # the parent's record (same frame as the hops)
+                        from bigdl_tpu.obs import recorder as obs_rec
+                        obs_rec.note(tr.trace_id, **msg["rec"])
                 if fut.streaming and self._delivery is not None:
                     # streaming submits resolve through the delivery
                     # FIFO so the final token chunk always lands before
@@ -1251,6 +1262,13 @@ class WorkerOps:
                 # only the hops stamped on THIS side of the wire; the
                 # parent extends its original context with them
                 msg["hops"] = tr.new_hops()
+                from bigdl_tpu.obs import recorder as obs_rec
+                rec = obs_rec.export_notes(tr.trace_id)
+                if rec:
+                    # this side's flight-recorder notes (decode flags,
+                    # committed row, page counters, weight version)
+                    # ride the SAME reply frame as the hops
+                    msg["rec"] = rec
             self.send(msg)
         except BaseException as e:
             self._err(rid, e)

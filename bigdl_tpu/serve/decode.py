@@ -104,6 +104,7 @@ from collections import deque
 
 import numpy as np
 
+from bigdl_tpu.obs import recorder as obs_recorder
 from bigdl_tpu.serve.paging import PagePool, RequestTooLongError
 from bigdl_tpu.serve.prefix import PrefixCache, chain_keys
 from bigdl_tpu.serve.streaming import StreamFuture, TokenDelivery
@@ -723,6 +724,13 @@ class ContinuousDecoder:
         # fleet replicas pass an explicit name so per-replica decoder
         # series stay attributable after the child-registry merge
         self.name = name or f"decoder{next(_DECODER_SEQ)}"
+        self._flags_cache = None   # decode_flags() memo
+        #: optional WeightStore version this decoder serves — set by
+        #: whoever snapshotted the weights (a decode replica has no
+        #: rollout machinery of its own); the flight recorder notes it
+        #: per request so tools/request_replay.py can pin the exact
+        #: served weights
+        self.weights_version = None
         reg = obs_metrics.get()
         lab = {"decoder": self.name}
         self._m_steps = reg.counter(
@@ -1089,6 +1097,17 @@ class ContinuousDecoder:
             raise ValueError("n_words must be >= 1")
         req = _DecodeReq(seed.tolist(), n_words, trace=trace)
         req.rid = next(self._req_seq)
+        if trace is not None:
+            # flight-recorder identity: everything request_replay needs
+            # to rebuild an equivalent decoder for this request (plain
+            # host dict merges — the device is never touched)
+            obs_recorder.note(
+                trace.trace_id, rid=f"{self.name}/{req.rid}",
+                decoder=self.name,
+                seed_hash=obs_recorder.seed_hash(req.seed),
+                seed_len=len(req.seed), n_words=req.n_words,
+                flags=self.decode_flags(),
+                weights_version=self.weights_version)
         too_long = req.steps_needed > self.n_pos
         if self.paged and not too_long:
             too_long = (_pages_needed(req.steps_needed, self.page_size)
@@ -1152,6 +1171,13 @@ class ContinuousDecoder:
             req.t_admit = time.perf_counter()
             if req.trace is not None:
                 req.trace.stamp("decode_admit", req.t_admit)
+                if self.paged:
+                    # page/prefix counters at admission (already on the
+                    # host — _try_admit_paged computed them)
+                    obs_recorder.note(
+                        req.trace.trace_id, start_pos=req.start_pos,
+                        kv_pages=len(req.pages),
+                        prefix_pages=req.start_pos // self.page_size)
             self.admitted += 1
             self._m_admitted.inc()
         if self.paged:
@@ -1266,6 +1292,17 @@ class ContinuousDecoder:
                 s = len(r.seed)
                 toks = gen_host[r.slot, s - 1:s - 1 + r.n_words]
                 row = r.seed + [int(t) for t in toks]
+                if r.trace is not None:
+                    # the committed row — request_replay's oracle.
+                    # Reuses the boundary's ONE slab materialization;
+                    # no added sync, no per-token host work beyond the
+                    # row already built for the future
+                    obs_recorder.note(r.trace.trace_id, tokens=row)
+                    if self.spec_k:
+                        obs_recorder.note(
+                            r.trace.trace_id,
+                            spec_windows=self.spec_windows,
+                            spec_accepted=self.spec_accepted)
                 # retire BEFORE resolving: a serial client waiting on
                 # this future may submit again the instant it resolves,
                 # and the dispatch decision it triggers (least-loaded /
@@ -1460,6 +1497,24 @@ class ContinuousDecoder:
             self._tier.close()
             self._tier = None
         self._drop_series()
+
+    def decode_flags(self) -> dict:
+        """The constructor recipe ``tools/request_replay.py`` needs to
+        rebuild an equivalent decoder for a recorded request: every
+        flag that shapes the committed token stream or the KV layout.
+        Built once — the flight recorder notes it per traced request."""
+        if self._flags_cache is None:
+            self._flags_cache = {
+                "max_slots": self.B, "n_pos": self.n_pos,
+                "sync_interval": self.sync_interval,
+                "paged": self.paged, "page_size": self.page_size,
+                "n_pages": (self._pool.n_pages if self.paged
+                            else None),
+                "prefix_cache": self._prefix is not None,
+                "spec_k": self.spec_k,
+                "draft_layers": self.draft_layers,
+                "kv_quant": self.kv_quant}
+        return self._flags_cache
 
     def stats(self) -> dict:
         out = {"steps": self.steps, "host_syncs": self.host_syncs,

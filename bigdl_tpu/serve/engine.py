@@ -777,6 +777,12 @@ class ServeEngine:
                     # callback runs on this thread and stamps complete
                     # after, keeping the hop chain monotone
                     r.trace.stamp("compute", done)
+                    # the version this batch actually served — the
+                    # replay tool's weight pin (host-only, traced-only)
+                    from bigdl_tpu.obs import recorder as obs_recorder
+                    obs_recorder.note(r.trace.trace_id,
+                                      weights_version=self.weights_version,
+                                      engine=self.name)
             for i, r in enumerate(reqs):
                 r.future.set_result(out[i])
 

@@ -178,6 +178,9 @@ class DecodeReplica:
     ``host_mb=None`` the decoder's own ``BIGDL_SERVE_KV_HOST_MB`` path
     applies (which correctly skips the tier for non-paged decoders)."""
 
+    #: flight-recorder transport attribution (obs/recorder.py)
+    transport = "inproc"
+
     def __init__(self, model, name: str = "decode0",
                  host_mb: int | None = None, host_tier=None,
                  **decoder_kwargs):
@@ -728,6 +731,11 @@ class FleetRouter(Router):
             name, keys, outcome = note
             self._aff_counter(name, outcome).inc()
             self.index.note(name, keys)
+            if req.trace is not None:
+                from bigdl_tpu.obs import recorder as obs_recorder
+                obs_recorder.note(req.trace.trace_id,
+                                  affinity=outcome,
+                                  affinity_pages=req.affinity)
 
     def _mark_dead(self, replica):
         self.index.forget(getattr(replica, "name", ""))
@@ -788,6 +796,14 @@ class FleetRouter(Router):
                        "falling back to colocated prefill", name)
         self._emit("replica_dead", replica=name, role="prefill")
 
+    @staticmethod
+    def _note_prefill(req, outcome: str, pages: int | None = None):
+        """Prefill-ship attribution on the request's flight record."""
+        if req.trace is not None:
+            from bigdl_tpu.obs import recorder as obs_recorder
+            obs_recorder.note(req.trace.trace_id, prefill=outcome,
+                              shipped_pages=pages)
+
     def _submit_direct(self, replica, req, x):
         if req.trace is not None and self._accepts_trace(replica):
             return replica.submit(x, trace=req.trace)
@@ -808,10 +824,12 @@ class FleetRouter(Router):
             # pages the admission will match locally.  Affinity does
             # not just route better, it SHEDS prefill work.
             self._m_skip.inc()
+            self._note_prefill(req, "skipped")
             return super()._submit_to(replica, req)
         pf = self._pick_prefill()
         if pf is None:
             self._m_fallback.inc()
+            self._note_prefill(req, "fallback")
             return super()._submit_to(replica, req)
 
         outer = StreamFuture()
@@ -827,8 +845,10 @@ class FleetRouter(Router):
             if pages:
                 x2["pages"] = pages
                 self._m_ship.inc()
+                self._note_prefill(req, "shipped", len(pages))
             else:
                 self._m_fallback.inc()
+                self._note_prefill(req, "fallback")
             try:
                 inner = self._submit_direct(replica, req, x2)
             except Exception as e:

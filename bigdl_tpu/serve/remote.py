@@ -207,6 +207,9 @@ class RemoteReplica:
     :class:`AgentHandle` so death errors carry the agent's stderr
     tail."""
 
+    #: flight-recorder transport attribution (obs/recorder.py)
+    transport = "tcp"
+
     #: role the init frame declares; subclasses repoint it
     def _init_frame(self, model, worker_kwargs) -> dict:
         return {"op": "init", "model": model, "engine": worker_kwargs}
@@ -427,6 +430,11 @@ class RemoteReplica:
         if msg.get("ok"):
             if tr is not None:
                 tr.extend(msg.get("hops") or ())
+                if msg.get("rec"):
+                    # the agent-side flight-recorder notes merge into
+                    # this client's record (same frame as the hops)
+                    from bigdl_tpu.obs import recorder as obs_recorder
+                    obs_recorder.note(tr.trace_id, **msg["rec"])
             if fut.streaming and self._delivery is not None:
                 self._delivery.resolve(fut, msg.get("out"))
             else:
@@ -444,6 +452,15 @@ class RemoteReplica:
         deadline = t0 + self.liveness_s
         self._teardown_conn()
         obs_events.emit("remote", kind="blip", replica=self.name)
+        # requests in flight across the blip: note the partition
+        # involvement so the recorder's terminal classification keeps
+        # their full timeline even when they resolve healthy
+        from bigdl_tpu.obs import recorder as obs_recorder
+        with self._lock:
+            blipped = [t for _, t in self._futures.values()
+                       if t is not None]
+        for t in blipped:
+            obs_recorder.note(t.trace_id, blip_replica=self.name)
         logger.warning("replica %s: connection to %s:%d lost; "
                        "reconnecting (budget %.2fs)", self.name,
                        self.addr[0], self.addr[1], self.liveness_s)

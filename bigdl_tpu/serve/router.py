@@ -62,6 +62,7 @@ import threading
 import time
 from concurrent.futures import Future
 
+from bigdl_tpu.obs import recorder as obs_recorder
 from bigdl_tpu.obs import trace as obs_trace
 from bigdl_tpu.serve.engine import SheddedError  # noqa: F401 (re-export)
 from bigdl_tpu.serve.streaming import StreamFuture, ttft_ms_default
@@ -106,10 +107,10 @@ class DeadReplicaError(RuntimeError):
 class _RouterReq:
     __slots__ = ("x", "future", "priority", "deadline", "ttft_deadline",
                  "t_submit", "attempts", "queued", "trace", "affinity",
-                 "aff_note")
+                 "aff_note", "head")
 
     def __init__(self, x, priority, deadline, trace=None,
-                 ttft_deadline=None):
+                 ttft_deadline=None, head=False):
         self.x = x
         # StreamFuture: decode replicas pipe incremental token chunks
         # into it (dedup by absolute index, so a requeue after replica
@@ -123,6 +124,10 @@ class _RouterReq:
         self.ttft_deadline = ttft_deadline
         self.t_submit = time.perf_counter()
         self.trace = trace                # obs.trace.Trace when sampled
+        #: True when the HEAD sampler picked this request — its trace
+        #: event is always emitted; tail retention (obs/recorder.py)
+        #: additionally emits unsampled requests that end anomalous
+        self.head = bool(head)
         #: pages the dispatcher predicts the chosen replica's prefix
         #: cache already holds (fleet affinity routing; None = unknown)
         self.affinity = None
@@ -285,10 +290,33 @@ class Router:
         ttft_deadline = (now + ttft_s) if ttft_s > 0 and wants_stream \
             else None
         tr = self._sampler.next()
+        head = tr is not None
+        rec = obs_recorder.get()
+        if tr is None and rec is not None:
+            # tail-based retention: EVERY request gets a (cheap) trace
+            # context; whether its hop chain is ever EMITTED is decided
+            # at the terminal state (_finish_trace → recorder.finalize)
+            tr = obs_trace.Trace()
         if tr is not None:
             tr.stamp("admit")
         req = _RouterReq(x, priority, deadline, trace=tr,
-                         ttft_deadline=ttft_deadline)
+                         ttft_deadline=ttft_deadline, head=head)
+        if rec is not None and tr is not None:
+            fields = {"priority": int(priority),
+                      "slo_ms": slo_s * 1e3 if slo_s > 0 else None,
+                      "ttft_slo_ms": ttft_s * 1e3
+                      if ttft_s > 0 and wants_stream else None,
+                      "stream": True if wants_stream else None,
+                      "head": True if head else None}
+            if isinstance(x, dict) and "seed" in x:
+                # decode payload: enough identity for request_replay
+                # even when the replica-side notes never come back (a
+                # death before the reply frame)
+                seed = x["seed"]
+                fields.update(seed_hash=obs_recorder.seed_hash(seed),
+                              seed_len=len(seed),
+                              n_words=x.get("n_words"))
+            rec.note(tr.trace_id, **fields)
         if wants_stream:
             req.future.request_stream()
         if on_tokens is not None:
@@ -381,7 +409,8 @@ class Router:
                 self._m_shed["admission"].inc()
                 self._emit("shed", priority=req.priority,
                            wait_ms=(now - req.t_submit) * 1e3)
-                self._finish_trace(req, "shed", hop="shed")
+                self._finish_trace(req, "shed", hop="shed",
+                                   shed_stage="admission")
                 req.future.set_exception(SheddedError(
                     f"projected {reason} (priority {req.priority}, "
                     f"backlog {load}, est {miss * 1e3:.1f} ms)"))
@@ -493,6 +522,8 @@ class Router:
             self._m_req["completed"].inc()
             self._finish_trace(req, "ok", hop="complete",
                                replica=getattr(replica, "name", None),
+                               transport=getattr(replica, "transport",
+                                                 None),
                                latency_ms=lat * 1e3)
             if not req.future.done():
                 req.future.set_result(inner.result())
@@ -508,7 +539,9 @@ class Router:
             # the router's taxonomy too, not a failure — the documented
             # counter contract keeps shed/failed disjoint
             self._m_shed["replica"].inc()
-            self._finish_trace(req, "shed", hop="shed")
+            self._finish_trace(req, "shed", hop="shed",
+                               shed_stage="replica",
+                               replica=getattr(replica, "name", None))
             if not req.future.done():
                 req.future.set_exception(exc)
             return
@@ -525,8 +558,7 @@ class Router:
                 with self._cv:
                     if self._push(req):
                         self._m_req["requeued"].inc()
-                        if req.trace is not None:
-                            req.trace.stamp("requeue")
+                        self._note_requeue(req, replica)
                         self._cv.notify()
                 return
         self._fail(req, exc)
@@ -538,18 +570,56 @@ class Router:
         if not req.future.done():
             req.future.set_exception(exc)
 
+    def _note_requeue(self, req, replica=None):
+        """Requeue bookkeeping: the hop stamp plus the flight-recorder
+        involvement note (the dead replica that caused the requeue)."""
+        if req.trace is None:
+            return
+        req.trace.stamp("requeue")
+        name = getattr(replica, "name", None) if replica is not None \
+            else None
+        if name is not None:
+            obs_recorder.note(req.trace.trace_id, death_replica=name)
+
+    def _slo_verdict(self, req, status) -> str | None:
+        """Which SLO budget a COMPLETED request blew (None = in
+        budget): ``deadline`` = the future resolved past its e2e
+        deadline, ``ttft`` = the first token streamed past its budget.
+        Failed/shed requests are classified under their own forensic
+        kinds, not here."""
+        if status != "ok":
+            return None
+        if (req.deadline is not None
+                and time.perf_counter() > req.deadline):
+            return "deadline"
+        t_first = getattr(req.future, "t_first_token", None)
+        if (req.ttft_deadline is not None and t_first is not None
+                and t_first > req.ttft_deadline):
+            return "ttft"
+        return None
+
     def _finish_trace(self, req, status, hop=None, **fields):
-        """Terminal trace emission for a sampled request (no-op for the
-        unsampled 99.x%).  The trace object is detached afterwards so a
-        double-resolution path (death sweep + failing future) cannot
-        emit twice."""
+        """Terminal trace handling for EVERY request: the hop chain and
+        last fields are absorbed into the flight recorder, which
+        decides retention — a head-sampled request's trace event is
+        always emitted, an unsampled one only when it ended anomalous
+        (obs/recorder.py tail retention).  The trace object is detached
+        afterwards so a double-resolution path (death sweep + failing
+        future) cannot emit twice."""
         tr, req.trace = req.trace, None
         if tr is None:
             return
         if hop:
             tr.stamp(hop)
-        tr.emit(status=status, priority=req.priority,
-                **{k: v for k, v in fields.items() if v is not None})
+        fields = {k: v for k, v in fields.items() if v is not None}
+        emit = obs_recorder.finalize(
+            tr.trace_id, status, trace=tr, head_sampled=req.head,
+            priority=req.priority,
+            requeues=req.attempts if req.attempts else None,
+            slo_miss=self._slo_verdict(req, status),
+            e2e_ms=fields.get("latency_ms"), **fields)
+        if emit:
+            tr.emit(status=status, priority=req.priority, **fields)
 
     # -- health -------------------------------------------------------------
     def _mark_dead(self, replica):
@@ -575,8 +645,7 @@ class Router:
                 with self._cv:
                     if self._push(req):
                         self._m_req["requeued"].inc()
-                        if req.trace is not None:
-                            req.trace.stamp("requeue")
+                        self._note_requeue(req, replica)
                         self._cv.notify()
             else:
                 self._fail(req, DeadReplicaError(
@@ -688,8 +757,7 @@ class Router:
             with self._cv:
                 if self._push(req):
                     self._m_req["requeued"].inc()
-                    if req.trace is not None:
-                        req.trace.stamp("requeue")
+                    self._note_requeue(req, replica)
                     self._cv.notify()
         self._emit("replica_removed",
                    replica=getattr(replica, "name", repr(replica)),

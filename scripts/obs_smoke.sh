@@ -12,6 +12,13 @@
 #      must leave ledger events + a finite, stable train_mfu gauge, an
 #      injected queue-depth spike must fire then resolve an alert, and
 #      obs_report must render the ledger + alert sections
+#   5. the request-forensics drill: the forensic-marked tests, then a
+#      2-replica fleet under load with an injected serve_kill and a
+#      chaos-slowed request — every anomalous request must keep a
+#      complete monotone recorded timeline while healthy traffic at
+#      sample=0 emits ZERO trace events, tools/request_replay.py must
+#      reproduce a recorded greedy decode token-identically, and the
+#      report's Forensics section must render under --strict
 #
 #   scripts/obs_smoke.sh            # full smoke
 #
@@ -20,14 +27,14 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-echo "== obs smoke 1/4: fast obs-marked tests =="
+echo "== obs smoke 1/5: fast obs-marked tests =="
 python -m pytest tests/test_obs.py tests/test_obs_metrics.py \
     tests/test_obs_ledger.py tests/test_obs_alerts.py -q \
     -m "obs and not slow" \
     -p no:cacheprovider -p no:randomly
 
 RUN=$(mktemp -d)
-echo "== obs smoke 2/4: 5-step LeNet with taps+events ($RUN) =="
+echo "== obs smoke 2/5: 5-step LeNet with taps+events ($RUN) =="
 BIGDL_OBS_DIR="$RUN" BIGDL_OBS_TAPS=1 BIGDL_OBS_TAPS_CADENCE=2 \
 python - "$RUN" <<'PY'
 import json, sys, time
@@ -108,7 +115,7 @@ echo "OK: report rendered ($RUN/report.md)"
 
 RUN2=$(mktemp -d)
 HB=$(mktemp -d)
-echo "== obs smoke 3/4: watchdog trip via BIGDL_FAULTS ($RUN2) =="
+echo "== obs smoke 3/5: watchdog trip via BIGDL_FAULTS ($RUN2) =="
 python - "$RUN2" "$HB" <<'PY'
 import os, socket, subprocess, sys
 
@@ -139,7 +146,7 @@ python tools/obs_report.py "$RUN2" -o "$RUN2/report.md"
 grep -q "Crash bundles" "$RUN2/report.md"
 
 RUN3=$(mktemp -d)
-echo "== obs smoke 4/4: performance observatory drill ($RUN3) =="
+echo "== obs smoke 4/5: performance observatory drill ($RUN3) =="
 BIGDL_OBS_DIR="$RUN3" python - <<'PY'
 import math
 import numpy as np
@@ -213,4 +220,107 @@ python tools/obs_report.py "$RUN3" --strict -o "$RUN3/report.md"
 grep -q "Performance ledger" "$RUN3/report.md"
 grep -q "Alert timeline" "$RUN3/report.md"
 echo "OK: observatory report rendered ($RUN3/report.md)"
+
+RUN4=$(mktemp -d)
+echo "== obs smoke 5/5: request-forensics drill ($RUN4) =="
+python -m pytest tests/test_recorder.py tests/test_remote.py -q \
+    -m "forensic and not slow" -p no:cacheprovider -p no:randomly
+BIGDL_OBS_DIR="$RUN4" python - "$RUN4" <<'PY'
+import json, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.obs import events as obs_events
+from bigdl_tpu.obs import recorder
+from bigdl_tpu.obs.trace import Trace
+from bigdl_tpu.serve import (LocalReplica, ProcessReplica, Router,
+                             ServeEngine, WeightStore)
+from bigdl_tpu.serve.decode import ContinuousDecoder
+from bigdl_tpu.utils.random import set_seed
+
+run_dir = sys.argv[1]
+set_seed(1)
+model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax())
+
+# -- 2-replica fleet under load, one replica chaos-killed mid-burst,
+#    head sampling at 0 (the production default) ---------------------------
+eng = ServeEngine(model, max_batch=4, max_wait_ms=2, input_shape=(4,))
+victim = ProcessReplica(model, name="victim",
+                        env={"BIGDL_FAULTS": "serve_kill@at=4"},
+                        max_batch=4, max_wait_ms=2, input_shape=(4,))
+rng = np.random.RandomState(0)
+failed = 0
+try:
+    with Router([LocalReplica(eng, name="healthy"), victim],
+                shed=False, trace_sample=0.0) as router:
+        futs = [router.submit(rng.randn(4).astype(np.float32))
+                for _ in range(24)]
+        # one deliberately chaos-slowed request: a 1 ms deadline no
+        # batched engine can make -> slo_miss forensics
+        slow = router.submit(rng.randn(4).astype(np.float32), slo_ms=1)
+        for f in futs + [slow]:
+            try:
+                f.result(timeout=120)
+            except Exception:
+                failed += 1
+finally:
+    victim.close()
+    eng.close()
+
+recs = [r for r in recorder.get().records() if r.get("outcome")]
+anom = [r for r in recs if r.get("anomaly")]
+assert len(recs) == 25, len(recs)
+assert anom, "the serve_kill drill must produce anomalies"
+assert any(r["anomaly"] == "slo_miss" for r in anom), \
+    [r["anomaly"] for r in anom]
+# 100% of anomalous requests keep a complete, monotone timeline
+for r in anom:
+    phases = [h[0] for h in r["hops"]]
+    stamps = [h[1] for h in r["hops"]]
+    assert phases[0] == "admit", phases
+    assert stamps == sorted(stamps), r
+ring = obs_events.get().ring_events()
+traces = [e for e in ring if e["type"] == "trace"]
+forensics = [e for e in ring if e["type"] == "forensic"]
+# tail retention: at sample=0 the ONLY emitted traces are the anomalies
+assert len(traces) == len(anom) == len(forensics), \
+    (len(traces), len(anom), len(forensics))
+print(f"OK: {len(anom)} anomalous / {len(recs) - len(anom)} healthy "
+      f"records; every anomaly bundled, zero healthy trace events")
+
+# -- record one greedy decode for the offline replay check -----------------
+set_seed(1)
+lm = TransformerLM(vocab_size=11, d_model=16, n_heads=2, n_layers=2,
+                   hidden=32)
+store = WeightStore()
+dec = ContinuousDecoder(lm, max_slots=2, n_pos=16, page_size=4,
+                        sync_interval=2)
+dec.weights_version = store.put_model(lm)
+tr = Trace()
+fut = dec.submit([1, 2, 3, 4], 5, trace=tr)
+dec.run()
+row = fut.result()
+rec = recorder.get().get(tr.trace_id)
+assert rec["tokens"] == row and rec["seed_len"] == 4
+with open(os.path.join(run_dir, "records.jsonl"), "w") as fh:
+    fh.write(json.dumps(rec) + "\n")
+with open(os.path.join(run_dir, "replay_model.py"), "w") as fh:
+    fh.write(
+        "from bigdl_tpu.models.transformer import TransformerLM\n"
+        "from bigdl_tpu.utils.random import set_seed\n\n\n"
+        "def model():\n"
+        "    set_seed(1)\n"
+        "    return TransformerLM(vocab_size=11, d_model=16,\n"
+        "                         n_heads=2, n_layers=2, hidden=32)\n")
+print("OK: recorded a greedy decode for replay")
+PY
+PYTHONPATH="$RUN4:${PYTHONPATH:-}" \
+python tools/request_replay.py "$RUN4/records.jsonl" \
+    --model replay_model:model | grep MATCH
+python tools/obs_report.py "$RUN4" --strict -o "$RUN4/report.md"
+grep -q "## Forensics" "$RUN4/report.md"
+echo "OK: forensics drill green (replay MATCH, report rendered)"
 echo "obs smoke: all green"

@@ -63,6 +63,11 @@ def _seed():
     lg = sys.modules.get("bigdl_tpu.obs.ledger")
     if lg is not None:
         lg.reset()
+    # and the flight recorder: a per-test ring keeps forensic-bundle
+    # and tail-retention assertions independent across tests
+    fr = sys.modules.get("bigdl_tpu.obs.recorder")
+    if fr is not None:
+        fr.reset()
     yield
 
 

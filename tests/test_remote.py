@@ -659,6 +659,70 @@ class TestPartitionDrillFleet:
 
 
 # ---------------------------------------------------------------------------
+# trace + flight-recorder forensics over TCP (docs/observability.md
+# "Request forensics")
+# ---------------------------------------------------------------------------
+
+@pytest.mark.forensic
+class TestTraceForensicsOverTcp:
+    def test_blip_yields_one_monotone_deduped_hop_chain(self):
+        """A blip + re-attach must NOT duplicate or reorder trace hops:
+        the pending-frame replay can serve a request twice on the agent,
+        but the client's rid dedup pops each future once, so every
+        request ends with exactly one monotone hop chain — and the
+        blipped requests carry the partition involvement that turns
+        into a ``forensic`` bundle at finalize."""
+        from bigdl_tpu.obs import recorder as obs_recorder
+        from bigdl_tpu.obs.trace import Trace
+        lm = _lm()
+        obs_events.configure(None)
+        faults.configure("serve_partition@at=2,len_s=0.2")
+        agent = _agent()
+        try:
+            r = RemoteDecodeReplica(
+                (agent.host, agent.port), lm, name="d0", token=TOKEN,
+                liveness_s=1.5, max_slots=2, n_pos=16, page_size=4,
+                sync_interval=2)
+            try:
+                traces = [Trace() for _ in range(6)]
+                futs = [r.submit({"seed": [1, 2, 3, 4, 5],
+                                  "n_words": 4}, trace=tr)
+                        for tr in traces]
+                rows = [f.result(timeout=120) for f in futs]
+                assert all(rows)
+                assert r.alive()                 # a blip, not a death
+                blipped = 0
+                for tr in traces:
+                    names = [h[0] for h in tr.hops]
+                    assert names, "hop chain lost across the blip"
+                    # deduped: the replayed frame must not double-stamp
+                    assert len(names) == len(set(names)), names
+                    stamps = [h[1] for h in tr.hops]
+                    assert stamps == sorted(stamps)
+                    # agent-side record notes merged on the SAME reply
+                    # frame: the replay recipe crossed the wire
+                    rec = obs_recorder.get().get(tr.trace_id)
+                    assert rec is not None
+                    assert rec["tokens"] == rows[traces.index(tr)]
+                    assert rec["flags"]["page_size"] == 4
+                    emit = obs_recorder.finalize(tr.trace_id, "ok",
+                                                 trace=tr)
+                    if rec.get("blip_replica"):
+                        blipped += 1
+                        assert emit             # tail-retained
+                assert blipped >= 1
+                forensics = [e for e in obs_events.get().ring_events()
+                             if e["type"] == "forensic"]
+                assert len(forensics) == blipped
+                assert all(e["kind"] == "partition"
+                           and e["replica"] == "d0" for e in forensics)
+            finally:
+                r.close()
+        finally:
+            agent.close()
+
+
+# ---------------------------------------------------------------------------
 # the real thing: a spawned agent subprocess over TCP loopback (slow)
 # ---------------------------------------------------------------------------
 

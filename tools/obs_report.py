@@ -259,6 +259,68 @@ def _serving_section(events, waterfall=5):
     return out
 
 
+def _forensics_section(events, waterfall=5):
+    """Markdown lines for the ``forensic`` event type (obs/recorder.py
+    flight recorder, schema v7): anomaly counts by kind plus failed-
+    and slowest-request waterfalls rendered from the recorder RECORDS
+    riding the bundles — populated even when head sampling is 0
+    (tail-based retention keeps exactly the anomalous chains)."""
+    from bigdl_tpu.obs.trace import hop_deltas
+
+    forensics = _by_type(events, "forensic")
+    if not forensics:
+        return []
+    out = ["## Forensics", ""]
+    kinds = {}
+    for e in forensics:
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    out.append(f"- anomalous requests bundled: **{len(forensics)}** ("
+               + ", ".join(f"{k}={n}" for k, n in sorted(kinds.items()))
+               + ")")
+    out.append("")
+
+    def _hop_table(rows, title):
+        if not rows:
+            return
+        phases = []
+        for e in rows:          # union of hop names, first-seen order
+            for ph, _ in hop_deltas(e["record"].get("hops") or []):
+                if ph not in phases:
+                    phases.append(ph)
+        out.append(title)
+        out.append("")
+        out.append("| trace | kind | replica | e2e ms | "
+                   + " | ".join(phases) + " |")
+        out.append("|---|---|---|---|" + "---|" * len(phases))
+        for e in rows:
+            rec = e["record"]
+            cells = {ph: 0.0 for ph in phases}
+            for ph, dt in hop_deltas(rec.get("hops") or []):
+                cells[ph] = cells.get(ph, 0.0) + dt * 1e3
+            hop_row = " | ".join(f"{cells[ph]:.2f}" for ph in phases)
+            e2e = rec.get("e2e_ms")
+            out.append(
+                f"| `{e['trace_id'][:8]}` | {e['kind']} | "
+                f"{rec.get('replica', '-')} | "
+                f"{'-' if e2e is None else f'{e2e:.2f}'} | {hop_row} |")
+        out.append("")
+
+    hard = [e for e in forensics
+            if e["kind"] in ("error", "shed", "replica_death",
+                             "requeue", "partition")]
+    if hard and waterfall > 0:
+        _hop_table(hard[-waterfall:],
+                   f"### Failed / disrupted requests (last "
+                   f"{min(waterfall, len(hard))} of {len(hard)})")
+    if waterfall > 0:
+        slow = sorted(forensics,
+                      key=lambda e: -(e["record"].get("e2e_ms") or 0.0))
+        slow = slow[:waterfall]
+        _hop_table(slow, f"### Slowest anomalous requests (top "
+                         f"{len(slow)} of {len(forensics)})")
+    return out
+
+
 def _bytes_h(n) -> str:
     n = float(n)
     for unit in ("B", "KiB", "MiB", "GiB"):
@@ -493,6 +555,7 @@ def render(events, bad, bundles, title="obs run report",
         out.append("")
 
     out.extend(_serving_section(events, waterfall))
+    out.extend(_forensics_section(events, waterfall))
     out.extend(_scale_section(events))
     out.extend(_ledger_section(events))
     out.extend(_alerts_section(events))

@@ -1,0 +1,189 @@
+"""Deterministic offline replay of a flight-recorded decode request
+(docs/observability.md "Request forensics").
+
+The flight recorder (``bigdl_tpu/obs/recorder.py``) captures, per
+request, everything the decode path consumed: the committed token row
+(seed included), the seed length and hash, the decoder's construction
+flags (paged/prefix/spec/quant recipe), and the served weight version.
+That is a complete re-execution recipe: ``replay_request`` builds a
+FRESH :class:`~bigdl_tpu.serve.decode.ContinuousDecoder` with the
+recorded flags, pins the recorded weight version from a
+:class:`~bigdl_tpu.serve.cluster.WeightStore` when one is supplied,
+re-submits the recorded seed, and diffs the replayed token row against
+the committed one.  Greedy decode is deterministic, so the replay must
+be token-identical — a non-empty diff means the weights rolled
+(reported as ``version_mismatch``), the flags lied, or the decode
+stack has a real reproducibility bug.
+
+Usage (CLI reads ``forensic`` events out of a run dir, or any JSONL of
+records; the smoke drill and tests drive the Python API directly):
+
+    python tools/request_replay.py RUN_DIR --model pkg.mod:factory
+    python tools/request_replay.py RUN_DIR --model pkg.mod:factory \\
+        --trace-id 1f2e3d...
+
+``factory`` is a zero-arg callable returning the served model (same
+architecture AND weights — replay against different weights reports
+the divergence, which is the point of the version check, not a crash).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: ContinuousDecoder kwargs a recorded ``flags`` dict maps onto —
+#: exactly decode_flags()'s keys (anything else in the record is
+#: provenance, not construction input)
+FLAG_KEYS = ("max_slots", "n_pos", "sync_interval", "paged",
+             "page_size", "n_pages", "prefix_cache", "spec_k",
+             "draft_layers", "kv_quant")
+
+
+def _first_divergence(a, b):
+    """Index of the first differing token, or None when equal."""
+    for i, (x, y) in enumerate(zip(a, b)):
+        if int(x) != int(y):
+            return i
+    if len(a) != len(b):
+        return min(len(a), len(b))
+    return None
+
+
+def replay_request(record: dict, model, store=None) -> dict:
+    """Re-execute one recorded request and diff the token stream.
+
+    ``record`` is a flight-recorder record (the ``record`` field of a
+    ``forensic`` event, or ``FlightRecorder.get``'s copy) that carries
+    ``tokens``, ``seed_len`` and ``flags``.  ``model`` is the served
+    model; when ``store`` (a :class:`WeightStore`) is given and the
+    record names a ``weights_version``, the snapshot of that version is
+    loaded into ``model`` first — a version the store no longer retains
+    is reported as ``version_mismatch`` and the replay proceeds on the
+    model's current weights (the diff then SHOWS the roll).
+
+    Returns a report dict::
+
+        {trace_id, match, diverge_at, replayed, recorded,
+         weights_version, version_mismatch, seed_hash_ok}
+    """
+    from bigdl_tpu.obs import recorder as obs_recorder
+    from bigdl_tpu.serve.decode import ContinuousDecoder
+
+    tokens = record.get("tokens")
+    seed_len = record.get("seed_len")
+    flags = record.get("flags")
+    if not tokens or not seed_len or flags is None:
+        raise ValueError(
+            "record is not replayable: needs tokens + seed_len + flags "
+            f"(have {sorted(k for k in record if record[k] is not None)})")
+    seed = [int(t) for t in tokens[:seed_len]]
+    n_words = int(record.get("n_words") or (len(tokens) - seed_len))
+
+    version = record.get("weights_version")
+    version_mismatch = None
+    if store is not None and version is not None:
+        try:
+            params, state = store.get(version)
+            model.load_params(params)
+            model.load_state(state)
+        except KeyError as e:
+            version_mismatch = str(e)
+
+    kwargs = {k: flags[k] for k in FLAG_KEYS
+              if flags.get(k) is not None}
+    dec = ContinuousDecoder(model, **kwargs)
+    fut = dec.submit(seed, n_words)
+    dec.run()
+    replayed = [int(t) for t in fut.result()]
+
+    recorded = [int(t) for t in tokens]
+    diverge_at = _first_divergence(replayed, recorded)
+    want_hash = record.get("seed_hash")
+    return {
+        "trace_id": record.get("trace_id"),
+        "match": diverge_at is None,
+        "diverge_at": diverge_at,
+        "replayed": replayed,
+        "recorded": recorded,
+        "weights_version": version,
+        "version_mismatch": version_mismatch,
+        "seed_hash_ok": (want_hash is None
+                         or obs_recorder.seed_hash(seed) == want_hash),
+    }
+
+
+def load_records(path: str) -> list:
+    """Replayable records out of a run dir's ``forensic`` events (or
+    any JSONL whose lines are events or bare records)."""
+    if os.path.isdir(path):
+        from obs_report import load_run
+        events, _, _ = load_run(path)
+        return [e["record"] for e in events
+                if e.get("type") == "forensic" and e.get("record")]
+    out = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if not ln:
+                continue
+            obj = json.loads(ln)
+            if obj.get("type") == "forensic" and obj.get("record"):
+                out.append(obj["record"])
+            elif "tokens" in obj and "flags" in obj:
+                out.append(obj)
+    return out
+
+
+def _load_factory(spec: str):
+    mod, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(f"--model wants module:factory, got {spec!r}")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run dir (BIGDL_OBS_DIR) or a JSONL "
+                    "of forensic events / records")
+    ap.add_argument("--model", required=True,
+                    help="module:factory returning the served model")
+    ap.add_argument("--trace-id", help="replay only this trace id "
+                    "(prefix match); default: every replayable record")
+    args = ap.parse_args(argv)
+
+    records = load_records(args.path)
+    if args.trace_id:
+        records = [r for r in records
+                   if str(r.get("trace_id", "")).startswith(args.trace_id)]
+    records = [r for r in records
+               if r.get("tokens") and r.get("seed_len")
+               and r.get("flags") is not None]
+    if not records:
+        print("no replayable records found")
+        return 1
+
+    factory = _load_factory(args.model)
+    failures = 0
+    for rec in records:
+        rep = replay_request(rec, factory())
+        tid = str(rep["trace_id"])[:8]
+        if rep["match"]:
+            print(f"{tid}  MATCH  ({len(rep['replayed'])} tokens)")
+        else:
+            failures += 1
+            print(f"{tid}  DIVERGED at token {rep['diverge_at']}  "
+                  f"(recorded {rep['recorded'][rep['diverge_at']:][:4]}... "
+                  f"replayed {rep['replayed'][rep['diverge_at']:][:4]}...)")
+        if not rep["seed_hash_ok"]:
+            print(f"{tid}  WARNING: seed hash mismatch — the record's "
+                  "token row does not match its own seed hash")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,10 @@ autoscaler's spawn circuit breaker is open — docs/serving.md
 "Autoscaling") plus the affinity/prefill/host-tier telemetry, and —
 when an alert engine is exporting
 ``alert_active`` gauges (``obs/alerts.py``) — one ``alerts:`` line
-naming every firing rule (``alerts: none`` when quiet).
+naming every firing rule (``alerts: none`` when quiet), and — when the
+flight recorder has bundled anomalies (``obs/recorder.py``,
+docs/observability.md "Request forensics") — one ``anomalies:`` line
+with the windowed per-kind counts and the worst anomalous e2e.
 
 Rates are differences between consecutive snapshots (the counters are
 monotonic, so the math survives engine restarts landing mid-window as a
@@ -331,6 +334,37 @@ def stream_line(cur: dict, prev: dict | None, dt: float) -> str | None:
             + " tok/s streamed")
 
 
+def anomalies_line(cur: dict, prev: dict | None,
+                   dt: float) -> str | None:
+    """One trailing ``anomalies:`` line from the flight recorder's
+    ``forensic_requests_total{kind=...}`` counter (obs/recorder.py
+    tail-based forensics): windowed per-kind anomaly counts (lifetime
+    totals on the first frame — the engine rows' fallback rule) and the
+    worst anomalous end-to-end latency high-water mark.  None when no
+    recorder has ever bundled an anomaly (family absent)."""
+    fam = cur.get("forensic_requests_total")
+    if fam is None:
+        return None
+    kinds = sorted({row["labels"].get("kind", "?")
+                    for row in fam["series"]})
+    parts = []
+    for kind in kinds:
+        n = _rate(cur, prev, dt, "forensic_requests_total",
+                  kind=kind) * dt
+        if prev is None:       # first frame: lifetime totals
+            n = metrics.family_total(cur, "forensic_requests_total",
+                                     kind=kind)
+        if n:
+            parts.append(f"{kind}={int(n)}")
+    if not parts:
+        return "anomalies: none"
+    worst = metrics.family_total(cur, "forensic_worst_e2e_ms")
+    line = "anomalies: " + " ".join(parts)
+    if worst:
+        line += f"   worst e2e {worst:.1f} ms"
+    return line
+
+
 def alerts_line(cur: dict) -> str | None:
     """One trailing ``alerts:`` line from the ``alert_active`` gauges
     the declarative alert engine exports (``obs/alerts.py`` — rides the
@@ -354,6 +388,7 @@ def render(rows: list, source: str, dt: float,
            decode: str | None = None,
            stream: str | None = None,
            fleet: str | None = None,
+           anomalies: str | None = None,
            alerts: str | None = None) -> str:
     out = [f"serve_top — {source}  (window {dt:.1f}s)", "",
            f"{'engine':<12} {'rows/s':>8} {'queue':>6} {'inflt':>6} "
@@ -368,7 +403,7 @@ def render(rows: list, source: str, dt: float,
             f"{marker}{name:<11} {r['rows_s']:8.1f} {r['queue']:6d} "
             f"{r['inflight']:6d} {r['shed_s']:7.1f} {_ms(r['p50_ms'])} "
             f"{_ms(r['p95_ms'])} {_ms(r['p99_ms'])} {r['burn']:6.2f}")
-    for line in (decode, stream, fleet, alerts):
+    for line in (decode, stream, fleet, anomalies, alerts):
         if line:
             out += ["", line]
     return "\n".join(out)
@@ -401,6 +436,8 @@ def main(argv=None) -> int:
                                           dt),
                        fleet=fleet_line(cur, prev[1] if prev else None,
                                         dt),
+                       anomalies=anomalies_line(
+                           cur, prev[1] if prev else None, dt),
                        alerts=alerts_line(cur))
         if args.once:
             print(frame)
