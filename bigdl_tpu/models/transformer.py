@@ -419,7 +419,8 @@ def _lm_forward_one(tok, i, caches, handles, n_pos, pe, tp_axis=None,
 
 
 def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
-              temperature: float = 1.0, top_k: int = 0):
+              temperature: float = 1.0, top_k: int = 0,
+              top_p: float = 0.0):
     """KV-cached incremental decoding for a ``TransformerLM`` model.
 
     Same math as re-forwarding the whole prefix per token
@@ -434,9 +435,15 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
 
     ``greedy=True`` takes the argmax; otherwise ``key`` (a JAX PRNG key)
     drives ``jax.random.categorical`` — a different draw stream from
-    ``generate``'s host inverse-CDF, same distribution —
-    with optional ``temperature`` scaling and ``top_k`` truncation
-    (models.rnn.adjust_logprobs semantics, computed device-side).
+    ``generate``'s host inverse-CDF, same distribution — with optional
+    ``temperature`` scaling plus ``top_k`` / ``top_p`` truncation
+    through the ONE shared sampler
+    (:func:`bigdl_tpu.serve.sampling.sample_tokens` — the served
+    continuous decoder filters logits with the same function, so the
+    offline and serving paths cannot drift).  Pre-existing
+    (temperature, top_k) draws are byte-identical to the historical
+    inline math; ``top_p`` in (0, 1) additionally keeps only the
+    smallest descending-probability prefix reaching that mass.
 
     ``seed_ids`` is a flat list of ids (returns the extended flat list)
     or a rectangular batch of B seed rows (returns B extended rows) —
@@ -446,10 +453,14 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
     import jax.numpy as jnp
     import numpy as np
 
+    from bigdl_tpu.serve.sampling import sample_tokens
+
     if not greedy and key is None:
         raise ValueError("sampling (greedy=False) needs a PRNG key")
     if temperature <= 0:
         raise ValueError("temperature must be > 0")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError("top_p must be in [0, 1] (0 or 1 = off)")
     handles = _lm_handles(model)
     mods, n_layers = handles.mods, handles.n_layers
     n_heads, hd, vocab = handles.n_heads, handles.hd, handles.vocab
@@ -480,12 +491,9 @@ def lm_decode(model, seed_ids, n_words, greedy: bool = True, key=None,
         if greedy:
             nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
         else:
-            lp = logp if temperature == 1.0 else logp / temperature
-            if top_k and top_k < vocab:
-                kth = jax.lax.top_k(lp, top_k)[0][:, -1:]
-                lp = jnp.where(lp >= kth, lp, -jnp.inf)
             k_rng, sub = jax.random.split(k_rng)
-            nxt = jax.random.categorical(sub, lp).astype(jnp.int32)
+            nxt = sample_tokens(logp, sub, temperature, top_k,
+                                top_p).astype(jnp.int32)
         return (kcache, vcache, nxt, k_rng), nxt
 
     k0 = jnp.zeros((n_layers, bsz, n_pos, n_heads, hd), jnp.float32)

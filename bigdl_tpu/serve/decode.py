@@ -76,9 +76,29 @@ when traffic skews short.  On top of the pool:
   fixed ``k+1`` window, pre-warmed through the shared executable cache
   at construction, so acceptance-length variance never compiles.
 
-Greedy decoding only (the serial oracle is ``lm_decode(greedy=True)``;
-sampling needs per-slot key streams, which would change the draw order
-vs the serial scan and break the bit-parity contract).
+**Sampled decode on the fast path** (``serve/sampling.py``,
+docs/serving.md "Sampled decode"): :meth:`ContinuousDecoder.submit`
+takes per-request :class:`~bigdl_tpu.serve.sampling.SamplingParams`
+(temperature / top-k / top-p / seed / stop sequences / max_tokens)
+carried as per-slot TRACED vectors — float temps, int ks, packed stop
+buffers and a ``(B, 2)`` per-slot PRNG-key array ride the step program
+as data, so a batch mixing greedy and any number of distinct sampling
+configs runs the SAME compiled step with zero cold compiles.  Greedy is
+the ``temperature == 0`` branch of a ``jnp.where`` whose selected lane
+is exactly the historical argmax — greedy streams stay byte-identical
+to the sampling-free decoder.  Draw keys are
+``fold_in(request_key, DRAW_TAGS * gen_index + tag)`` — a pure function
+of the request seed and generated-token index, never of slot, batch mix
+or prefix-hit start position — so every sampled request replays
+bit-exactly (``tools/request_replay.py``).  Under speculative decode
+the argmax prefix-acceptance generalizes to the Leviathan lossless
+accept/reject rule (accept draft ``x`` with prob ``min(1, p(x)/q(x))``,
+resample the residual on rejection), so spec keeps its amortization at
+temperature > 0 while committing EXACTLY the non-speculative sampling
+distribution.  Requests with stop sequences retire early at the first
+sync boundary after a device-side match — pages and the slot free
+immediately instead of burning steps to ``max_tokens``
+(``decode_stop_retired_total`` / ``decode_steps_saved_total``).
 
 **Tensor-parallel serving** (``mesh=``): a model whose KV pool + weights
 outgrow one chip's HBM serves by sharding the decode step over the
@@ -105,6 +125,7 @@ from collections import deque
 import numpy as np
 
 from bigdl_tpu.obs import recorder as obs_recorder
+from bigdl_tpu.serve import sampling as smp
 from bigdl_tpu.serve.paging import PagePool, RequestTooLongError
 from bigdl_tpu.serve.prefix import PrefixCache, chain_keys
 from bigdl_tpu.serve.streaming import StreamFuture, TokenDelivery
@@ -121,6 +142,10 @@ DEFAULT_PAGE_SIZE = 16
 ENV_PAGES = "BIGDL_SERVE_PAGES"
 ENV_PREFIX = "BIGDL_SERVE_PREFIX_CACHE"
 ENV_SPEC_K = "BIGDL_SERVE_SPEC_K"
+ENV_STOP_SEQS = "BIGDL_SERVE_MAX_STOP_SEQS"
+DEFAULT_STOP_SEQS = 2
+ENV_STOP_LEN = "BIGDL_SERVE_MAX_STOP_LEN"
+DEFAULT_STOP_LEN = 8
 
 
 def _env_int(name, default):
@@ -196,11 +221,13 @@ class _DecodeReq:
     __slots__ = ("seed", "n_words", "future", "slot", "steps_needed",
                  "steps_run", "start_pos", "pages", "rid", "trace",
                  "t_submit", "t_admit", "first_ts", "last_ts",
-                 "streamed", "timeline")
+                 "streamed", "timeline", "params", "stop_retired")
 
-    def __init__(self, seed, n_words, trace=None):
+    def __init__(self, seed, n_words, trace=None, params=None):
         self.seed = [int(t) for t in seed]
         self.n_words = int(n_words)
+        self.params = params if params is not None else smp.GREEDY
+        self.stop_retired = False    # retired early on a stop match
         self.future = StreamFuture()
         self.slot = None
         # positions fed through = n_seed + n_words - 1 (lm_decode's n_pos)
@@ -260,6 +287,8 @@ class ContinuousDecoder:
                  draft_layers: int | None = None,
                  kv_quant: str | None = None,
                  host_tier=None, prefill_adopt: bool = False,
+                 max_stop_seqs: int | None = None,
+                 max_stop_len: int | None = None,
                  name: str | None = None):
         import jax
         import jax.numpy as jnp
@@ -288,6 +317,17 @@ class ContinuousDecoder:
                 or self.pages_per_slot * self.B
         self.spec_k = max(0, _env_int(ENV_SPEC_K, 0) if spec_k is None
                           else int(spec_k))
+        # packed stop-sequence capacity: every slot carries an
+        # (NS, LS) right-aligned token buffer; a submit whose stop list
+        # exceeds either dim fails its own future
+        self.max_stop_seqs = max(1, _env_int(ENV_STOP_SEQS,
+                                             DEFAULT_STOP_SEQS)
+                                 if max_stop_seqs is None
+                                 else int(max_stop_seqs))
+        self.max_stop_len = max(1, _env_int(ENV_STOP_LEN,
+                                            DEFAULT_STOP_LEN)
+                                if max_stop_len is None
+                                else int(max_stop_len))
         use_prefix = bool(_env_int(ENV_PREFIX, 1)) \
             if prefix_cache is None else bool(prefix_cache)
         if kv_quant is None:
@@ -350,51 +390,109 @@ class ContinuousDecoder:
         # KV quantization (the scale arrays are traced state exactly
         # like the pools — serve/decode carries them, quant/kv.py and
         # _lm_forward_window do the math)
-        def slab_step_body(local_handles, caches, pos, prev, active,
-                           seeds, seed_len, gen, tp_axis=None):
+        #
+        # Per-slot sampling state rides every body as traced vectors:
+        # ``temp``/``topk``/``topp`` (B,), ``keys`` (B, 2) uint32,
+        # ``stop_buf`` (B, NS, LS) right-aligned + ``stop_len`` (B, NS),
+        # and ``finished`` (B,) — a stop-matched row freezes (drops out
+        # of ``live``) until the boundary retires it.
+        NS, LS = self.max_stop_seqs, self.max_stop_len
+
+        def _next_token(logp, pos, seed_len, temp, topk, topp, keys):
+            """The committed token for the write position ``pos``:
+            greedy rows take the UNCHANGED argmax (the byte-identity
+            lane), sampled rows draw from the filtered distribution
+            under the request-keyed stream for this generated index."""
+            greedy_tok = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+            gidx = jnp.maximum(pos - (seed_len - 1), 0)
+            sub = smp.fold_in_rows(
+                keys, smp.DRAW_TAGS * gidx + smp.TAG_MAIN)
+            samp = smp.sample_tokens(logp, sub, temp, topk,
+                                     topp).astype(jnp.int32)
+            return jnp.where(temp > 0, samp, greedy_tok)
+
+        def _stop_hit(gen, ends, seed_len, stop_buf, stop_len):
+            """Device-side stop-sequence match: does any of the slot's
+            stop sequences end EXACTLY at write position ``ends[b, s]``?
+            ``ends`` is (B, S); returns (B, S) bool.  The window looks
+            backward only, must lie entirely inside the OUTPUT region
+            (write positions >= seed_len - 1 — seeds never match), and
+            right-aligned buffers make the comparison one fixed-shape
+            equality regardless of per-sequence length."""
             rows = jnp.arange(B)
-            live = active & (pos < n_pos)
+            idx = (ends[:, :, None] - (LS - 1)
+                   + jnp.arange(LS)[None, None, :])           # (B,S,LS)
+            tok = gen[rows[:, None, None], jnp.clip(idx, 0, n_view - 1)]
+            out_ok = idx >= (seed_len - 1)[:, None, None]
+            eq = (tok[:, :, None, :] == stop_buf[:, None, :, :]
+                  ) & out_ok[:, :, None, :]                 # (B,S,NS,LS)
+            need = (jnp.arange(LS)[None, None, None, :]
+                    >= (LS - stop_len)[:, None, :, None])
+            hit = jnp.where(need, eq, True).all(axis=-1)      # (B,S,NS)
+            return ((stop_len > 0)[:, None, :] & hit).any(axis=-1)
+
+        def slab_step_body(local_handles, caches, pos, prev, active,
+                           seeds, seed_len, gen, temp, topk, topp,
+                           keys, stop_buf, stop_len, finished,
+                           tp_axis=None):
+            rows = jnp.arange(B)
+            live = active & ~finished & (pos < n_pos)
             wp = jnp.clip(pos, 0, n_pos - 1)
             tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
             logp, caches = _lm_forward_one(
                 tok.astype(jnp.int32), wp, caches, local_handles,
                 n_pos, pe, tp_axis=tp_axis)
-            nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+            nxt = _next_token(logp, pos, seed_len, temp, topk, topp,
+                              keys)
             # parked/finished slots must not advance or write tokens
             gen = gen.at[rows, wp].set(jnp.where(live, nxt, gen[rows, wp]))
             prev = jnp.where(live, nxt, prev)
             pos = jnp.where(live, pos + 1, pos)
-            return caches, pos, prev, gen
+            hit = _stop_hit(gen, wp[:, None], seed_len, stop_buf,
+                            stop_len)[:, 0]
+            finished = finished | (live & hit)
+            return caches, pos, prev, gen, finished
 
         def paged_step_body(local_handles, caches, ptab, pos, prev,
-                            active, seeds, seed_len, cap, gen,
-                            tp_axis=None, view_pages=None):
+                            active, seeds, seed_len, cap, gen, temp,
+                            topk, topp, keys, stop_buf, stop_len,
+                            finished, tp_axis=None, view_pages=None):
             rows = jnp.arange(B)
-            live = active & (pos < cap)
+            live = active & ~finished & (pos < cap)
             wp = jnp.clip(pos, 0, cap - 1)
             tok = jnp.where(pos < seed_len, seeds[rows, wp], prev)
             logp, caches = _lm_forward_one(
                 tok.astype(jnp.int32), wp, caches, local_handles,
                 n_view, pe, tp_axis=tp_axis, pages=(ptab, ps), valid=live,
                 view_pages=view_pages)
-            nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+            nxt = _next_token(logp, pos, seed_len, temp, topk, topp,
+                              keys)
             # frozen rows route their token write out of bounds (dropped)
             gen = gen.at[rows, jnp.where(live, wp, n_view)].set(nxt)
             prev = jnp.where(live, nxt, prev)
             pos = jnp.where(live, pos + 1, pos)
-            return caches, pos, prev, gen
+            hit = _stop_hit(gen, wp[:, None], seed_len, stop_buf,
+                            stop_len)[:, 0]
+            finished = finished | (live & hit)
+            return caches, pos, prev, gen, finished
 
         def spec_step_body(local_full, local_draft, caches, ptab,
                            pos, prev, active, seeds, seed_len, cap, gen,
-                           acc_hist, tp_axis=None, view_pages=None):
+                           temp, topk, topp, keys, stop_buf, stop_len,
+                           finished, acc_hist, tp_axis=None,
+                           view_pages=None):
             rows = jnp.arange(B)
-            live = active & (pos < cap)
+            live = active & ~finished & (pos < cap)
+            sampled = temp > 0                   # (B,) sampled-row lane
             # -- draft k tokens with the shallow pass (window position 0
-            # is the normal step token; seed positions stay forced)
+            # is the normal step token; seed positions stay forced).
+            # Sampled rows DRAW their draft from the filtered shallow
+            # distribution (q must be the actual proposal for the
+            # accept/reject rule below); greedy rows keep the argmax.
             wp0 = jnp.clip(pos, 0, cap - 1)
             t0 = jnp.where(pos < seed_len,
                            seeds[rows, wp0], prev).astype(jnp.int32)
-            toks, d_tok, d_pos = [t0], t0, pos
+            toks, qs, d_tok, d_pos = [t0], [], t0, pos
             for _ in range(k):
                 d_valid = live & (d_pos < cap)
                 dlogp, caches = _lm_forward_one(
@@ -403,12 +501,24 @@ class ContinuousDecoder:
                     pages=(ptab, ps), valid=d_valid,
                     view_pages=view_pages)
                 d_arg = jnp.argmax(dlogp, axis=-1).astype(jnp.int32)
+                # proposal draw keyed by the WRITE position of this
+                # drafted token (= d_pos before the increment)
+                lq = smp.filter_logits(dlogp, temp, topk, topp)
+                gq = jnp.maximum(d_pos - (seed_len - 1), 0)
+                dsub = smp.fold_in_rows(
+                    keys, smp.DRAW_TAGS * gq + smp.TAG_DRAFT)
+                d_smp = jax.vmap(jax.random.categorical)(
+                    dsub, lq).astype(jnp.int32)
+                qs.append(jax.nn.softmax(lq, axis=-1))
                 d_pos = d_pos + 1
+                d_draft = jnp.where(sampled, d_smp, d_arg)
                 d_tok = jnp.where(
                     d_pos < seed_len,
-                    seeds[rows, jnp.clip(d_pos, 0, n_view - 1)], d_arg)
+                    seeds[rows, jnp.clip(d_pos, 0, n_view - 1)],
+                    d_draft)
                 toks.append(d_tok)
             W = jnp.stack(toks, axis=1)                     # (B, k+1)
+            qs = jnp.stack(qs, axis=1)                      # (B, k, V)
             p_idx = pos[:, None] + jnp.arange(k + 1)[None, :]
             valid = live[:, None] & (p_idx < cap[:, None])
             wp = jnp.clip(p_idx, 0, n_view - 1)
@@ -418,8 +528,8 @@ class ContinuousDecoder:
                 W, wp, caches, local_full, pe, (ptab, ps),
                 valid=valid, tp_axis=tp_axis, view_pages=view_pages)
             g = jnp.argmax(logp, axis=-1).astype(jnp.int32)  # (B, k+1)
-            # -- longest accepted prefix: drafted token j+1 survives iff
-            # it equals the verify argmax at position j (seed-forced
+            # -- greedy lane (byte-identity): drafted token j+1 survives
+            # iff it equals the verify argmax at position j (seed-forced
             # positions always survive), so the committed stream is
             # EXACTLY the non-speculative greedy stream
             forced = p_idx[:, 1:] < seed_len[:, None]
@@ -427,15 +537,65 @@ class ContinuousDecoder:
             # the slot's page capacity cannot extend the run (it could
             # never commit — consumed caps at cap - pos — but it would
             # inflate the acceptance telemetry)
-            match = valid[:, 1:] & (forced | (W[:, 1:] == g[:, :k]))
+            match_g = valid[:, 1:] & (forced | (W[:, 1:] == g[:, :k]))
+            # -- sampled lane (Leviathan lossless accept/reject): the
+            # target distribution p at every window slot, filtered with
+            # the SAME per-row params as the draft's q
+            pp = jax.nn.softmax(
+                smp.filter_logits(logp, temp, topk, topp), axis=-1)
+            ga = jnp.maximum(p_idx[:, :k] - (seed_len - 1)[:, None], 0)
+            asub = smp.fold_in_rows(
+                jnp.broadcast_to(keys[:, None, :],
+                                 (B, k, 2)).reshape(B * k, 2),
+                (smp.DRAW_TAGS * ga + smp.TAG_ACCEPT).reshape(B * k))
+            u = smp.uniform_rows(asub).reshape(B, k)
+            p_x = jnp.take_along_axis(pp[:, :k], W[:, 1:, None],
+                                      axis=-1)[..., 0]
+            q_x = jnp.take_along_axis(qs, W[:, 1:, None],
+                                      axis=-1)[..., 0]
+            # division-free min(1, p/q) accept: u * q(x) < p(x)
+            match_s = valid[:, 1:] & (forced | (u * q_x < p_x))
+            match = jnp.where(sampled[:, None], match_s, match_g)
             acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
             consumed = jnp.where(live,
                                  jnp.minimum(acc + 1, cap - pos), 0)
             commit = jnp.arange(k + 1)[None, :] < consumed[:, None]
+            # committed tokens: greedy rows commit the verify argmax;
+            # sampled rows commit their accepted drafts, with the slot
+            # at ``acc`` replaced by the residual draw (rejection) or —
+            # at slot k with q = 0 — a fresh draw from p (the bonus
+            # token), which keeps the committed marginal exactly p
+            qa = jnp.concatenate(
+                [qs, jnp.zeros_like(qs[:, :1])],
+                axis=1)[rows, jnp.clip(acc, 0, k)]
+            pa = pp[rows, jnp.clip(acc, 0, k)]
+            gfix = jnp.maximum(pos + acc - (seed_len - 1), 0)
+            fsub = smp.fold_in_rows(
+                keys, smp.DRAW_TAGS * gfix + smp.TAG_FIX)
+            c = jax.vmap(jax.random.categorical)(
+                fsub, jnp.log(smp.spec_residual(pa, qa))
+            ).astype(jnp.int32)
+            S = jnp.concatenate([W[:, 1:], jnp.zeros((B, 1), jnp.int32)],
+                                axis=1)
+            S = jnp.where(jnp.arange(k + 1)[None, :] == acc[:, None],
+                          c[:, None], S)
+            C = jnp.where(sampled[:, None], S, g)
             gen = gen.at[rows[:, None],
-                         jnp.where(commit, wp, n_view)].set(g)
+                         jnp.where(commit, wp, n_view)].set(C)
+            # -- stop sequences: scan the freshly committed window slots
+            # (backward-looking matches only read already-written gen);
+            # the first matching slot truncates the commit run and
+            # freezes the row for boundary retirement
+            hit = _stop_hit(gen, wp, seed_len, stop_buf,
+                            stop_len) & commit
+            any_hit = hit.any(axis=1)
+            jstar = jnp.argmax(hit, axis=1)
+            consumed = jnp.where(any_hit,
+                                 jnp.minimum(consumed, jstar + 1),
+                                 consumed)
+            finished = finished | (any_hit & live)
             prev = jnp.where(consumed > 0,
-                             g[rows, jnp.clip(consumed - 1, 0, k)], prev)
+                             C[rows, jnp.clip(consumed - 1, 0, k)], prev)
             # acceptance telemetry covers PURE decode windows only —
             # every drafted position past the seed.  Seed-forced
             # (chunked-prefill) windows "accept" by construction and
@@ -447,7 +607,7 @@ class ContinuousDecoder:
                 rec[:, None],
                 jax.nn.one_hot(acc, k + 1, dtype=jnp.int32), 0
             ).sum(axis=0)
-            return caches, pos, prev, gen, acc_hist
+            return caches, pos, prev, gen, finished, acc_hist
 
         def _draft_of(local):
             return local._replace(blocks=local.blocks[:Ld],
@@ -464,6 +624,10 @@ class ContinuousDecoder:
         key_tail = ((ps, self.pages_per_slot, self._pool.n_pages, k, Ld,
                      self.kv_quant)
                     if self.paged else ())
+        if (NS, LS) != (DEFAULT_STOP_SEQS, DEFAULT_STOP_LEN):
+            # non-default stop capacity changes the packed-buffer shapes
+            # every program takes; keep the default fn_key unchanged
+            key_tail = key_tail + ("stop%dx%d" % (NS, LS),)
 
         if self.tp > 1:
             # Megatron head/hidden sharding over the mesh's "model"
@@ -554,17 +718,17 @@ class ContinuousDecoder:
                         return spec_step_body(local, _draft_of(local),
                                               *st, tp_axis=ax,
                                               view_pages=view_w)
-                    n_rep_in, n_rep_out = 9, 4
+                    n_rep_in, n_rep_out = 16, 5
                 elif self.paged:
                     def step_tp(W, *st):
                         return paged_step_body(_local(W), *st,
                                                tp_axis=ax,
                                                view_pages=view_w)
-                    n_rep_in, n_rep_out = 8, 3
+                    n_rep_in, n_rep_out = 15, 4
                 else:
                     def step_tp(W, *st):
                         return slab_step_body(_local(W), *st, tp_axis=ax)
-                    n_rep_in, n_rep_out = 6, 3
+                    n_rep_in, n_rep_out = 13, 4
                 sharded = compat.shard_map(
                     step_tp, mesh=mesh,
                     in_specs=(wspec, cspec) + (rep,) * n_rep_in,
@@ -590,9 +754,26 @@ class ContinuousDecoder:
         # anchor for decode_model_flops_util, and the widest warm step
         self._step = self._step_program(self._view_buckets[-1])
 
+        def _admit_sampling(temp, topk, topp, keys, stop_buf, stop_len,
+                            finished, slot, t_v, k_v, p_v, key_row,
+                            sb_row, sl_row):
+            """The per-slot sampling-state half of admission (shared by
+            both layouts): load the request's params/key/stop rows and
+            clear the stop-finished flag."""
+            temp = temp.at[slot].set(t_v)
+            topk = topk.at[slot].set(k_v)
+            topp = topp.at[slot].set(p_v)
+            keys = keys.at[slot].set(key_row)
+            stop_buf = stop_buf.at[slot].set(sb_row)
+            stop_len = stop_len.at[slot].set(sl_row)
+            finished = finished.at[slot].set(False)
+            return temp, topk, topp, keys, stop_buf, stop_len, finished
+
         if self.paged:
-            def admit(ptab, pos, active, seeds, seed_len, cap, gen, slot,
-                      ptab_row, start, seed_row, s_len, capv):
+            def admit(ptab, pos, active, seeds, seed_len, cap, gen,
+                      temp, topk, topp, keys, stop_buf, stop_len,
+                      finished, slot, ptab_row, start, seed_row, s_len,
+                      capv, t_v, k_v, p_v, key_row, sb_row, sl_row):
                 ptab = ptab.at[slot].set(ptab_row)
                 pos = pos.at[slot].set(start)
                 active = active.at[slot].set(True)
@@ -600,7 +781,11 @@ class ContinuousDecoder:
                 seed_len = seed_len.at[slot].set(s_len)
                 cap = cap.at[slot].set(capv)
                 gen = gen.at[slot].set(0)
-                return ptab, pos, active, seeds, seed_len, cap, gen
+                return (ptab, pos, active, seeds, seed_len, cap, gen
+                        ) + _admit_sampling(
+                            temp, topk, topp, keys, stop_buf, stop_len,
+                            finished, slot, t_v, k_v, p_v, key_row,
+                            sb_row, sl_row)
 
             def retire(ptab, active, slot):
                 # frozen rows' K/V writes are valid-gated out, so the
@@ -608,8 +793,10 @@ class ContinuousDecoder:
                 # gathered into this slot's (masked) attention view
                 return ptab.at[slot].set(0), active.at[slot].set(False)
         else:
-            def admit(caches, pos, active, seeds, seed_len, gen, slot,
-                      seed_row, s_len):
+            def admit(caches, pos, active, seeds, seed_len, gen,
+                      temp, topk, topp, keys, stop_buf, stop_len,
+                      finished, slot, seed_row, s_len, t_v, k_v, p_v,
+                      key_row, sb_row, sl_row):
                 kc, vc = caches
                 kc = kc.at[:, slot].set(0.0)
                 vc = vc.at[:, slot].set(0.0)
@@ -618,7 +805,11 @@ class ContinuousDecoder:
                 seeds = seeds.at[slot].set(seed_row)
                 seed_len = seed_len.at[slot].set(s_len)
                 gen = gen.at[slot].set(0)
-                return (kc, vc), pos, active, seeds, seed_len, gen
+                return ((kc, vc), pos, active, seeds, seed_len, gen
+                        ) + _admit_sampling(
+                            temp, topk, topp, keys, stop_buf, stop_len,
+                            finished, slot, t_v, k_v, p_v, key_row,
+                            sb_row, sl_row)
 
             def retire(active, slot):
                 return active.at[slot].set(False)
@@ -632,16 +823,16 @@ class ContinuousDecoder:
             cache, rep = P(None, None, None, "model"), P()
             if self.paged:
                 admit = compat.shard_map(
-                    admit, mesh=mesh, in_specs=(rep,) * 13,
-                    out_specs=(rep,) * 7)
+                    admit, mesh=mesh, in_specs=(rep,) * 26,
+                    out_specs=(rep,) * 14)
                 retire = compat.shard_map(
                     retire, mesh=mesh, in_specs=(rep,) * 3,
                     out_specs=(rep, rep))
             else:
                 admit = compat.shard_map(
                     admit, mesh=mesh,
-                    in_specs=((cache, cache),) + (rep,) * 8,
-                    out_specs=((cache, cache),) + (rep,) * 5)
+                    in_specs=((cache, cache),) + (rep,) * 21,
+                    out_specs=((cache, cache),) + (rep,) * 12)
                 retire = compat.shard_map(retire, mesh=mesh,
                                           in_specs=(rep, rep),
                                           out_specs=rep)
@@ -701,6 +892,16 @@ class ContinuousDecoder:
         self._seeds = z((B, n_view), jnp.int32)
         self._seed_len = z((B,), jnp.int32)
         self._gen = z((B, n_view), jnp.int32)
+        # per-slot traced sampling state (zeros = the greedy default:
+        # temp 0 selects the argmax lane, stop_len 0 never matches)
+        self._temp = z((B,), jnp.float32)
+        self._topk = z((B,), jnp.int32)
+        self._topp = z((B,), jnp.float32)
+        self._keys = z((B, 2), jnp.uint32)
+        self._stop_buf = z((B, self.max_stop_seqs, self.max_stop_len),
+                           jnp.int32)
+        self._stop_len = z((B, self.max_stop_seqs), jnp.int32)
+        self._finished = z((B,), bool)
         if self.paged:
             self._ptab = z((B, self.pages_per_slot), jnp.int32)
             # capacity starts at one page so clips/masks stay in range
@@ -800,6 +1001,18 @@ class ContinuousDecoder:
         self._m_stream_toks = reg.counter(
             "decode_stream_tokens_total",
             "tokens delivered incrementally at sync boundaries", **lab)
+        # sampled decode + stop-sequence early retirement
+        # (docs/observability.md "Sampled decode")
+        self._m_sampled = reg.counter(
+            "decode_sampled_total",
+            "sampled (temperature > 0) requests admitted", **lab)
+        self._m_stop_retired = reg.counter(
+            "decode_stop_retired_total",
+            "requests retired early on a stop-sequence match", **lab)
+        self._m_steps_saved = reg.counter(
+            "decode_steps_saved_total",
+            "decode step-slots reclaimed by stop-sequence early "
+            "retirement", **lab)
         # directly-constructed decoders (the TP-serving entry point)
         # may never see close() — drop the uniquely-labelled series at
         # GC so the process registry cannot grow without bound, and
@@ -816,6 +1029,9 @@ class ContinuousDecoder:
         self.live_hwm = 0
         self.spec_windows = 0
         self.spec_accepted = 0
+        self.sampled = 0           # admitted requests with temp > 0
+        self.stop_retired = 0      # requests retired on a stop match
+        self.steps_saved = 0       # step-slots reclaimed by early retire
         # streaming lifetime aggregates (stats() / emit_decode_event)
         self.streams = 0           # requests that streamed >= 1 token
         self.stream_tokens = 0
@@ -890,6 +1106,8 @@ class ContinuousDecoder:
         else:
             args = (self._caches, self._pos, self._prev,
                     self._active, self._seeds, self._seed_len, self._gen)
+        args = args + (self._temp, self._topk, self._topp, self._keys,
+                       self._stop_buf, self._stop_len, self._finished)
         if self.spec_k:
             args = args + (self._acc_hist,)
         if self._W is not None:
@@ -897,29 +1115,52 @@ class ContinuousDecoder:
         out = self._step_program(view_w)(*args)
         if self.spec_k:
             (self._caches, self._pos, self._prev, self._gen,
-             self._acc_hist) = out
+             self._finished, self._acc_hist) = out
         else:
-            (self._caches, self._pos, self._prev, self._gen) = out
+            (self._caches, self._pos, self._prev, self._gen,
+             self._finished) = out
+
+    def _sampling_rows(self, req):
+        """Host-built admit operands for the request's sampling state:
+        scalar params, the threefry key row, and the right-aligned
+        packed stop buffers (submit() already validated capacity)."""
+        p = req.params
+        NS, LS = self.max_stop_seqs, self.max_stop_len
+        sb_row = np.zeros((NS, LS), np.int32)
+        sl_row = np.zeros((NS,), np.int32)
+        for j, seq in enumerate(p.stop):
+            sb_row[j, LS - len(seq):] = seq
+            sl_row[j] = len(seq)
+        return (np.float32(p.temperature), np.int32(p.top_k),
+                np.float32(p.top_p), smp.key_data(p.seed), sb_row,
+                sl_row)
 
     def _apply_admit(self, slot, req):
         seed_row = np.zeros((self._n_view,), np.int32)
         seed_row[:len(req.seed)] = req.seed
+        samp = self._sampling_rows(req)
+        state = (self._temp, self._topk, self._topp, self._keys,
+                 self._stop_buf, self._stop_len, self._finished)
         if self.paged:
             row = np.zeros((self.pages_per_slot,), np.int32)
             row[:len(req.pages)] = req.pages
             (self._ptab, self._pos, self._active, self._seeds,
-             self._seed_len, self._cap, self._gen) = self._admit_fn(
+             self._seed_len, self._cap, self._gen, self._temp,
+             self._topk, self._topp, self._keys, self._stop_buf,
+             self._stop_len, self._finished) = self._admit_fn(
                 self._ptab, self._pos, self._active, self._seeds,
-                self._seed_len, self._cap, self._gen, np.int32(slot),
-                row, np.int32(req.start_pos), seed_row,
+                self._seed_len, self._cap, self._gen, *state,
+                np.int32(slot), row, np.int32(req.start_pos), seed_row,
                 np.int32(len(req.seed)),
-                np.int32(len(req.pages) * self.page_size))
+                np.int32(len(req.pages) * self.page_size), *samp)
         else:
             (self._caches, self._pos, self._active, self._seeds,
-             self._seed_len, self._gen) = self._admit_fn(
+             self._seed_len, self._gen, self._temp, self._topk,
+             self._topp, self._keys, self._stop_buf, self._stop_len,
+             self._finished) = self._admit_fn(
                 self._caches, self._pos, self._active, self._seeds,
-                self._seed_len, self._gen, np.int32(slot), seed_row,
-                np.int32(len(req.seed)))
+                self._seed_len, self._gen, *state, np.int32(slot),
+                seed_row, np.int32(len(req.seed)), *samp)
 
     def _apply_retire(self, slot):
         if self.paged:
@@ -1076,13 +1317,24 @@ class ContinuousDecoder:
         return adopted
 
     # -- submit -------------------------------------------------------------
-    def submit(self, seed_ids, n_words: int,
-               trace=None) -> StreamFuture:
+    def submit(self, seed_ids, n_words: int, trace=None,
+               sampling=None) -> StreamFuture:
         """Queue one request; the future resolves to the full token row
-        (seed + ``n_words`` generated ids), exactly ``lm_decode``'s
-        greedy output for the same seed.  A request that cannot ever
-        fit fails ONLY its own future with :class:`RequestTooLongError`
-        — other submitted requests are untouched.
+        (seed + up to ``n_words`` generated ids) — exactly
+        ``lm_decode``'s greedy output for the same seed by default.  A
+        request that cannot ever fit fails ONLY its own future with
+        :class:`RequestTooLongError` — other submitted requests are
+        untouched.
+
+        ``sampling`` (a :class:`~bigdl_tpu.serve.sampling.SamplingParams`,
+        a dict in its ``to_dict`` form, or None for greedy) selects the
+        sampled lane: temperature/top-k/top-p draws keyed by the
+        request's (resolved) seed, stop token-sequences that retire the
+        request early at the boundary after a match — the row then ends
+        just past the matched sequence, shorter than ``n_words`` — and
+        ``max_tokens`` capping ``n_words``.  A stop list exceeding this
+        decoder's packed capacity (``max_stop_seqs`` × ``max_stop_len``)
+        fails its own future with ``ValueError``.
 
         The returned :class:`~bigdl_tpu.serve.streaming.StreamFuture`
         additionally streams: ``on_tokens(cb)`` (or ``request_stream``)
@@ -1095,7 +1347,11 @@ class ContinuousDecoder:
             raise ValueError("seed_ids must be one flat non-empty id row")
         if n_words < 1:
             raise ValueError("n_words must be >= 1")
-        req = _DecodeReq(seed.tolist(), n_words, trace=trace)
+        params = smp.SamplingParams.of(sampling).resolved()
+        if params.max_tokens is not None:
+            n_words = min(int(n_words), params.max_tokens)
+        req = _DecodeReq(seed.tolist(), n_words, trace=trace,
+                         params=params)
         req.rid = next(self._req_seq)
         if trace is not None:
             # flight-recorder identity: everything request_replay needs
@@ -1108,6 +1364,19 @@ class ContinuousDecoder:
                 seed_len=len(req.seed), n_words=req.n_words,
                 flags=self.decode_flags(),
                 weights_version=self.weights_version)
+            if not params.is_default:
+                # the resolved params (seed pinned) — what replay
+                # re-submits to redraw the exact token stream
+                obs_recorder.note(trace.trace_id,
+                                  sampling=params.to_dict())
+        if (len(params.stop) > self.max_stop_seqs
+                or any(len(s) > self.max_stop_len for s in params.stop)):
+            req.future.set_exception(ValueError(
+                f"stop list exceeds this decoder's packed capacity "
+                f"({self.max_stop_seqs} sequences x "
+                f"{self.max_stop_len} tokens); raise max_stop_seqs/"
+                f"max_stop_len at construction"))
+            return req.future
         too_long = req.steps_needed > self.n_pos
         if self.paged and not too_long:
             too_long = (_pages_needed(req.steps_needed, self.page_size)
@@ -1180,6 +1449,9 @@ class ContinuousDecoder:
                         prefix_pages=req.start_pos // self.page_size)
             self.admitted += 1
             self._m_admitted.inc()
+            if not req.params.greedy:
+                self.sampled += 1
+                self._m_sampled.inc()
         if self.paged:
             self._m_pages.set(self._pool.in_use)
 
@@ -1234,6 +1506,11 @@ class ContinuousDecoder:
         w0, a0 = self.spec_windows, self.spec_accepted
         self._admit_waiting()
         live = [r for r in self._slots if r is not None]
+        # stop-sequence rows make completion data-dependent exactly like
+        # speculative decode: those boundaries fetch the position row
+        # (plus the finished flags) — greedy no-stop streams keep the
+        # pre-sampling host-sync count
+        has_stop = any(r.params.stop for r in live)
         if not live:
             # idle boundary: restart the utilization window so wait
             # time between submissions is not charged to the next one
@@ -1254,17 +1531,24 @@ class ContinuousDecoder:
             self._run_step()
         self.steps += self.sync_interval
         self._m_steps.inc(self.sync_interval)
-        pos_host = None
-        if spec:
+        pos_host = fin_host = None
+        if spec or has_stop:
             pos_host = np.asarray(self._pos)
+            if has_stop:
+                # rides the same boundary fetch — ONE host sync
+                fin_host = np.asarray(self._finished)
             self.host_syncs += 1
             self._m_syncs.inc()
-            self._drain_accept_hist()
-            done = [r for r in live
-                    if int(pos_host[r.slot]) >= r.steps_needed]
-        else:
+            if spec:
+                self._drain_accept_hist()
+        if not spec:
             for r in live:
                 r.steps_run += self.sync_interval
+        if pos_host is not None:
+            done = [r for r in live
+                    if int(pos_host[r.slot]) >= r.steps_needed
+                    or (fin_host is not None and bool(fin_host[r.slot]))]
+        else:
             done = [r for r in live
                     if r.start_pos + r.steps_run >= r.steps_needed]
         # ONE slab materialization per boundary, shared by streaming
@@ -1282,7 +1566,8 @@ class ContinuousDecoder:
         if streaming:
             ts = time.perf_counter()
             for r in streaming:
-                consumed = (int(pos_host[r.slot]) if spec
+                consumed = (int(pos_host[r.slot])
+                            if pos_host is not None
                             else r.start_pos + r.steps_run)
                 delivered |= self._feed_stream(r, gen_host, consumed,
                                                ts)
@@ -1290,14 +1575,34 @@ class ContinuousDecoder:
             ts = time.perf_counter()
             for r in done:
                 s = len(r.seed)
-                toks = gen_host[r.slot, s - 1:s - 1 + r.n_words]
+                final, n_gen = r.steps_needed, r.n_words
+                if pos_host is not None:
+                    # stop-retired rows froze early: the row ends just
+                    # past the matched sequence (pos overshoot on
+                    # normal rows is clipped back to n_words)
+                    final = int(pos_host[r.slot])
+                    n_gen = max(1, min(r.n_words, final - (s - 1)))
+                toks = gen_host[r.slot, s - 1:s - 1 + n_gen]
                 row = r.seed + [int(t) for t in toks]
+                if n_gen < r.n_words:
+                    # stop-sequence early retirement: the slot + pages
+                    # free NOW instead of after the row's remaining
+                    # step budget — count the reclaimed step-slots
+                    r.stop_retired = True
+                    saved = r.steps_needed - final
+                    self.stop_retired += 1
+                    self.steps_saved += saved
+                    self._m_stop_retired.inc()
+                    self._m_steps_saved.inc(saved)
                 if r.trace is not None:
                     # the committed row — request_replay's oracle.
                     # Reuses the boundary's ONE slab materialization;
                     # no added sync, no per-token host work beyond the
                     # row already built for the future
                     obs_recorder.note(r.trace.trace_id, tokens=row)
+                    if r.stop_retired:
+                        obs_recorder.note(r.trace.trace_id,
+                                          stop_retired=True)
                     if self.spec_k:
                         obs_recorder.note(
                             r.trace.trace_id,
@@ -1315,9 +1620,12 @@ class ContinuousDecoder:
                     # catch-up (a consumer registered this boundary),
                     # then the stream epilogue; the resolution rides
                     # the delivery FIFO so the final chunk is always
-                    # delivered before result() unblocks
-                    delivered |= self._feed_stream(r, gen_host,
-                                                   r.steps_needed, ts)
+                    # delivered before result() unblocks.  The catch-up
+                    # bound is the row's ACTUAL final consumption — a
+                    # stop-retired stream must never over-deliver past
+                    # its truncation point
+                    delivered |= self._feed_stream(
+                        r, gen_host, min(final, r.steps_needed), ts)
                     self._finish_stream(r, ts)
                     self._ensure_delivery().resolve(r.future, row)
                 else:
@@ -1464,6 +1772,13 @@ class ContinuousDecoder:
                          spec_windows=self.spec_windows,
                          accept_mean=(self.spec_accepted
                                       / max(1, self.spec_windows)))
+        if self.sampled:
+            # sampled-vs-greedy split (greedy = admitted - sampled)
+            extra.update(sampled=self.sampled,
+                         greedy=self.admitted - self.sampled)
+        if self.stop_retired:
+            extra.update(stop_retired=self.stop_retired,
+                         steps_saved=self.steps_saved)
         if self.streams:
             # required-when-streaming (events schema v4)
             extra.update(streaming=True, streams=self.streams,
@@ -1513,7 +1828,9 @@ class ContinuousDecoder:
                 "prefix_cache": self._prefix is not None,
                 "spec_k": self.spec_k,
                 "draft_layers": self.draft_layers,
-                "kv_quant": self.kv_quant}
+                "kv_quant": self.kv_quant,
+                "max_stop_seqs": self.max_stop_seqs,
+                "max_stop_len": self.max_stop_len}
         return self._flags_cache
 
     def stats(self) -> dict:
@@ -1526,7 +1843,10 @@ class ContinuousDecoder:
                "n_pos": self.n_pos, "paged": self.paged,
                "sync_interval": self.sync_interval, "tp": self.tp,
                "name": self.name, "kv_quant": self.kv_quant,
-               "kv_bytes_per_token": self.kv_bytes_per_token}
+               "kv_bytes_per_token": self.kv_bytes_per_token,
+               "sampled": self.sampled,
+               "stop_retired": self.stop_retired,
+               "steps_saved": self.steps_saved}
         if self.paged:
             out["pool"] = self._pool.stats()
             if self._prefix is not None:

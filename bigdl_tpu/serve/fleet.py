@@ -261,7 +261,8 @@ class DecodeReplica:
                             "replica %s: shipped-page adoption failed",
                             self.name, exc_info=True)
                 inner = self.decoder.submit(x["seed"], x["n_words"],
-                                            trace=trace)
+                                            trace=trace,
+                                            sampling=x.get("sampling"))
             except Exception as e:
                 if not fut.done():
                     fut.set_exception(e)
@@ -390,6 +391,7 @@ class ProcessDecodeReplica(ProcessReplica):
             seed=[int(t) for t in x["seed"]],
             n_words=int(x["n_words"]), pages=x.get("pages"),
             stream=bool(x.get("stream")),
+            sampling=x.get("sampling"),
             trace=None if trace is None else trace.to_wire())
 
 
@@ -1099,17 +1101,29 @@ class DecodeFleet(DynamicMembership):
     # -- request path -------------------------------------------------------
     def submit(self, seed, n_words: int, priority: int = 1,
                slo_ms: float | None = None, ttft_ms: float | None = None,
-               on_tokens=None, stream: bool = False) -> Future:
+               on_tokens=None, stream: bool = False,
+               sampling=None) -> Future:
         """One decode request through the fleet.  ``on_tokens`` (or
         ``stream=True``) turns on incremental token delivery: chunks
         flow decode replica → router → the returned
         :class:`~bigdl_tpu.serve.streaming.StreamFuture` (across the
         frame protocol for subprocess replicas), byte-identical to the
         resolved row's tail, and the request joins the per-token SLO
-        class (``ttft_ms`` / ``BIGDL_SERVE_SLO_TTFT_MS``)."""
+        class (``ttft_ms`` / ``BIGDL_SERVE_SLO_TTFT_MS``).
+
+        ``sampling`` (:class:`~bigdl_tpu.serve.sampling.SamplingParams`
+        or its dict form) rides the request payload: the PRNG seed is
+        RESOLVED here — before the payload can be requeued after a
+        replica death — so re-delivery redraws the exact same token
+        stream."""
         x = {"seed": [int(t) for t in seed], "n_words": int(n_words)}
         if stream or on_tokens is not None:
             x["stream"] = True
+        if sampling is not None:
+            from bigdl_tpu.serve.sampling import SamplingParams
+            params = SamplingParams.of(sampling).resolved()
+            if not params.is_default:
+                x["sampling"] = params.to_dict()
         return self.router.submit(x, priority=priority, slo_ms=slo_ms,
                                   ttft_ms=ttft_ms, on_tokens=on_tokens)
 
@@ -1219,6 +1233,8 @@ class DecodeOps(cluster_ops.WorkerOps):
             x["pages"] = msg["pages"]
         if msg.get("stream"):
             x["stream"] = True
+        if msg.get("sampling"):
+            x["sampling"] = msg["sampling"]
         tr = (obs_trace.Trace.from_wire(msg["trace"])
               if msg.get("trace") else None)
         fut = self.target.submit(x, trace=tr)

@@ -709,6 +709,7 @@ class RemoteDecodeReplica(RemoteReplica):
             seed=[int(t) for t in x["seed"]],
             n_words=int(x["n_words"]), pages=x.get("pages"),
             stream=bool(x.get("stream")),
+            sampling=x.get("sampling"),
             trace=None if trace is None else trace.to_wire())
 
 

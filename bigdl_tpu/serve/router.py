@@ -316,6 +316,11 @@ class Router:
                 fields.update(seed_hash=obs_recorder.seed_hash(seed),
                               seed_len=len(seed),
                               n_words=x.get("n_words"))
+                if x.get("sampling"):
+                    # router-side copy of the (seed-resolved) sampling
+                    # params — survives a replica death before the
+                    # replica-side note comes back
+                    fields["sampling"] = x["sampling"]
             rec.note(tr.trace_id, **fields)
         if wants_stream:
             req.future.request_stream()

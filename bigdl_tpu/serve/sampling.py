@@ -1,0 +1,256 @@
+"""Sampling for serving and offline decode (docs/serving.md "Sampled
+decode").
+
+ONE sampler for both decode paths: the offline ``lm_decode`` scan and
+the served :class:`~bigdl_tpu.serve.decode.ContinuousDecoder` step
+bodies call the same :func:`filter_logits` / :func:`sample_tokens`
+math, so the two can never drift.  Everything here is traced-friendly
+in BOTH regimes:
+
+- **static scalars** (``lm_decode``'s keyword arguments): the filter
+  reduces to exactly the historical temperature-scale + top-k-threshold
+  ops, so pre-existing (temperature, top_k) draws stay byte-identical;
+- **per-row traced vectors** (the served step): a ``(B,)`` float
+  temperature, int top-k, float top-p and a ``(B, 2)`` uint32 PRNG-key
+  row per slot ride the compiled step program as data — the vLLM-style
+  traced-sampling-params trick — so a batch mixing greedy and any
+  number of distinct sampling configs runs ONE compiled step with zero
+  cold compiles.
+
+**Key discipline (the replay contract).**  Served draws are keyed
+``fold_in(request_key, DRAW_TAGS * gen_index + tag)`` — a pure function
+of the request's own key and the GENERATED-TOKEN INDEX, never of slot,
+batch composition, prefix-hit start position or sync cadence.  That
+makes every sampled request bit-exactly replayable
+(``tools/request_replay.py``) and its token stream invariant to where
+and next to whom it was scheduled.  The tags separate the independent
+draw streams one generated position can consume:
+
+====================  ====================================================
+``TAG_MAIN``          the non-speculative per-step draw
+``TAG_DRAFT``         speculative draft proposal at this position
+``TAG_ACCEPT``        the accept/reject uniform for that proposal
+``TAG_FIX``           the residual (rejection) / bonus (all-accepted) draw
+====================  ====================================================
+
+**Lossless speculative sampling** (Leviathan et al.): accept the draft
+token ``x`` with probability ``min(1, p(x)/q(x))`` — evaluated
+division-free as ``u * q(x) < p(x)`` — and on rejection resample from
+the normalized residual ``max(p - q, 0)`` (:func:`spec_residual`).
+The committed marginal is exactly ``p``, so speculative decode keeps
+its speedup at temperature > 0 while matching the non-speculative
+sampling distribution; ``tests/test_sampling.py`` pins it with a
+fixed-key χ² test.  :func:`spec_accept_one` is the single-position
+reference chain the spec step body vectorizes.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+#: draw-stream tags: one generated position may consume up to
+#: DRAW_TAGS independent subkeys (see the module docstring)
+TAG_MAIN, TAG_DRAFT, TAG_ACCEPT, TAG_FIX = 0, 1, 2, 3
+DRAW_TAGS = 4
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode sampling recipe.
+
+    ``temperature <= 0`` is greedy (argmax — byte-identical to the
+    pre-sampling decode stream); ``top_k``/``top_p`` truncate the
+    scaled distribution (0 disables either; ``top_p`` in (0, 1));
+    ``seed`` pins the request's PRNG key (resolved to a fresh random
+    seed at submit when left None on a sampled request — the resolved
+    value is what travels in fleet payloads and flight-recorder
+    records, so requeue-after-death and replay redraw identically).
+    ``stop`` is a tuple of token-id sequences: generation retires
+    early at the sync boundary after any of them is produced, the
+    resolved row truncated just past the match.  ``max_tokens`` caps
+    ``n_words`` at submit when set."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int | None = None
+    stop: tuple = ()
+    max_tokens: int | None = None
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0 (0 = greedy)")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0 (0 = off)")
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError("top_p must be in [0, 1] (0 or 1 = off)")
+        if self.max_tokens is not None and self.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1 when set")
+        stop = tuple(tuple(int(t) for t in s) for s in (self.stop or ()))
+        if any(len(s) == 0 for s in stop):
+            raise ValueError("stop sequences must be non-empty")
+        object.__setattr__(self, "stop", stop)
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0
+
+    @property
+    def is_default(self) -> bool:
+        """True for the plain greedy request (nothing worth recording)."""
+        return (self.greedy and not self.stop and not self.top_k
+                and not self.top_p and self.max_tokens is None)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def of(cls, val) -> "SamplingParams":
+        """Coerce ``None`` (greedy default), a dict (fleet payloads,
+        flight-recorder records) or an instance."""
+        if val is None:
+            return GREEDY
+        if isinstance(val, cls):
+            return val
+        if isinstance(val, dict):
+            known = ("temperature", "top_k", "top_p", "seed", "stop",
+                     "max_tokens")
+            kw = {k: val[k] for k in known if val.get(k) is not None}
+            if "stop" in kw:
+                kw["stop"] = tuple(tuple(s) for s in kw["stop"])
+            return cls(**kw)
+        raise TypeError(
+            f"sampling must be SamplingParams, dict or None, "
+            f"got {type(val).__name__}")
+
+    def resolved(self) -> "SamplingParams":
+        """Pin the PRNG seed: a sampled request with ``seed=None``
+        gets a fresh random one HERE — before the params ever ride a
+        fleet payload — so re-delivery after a replica death and
+        offline replay both redraw the exact same stream."""
+        if self.greedy or self.seed is not None:
+            return self
+        seed = int.from_bytes(os.urandom(4), "big")
+        return SamplingParams(self.temperature, self.top_k, self.top_p,
+                              seed, self.stop, self.max_tokens)
+
+    def to_dict(self) -> dict:
+        """Wire/record form (plain JSON types; ``of`` round-trips it)."""
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed,
+                "stop": [list(s) for s in self.stop],
+                "max_tokens": self.max_tokens}
+
+
+GREEDY = SamplingParams()
+
+
+def key_data(seed) -> np.ndarray:
+    """The ``(2,)`` uint32 PRNG key row for one request seed — the
+    threefry key layout ``jax.random.PRNGKey`` produces, computed
+    host-side so admission never pays a device dispatch."""
+    s = int(seed or 0) & 0xFFFFFFFFFFFFFFFF
+    return np.array([(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], np.uint32)
+
+
+def _param(v, lp):
+    """Broadcast a scalar or ``(B,)`` vector parameter against
+    ``(..., V)`` logits: append singleton dims up to ``lp.ndim``."""
+    import jax.numpy as jnp
+    v = jnp.asarray(v)
+    return v.reshape(v.shape + (1,) * (lp.ndim - v.ndim))
+
+
+def filter_logits(logp, temperature=1.0, top_k=0, top_p=0.0):
+    """Temperature-scale then top-k / top-p truncate log-probs.
+
+    ``logp`` is ``(..., V)``; each parameter is a static scalar or a
+    per-row vector broadcastable against the leading dims.  Rows with
+    ``temperature <= 0`` pass through unscaled (the greedy lane takes
+    the argmax and never reads the sampled draw); ``top_k`` keeps the
+    k highest logits (0 or >= V disables — ties at the k-th value all
+    survive, the historical ``lm_decode`` semantics); ``top_p`` keeps
+    the smallest descending-probability prefix whose cumulative mass
+    reaches p (0 or 1 disables; the top token always survives).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    V = logp.shape[-1]
+    t = _param(temperature, logp).astype(logp.dtype)
+    lp = logp / jnp.where(t > 0, t, 1)
+    kk = _param(top_k, logp)
+    # k-th largest via one ascending sort (== lax.top_k's k-th value,
+    # so the keep set matches the historical threshold exactly)
+    srt = jnp.sort(lp, axis=-1)
+    idx = jnp.broadcast_to(jnp.clip(V - kk, 0, V - 1),
+                           lp.shape[:-1] + (1,))
+    kth = jnp.take_along_axis(srt, idx, axis=-1)
+    k_on = (kk > 0) & (kk < V)
+    lp = jnp.where(k_on & (lp < kth), -jnp.inf, lp)
+    pp = _param(top_p, logp).astype(logp.dtype)
+    probs = jax.nn.softmax(lp, axis=-1)
+    sp = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+    cum = jnp.cumsum(sp, axis=-1)
+    keep = (cum - sp) < pp          # mass BEFORE this token still short
+    thr = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1, keepdims=True)
+    p_on = (pp > 0) & (pp < 1)
+    return jnp.where(p_on & (probs < thr), -jnp.inf, lp)
+
+
+def sample_tokens(logits, key, temperature=1.0, top_k=0, top_p=0.0):
+    """One sampled token per row from filtered logits — the shared
+    sampler both decode paths call.
+
+    ``key`` is either one PRNG key (a single batch draw — the offline
+    ``lm_decode`` scan, one split per step) or a ``(B, 2)`` uint32
+    per-row key array (the served step — each row draws from its own
+    request-keyed stream via :func:`fold_in_rows`)."""
+    import jax
+
+    lp = filter_logits(logits, temperature, top_k, top_p)
+    if getattr(key, "ndim", 0) == 2:
+        return jax.vmap(jax.random.categorical)(key, lp)
+    return jax.random.categorical(key, lp)
+
+
+def fold_in_rows(keys, data):
+    """Per-row ``jax.random.fold_in``: ``(B, 2)`` uint32 keys x ``(B,)``
+    int data -> ``(B, 2)`` subkeys.  The served step derives every draw
+    key this way (``DRAW_TAGS * gen_index + tag``)."""
+    import jax
+    return jax.vmap(jax.random.fold_in)(keys, data)
+
+
+def uniform_rows(keys):
+    """One uniform [0, 1) draw per ``(B, 2)`` key row."""
+    import jax
+    return jax.vmap(lambda k: jax.random.uniform(k, ()))(keys)
+
+
+def spec_residual(p, q):
+    """The Leviathan rejection distribution: ``max(p - q, 0)``
+    normalized, falling back to ``p`` where the residual has zero mass
+    (draft == target).  ``p``/``q`` are probability rows ``(..., V)``."""
+    import jax.numpy as jnp
+    r = jnp.maximum(p - q, 0.0)
+    z = r.sum(axis=-1, keepdims=True)
+    return jnp.where(z > 0, r / jnp.where(z > 0, z, 1.0), p)
+
+
+def spec_accept_one(key, p_logits, q_logits):
+    """Single-position reference of the lossless accept/reject chain
+    (what ``spec_step_body`` vectorizes across the window): draft
+    ``x ~ q``, accept iff ``u * q(x) < p(x)``, else resample from the
+    residual.  The committed marginal is exactly ``softmax(p_logits)``
+    — the χ² pin in tests/test_sampling.py."""
+    import jax
+    import jax.numpy as jnp
+    kd, ka, kr = jax.random.split(key, 3)
+    x = jax.random.categorical(kd, q_logits)
+    p = jax.nn.softmax(p_logits)
+    q = jax.nn.softmax(q_logits)
+    u = jax.random.uniform(ka, ())
+    y = jax.random.categorical(kr, jnp.log(spec_residual(p, q)))
+    return jnp.where(u * q[x] < p[x], x, y)

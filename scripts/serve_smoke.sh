@@ -28,6 +28,14 @@
 #     event log are monotone, serve_top's stream: line renders from the
 #     merged registry, and a ttft_burn alert fires on an injected
 #     stalled-prefill and resolves when fast first tokens return;
+#   - sampled decode drill (docs/serving.md "Sampled decode"): a mixed
+#     greedy / sampled / stop-sequence stream on a 2-replica fleet —
+#     ZERO cold compiles after construction (the params are traced
+#     per-slot data on the one compiled step), greedy rows
+#     byte-identical to serial lm_decode, stop rows retire early with
+#     the row truncated just past the match, and a flight-recorded
+#     sampled request replays token-exactly (MATCH) through
+#     tools/request_replay.py;
 #   - quantized serving drill: the same mixed stream through int8 KV
 #     pages + a calibrated int8-weight engine — greedy drift within
 #     the declared budget, prefix hit-rate and spec acceptance equal
@@ -56,10 +64,12 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 
-python -m pytest -q -m "(serve or quant or stream or autoscale) and not slow" \
+python -m pytest -q \
+    -m "(serve or quant or stream or autoscale or sampling) and not slow" \
     -p no:cacheprovider -p no:randomly \
     tests/test_serve.py tests/test_serve_cluster.py tests/test_quant.py \
     tests/test_streaming.py tests/test_autoscale.py tests/test_remote.py \
+    tests/test_sampling.py \
     "$@"
 
 # The narrowed form is a targeted check; the drill needs the full run.
@@ -270,6 +280,97 @@ PY
 python tools/obs_report.py "$STREAMRUN" --strict -o "$STREAMRUN/report.md"
 grep -q "Token waterfall" "$STREAMRUN/report.md"
 echo "OK: token waterfall rendered ($STREAMRUN/report.md)"
+
+echo "== serve smoke: sampled decode drill (2-replica fleet) =="
+python - <<'PY'
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from bigdl_tpu.models.transformer import TransformerLM, lm_decode
+from bigdl_tpu.obs import metrics as obs_metrics
+from bigdl_tpu.obs import recorder
+from bigdl_tpu.obs.trace import Trace
+from bigdl_tpu.serve import WeightStore, xcache
+from bigdl_tpu.serve.decode import ContinuousDecoder
+from bigdl_tpu.serve.fleet import DecodeFleet
+from bigdl_tpu.utils.random import set_seed
+sys.path.insert(0, "tools")
+import request_replay
+
+set_seed(1)
+model = TransformerLM(vocab_size=64, d_model=32, n_heads=4, n_layers=2,
+                      hidden=64)
+rng = np.random.RandomState(0)
+reqs = [rng.randint(1, 64, 2 + i % 4).tolist() for i in range(18)]
+n_words = 8
+oracle = [lm_decode(model, s, n_words) for s in reqs]
+
+# in-process fleet so the shared xcache counter audits BOTH replicas
+fleet = DecodeFleet(model, n_decode=2, max_slots=4, n_pos=24,
+                    page_size=4, sync_interval=2)
+c0 = xcache.get().stats()["compiles"]
+futs, kinds = [], []
+for i, (s, ora) in enumerate(zip(reqs, oracle)):
+    if i % 3 == 0:                      # greedy
+        futs.append(fleet.submit(s, n_words))
+    elif i % 3 == 1:                    # sampled, pinned seed
+        futs.append(fleet.submit(s, n_words, sampling={
+            "temperature": 0.8, "top_k": 8, "seed": 100 + i}))
+    else:                               # stop cut from its own oracle
+        futs.append(fleet.submit(s, n_words, sampling={
+            "stop": [list(ora[len(s) + 3:len(s) + 5])]}))
+    kinds.append(i % 3)
+rows = [f.result(timeout=120) for f in futs]
+assert xcache.get().stats()["compiles"] == c0, \
+    "sampled stream hit cold compiles — params leaked into the program"
+n_diff = 0
+for s, ora, row, kind in zip(reqs, oracle, rows, kinds):
+    if kind == 0:
+        assert row == ora, "greedy row drifted next to sampled traffic"
+    elif kind == 1:
+        assert len(row) == len(ora)
+        n_diff += row != ora
+    else:
+        # The stop seq may first match BEFORE the cut point on a
+        # degenerate tiny-model stream; the contract is: row is an
+        # exact oracle prefix, ends with the stop, no later than cut.
+        stop = list(ora[len(s) + 3:len(s) + 5])
+        assert list(row) == list(ora[:len(row)]), "stop row drifted"
+        assert list(row[-len(stop):]) == stop, "stop not included"
+        assert len(row) <= len(s) + 5, "stop row mistruncated"
+assert n_diff > 0, "sampled rows never diverged from greedy"
+merged = fleet.merged_registry()
+assert obs_metrics.family_total(merged, "decode_sampled_total") == 6
+assert obs_metrics.family_total(merged, "decode_stop_retired_total") == 6
+assert obs_metrics.family_total(merged, "decode_steps_saved_total") > 0
+fleet.close()
+
+# flight-record one sampled request, then replay it token-exactly
+store = WeightStore()
+dec = ContinuousDecoder(model, max_slots=2, n_pos=24, page_size=4,
+                        sync_interval=2)
+dec.weights_version = store.put_model(model)
+tr = Trace()
+fut = dec.submit(reqs[1], n_words, trace=tr,
+                 sampling={"temperature": 0.8, "top_k": 8})
+dec.run()
+committed = fut.result()
+dec.close()
+record = recorder.get().get(tr.trace_id)
+assert record["sampling"]["seed"] is not None, "seed was not resolved"
+set_seed(1)
+replay_model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, hidden=64)
+report = request_replay.replay_request(record, replay_model,
+                                       store=store)
+assert report["param_mismatch"] is None and report["match"], report
+assert report["replayed"] == committed
+print(f"OK: 18-request mixed sampled/greedy/stop stream, 2 replicas, "
+      f"0 cold compiles; sampled replay MATCH "
+      f"({len(report['replayed'])} tokens)")
+PY
 
 echo "== serve smoke: quantized serving drill =="
 python - <<'PY'

@@ -463,7 +463,7 @@ class TestBenchDecodeSweepContract:
                     "accept_mean", "accept_p50", "prefix_hits",
                     "compiles", "quant", "kv_quant", "pool_bytes",
                     "ttft_p50", "ttft_p99", "itl_p50", "e2e_p50",
-                    "attn_kernel"):
+                    "attn_kernel", "sampled", "steps_saved"):
             assert key in d, key
         assert d["mode"] == "decode_sweep" and d["impl"] == "paged"
         assert d["tok_per_s"] == pytest.approx(240.0)
@@ -477,6 +477,20 @@ class TestBenchDecodeSweepContract:
         # None so pre-streaming parsers keep working
         assert d["ttft_p50"] is None and d["ttft_p99"] is None
         assert d["itl_p50"] is None
+        # no sampled-decode counters in the stats: the sampled columns
+        # default to None so pre-sampling parsers keep working
+        assert d["sampled"] is None and d["steps_saved"] is None
+
+    def test_decode_sweep_row_sampled_columns(self):
+        """The sampled-decode counters ride the decoder stats."""
+        bench = _tool("bench_serve")
+        stats = {"slots": 8, "live_hwm": 6, "paged": True,
+                 "sampled": 5, "stop_retired": 3, "steps_saved": 40,
+                 "pool": {"pages": 24, "page_size": 4, "in_use": 0,
+                          "free": 24, "in_use_hwm": 18}}
+        row = bench.decode_sweep_row("paged+sampled", 8, 120, 0.5,
+                                     stats, 0)
+        assert row["sampled"] == 5 and row["steps_saved"] == 40
 
     def test_decode_sweep_row_stream_columns(self):
         """The streaming SLO columns ride a measurement dict (ms

@@ -23,13 +23,20 @@ at the slab width) against the paged pool (live requests capped only by
 pooled tokens), asserts paged output token-for-token equal to serial
 ``lm_decode``, and finishes with a mixed-length SPECULATIVE stream
 (``--spec-k``) audited for zero cold compiles after warmup through the
-shared executable-cache counter.  Every point STREAMS its tokens
-(``StreamFuture.on_tokens``), so rows carry the client-observed
-``ttft_p50``/``ttft_p99``/``itl_p50`` SLO columns next to throughput.
-One JSON row per point (contract pinned by
+shared executable-cache counter.  Three SAMPLED-decode points follow
+(docs/serving.md "Sampled decode"): a uniformly sampled stream
+(``--temperature/--top-k/--top-p``), a mixed-param rotation whose
+greedy rows must stay byte-identical, and a stop-sequence
+early-retirement point (``--stop-len``) whose stops are cut from each
+request's own greedy oracle so every row retires early.  Every point
+STREAMS its tokens (``StreamFuture.on_tokens``), so rows carry the
+client-observed ``ttft_p50``/``ttft_p99``/``itl_p50`` SLO columns next
+to throughput.  One JSON row per point (contract pinned by
 ``tests/test_paged_decode.py``); ``--check`` enforces the acceptance
 bar: more live requests than the slab bound, parity (streamed chunks
-included), zero cold compiles, and TTFT p50 below the e2e p50 on a
+included), zero cold compiles (sampled and mixed-param streams
+included), sampled throughput >= 0.9x the greedy point, a wall-clock
+win from stop retirement, and TTFT p50 below the e2e p50 on a
 long-generation point.
 
 Traffic (``--traffic``): seeded OPEN-LOOP bursty/diurnal load — Poisson
@@ -379,7 +386,9 @@ def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
     point did not stream, so old parsers keep working).
     ``attn_kernel`` names the Mosaic decode kernel active for the point
     (``--attn-kernel``; None — the default XLA gathered view — keeps
-    old parsers working).  ``tests/test_paged_decode.py`` keeps this
+    old parsers working).  ``sampled``/``steps_saved`` surface the
+    sampled-decode counters (None on points that used neither, so old
+    parsers keep working).  ``tests/test_paged_decode.py`` keeps this
     shape honest."""
     live = dec_stats.get("live_hwm") or dec_stats["slots"]
     pool = dec_stats.get("pool") or {}
@@ -410,6 +419,8 @@ def decode_sweep_row(impl, offered, tokens, wall_s, dec_stats,
             "itl_p50": stream.get("itl_p50"),
             "e2e_p50": stream.get("e2e_p50"),
             "attn_kernel": attn_kernel,
+            "sampled": dec_stats.get("sampled") or None,
+            "steps_saved": dec_stats.get("steps_saved") or None,
             "compiles": compiles}
 
 
@@ -464,7 +475,15 @@ def bench_decode_sweep(args):
                 parts.append("spec")
         return "+".join(parts) or None
 
-    def run_point(impl, offered, **kw):
+    def run_point(impl, offered, sampling=None, parity_mode="exact",
+                  **kw):
+        # ``sampling`` is a per-request list of SamplingParams dicts
+        # (None entries stay greedy); ``parity_mode`` picks the oracle
+        # comparison — "exact" (every row byte-identical), "greedy_rows"
+        # (only the greedy rows of a mixed-param stream), "prefix"
+        # (stop-retired rows are exact PREFIXES of their oracle rows),
+        # or "none" (sampled rows have no greedy oracle — parity=None
+        # keeps the --check fp gate out of their way)
         dec = ContinuousDecoder(model, n_pos=n_pos,
                                 sync_interval=args.decode_sync, **kw)
         c0 = xcache.get().stats()["compiles"]
@@ -480,7 +499,8 @@ def bench_decode_sweep(args):
         futs = []
         for i, s in enumerate(seeds):
             sub_at[i] = time.perf_counter()
-            f = dec.submit(s, n_words)
+            f = dec.submit(s, n_words,
+                           sampling=sampling[i] if sampling else None)
             f.on_tokens(lambda toks, i=i: arrivals[i].append(
                 (time.perf_counter(), len(toks))))
             f.add_done_callback(lambda _f, i=i: done_at.__setitem__(
@@ -513,16 +533,31 @@ def bench_decode_sweep(args):
         stream = {"ttft_p50": pct(ttfts, 50), "ttft_p99": pct(ttfts, 99),
                   "itl_p50": pct(itls, 50), "e2e_p50": pct(e2e, 50)}
         # per-token agreement with the serial fp oracle over the
-        # GENERATED tail: 1.0 on every fp point (exact parity contract);
-        # quantized-KV points may drift within the declared budget
+        # GENERATED tail (truncated to the replayed row's length, so
+        # stop-retired rows compare what they actually generated): 1.0
+        # on every fp greedy point (exact parity contract); sampled
+        # rows and quantized-KV points may diverge within their budget
         agree = float(np.mean([
-            np.mean(np.asarray(r[len(s):]) == np.asarray(o[len(s):]))
+            np.mean(np.asarray(r[len(s):])
+                    == np.asarray(o[len(s):len(r)]))
             for r, o, s in zip(rows, oracle, seeds)]))
-        row = decode_sweep_row(impl, offered, toks, wall, dec.stats(),
+        n_tok = sum(len(r) - len(s) for r, s in zip(rows, seeds))
+        row = decode_sweep_row(impl, offered, n_tok, wall, dec.stats(),
                                xcache.get().stats()["compiles"] - c0,
                                stream=stream,
                                attn_kernel=_active_attn_kernel(kw))
-        row["parity"] = rows == oracle
+        if parity_mode == "exact":
+            row["parity"] = rows == oracle
+        elif parity_mode == "greedy_rows":
+            row["parity"] = all(
+                r == o for r, o, sp in zip(rows, oracle, sampling)
+                if sp is None)
+        elif parity_mode == "prefix":
+            row["parity"] = all(
+                len(r) <= len(o) and list(r) == list(o[:len(r)])
+                for r, o in zip(rows, oracle))
+        else:
+            row["parity"] = None
         row["stream_parity"] = stream_parity
         row["agreement"] = agree
         dec.close()
@@ -541,6 +576,51 @@ def bench_decode_sweep(args):
                          n_pages=pool_pages, prefix_cache=True,
                          spec_k=args.spec_k)
         points.append(spec)
+
+        # the sampled-decode points ride the SAME paged config as
+        # points[1] (offered == slots), so a cold compile here would
+        # mean sampling params leaked into the program shape
+        samp = run_point(
+            "paged+sampled", slab_slots, max_slots=slab_slots,
+            page_size=ps, n_pages=pool_pages, prefix_cache=False,
+            parity_mode="none",
+            sampling=[{"temperature": args.temperature,
+                       "top_k": args.top_k, "top_p": args.top_p,
+                       "seed": 1000 + i} for i in range(len(seeds))])
+        points.append(samp)
+
+        # mixed-param rotation: greedy / temp / temp+top_k / temp+top_p
+        # interleave across one stream — one compiled program serves
+        # all four, and the greedy rows must stay byte-identical
+        def _rot(i):
+            j = i % 4
+            if j == 0:
+                return None
+            p = {"temperature": args.temperature, "seed": 2000 + i}
+            if j == 2:
+                p["top_k"] = args.top_k or 8
+            elif j == 3:
+                p["top_p"] = args.top_p or 0.9
+            return p
+        mixed = run_point(
+            "paged+mixed", slab_slots, max_slots=slab_slots,
+            page_size=ps, n_pages=pool_pages, prefix_cache=False,
+            parity_mode="greedy_rows",
+            sampling=[_rot(i) for i in range(len(seeds))])
+        points.append(mixed)
+
+        # stop-sequence early retirement: each request's stop is cut
+        # from its OWN greedy oracle a quarter of the way in, so every
+        # row retires early and the point's rows/s beats the full run
+        cut = max(1, n_words // 4)
+        stop_pt = run_point(
+            "paged+stop", slab_slots, max_slots=slab_slots,
+            page_size=ps, n_pages=pool_pages, prefix_cache=False,
+            max_stop_len=max(8, args.stop_len), parity_mode="prefix",
+            sampling=[{"stop": [list(o[len(s):])[
+                max(0, cut - args.stop_len):cut]]}
+                for s, o in zip(seeds, oracle)])
+        points.append(stop_pt)
 
         qpoints = []
         qspec = None
@@ -588,7 +668,10 @@ def bench_decode_sweep(args):
                     is not None else "-")
                  if ttft is not None else "")
               + (f", accept mean {pt['accept_mean']:.2f}"
-                 if pt["spec_k"] else ""))
+                 if pt["spec_k"] else "")
+              + (f", sampled {pt['sampled']}" if pt["sampled"] else "")
+              + (f", steps saved {pt['steps_saved']}"
+                 if pt["steps_saved"] else ""))
     scaled = [p for p in points if p["impl"] == "paged"
               and p["offered"] > slab_slots]
     best_live = max(p["live_max"] for p in scaled)
@@ -608,7 +691,8 @@ def bench_decode_sweep(args):
               f"bound), agreement >= "
               f"{min(p['agreement'] for p in qpoints):.3f}")
     if args.check:
-        fp_points = [p for p in points if p["kv_quant"] == "off"]
+        fp_points = [p for p in points if p["kv_quant"] == "off"
+                     and p["parity"] is not None]
         if not all(p["parity"] for p in fp_points):
             raise SystemExit("decode sweep lost token parity")
         if not all(p["stream_parity"] for p in points):
@@ -632,6 +716,29 @@ def bench_decode_sweep(args):
             raise SystemExit(
                 f"speculative stream hit {spec['compiles']} cold "
                 f"compiles after warmup")
+        # sampled decode rides the greedy fast path: same compiled
+        # program (zero cold compiles on sampled AND mixed-param
+        # streams) at no worse than a 10% throughput haircut
+        base = points[1]       # greedy paged @ offered == slots
+        for pt in (samp, mixed):
+            if pt["compiles"]:
+                raise SystemExit(
+                    f"{pt['impl']} stream hit {pt['compiles']} cold "
+                    f"compiles — sampling params leaked into the "
+                    f"program shape")
+        if samp["tok_per_s"] < 0.9 * base["tok_per_s"]:
+            raise SystemExit(
+                f"sampled throughput {samp['tok_per_s']:.1f} tok/s "
+                f"fell below 0.9x the greedy point "
+                f"{base['tok_per_s']:.1f} tok/s")
+        if not stop_pt["steps_saved"]:
+            raise SystemExit("stop point retired no request early")
+        if stop_pt["wall_s"] >= base["wall_s"]:
+            raise SystemExit(
+                f"stop-retirement point took {stop_pt['wall_s']:.2f}s "
+                f"for the same request count the greedy point "
+                f"finished in {base['wall_s']:.2f}s — early "
+                f"retirement saved nothing")
         if qpoints:
             if not fp_saturated:
                 print("  note: density gate not evaluable — the fp "
@@ -1169,6 +1276,19 @@ def main():
                          "_PALLAS_SPEC_VERIFY; interpreter off-TPU) — "
                          "the rows' attn_kernel column records what "
                          "was active")
+    ap.add_argument("--temperature", type=float, default=0.7,
+                    help="sampling temperature for the sweep's "
+                         "sampled/mixed points")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="top-k filter for the sweep's sampled point "
+                         "(0 = off)")
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="nucleus filter for the sweep's sampled "
+                         "point (0 = off)")
+    ap.add_argument("--stop-len", type=int, default=2,
+                    help="stop-sequence length for the sweep's "
+                         "early-retirement point (cut from each "
+                         "request's own greedy oracle)")
     ap.add_argument("--quant", default=None,
                     choices=("off", "int8", "fp8"),
                     help="weight quantization for the scoring/router "

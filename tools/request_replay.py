@@ -10,10 +10,15 @@ FRESH :class:`~bigdl_tpu.serve.decode.ContinuousDecoder` with the
 recorded flags, pins the recorded weight version from a
 :class:`~bigdl_tpu.serve.cluster.WeightStore` when one is supplied,
 re-submits the recorded seed, and diffs the replayed token row against
-the committed one.  Greedy decode is deterministic, so the replay must
-be token-identical — a non-empty diff means the weights rolled
-(reported as ``version_mismatch``), the flags lied, or the decode
-stack has a real reproducibility bug.
+the committed one.  Greedy decode is deterministic, and SAMPLED decode
+is too — the recorded ``sampling`` params carry the request's resolved
+PRNG seed, and the served draw keys are a pure function of (request
+seed, generated index) — so the replay must be token-identical either
+way.  A non-empty diff means the weights rolled (reported as
+``version_mismatch``), the flags lied, or the decode stack has a real
+reproducibility bug; a sampled record whose params LACK a resolved
+seed is reported as ``param_mismatch`` (like ``version_mismatch``, the
+replay proceeds and the diff shows the fresh draws).
 
 Usage (CLI reads ``forensic`` events out of a run dir, or any JSONL of
 records; the smoke drill and tests drive the Python API directly):
@@ -41,7 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 #: provenance, not construction input)
 FLAG_KEYS = ("max_slots", "n_pos", "sync_interval", "paged",
              "page_size", "n_pages", "prefix_cache", "spec_k",
-             "draft_layers", "kv_quant")
+             "draft_layers", "kv_quant", "max_stop_seqs",
+             "max_stop_len")
 
 
 def _first_divergence(a, b):
@@ -69,10 +75,12 @@ def replay_request(record: dict, model, store=None) -> dict:
     Returns a report dict::
 
         {trace_id, match, diverge_at, replayed, recorded,
-         weights_version, version_mismatch, seed_hash_ok}
+         weights_version, version_mismatch, sampling, param_mismatch,
+         seed_hash_ok}
     """
     from bigdl_tpu.obs import recorder as obs_recorder
     from bigdl_tpu.serve.decode import ContinuousDecoder
+    from bigdl_tpu.serve.sampling import SamplingParams
 
     tokens = record.get("tokens")
     seed_len = record.get("seed_len")
@@ -94,10 +102,21 @@ def replay_request(record: dict, model, store=None) -> dict:
         except KeyError as e:
             version_mismatch = str(e)
 
+    sampling = record.get("sampling")
+    param_mismatch = None
+    if sampling:
+        sp = SamplingParams.of(sampling)
+        if not sp.greedy and sp.seed is None:
+            # a sampled record without its resolved PRNG seed cannot
+            # redraw the recorded stream — report it like a weight
+            # roll and let the diff show the fresh draws
+            param_mismatch = ("sampled record carries no resolved "
+                              "seed; replay draws a fresh stream")
+
     kwargs = {k: flags[k] for k in FLAG_KEYS
               if flags.get(k) is not None}
     dec = ContinuousDecoder(model, **kwargs)
-    fut = dec.submit(seed, n_words)
+    fut = dec.submit(seed, n_words, sampling=sampling)
     dec.run()
     replayed = [int(t) for t in fut.result()]
 
@@ -112,6 +131,8 @@ def replay_request(record: dict, model, store=None) -> dict:
         "recorded": recorded,
         "weights_version": version,
         "version_mismatch": version_mismatch,
+        "sampling": sampling,
+        "param_mismatch": param_mismatch,
         "seed_hash_ok": (want_hash is None
                          or obs_recorder.seed_hash(seed) == want_hash),
     }
@@ -179,6 +200,9 @@ def main(argv=None) -> int:
             print(f"{tid}  DIVERGED at token {rep['diverge_at']}  "
                   f"(recorded {rep['recorded'][rep['diverge_at']:][:4]}... "
                   f"replayed {rep['replayed'][rep['diverge_at']:][:4]}...)")
+        if rep["param_mismatch"]:
+            print(f"{tid}  WARNING: param mismatch — "
+                  f"{rep['param_mismatch']}")
         if not rep["seed_hash_ok"]:
             print(f"{tid}  WARNING: seed hash mismatch — the record's "
                   "token row does not match its own seed hash")

@@ -284,10 +284,10 @@ def fleet_line(cur: dict, prev: dict | None, dt: float) -> str | None:
 def decode_line(cur: dict, prev: dict | None, dt: float) -> str | None:
     """One trailing line of continuous-decode telemetry when a paged
     decoder is exporting: KV page-pool occupancy (current gauges),
-    prefix-cache hit-rate and speculative acceptance p50 — the latter
-    two WINDOWED like the engine rates (lifetime fallback when the
-    window saw no admissions/windows).  None when no decoder series are
-    present."""
+    prefix-cache hit-rate, speculative acceptance p50 — the latter two
+    WINDOWED like the engine rates (lifetime fallback when the window
+    saw no admissions/windows) — and the lifetime sampled fraction of
+    admitted requests.  None when no decoder series are present."""
     if "decode_pages_total" not in cur:
         return None
     total = metrics.family_total(cur, "decode_pages_total")
@@ -301,11 +301,16 @@ def decode_line(cur: dict, prev: dict | None, dt: float) -> str | None:
     hit_rate = h / (h + m) if (h + m) else None
     accept = _window_quantiles(cur, prev,
                                "decode_spec_accept_len").get("p50")
+    adm = metrics.family_total(cur, "decode_admitted_total")
+    samp = metrics.family_total(cur, "decode_sampled_total")
+    frac = samp / adm if adm else None
     return (f"decode: pages {int(in_use)}/{int(total)} ({occ:.0%})   "
             f"prefix hit "
             + (f"{hit_rate:.0%}" if hit_rate is not None else "-")
             + "   spec accept p50 "
-            + (f"{accept:.1f}" if accept is not None else "-"))
+            + (f"{accept:.1f}" if accept is not None else "-")
+            + "   sampled "
+            + (f"{frac:.0%}" if frac is not None else "-"))
 
 
 def stream_line(cur: dict, prev: dict | None, dt: float) -> str | None:
